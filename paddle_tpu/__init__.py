@@ -1,0 +1,98 @@
+"""paddle_tpu — a TPU-native deep learning framework with the capability
+surface of PaddlePaddle (reference: ZibinGuo/Paddle @ 2024-10).
+
+Architecture (vs the reference's layer map, SURVEY.md §1):
+  - kernels + compiler + collectives: jax/XLA (replaces phi kernels, CINN,
+    NCCL process groups) with Pallas kernels for the hot set (paddle_tpu.ops)
+  - eager dygraph: Tensor-on-jax.Array + vjp tape (replaces fluid/eager)
+  - compiled path: whole-step jax.jit (replaces new_executor + PIR)
+  - distributed: jax.sharding Mesh + GSPMD (replaces Fleet NCCL engine),
+    same user API (paddle_tpu.distributed.fleet / auto_parallel)
+"""
+from __future__ import annotations
+
+import os
+
+# int64/float64 available like the reference; float defaults remain float32
+# (creation ops set dtypes explicitly; python-float literals stay weakly typed
+# so bf16/f32 compute is not silently promoted).
+import jax as _jax
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from .framework import (  # noqa: E402
+    dtype, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, bool_,
+    Tensor, to_tensor,
+    no_grad, enable_grad, is_grad_enabled, set_grad_enabled,
+    CPUPlace, TPUPlace, CUDAPlace, XPUPlace, Place,
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_rocm,
+    is_compiled_with_xpu, is_compiled_with_cinn, is_compiled_with_distribute,
+    device_count,
+    seed, get_rng_state, set_rng_state,
+    set_flags, get_flags,
+    iinfo, finfo,
+)
+from .framework.tensor import Parameter  # noqa: E402
+
+from .tensor import *  # noqa: F401,F403,E402
+from .tensor import creation as _creation  # noqa: E402
+
+from . import framework  # noqa: E402
+from . import autograd  # noqa: E402
+from .autograd import grad  # noqa: E402
+from . import tensor  # noqa: E402
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import amp  # noqa: E402
+from . import io  # noqa: E402
+from . import metric  # noqa: E402
+from . import device  # noqa: E402
+from . import jit  # noqa: E402
+from . import static  # noqa: E402
+from . import vision  # noqa: E402
+from . import distributed  # noqa: E402
+from . import incubate  # noqa: E402
+from . import profiler  # noqa: E402
+from . import utils  # noqa: E402
+from . import ops  # noqa: E402
+from . import sparse  # noqa: E402
+from . import models  # noqa: E402
+from . import parallel  # noqa: E402
+from . import linalg  # noqa: E402
+from . import regularizer  # noqa: E402
+from .framework.param_attr import ParamAttr  # noqa: E402
+
+from .hapi.model import Model  # noqa: E402
+from .hapi.model_summary import summary  # noqa: E402
+from .framework.io import save, load  # noqa: E402
+
+# paddle.disable_static/enable_static parity: dygraph is the only eager mode;
+# enable_static switches the `paddle.static` Program-capture facade on.
+from .static.state import (enable_static, disable_static,  # noqa: E402
+                           in_dynamic_mode, in_static_mode)
+
+# commonly used aliases at top level (reference exports these)
+randn = tensor.randn
+rand = tensor.rand
+randint = tensor.randint
+
+DataParallel = distributed.DataParallel
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def set_default_dtype(d):
+    from .framework import dtypes as _dt
+    global _default_dtype
+    _default_dtype = _dt.convert_np_dtype_to_dtype_(d)
+
+
+def get_default_dtype():
+    return getattr(__import__("paddle_tpu"), "_default_dtype", float32).name
+
+
+_default_dtype = float32

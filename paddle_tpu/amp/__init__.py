@@ -1,0 +1,250 @@
+"""Automatic mixed precision.
+
+Reference: `python/paddle/amp/` — `auto_cast` (auto_cast.py:1029, O1
+white/black lists in amp_lists.py, O2 pure fp16/bf16 with master weights),
+`GradScaler` (grad_scaler.py:657).
+
+TPU-native: bf16 is the native AMP dtype; there are no inf/nan scaling
+concerns (bf16 has fp32's exponent range), so GradScaler is a functional
+no-op that keeps the reference API (scale()/step()/update()/unscale_()).
+O1 works by wrapping op dispatch: ops in the white list cast inputs to the
+amp dtype; black-list ops compute in fp32.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework import dtypes
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler",
+           "is_bfloat16_supported", "is_float16_supported",
+           "white_list", "black_list"]
+
+# reference: amp_lists.py (O1 lists) — matmul-ish ops benefit from low
+# precision; reductions/norms/softmax/exp stay fp32
+WHITE_LIST = {"matmul", "linear", "conv", "conv_transpose", "einsum", "bmm",
+              "mm", "attention", "sdpa"}
+BLACK_LIST = {"exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+              "log_softmax", "cross_entropy", "layer_norm", "norm",
+              "batch_norm", "group_norm", "instance_norm", "rms_norm",
+              "reduce", "cumsum", "pow", "erf", "logsumexp"}
+
+
+def white_list():
+    return {"float16": {"O1": set(WHITE_LIST)},
+            "bfloat16": {"O1": set(WHITE_LIST)}}
+
+
+def black_list():
+    return {"float16": {"O1": set(BLACK_LIST)},
+            "bfloat16": {"O1": set(BLACK_LIST)}}
+
+
+class _AmpState:
+    enabled = False
+    dtype = "bfloat16"
+    level = "O1"
+    custom_white = set()
+    custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+def _amp_cast_inputs(name, vals):
+    """Called by dispatch.run when AMP O1 is active."""
+    if not _state.enabled or _state.level != "O1":
+        return vals
+    base = name.split("_")[0] if name else ""
+    wl = WHITE_LIST | _state.custom_white
+    bl = (BLACK_LIST | _state.custom_black) - _state.custom_white
+    jd = dtypes.to_jax(_state.dtype)
+
+    def _castable(v):
+        return hasattr(v, "dtype") and v.dtype in (jnp.float32, jnp.float16,
+                                                   jnp.bfloat16)
+    if name in wl or base in wl:
+        return [v.astype(jd) if _castable(v) else v for v in vals]
+    if name in bl or base in bl:
+        return [v.astype(jnp.float32)
+                if (hasattr(v, "dtype") and v.dtype in (jnp.float16,
+                                                        jnp.bfloat16))
+                else v for v in vals]
+    return vals
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """Reference: amp/auto_cast.py:1029."""
+    prev = (_state.enabled, _state.dtype, _state.level,
+            _state.custom_white, _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = dtype
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2: cast model params to amp dtype, keep master weights in the
+    optimizer (reference: auto_cast.py decorate/amp_decorate)."""
+    from ..nn import Layer
+    from ..nn.layer.norm import _BatchNormBase, LayerNorm
+
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        excluded = (excluded_layers if excluded_layers is not None
+                    else [_BatchNormBase, LayerNorm])
+        excl_types = tuple(excluded) if excluded else ()
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                if excl_types and isinstance(layer, excl_types):
+                    continue
+                for pname, p in layer._parameters.items():
+                    if p is not None and p.dtype.is_floating_point():
+                        p._value = p._value.astype(dtypes.to_jax(dtype))
+    if optimizers is None:
+        return models if single_model else model_list
+    single_opt = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if single_opt else list(optimizers)
+    for o in opt_list:
+        o._multi_precision = True
+    return (models if single_model else model_list,
+            optimizers if single_opt else opt_list)
+
+
+class GradScaler:
+    """Reference: amp/grad_scaler.py:657.  On TPU bf16 needs no loss
+    scaling; the API is preserved (scale is identity by default) so fp16
+    scripts run unchanged.  use_loss_scaling still works for fp16."""
+
+    def __init__(self, enable=True, init_loss_scaling=65536.0,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=False):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if use_dynamic_loss_scaling \
+            else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable or self._scale == 1.0:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._scale == 1.0:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad.value.astype(jnp.float32) * inv
+                found = bool(found or not jnp.all(jnp.isfinite(g)))
+                p.grad._value = g.astype(p.grad.value.dtype)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if self._scale != 1.0:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+        self.update()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+
+# register the O1 cast hook into op dispatch
+from ..framework.dispatch import set_amp_hook as _set_amp_hook
+_set_amp_hook(_amp_cast_inputs)
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+class debugging:
+    """Namespace shim for paddle.amp.debugging (tensor checks)."""
+
+    @staticmethod
+    def check_numerics(tensor, op_type="", var_name=""):
+        import jax.numpy as _jnp
+        v = tensor.value if isinstance(tensor, Tensor) else tensor
+        has_inf = bool(_jnp.any(_jnp.isinf(v)))
+        has_nan = bool(_jnp.any(_jnp.isnan(v)))
+        if has_inf or has_nan:
+            raise FloatingPointError(
+                f"check_numerics failed for {op_type}/{var_name}: "
+                f"inf={has_inf} nan={has_nan}")
+        return tensor

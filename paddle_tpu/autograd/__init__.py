@@ -1,0 +1,126 @@
+"""paddle_tpu.autograd — user-facing autograd API.
+
+Reference: `python/paddle/autograd/` (backward, PyLayer, hooks) over the C++
+eager engine `paddle/fluid/eager/backward.cc`.  Here the engine is the vjp
+tape in framework/tape.py.
+"""
+from __future__ import annotations
+
+from ..framework.tape import (no_grad, enable_grad, is_grad_enabled,
+                              set_grad_enabled, run_backward, calc_gradients)
+from ..framework.tensor import Tensor
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    run_backward(tensors, grad_tensors, retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None):
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order eager grad) is not supported "
+            "yet; use paddle_tpu.jit.grad on a functional form instead.")
+    return calc_gradients(outputs, inputs, grad_outputs,
+                          retain_graph=bool(retain_graph),
+                          allow_unused=allow_unused)
+
+
+class PyLayerContext:
+    """Reference: python/paddle/autograd/py_layer.py PyLayerContext."""
+
+    def __init__(self):
+        self._saved = ()
+        self.__dict__["not_inplace_tensors"] = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayer:
+    """User-defined differentiable op (reference: paddle.autograd.PyLayer).
+
+    Subclass with static `forward(ctx, ...)` and `backward(ctx, *grads)`.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..framework.tape import Node, is_grad_enabled
+        from ..framework import dispatch
+        import jax.numpy as jnp
+
+        ctx = PyLayerContext()
+        with __import__("paddle_tpu").no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = (outs,) if single else tuple(outs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        record = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if record:
+            new_outs = []
+            out_refs, out_avals = [], []
+            for o in outs_t:
+                t = Tensor(o.value, stop_gradient=False)
+                new_outs.append(t)
+                out_refs.append(t._ref)
+                out_avals.append((o.value.shape, o.value.dtype))
+
+            def vjp_fn(cts):
+                if not isinstance(cts, (tuple, list)):
+                    cts = (cts,)
+                grads = cls.backward(ctx, *[Tensor(c) for c in cts])
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                out = []
+                gi = iter(grads)
+                for a in args:
+                    if isinstance(a, Tensor):
+                        g = next(gi, None)
+                        out.append(None if g is None else
+                                   (g.value if isinstance(g, Tensor) else g))
+                return tuple(out)
+
+            in_refs = [t._ref if (not t.stop_gradient or
+                                  t._ref.node is not None) else None
+                       for t in tensor_inputs]
+            node = Node(vjp_fn, in_refs, out_refs, out_avals,
+                        name=cls.__name__)
+            for i, r in enumerate(out_refs):
+                r.node = node
+                r.index = i
+            outs_t = new_outs
+        return outs_t[0] if single else tuple(outs_t)
+
+
+class saved_tensors_hooks:
+    """no-op parity shim (reference uses it to offload saved tensors)."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

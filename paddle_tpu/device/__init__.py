@@ -1,0 +1,113 @@
+"""paddle_tpu.device — reference: python/paddle/device/.
+
+Stream/event APIs are no-op shims: XLA owns scheduling on TPU (there is no
+user-visible stream model; the reference's stream-safe allocator and event
+machinery have no TPU analog).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..framework.device import (Place, CPUPlace, TPUPlace, CUDAPlace,
+                                XPUPlace, set_device, get_device,
+                                get_all_devices, is_compiled_with_cuda,
+                                is_compiled_with_rocm, is_compiled_with_xpu,
+                                device_count, cuda_device_count)
+
+__all__ = ["set_device", "get_device", "get_all_devices",
+           "get_available_device", "get_available_custom_device",
+           "is_compiled_with_cuda", "is_compiled_with_rocm",
+           "is_compiled_with_xpu", "Stream", "Event", "synchronize",
+           "stream_guard", "current_stream", "device_count", "cuda"]
+
+
+def get_available_device():
+    return get_all_devices()
+
+
+def get_available_custom_device():
+    return []
+
+
+def synchronize(device=None):
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+class Stream:
+    """No-op stream shim (XLA owns ordering on TPU)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+@contextlib.contextmanager
+def stream_guard(stream):
+    yield
+
+
+class cuda:
+    """paddle.device.cuda shim (maps to the accelerator)."""
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def current_stream(device=None):
+        return _current_stream
+
+    @staticmethod
+    def stream_guard(stream):
+        return stream_guard(stream)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return 0

@@ -1,0 +1,36 @@
+"""paddle_tpu.distributed — reference: python/paddle/distributed/ (148K LoC).
+
+Layer map (SURVEY §2.3) → TPU-native:
+  ProcessGroup/NCCL        → XLA collectives compiled into programs
+  TCPStore rendezvous      → jax.distributed coordination service
+  HybridCommunicateGroup   → jax.sharding.Mesh (topology.py)
+  fleet hybrid engine      → NamedSharding policies + jit TrainStep
+  DistTensor semi-auto     → NamedSharding + GSPMD (auto_parallel/)
+  reshard function library → jax.device_put between NamedShardings
+"""
+from .env import (init_parallel_env, get_rank, get_world_size,  # noqa: F401
+                  is_initialized, ParallelEnv)
+from .parallel import DataParallel  # noqa: F401
+from .collective import (ReduceOp, all_reduce, all_gather, reduce,  # noqa: F401
+                         reduce_scatter, broadcast, scatter, alltoall,
+                         all_to_all, send, recv, barrier, new_group, wait,
+                         stream)
+from .topology import (HybridCommunicateGroup, CommunicateTopology,  # noqa: F401
+                       build_mesh, get_hybrid_communicate_group)
+from .auto_parallel import (ProcessMesh, Shard, Replicate, Partial,  # noqa: F401
+                            shard_tensor, reshard, shard_layer, get_mesh,
+                            set_mesh, dtensor_from_fn)
+from . import fleet  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from . import checkpoint  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference: distributed/spawn.py — multiprocess launch.  On TPU a
+    single process drives all local chips (SPMD), so spawn degenerates to
+    calling func once; multi-host uses paddle_tpu.distributed.launch."""
+    func(*args)
+
+
+def get_backend():
+    return "xla"

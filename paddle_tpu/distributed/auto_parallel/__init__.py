@@ -1,0 +1,231 @@
+"""Semi-auto parallel (DistTensor) API.
+
+Reference: `python/paddle/distributed/auto_parallel/api.py` — shard_tensor
+(:205), reshard (:727), shard_layer (:828), shard_optimizer (:1613),
+ProcessMesh, placements Shard/Replicate/Partial; C++ DistTensor + per-op
+SPMD rules + reshard function library (SURVEY §2.1).
+
+TPU-native redesign: DistTensor == jax.Array with a NamedSharding; per-op
+SPMD propagation == XLA GSPMD; the whole reshard function library (r_to_s,
+s_to_r, p_to_r, ... registry) == ONE primitive: `jax.device_put` to the
+target NamedSharding — XLA emits the optimal collective for every (src,dst)
+placement pair, including the cross-mesh cases the reference enumerates by
+hand.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...framework.tensor import Tensor, Parameter
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "Placement",
+           "shard_tensor", "reshard", "shard_layer", "dtensor_from_fn",
+           "get_mesh", "set_mesh", "to_placements", "placements_to_spec"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, o):
+        return isinstance(o, Partial) and o.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """Reference: auto_parallel/process_mesh.py.  Thin front over
+    jax.sharding.Mesh."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.asarray(process_ids).reshape(shape)
+        self._shape = list(arr.shape)
+        self._ids = arr
+        self._dim_names = list(dim_names) if dim_names else [
+            f"d{i}" for i in range(arr.ndim)]
+        devices = jax.devices()
+        flat = [devices[i % len(devices)] for i in arr.reshape(-1)]
+        self.jax_mesh = Mesh(np.asarray(flat).reshape(arr.shape),
+                             tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._dim_names == other._dim_names)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dims={self._dim_names})"
+
+
+_global_mesh: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh():
+    return _global_mesh
+
+
+def placements_to_spec(mesh: ProcessMesh, placements, ndim: int
+                       ) -> PartitionSpec:
+    """Map paddle placements (ordered by mesh dim) to a PartitionSpec
+    (ordered by tensor dim)."""
+    entries: List = [None] * ndim
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            d = p.dim
+            axis_name = mesh.dim_names[mesh_dim]
+            if entries[d] is None:
+                entries[d] = axis_name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (axis_name,)
+            else:
+                entries[d] = (entries[d], axis_name)
+    return PartitionSpec(*entries)
+
+
+def to_placements(spec: PartitionSpec, mesh: ProcessMesh, ndim: int):
+    placements = [Replicate() for _ in mesh.dim_names]
+    for tdim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for n in names:
+            placements[mesh.dim_names.index(n)] = Shard(tdim)
+    return placements
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 place=None, stop_gradient=None):
+    """Reference: api.py:205.  Returns the same Tensor type whose jax.Array
+    carries a NamedSharding — every downstream op propagates it via GSPMD."""
+    t = data if isinstance(data, Tensor) else Tensor(data)
+    spec = placements_to_spec(mesh, placements, t.ndim)
+    sharding = NamedSharding(mesh.jax_mesh, spec)
+    val = jax.device_put(t.value, sharding)
+    if isinstance(t, Parameter):
+        out = Parameter(val, trainable=not t.stop_gradient, name=t.name)
+    else:
+        out = Tensor(val, stop_gradient=t.stop_gradient
+                     if stop_gradient is None else stop_gradient,
+                     name=t.name)
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def reshard(dist_tensor, mesh: ProcessMesh, placements):
+    """Reference: api.py:727 + the C++ reshard function registry.  One
+    device_put covers the full (src,dst) matrix; XLA picks the collective
+    (all_gather for s→r, dynamic-slice for r→s, psum for p→r, all_to_all
+    for s→s axis moves, send/recv cross-mesh)."""
+    return shard_tensor(dist_tensor, mesh, placements)
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """Reference: api.py:828 — apply shard_fn(name, layer, mesh) to every
+    sublayer's params; default replicates."""
+    def _default_shard(name, sublayer, mesh):
+        for pname, p in list(sublayer._parameters.items()):
+            if p is None:
+                continue
+            placements = [Replicate() for _ in mesh.dim_names]
+            sublayer._parameters[pname] = shard_tensor(p, mesh, placements)
+
+    fn = shard_fn or _default_shard
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer

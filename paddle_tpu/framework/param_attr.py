@@ -1,0 +1,35 @@
+"""ParamAttr — parameter configuration.
+
+Reference: `python/paddle/base/param_attr.py` (ParamAttr, WeightNormParamAttr).
+"""
+from __future__ import annotations
+
+__all__ = ["ParamAttr"]
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        """Normalize: None → default attr, str → named, Initializer → attr
+        with that initializer, False handled by caller."""
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        # duck-type initializer
+        if callable(attr):
+            return ParamAttr(initializer=attr)
+        raise TypeError(f"bad param attr {attr!r}")

@@ -1,0 +1,91 @@
+"""RNG state management.
+
+Reference: `paddle/phi/core/generator.h` (per-device Generator with
+seed+offset) and `paddle.seed` (python/paddle/framework/random.py).
+
+TPU-native: jax's counter-based PRNG (threefry) replaces the Philox
+offset bookkeeping.  A global Generator holds (seed, counter); every random
+op folds the counter into the key, which is deterministic, replayable and —
+unlike stateful Philox offsets — safe under SPMD since the key is data, not
+device state.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["Generator", "default_generator", "seed", "get_rng_state",
+           "set_rng_state", "next_key"]
+
+
+class Generator:
+    def __init__(self, seed_: int = 0):
+        self._seed = int(seed_)
+        self._counter = 0
+
+    def manual_seed(self, seed_: int):
+        self._seed = int(seed_)
+        self._counter = 0
+        return self
+
+    def seed(self):
+        return self._seed
+
+    def initial_seed(self):
+        return self._seed
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed, self._counter = int(state[0]), int(state[1])
+
+    def next_key(self):
+        k = jax.random.key(self._seed)
+        k = jax.random.fold_in(k, self._counter)
+        self._counter += 1
+        return k
+
+
+default_generator = Generator(0)
+
+# functional-mode key stack: compiled code paths push an explicit key so that
+# randomness inside jit is traced data, not a baked-in constant.
+_key_stack = []
+
+
+class key_scope:
+    """Context manager making `next_key()` derive from an explicit jax key."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        _key_stack.append([self._key, 0])
+        return self
+
+    def __exit__(self, *exc):
+        _key_stack.pop()
+        return False
+
+
+def next_key():
+    if _key_stack:
+        entry = _key_stack[-1]
+        k = jax.random.fold_in(entry[0], entry[1])
+        entry[1] += 1
+        return k
+    return default_generator.next_key()
+
+
+def seed(s: int):
+    """paddle.seed"""
+    default_generator.manual_seed(s)
+    return default_generator
+
+
+def get_rng_state():
+    return [default_generator.get_state()]
+
+
+def set_rng_state(state):
+    default_generator.set_state(state[0])

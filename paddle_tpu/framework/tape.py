@@ -1,0 +1,276 @@
+"""Eager (dygraph) autograd tape.
+
+Reference design: `paddle/fluid/eager/` — GradNodeBase / AutogradMeta /
+GradTensorHolder with a ready-queue in `backward.cc:105 RunBackward`.
+
+TPU-native redesign: instead of per-op C++ GradNodes generated from YAML, each
+eager op call captures a `jax.vjp` closure (forward runs once, residuals live
+as jax.Arrays on device).  The graph is a DAG of `VarRef`s (one per produced
+tensor *version*, so in-place ops get fresh refs, replacing the reference's
+inplace_version counter) and `Node`s (one per recorded op).  Backward is a
+reverse-topological walk calling each node's vjp — everything stays on device;
+only the graph bookkeeping is host-side Python, mirroring how the reference
+keeps only scheduling on host.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+import jax
+
+__all__ = ["VarRef", "Node", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "run_backward", "calc_gradients"]
+
+
+class VarRef:
+    """Identity of one produced tensor version in the autograd graph."""
+
+    __slots__ = ("node", "index", "tensor_wref", "__weakref__")
+
+    def __init__(self, node: Optional["Node"] = None, index: int = 0):
+        self.node = node          # producing Node, None for leaves
+        self.index = index        # output slot in the producing node
+        self.tensor_wref = None   # weakref to owning Tensor (set by Tensor)
+
+    @property
+    def tensor(self):
+        return self.tensor_wref() if self.tensor_wref is not None else None
+
+
+class Node:
+    """One recorded differentiable op (reference: GradNodeBase subclasses)."""
+
+    __slots__ = ("vjp_fn", "in_refs", "out_refs", "out_avals", "name", "hooks")
+
+    def __init__(self, vjp_fn: Callable, in_refs: Sequence[Optional[VarRef]],
+                 out_refs: Sequence[VarRef], out_avals, name: str = ""):
+        self.vjp_fn = vjp_fn
+        self.in_refs = list(in_refs)      # None for non-differentiable inputs
+        self.out_refs = list(out_refs)
+        self.out_avals = list(out_avals)  # (shape, dtype) per output
+        self.name = name
+        self.hooks = []                   # grad hooks on outputs
+
+
+_grad_enabled = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(flag: bool):
+    global _grad_enabled
+    _grad_enabled = bool(flag)
+
+
+class no_grad:
+    """Context manager + decorator disabling tape recording.
+
+    Reference: `paddle.no_grad` (python/paddle/base/dygraph/base.py).
+    """
+
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = True
+        return self
+
+
+def _toposort(seed_nodes: Sequence[Node]) -> List[Node]:
+    """Reverse-topological order of the subgraph reachable from seeds."""
+    order: List[Node] = []
+    state = {}  # node -> 0 visiting / 1 done
+    stack = [(n, False) for n in seed_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            state[id(node)] = 1
+            order.append(node)
+            continue
+        if id(node) in state:
+            continue
+        state[id(node)] = 0
+        stack.append((node, True))
+        for ref in node.in_refs:
+            if ref is not None and ref.node is not None and id(ref.node) not in state:
+                stack.append((ref.node, False))
+    order.reverse()  # producers last → iterate forward == reverse topo from seeds
+    return order
+
+
+def _zeros_like_aval(aval):
+    import jax.numpy as jnp
+    shape, dtype = aval
+    return jnp.zeros(shape, dtype)
+
+
+def _accumulate(store: dict, ref: VarRef, val):
+    if val is None:
+        return
+    # jax uses float0 tangents for integer primals — drop them.
+    if hasattr(val, "dtype") and val.dtype == jax.dtypes.float0:
+        return
+    prev = store.get(id(ref))
+    store[id(ref)] = val if prev is None else prev + val
+
+
+def _run_graph(seed_refs, seed_grads, retain_graph=False):
+    """Core backward executor. Returns {id(ref): cotangent} for all refs."""
+    cotangents: dict = {}
+    keep = {}  # id(ref) -> ref, keep refs alive during walk
+    seed_nodes = []
+    for ref, g in zip(seed_refs, seed_grads):
+        _accumulate(cotangents, ref, g)
+        keep[id(ref)] = ref
+        if ref.node is not None:
+            seed_nodes.append(ref.node)
+
+    for node in _toposort(seed_nodes):
+        outs_ct = []
+        any_ct = False
+        for ref, aval in zip(node.out_refs, node.out_avals):
+            ct = cotangents.get(id(ref))
+            if ct is None:
+                ct = _zeros_like_aval(aval)
+            else:
+                any_ct = True
+            outs_ct.append(ct)
+        if not any_ct:
+            continue
+        for hook in node.hooks:
+            outs_ct = hook(outs_ct)
+        ct_arg = tuple(outs_ct) if len(outs_ct) > 1 else outs_ct[0]
+        in_cts = node.vjp_fn(ct_arg)
+        if not isinstance(in_cts, (tuple, list)):
+            in_cts = (in_cts,)
+        for ref, ct in zip(node.in_refs, in_cts):
+            if ref is None:
+                continue
+            t = ref.tensor
+            # per-tensor registered hooks apply to its gradient flow
+            if t is not None and t._grad_hooks:
+                for h in t._grad_hooks:
+                    from .tensor import Tensor
+                    res = h(Tensor(ct))
+                    if res is not None:
+                        ct = res.value if isinstance(res, Tensor) else res
+            _accumulate(cotangents, ref, ct)
+            keep[id(ref)] = ref
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals eagerly
+    return cotangents, keep
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False):
+    """`tensor.backward()` / `paddle.autograd.backward` entry.
+
+    Accumulates into `.grad` of reachable leaf tensors with
+    stop_gradient=False (reference: GradNodeAccumulation).
+    """
+    from .tensor import Tensor
+    import jax.numpy as jnp
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    if not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    seed_refs, seed_grads = [], []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._ref.node is None:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            g = jnp.ones(t.value.shape, t.value.dtype)
+        else:
+            g = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        seed_refs.append(t._ref)
+        seed_grads.append(g)
+
+    cotangents, keep = _run_graph(seed_refs, seed_grads, retain_graph)
+
+    for rid, ref in keep.items():
+        t = ref.tensor
+        if t is None:
+            continue
+        is_leaf = ref.node is None
+        if (is_leaf and not t.stop_gradient) or t._retain_grads:
+            ct = cotangents.get(rid)
+            if ct is None:
+                continue
+            if ct.dtype != t.value.dtype:
+                ct = ct.astype(t.value.dtype)
+            if t._grad is None:
+                t._grad = Tensor(ct, stop_gradient=True)
+            else:
+                t._grad = Tensor(t._grad.value + ct, stop_gradient=True)
+
+
+def calc_gradients(outputs, inputs, grad_outputs=None, retain_graph=False,
+                   allow_unused=False):
+    """`paddle.grad` — returns grads w.r.t. inputs without touching .grad."""
+    from .tensor import Tensor
+    import jax.numpy as jnp
+
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    if not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    seed_refs, seed_grads = [], []
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            g = jnp.ones(t.value.shape, t.value.dtype)
+        else:
+            g = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        seed_refs.append(t._ref)
+        seed_grads.append(g)
+
+    cotangents, _ = _run_graph(seed_refs, seed_grads, retain_graph)
+
+    results = []
+    for t in inputs:
+        ct = cotangents.get(id(t._ref))
+        if ct is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused in the "
+                    "graph; set allow_unused=True to return None for it.")
+            results.append(None)
+        else:
+            results.append(Tensor(ct, stop_gradient=True))
+    return results

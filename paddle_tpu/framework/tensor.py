@@ -1,0 +1,461 @@
+"""Eager Tensor for paddle_tpu.
+
+Reference: `paddle/phi/core/dense_tensor.h:37` (C++ DenseTensor) + the eager
+Tensor bound in `paddle/fluid/pybind/eager_method.cc`.
+
+TPU-native redesign: the device buffer IS a `jax.Array` (XLA-managed HBM —
+the reference's allocator stack `phi/core/memory/` is subsumed by XLA/PJRT).
+`Tensor` is a thin host-side wrapper adding paddle dygraph semantics:
+`stop_gradient`, `.grad` accumulation, in-place versioning, hooks.  It is
+registered as a jax pytree node so the same objects flow through `jax.jit`,
+`jax.grad`, `shard_map` untouched — eager and compiled paths share one type.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtypes
+from .tape import VarRef
+import weakref
+
+__all__ = ["Tensor", "Parameter", "to_tensor"]
+
+
+def _ops():
+    import paddle_tpu.tensor as T
+    return T
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "_grad", "_ref", "name",
+                 "persistable", "_retain_grads", "_grad_hooks", "__weakref__",
+                 "__dict__")
+
+    # let binary numpy/jax ops defer to our reflected dunders
+    __array_priority__ = 100
+
+    def __init__(self, value, stop_gradient=True, name=None):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self.name = name
+        self.persistable = False
+        self._retain_grads = False
+        self._grad_hooks = []
+        r = VarRef()
+        r.tensor_wref = weakref.ref(self)
+        self._ref = r
+
+    # -- autograd plumbing -------------------------------------------------
+    def _set_ref(self, ref: VarRef):
+        ref.tensor_wref = weakref.ref(self)
+        self._ref = ref
+
+    @property
+    def value(self):
+        return self._value
+
+    def __jax_array__(self):
+        return self._value
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return dtypes.convert_np_dtype_to_dtype_(self._value.dtype)
+
+    @property
+    def place(self):
+        from .device import _place_of
+        return _place_of(self._value)
+
+    def numel(self):
+        return Tensor(jnp.asarray(self.size, jnp.int64
+                                  if False else jnp.int32))
+
+    def dim(self):
+        return self.ndim
+
+    @property
+    def is_leaf(self):
+        return self._ref.node is None
+
+    # -- grad --------------------------------------------------------------
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        if g is not None and not isinstance(g, Tensor):
+            g = Tensor(g)
+        self._grad = g
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad.value))
+        else:
+            self._grad = None
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook):
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def remove(handle_self):
+                try:
+                    self._grad_hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Handle()
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .tape import run_backward
+        run_backward(self, grad_tensor, retain_graph=retain_graph)
+
+    # -- host interop ------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args):
+        return self._value.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __float__(self):
+        return float(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    # -- copies ------------------------------------------------------------
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self.stop_gradient = True
+        r = VarRef()
+        r.tensor_wref = weakref.ref(self)
+        self._ref = r
+        return self
+
+    def clone(self):
+        return _ops().assign(self)
+
+    def cpu(self):
+        dev = jax.devices("cpu")[0]
+        return Tensor(jax.device_put(self._value, dev),
+                      stop_gradient=self.stop_gradient)
+
+    def cuda(self, device_id=None):  # parity shim: "cuda" → accelerator
+        return self.to_device()
+
+    def to_device(self, device=None):
+        from .device import _resolve_device
+        dev = _resolve_device(device)
+        return Tensor(jax.device_put(self._value, dev),
+                      stop_gradient=self.stop_gradient)
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # -- dtype/shape sugar (heavy ops monkey-patched from paddle_tpu.tensor)
+    def astype(self, d):
+        return _ops().cast(self, d)
+
+    def cast(self, d):
+        return _ops().cast(self, d)
+
+    def _to(self, *args, **kwargs):
+        # paddle's Tensor.to supports dtype / device / blocking combos
+        dtype_arg = kwargs.pop("dtype", None)
+        device_arg = kwargs.pop("device", None)
+        for a in args:
+            if isinstance(a, (str, dtypes.dtype)):
+                try:
+                    dtype_arg = dtypes.convert_np_dtype_to_dtype_(a)
+                except (TypeError, KeyError):
+                    device_arg = a
+        out = self
+        if device_arg is not None:
+            out = out.to_device(device_arg)
+        if dtype_arg is not None:
+            out = out.astype(dtype_arg)
+        return out
+
+    to = _to
+
+    # -- indexing ----------------------------------------------------------
+    def __getitem__(self, idx):
+        return _ops().manipulation._getitem(self, idx)
+
+    def __setitem__(self, idx, val):
+        return _ops().manipulation._setitem(self, idx, val)
+
+    # -- operators ---------------------------------------------------------
+    def __add__(self, o):
+        return _ops().add(self, o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return _ops().subtract(self, o)
+
+    def __rsub__(self, o):
+        return _ops().subtract(o, self)
+
+    def __mul__(self, o):
+        return _ops().multiply(self, o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return _ops().divide(self, o)
+
+    def __rtruediv__(self, o):
+        return _ops().divide(o, self)
+
+    def __floordiv__(self, o):
+        return _ops().floor_divide(self, o)
+
+    def __rfloordiv__(self, o):
+        return _ops().floor_divide(o, self)
+
+    def __mod__(self, o):
+        return _ops().remainder(self, o)
+
+    def __rmod__(self, o):
+        return _ops().remainder(o, self)
+
+    def __pow__(self, o):
+        return _ops().pow(self, o)
+
+    def __rpow__(self, o):
+        return _ops().pow(o, self)
+
+    def __matmul__(self, o):
+        return _ops().matmul(self, o)
+
+    def __rmatmul__(self, o):
+        return _ops().matmul(o, self)
+
+    def __neg__(self):
+        return _ops().neg(self)
+
+    def __abs__(self):
+        return _ops().abs(self)
+
+    def __invert__(self):
+        return _ops().logical_not(self)
+
+    def __eq__(self, o):
+        return _ops().equal(self, o)
+
+    def __ne__(self, o):
+        return _ops().not_equal(self, o)
+
+    def __lt__(self, o):
+        return _ops().less_than(self, o)
+
+    def __le__(self, o):
+        return _ops().less_equal(self, o)
+
+    def __gt__(self, o):
+        return _ops().greater_than(self, o)
+
+    def __ge__(self, o):
+        return _ops().greater_equal(self, o)
+
+    def __and__(self, o):
+        return _ops().bitwise_and(self, o)
+
+    def __or__(self, o):
+        return _ops().bitwise_or(self, o)
+
+    def __xor__(self, o):
+        return _ops().bitwise_xor(self, o)
+
+    @property
+    def T(self):
+        return _ops().transpose(self, list(range(self.ndim))[::-1])
+
+    # -- repr --------------------------------------------------------------
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_info},\n       {np.asarray(self._value)!r})")
+
+    __str__ = __repr__
+
+    # set_value for parity with paddle (used by optimizers/state loading)
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._value.shape}")
+        self._value = value.astype(self._value.dtype)
+        return self
+
+    def get_tensor(self):
+        return self
+
+    def _copy_to(self, place, blocking=True):
+        return self.to_device(place)
+
+    def fill_(self, v):
+        self._value = jnp.full_like(self._value, v)
+        return self
+
+    def block_until_ready(self):
+        self._value.block_until_ready()
+        return self
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: paddle.base.framework.Parameter).
+
+    stop_gradient defaults to False and `trainable` toggles it, matching the
+    reference's EagerParamBase (`python/paddle/base/framework.py`).
+    """
+
+    def __init__(self, value, trainable=True, name=None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+# ---------------------------------------------------------------------------
+# pytree registration: Tensors flow through jax transforms transparently.
+# ---------------------------------------------------------------------------
+def _flatten_tensor(t: Tensor):
+    return (t._value,), (t.stop_gradient, t.name)
+
+
+def _unflatten_tensor(aux, children):
+    stop_gradient, name = aux
+    val = children[0]
+    t = Tensor.__new__(Tensor)
+    t._value = val
+    t.stop_gradient = stop_gradient
+    t._grad = None
+    t.name = name
+    t.persistable = False
+    t._retain_grads = False
+    t._grad_hooks = []
+    r = VarRef()
+    r.tensor_wref = weakref.ref(t)
+    t._ref = r
+    return t
+
+
+def _flatten_param(p: Parameter):
+    return (p._value,), (p.stop_gradient, p.name)
+
+
+def _unflatten_param(aux, children):
+    stop_gradient, name = aux
+    p = Parameter(children[0], trainable=not stop_gradient, name=name)
+    return p
+
+
+jax.tree_util.register_pytree_node(Tensor, _flatten_tensor, _unflatten_tensor)
+jax.tree_util.register_pytree_node(Parameter, _flatten_param, _unflatten_param)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """`paddle.to_tensor` (reference: python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        val = data._value
+    elif isinstance(data, jax.Array):
+        val = data
+    else:
+        if isinstance(data, (list, tuple)):
+            if any(isinstance(x, Tensor) for x in jax.tree_util.tree_leaves(data)):
+                data = jax.tree_util.tree_map(
+                    lambda x: x._value if isinstance(x, Tensor) else x, data)
+                val = jnp.asarray(jnp.stack([jnp.asarray(d) for d in data])
+                                  if isinstance(data, (list, tuple)) else data)
+            else:
+                val = jnp.asarray(np.asarray(data))
+        else:
+            val = jnp.asarray(data)
+    if dtype is not None:
+        val = val.astype(dtypes.to_jax(dtype))
+    elif not isinstance(data, (Tensor, jax.Array)):
+        # paddle default: python floats → float32 (numpy gives float64)
+        if val.dtype == jnp.float64:
+            val = val.astype(jnp.float32)
+    t = Tensor(val, stop_gradient=stop_gradient)
+    if place is not None:
+        t = t.to_device(place)
+        t.stop_gradient = stop_gradient
+    return t

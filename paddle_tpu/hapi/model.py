@@ -1,0 +1,265 @@
+"""High-level `paddle.Model` API.
+
+Reference: `python/paddle/hapi/model.py` — Model (:1472), fit (:2200),
+prepare (:2114), DynamicGraphAdapter.train_batch (:759), evaluate/predict,
+save/load, callbacks integration.
+
+TPU-native: one adapter.  `prepare(jit=True)` (default) compiles the whole
+train step (forward+backward+update, donated buffers) via
+paddle_tpu.jit.TrainStep — this IS the static-graph path, no separate
+Program adapter is needed.  `jit=False` falls back to eager tape execution
+for debugging parity.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..framework import io as fio
+from .. import tensor as pten
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+
+    # -- prepare -----------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit=True):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, list) \
+                else [metrics]
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError("metrics must be paddle_tpu.metric.Metric")
+        self._use_jit = jit
+        self._amp_configs = amp_configs
+
+    # -- single-batch entry points (reference: train_batch :759) ------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = self._to_list(inputs)
+        labels = self._to_list(labels)
+        if self._use_jit and update and len(labels) == 1:
+            if self._train_step is None:
+                from ..jit import TrainStep
+                self._train_step = TrainStep(self.network, self._loss,
+                                             self._optimizer)
+            loss = self._train_step(*inputs, labels[0])
+            metrics = self._compute_metrics(None, labels)
+            return self._loss_and_metrics(loss, metrics)
+        outputs = self.network(*inputs)
+        losses = self._loss(outputs, *labels)
+        loss = losses if isinstance(losses, Tensor) else losses[0]
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._compute_metrics(outputs, labels)
+        return self._loss_and_metrics(loss, metrics)
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = self._to_list(inputs)
+        labels = self._to_list(labels)
+        outputs = self.network(*inputs)
+        metrics = self._compute_metrics(outputs, labels)
+        if self._loss is not None:
+            loss = self._loss(outputs, *labels)
+            loss = loss if isinstance(loss, Tensor) else loss[0]
+            return self._loss_and_metrics(loss, metrics)
+        return metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = self._to_list(inputs)
+        out = self.network(*inputs)
+        return [np.asarray(o.value) for o in self._to_list(out)]
+
+    def _compute_metrics(self, outputs, labels):
+        res = []
+        if outputs is None:
+            return res
+        outs = list(self._to_list(outputs))
+        labels = list(labels)
+        for m in self._metrics:
+            computed = m.compute(*(outs + labels))
+            r = m.update(computed)
+            res.append(r)
+        return res
+
+    @staticmethod
+    def _loss_and_metrics(loss, metrics):
+        l = [float(np.asarray(loss.value))]
+        if metrics:
+            return l, metrics
+        return l
+
+    @staticmethod
+    def _to_list(x):
+        if x is None:
+            return []
+        return x if isinstance(x, (list, tuple)) else [x]
+
+    # -- fit/evaluate/predict ----------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                save_freq=save_freq, save_dir=save_dir,
+                                verbose=verbose,
+                                metrics=self._metrics_name())
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(train_loader, cbks, "train",
+                                       num_iters=num_iters)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
+            if self.stop_training:
+                break
+        cbks.on_end("train", logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, steps=steps,
+                                log_freq=log_freq, verbose=verbose,
+                                metrics=self._metrics_name())
+        for m in self._metrics:
+            m.reset()
+        cbks.on_begin("eval")
+        logs = self._run_one_epoch(loader, cbks, "eval",
+                                   num_iters=num_iters)
+        cbks.on_end("eval", logs)
+        out = {"loss": logs.get("loss")}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            accs = m.accumulate()
+            accs = accs if isinstance(accs, list) else [accs]
+            out.update(dict(zip(names, accs)))
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            batch = self._to_list(batch)
+            inputs = batch[0] if len(batch) == 1 else batch[:-1]
+            outputs.append(self.predict_batch(self._to_list(inputs)))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    def _run_one_epoch(self, loader, cbks, mode, num_iters=None):
+        logs = {}
+        for m in self._metrics:
+            if mode == "train":
+                m.reset()
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            cbks.on_batch_begin(mode, step, logs)
+            batch = self._to_list(batch)
+            inputs, labels = batch[:-1], batch[-1:]
+            if mode == "train":
+                res = self.train_batch(inputs, labels)
+            else:
+                res = self.eval_batch(inputs, labels)
+            if isinstance(res, tuple):
+                losses, _ = res
+            else:
+                losses = res
+            logs["loss"] = losses[0] if isinstance(losses, list) else losses
+            logs["step"] = step
+            bs = inputs[0].shape[0] if inputs and hasattr(
+                inputs[0], "shape") else 1
+            logs["batch_size"] = bs
+            for m in self._metrics:
+                names = m.name() if isinstance(m.name(), list) \
+                    else [m.name()]
+                accs = m.accumulate()
+                accs = accs if isinstance(accs, list) else [accs]
+                logs.update(dict(zip(names, accs)))
+            cbks.on_batch_end(mode, step, logs)
+        return logs
+
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names += n if isinstance(n, list) else [n]
+        return names
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+        state = fio.load(path + ".pdparams") if os.path.exists(
+            path + ".pdparams") else fio.load(path)
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fio.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        return summary(self.network, input_size, dtypes=dtype)

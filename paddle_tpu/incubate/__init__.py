@@ -1,0 +1,12 @@
+"""paddle_tpu.incubate — fused ops + experimental features.
+
+Reference: `python/paddle/incubate/` — nn/functional fused transformer ops
+(fused_rms_norm, fused_rotary_position_embedding, swiglu,
+fused_matmul_bias, memory_efficient_attention), MoE models.
+"""
+from . import nn  # noqa: F401
+
+
+class autograd:
+    """incubate.autograd parity shim."""
+    pass

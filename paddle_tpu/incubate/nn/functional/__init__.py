@@ -1,0 +1,130 @@
+"""Fused transformer functionals.
+
+Reference: `python/paddle/incubate/nn/functional/` — fused_rms_norm.py,
+fused_rotary_position_embedding.py, swiglu.py, fused_matmul_bias.py,
+fused_linear.py, memory_efficient_attention.py, fused_moe.py.
+
+TPU-native: lower onto paddle_tpu.ops (Pallas on TPU, XLA elsewhere).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....framework.dispatch import run, to_tensor_args
+from ....framework.tensor import Tensor
+from .... import ops as tpu_ops
+
+__all__ = ["fused_rms_norm", "fused_layer_norm",
+           "fused_rotary_position_embedding", "swiglu",
+           "fused_matmul_bias", "fused_linear",
+           "fused_bias_act", "memory_efficient_attention",
+           "fused_bias_dropout_residual_layer_norm"]
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=None, **kw):
+    args = (x,) + ((norm_weight,) if norm_weight is not None else ())
+    ts = to_tensor_args(*args)
+
+    def _fn(v, *w):
+        out = tpu_ops.rms_norm(v, w[0] if w else None, epsilon)
+        if norm_bias is not None:
+            out = out + norm_bias.value
+        return out
+    out = run(_fn, *ts, name="rms_norm")
+    return (out, None)  # reference returns (out, invvar)
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=None, **kw):
+    extra = tuple(t for t in (norm_weight, norm_bias) if t is not None)
+    ts = to_tensor_args(x, *extra)
+
+    def _fn(v, *wb):
+        w = wb[0] if norm_weight is not None else None
+        b = wb[-1] if norm_bias is not None else None
+        return tpu_ops.layer_norm(v, w, b, epsilon)
+    return run(_fn, *ts, name="layer_norm"), None
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    rotary_emb_base=10000.0):
+    """Reference: fused_rotary_position_embedding.py — q/k/v [b, s, h, d]."""
+    if k is None:
+        k = q
+    q, k = to_tensor_args(q, k)
+    if sin is not None and cos is not None:
+        cos_a = cos.value if isinstance(cos, Tensor) else jnp.asarray(cos)
+        sin_a = sin.value if isinstance(sin, Tensor) else jnp.asarray(sin)
+        cos_a = jnp.squeeze(cos_a)
+        sin_a = jnp.squeeze(sin_a)
+        qo, ko = run(lambda a, b: tpu_ops.apply_rope(a, b, cos_a, sin_a),
+                     q, k, name="rope")
+    else:
+        pid = position_ids.value if isinstance(position_ids, Tensor) \
+            else position_ids
+        qo, ko = run(lambda a, b: tpu_ops.rope(
+            a, b, base=rotary_emb_base, position_ids=pid), q, k,
+            name="rope")
+    if v is not None:
+        return qo, ko, v
+    return qo, ko
+
+
+def swiglu(x, y=None, name=None):
+    from ....nn.functional.activation import swiglu as _sw
+    return _sw(x, y)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    args = (x, y) + ((bias,) if bias is not None else ())
+    ts = to_tensor_args(*args)
+
+    def _fn(a, b, *bs):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if bs:
+            out = out + bs[0]
+        return out
+    return run(_fn, *ts, name="matmul")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, False, transpose_weight)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    from ....nn import functional as F
+    if bias is not None:
+        from ....tensor.math import add
+        x = add(x, bias)
+    return getattr(F, act_method)(x)
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    """Reference: memory_efficient_attention.py — same math as flash path."""
+    from ....nn.functional.flash_attention import scaled_dot_product_attention
+    return scaled_dot_product_attention(query, key, value, attn_bias,
+                                        p, False, training)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.0, ln_epsilon=1e-5,
+                                           training=True, **kw):
+    from ....nn import functional as F
+    from ....tensor.math import add
+    if bias is not None:
+        x = add(x, bias)
+    if dropout_rate:
+        x = F.dropout(x, dropout_rate, training=training)
+    x = add(x, residual)
+    d = x.shape[-1]
+    return F.layer_norm(x, d, ln_scale, ln_bias, ln_epsilon)

@@ -1,0 +1,90 @@
+"""Fused transformer layers.
+
+Reference: `python/paddle/incubate/nn/layer/fused_transformer.py` —
+FusedMultiHeadAttention / FusedFeedForward (single-kernel CUDA paths).
+TPU-native: composition of Pallas attention + XLA-fused epilogues; the
+"fused" quality comes from the compiler, the layer just avoids layout
+round-trips.
+"""
+from __future__ import annotations
+
+from ...nn import Layer, Linear, Dropout, LayerNorm
+from ...nn import functional as F
+from ... import tensor as pten
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward"]
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.qkv = Linear(embed_dim, 3 * embed_dim, qkv_weight_attr,
+                          qkv_bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, linear_weight_attr,
+                               linear_bias_attr)
+        self.dropout = Dropout(dropout_rate)
+        self.attn_dropout_rate = attn_dropout_rate
+        self.norm = LayerNorm(embed_dim, epsilon=epsilon)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        x = query
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        b, s, _ = x.shape
+        qkv = pten.reshape(self.qkv(x), [b, s, 3, self.num_heads,
+                                         self.head_dim])
+        out, _ = F.flash_attn_qkvpacked(qkv, self.attn_dropout_rate,
+                                        training=self.training)
+        out = pten.reshape(out, [b, s, self.embed_dim])
+        out = self.dropout(self.out_proj(out))
+        out = residual + out
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-05, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.linear1 = Linear(d_model, dim_feedforward,
+                              linear1_weight_attr, linear1_bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model,
+                              linear2_weight_attr, linear2_bias_attr)
+        self.dropout = Dropout(act_dropout_rate
+                               if act_dropout_rate is not None
+                               else dropout_rate)
+        self.dropout2 = Dropout(dropout_rate)
+        self.norm = LayerNorm(d_model, epsilon=epsilon)
+        self.activation = activation
+
+    def forward(self, src, cache=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm(src)
+        src = self.linear1(src)
+        src = getattr(F, self.activation)(src)
+        src = self.linear2(self.dropout(src))
+        src = residual + self.dropout2(src)
+        if not self.normalize_before:
+            src = self.norm(src)
+        return src

@@ -1,0 +1,8 @@
+"""paddle.linalg namespace.  Reference: python/paddle/linalg.py."""
+from .tensor.linalg import (norm, vector_norm, matrix_norm, dist, cond,  # noqa: F401
+                            inv, inverse, pinv, det, slogdet, matrix_rank,
+                            matrix_power, qr, svd, svdvals, eig, eigh,
+                            eigvals, eigvalsh, cholesky, cholesky_solve,
+                            solve, triangular_solve, lstsq, lu, cross,
+                            multi_dot, matrix_exp, householder_product)
+from .tensor.math import matmul  # noqa: F401

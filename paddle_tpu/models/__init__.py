@@ -1,0 +1,5 @@
+"""Model zoo for LLM-scale benchmarks (reference parity: the models the
+reference's Fleet engine trains in its baseline configs — llama, gpt, bert).
+"""
+from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,  # noqa: F401
+                    llama_tiny_config, llama_7b_config, shard_llama_tp)

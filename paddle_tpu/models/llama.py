@@ -1,0 +1,257 @@
+"""Llama family — the flagship model (baseline config 3: Llama-2 7B/13B
+sharding-stage3 pretraining, SURVEY §6 / BASELINE.md).
+
+Reference capability: PaddleNLP-style llama built on the reference's fused
+ops (fused_rms_norm, fused_rotary_position_embedding, swiglu,
+flash_attention — python/paddle/incubate/nn/functional/) and Fleet TP
+layers (mp_layers.py).
+
+TPU-native design:
+  - weights created directly in bfloat16 (params + activations); master
+    fp32 copies live in the optimizer (multi_precision), matching the
+    reference's O2 scheme.
+  - attention → paddle_tpu.ops.attention (Pallas flash kernel on TPU).
+  - rmsnorm/rope/swiglu → paddle_tpu.ops (Pallas / XLA-fused).
+  - TP: q/k/v/gate/up projections are column-sharded, o/down row-sharded
+    over the 'mp' mesh axis; embedding vocab-sharded.  Sharding is carried
+    by parameter NamedShardings (fleet.meta_parallel), with GSPMD
+    inserting collectives — no comm code in the model.
+  - sequence axis can additionally be sharded over 'sep' (context
+    parallel); ring attention kernel handles the halo exchange.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from .. import tensor as pten
+from ..nn import functional as F
+from ..framework.tensor import Tensor
+from ..framework.dispatch import run, to_tensor_args
+from .. import ops as tpu_ops
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+           "llama_tiny_config", "llama_7b_config"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+    use_flash_attention: bool = True
+    recompute: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama_tiny_config(**kw):
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                      intermediate_size=384, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=256)
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def llama_7b_config(**kw):
+    cfg = LlamaConfig()
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _init_weight(shape, std, dtype):
+    from ..nn.initializer import Normal
+    return Normal(0.0, std)(tuple(shape), dtype)
+
+
+class LlamaRMSNorm(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        from ..framework.tensor import Parameter
+        self.weight = Parameter(jnp.ones([config.hidden_size],
+                                         jnp.bfloat16
+                                         if config.dtype == "bfloat16"
+                                         else jnp.float32))
+        self.eps = config.rms_norm_eps
+
+    def forward(self, x):
+        (x,) = to_tensor_args(x)
+        return run(lambda v, w: tpu_ops.rms_norm(v, w, self.eps), x,
+                   self.weight, name="rms_norm")
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        from ..framework.tensor import Parameter
+        self.config = config
+        h = config.hidden_size
+        hd = config.head_dim
+        nh = config.num_attention_heads
+        nkv = config.num_key_value_heads
+        std = 1.0 / math.sqrt(h)
+        self.q_proj = Parameter(_init_weight([h, nh * hd], std,
+                                             config.dtype))
+        self.k_proj = Parameter(_init_weight([h, nkv * hd], std,
+                                             config.dtype))
+        self.v_proj = Parameter(_init_weight([h, nkv * hd], std,
+                                             config.dtype))
+        self.o_proj = Parameter(_init_weight([nh * hd, h], std,
+                                             config.dtype))
+
+    def forward(self, x, cos, sin):
+        cfg = self.config
+        (x,) = to_tensor_args(x)
+        cos_a = cos.value if isinstance(cos, Tensor) else cos
+        sin_a = sin.value if isinstance(sin, Tensor) else sin
+
+        def _fn(v, wq, wk, wv, wo):
+            b, s, h = v.shape
+            q = (v @ wq).reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
+            k = (v @ wk).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+            val = (v @ wv).reshape(b, s, cfg.num_key_value_heads,
+                                   cfg.head_dim)
+            q, k = tpu_ops.apply_rope(q, k, cos_a, sin_a)
+            out = tpu_ops.attention(q, k, val, causal=True)
+            return out.reshape(b, s, -1) @ wo
+        return run(_fn, x, self.q_proj, self.k_proj, self.v_proj,
+                   self.o_proj, name="attention")
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        from ..framework.tensor import Parameter
+        h, i = config.hidden_size, config.intermediate_size
+        std = 1.0 / math.sqrt(h)
+        self.gate_proj = Parameter(_init_weight([h, i], std, config.dtype))
+        self.up_proj = Parameter(_init_weight([h, i], std, config.dtype))
+        self.down_proj = Parameter(_init_weight([i, h],
+                                                1.0 / math.sqrt(i),
+                                                config.dtype))
+
+    def forward(self, x):
+        (x,) = to_tensor_args(x)
+
+        def _fn(v, wg, wu, wd):
+            return tpu_ops.swiglu(v @ wg, v @ wu) @ wd
+        return run(_fn, x, self.gate_proj, self.up_proj, self.down_proj,
+                   name="mlp_swiglu")
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = LlamaRMSNorm(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config)
+
+    def forward(self, x, cos, sin):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        from ..framework.tensor import Parameter
+        self.config = config
+        std = 1.0 / math.sqrt(config.hidden_size)
+        self.embed_tokens = Parameter(_init_weight(
+            [config.vocab_size, config.hidden_size], std, config.dtype))
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = LlamaRMSNorm(config)
+
+    def forward(self, input_ids):
+        cfg = self.config
+        (input_ids,) = to_tensor_args(input_ids)
+        seq_len = input_ids.shape[1]
+        cos, sin = tpu_ops.rope_cos_sin(seq_len, cfg.head_dim,
+                                        cfg.rope_theta, jnp.float32)
+        x = run(lambda w: jnp.take(w, input_ids.value.astype(jnp.int32),
+                                   axis=0), self.embed_tokens,
+                name="embedding")
+        for layer in self.layers:
+            x = layer(x, cos, sin)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        from ..framework.tensor import Parameter
+        self.config = config
+        self.llama = LlamaModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = Parameter(_init_weight(
+                [config.hidden_size, config.vocab_size],
+                1.0 / math.sqrt(config.hidden_size), config.dtype))
+
+    def forward(self, input_ids):
+        x = self.llama(input_ids)
+        if self.config.tie_word_embeddings:
+            w = self.llama.embed_tokens
+            return run(lambda v, e: v @ e.T, x, w, name="lm_head")
+        return run(lambda v, w: v @ w, x, self.lm_head, name="lm_head")
+
+    def compute_loss(self, logits, labels):
+        """Next-token cross entropy in fp32 (reference:
+        ParallelCrossEntropy over vocab-sharded logits)."""
+        (logits,) = to_tensor_args(logits)
+        (labels,) = to_tensor_args(labels)
+        lbl = labels.value
+
+        def _fn(lg):
+            import jax
+            lgf = lg[:, :-1].astype(jnp.float32)
+            tgt = lbl[:, 1:].astype(jnp.int32)
+            logp = jax.nn.log_softmax(lgf, axis=-1)
+            picked = jnp.take_along_axis(logp, tgt[..., None],
+                                         axis=-1)[..., 0]
+            return -jnp.mean(picked)
+        return run(_fn, logits, name="causal_lm_loss")
+
+
+def shard_llama_tp(model: LlamaForCausalLM, mesh):
+    """Annotate llama params with TP NamedShardings over the 'mp' axis
+    (megatron layout: column for q/k/v/gate/up, row for o/down; vocab for
+    embed/lm_head).  Reference: mp_layers.py usage in llama pretraining."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(p, spec):
+        p._value = jax.device_put(p.value, NamedSharding(mesh, spec))
+
+    put(model.llama.embed_tokens, P("mp", None))
+    if not model.config.tie_word_embeddings:
+        put(model.lm_head, P(None, "mp"))
+    for layer in model.llama.layers:
+        put(layer.self_attn.q_proj, P(None, "mp"))
+        put(layer.self_attn.k_proj, P(None, "mp"))
+        put(layer.self_attn.v_proj, P(None, "mp"))
+        put(layer.self_attn.o_proj, P("mp", None))
+        put(layer.mlp.gate_proj, P(None, "mp"))
+        put(layer.mlp.up_proj, P(None, "mp"))
+        put(layer.mlp.down_proj, P("mp", None))
+    return model

@@ -1,0 +1,12 @@
+"""paddle_tpu.nn.functional — reference: python/paddle/nn/functional/."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import (conv1d, conv2d, conv3d, conv1d_transpose,  # noqa: F401
+                   conv2d_transpose, conv3d_transpose)
+from .pooling import *  # noqa: F401,F403
+from .norm import (layer_norm, batch_norm, instance_norm,  # noqa: F401
+                   group_norm, local_response_norm, rms_norm)
+from .loss import *  # noqa: F401,F403
+from .flash_attention import (scaled_dot_product_attention,  # noqa: F401
+                              flash_attention, flash_attn_qkvpacked,
+                              flash_attn_unpadded, sdp_kernel)

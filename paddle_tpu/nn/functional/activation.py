@@ -1,0 +1,202 @@
+"""Activation functionals.
+
+Reference: `python/paddle/nn/functional/activation.py`.  All are jnp/jax.nn
+one-liners — XLA fuses them into adjacent matmuls (HBM-bandwidth win), which
+is why there are no hand-written kernels here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import run, to_tensor_args
+from ...framework.tensor import Tensor
+
+
+def _unary(jfn, opname):
+    def op(x, name=None):
+        (x,) = to_tensor_args(x)
+        return run(jfn, x, name=opname)
+    op.__name__ = opname
+    return op
+
+
+relu = _unary(jax.nn.relu, "relu")
+relu6 = _unary(jax.nn.relu6, "relu6")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+tanh = _unary(jnp.tanh, "tanh")
+silu = _unary(jax.nn.silu, "silu")
+swish = silu
+mish = _unary(lambda v: v * jnp.tanh(jax.nn.softplus(v)), "mish")
+softsign = _unary(jax.nn.soft_sign, "softsign")
+tanhshrink = _unary(lambda v: v - jnp.tanh(v), "tanhshrink")
+log_sigmoid = _unary(jax.nn.log_sigmoid, "log_sigmoid")
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._value = out._value
+    x._set_ref(out._ref)
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def gelu(x, approximate=False, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jax.nn.gelu(v, approximate=approximate), x,
+               name="gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jax.nn.leaky_relu(v, negative_slope), x,
+               name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = to_tensor_args(x, weight)
+
+    def _fn(v, w):
+        if w.size > 1 and v.ndim > 1:
+            shape = [1] * v.ndim
+            ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(v >= 0, v, w * v)
+    return run(_fn, x, weight, name="prelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jax.nn.elu(v, alpha), x, name="elu")
+
+
+def celu(x, alpha=1.0, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jax.nn.celu(v, alpha), x, name="celu")
+
+
+def selu(x,
+         scale=1.0507009873554804934193349852946,
+         alpha=1.6732632423543772848170429916717, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: scale * jnp.where(v > 0, v,
+                                           alpha * jnp.expm1(v)), x,
+               name="selu")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x,
+               name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.where(v > threshold, v - threshold,
+                                   jnp.where(v < -threshold, v + threshold,
+                                             0.0)), x, name="softshrink")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.clip(v, min, max), x, name="hardtanh")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), x,
+               name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x,
+               name="hardswish")
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.where(beta * v > threshold, v,
+                                   jax.nn.softplus(beta * v) / beta), x,
+               name="softplus")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.where(v > threshold, v, value), x,
+               name="thresholded_relu")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    (x,) = to_tensor_args(x)
+    if dtype is not None:
+        from ...framework import dtypes
+        x = run(lambda v: v.astype(dtypes.to_jax(dtype)), x)
+    return run(lambda v: jax.nn.softmax(v, axis=axis), x, name="softmax")
+
+
+softmax_ = softmax
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    (x,) = to_tensor_args(x)
+    if dtype is not None:
+        from ...framework import dtypes
+        x = run(lambda v: v.astype(dtypes.to_jax(dtype)), x)
+    return run(lambda v: jax.nn.log_softmax(v, axis=axis), x,
+               name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import next_key
+    (x,) = to_tensor_args(x)
+    g = jax.random.gumbel(next_key(), x.value.shape, x.value.dtype)
+
+    def _fn(v):
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return run(_fn, x, name="gumbel_softmax")
+
+
+def maxout(x, groups, axis=1, name=None):
+    (x,) = to_tensor_args(x)
+
+    def _fn(v):
+        shp = list(v.shape)
+        c = shp[axis]
+        shp[axis:axis + 1] = [groups, c // groups]
+        return jnp.max(v.reshape(shp), axis=axis + 1)
+    return run(_fn, x, name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jax.nn.glu(v, axis=axis), x, name="glu")
+
+
+def swiglu(x, y=None, name=None):
+    """Reference: python/paddle/incubate/nn/functional/swiglu.py."""
+    if y is None:
+        (x,) = to_tensor_args(x)
+        return run(lambda v: jax.nn.silu(v[..., : v.shape[-1] // 2])
+                   * v[..., v.shape[-1] // 2:], x, name="swiglu")
+    x, y = to_tensor_args(x, y)
+    return run(lambda a, b: jax.nn.silu(a) * b, x, y, name="swiglu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from ...framework.random import next_key
+    (x,) = to_tensor_args(x)
+    if training:
+        a = jax.random.uniform(next_key(), x.value.shape, jnp.float32,
+                               lower, upper).astype(x.value.dtype)
+    else:
+        a = jnp.asarray((lower + upper) / 2.0, x.value.dtype)
+    return run(lambda v: jnp.where(v >= 0, v, a * v), x, name="rrelu")

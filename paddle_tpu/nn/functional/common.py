@@ -1,0 +1,323 @@
+"""Common functionals: linear, dropout, embedding, interpolate, etc.
+
+Reference: `python/paddle/nn/functional/common.py` + `input.py`.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import run, to_tensor_args
+from ...framework.tensor import Tensor
+from ...framework.random import next_key
+from ...framework import dtypes
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b.  Weight layout [in, out] as in the reference
+    (`python/paddle/nn/functional/common.py` linear → matmul kernel).
+    Kept as one dot for MXU mapping; XLA fuses the bias add."""
+    if bias is None:
+        x, weight = to_tensor_args(x, weight)
+        return run(lambda v, w: v @ w, x, weight, name="linear")
+    x, weight, bias = to_tensor_args(x, weight, bias)
+    return run(lambda v, w, b: v @ w + b, x, weight, bias, name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    (x,) = to_tensor_args(x)
+    if not training or p == 0.0:
+        return run(lambda v: v, x, name="dropout_id")
+    if p == 1.0:
+        return run(lambda v: jnp.zeros_like(v), x, name="dropout")
+    shape = list(x.value.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(shape))
+
+    def _fn(v):
+        k = keep.astype(v.dtype)
+        if mode == "upscale_in_train":
+            return v * k / jnp.asarray(1.0 - p, v.dtype)
+        return v * k  # downgrade_in_infer scales at infer time instead
+    return run(_fn, x, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    (x,) = to_tensor_args(x)
+    if not training or p == 0.0:
+        return run(lambda v: v, x)
+    alpha = 1.6732632423543772848170429916717
+    scale = 1.0507009873554804934193349852946
+    alpha_p = -alpha * scale
+    a = (1.0 / np.sqrt((alpha_p ** 2 * p + 1) * (1 - p)))
+    b = -a * alpha_p * p
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, x.value.shape)
+
+    def _fn(v):
+        k = keep
+        return (jnp.where(k, v, jnp.asarray(alpha_p, v.dtype))
+                * jnp.asarray(a, v.dtype) + jnp.asarray(b, v.dtype))
+    return run(_fn, x, name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None,
+              max_norm=None, norm_type=2.0, scale_grad_by_freq=False):
+    """Reference: nn/functional/input.py embedding → phi embedding kernel.
+    TPU-native: one-hot-free take(); padding_idx rows are masked so their
+    grads vanish (XLA handles the scatter-add in the vjp)."""
+    x, weight = to_tensor_args(x, weight)
+
+    def _fn(w):
+        tbl = w
+        if padding_idx is not None:
+            pid = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            tbl = w.at[pid].set(jnp.zeros_like(w[0]))
+        return jnp.take(tbl, x.value.astype(jnp.int32), axis=0)
+    return run(_fn, weight, name="embedding")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    (label,) = to_tensor_args(label)
+    k = label.shape[-1]
+
+    def _fn(v):
+        if prior_dist is not None:
+            pd = prior_dist.value if isinstance(prior_dist, Tensor) \
+                else jnp.asarray(prior_dist)
+            return (1 - epsilon) * v + epsilon * pd
+        return (1 - epsilon) * v + epsilon / k
+    return run(_fn, label, name="label_smooth")
+
+
+def one_hot(x, num_classes, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jax.nn.one_hot(x.value, num_classes, dtype=jnp.float32))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    x1, x2 = to_tensor_args(x1, x2)
+
+    def _fn(a, b):
+        an = jnp.linalg.norm(a, axis=axis, keepdims=True)
+        bn = jnp.linalg.norm(b, axis=axis, keepdims=True)
+        denom = jnp.maximum(an * bn, eps)
+        return jnp.sum(a * b, axis=axis) / jnp.squeeze(denom, axis)
+    return run(_fn, x1, x2, name="cosine_similarity")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    (x,) = to_tensor_args(x)
+
+    def _fn(v):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(v * v, axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+    return run(_fn, x, name="normalize")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...tensor.manipulation import pad as _pad
+    return _pad(x, pad, mode, value, data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    (x,) = to_tensor_args(x)
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    pads = _pair(paddings)
+    if len(pads) == 2:
+        pt, pb, pl, pr = pads[0], pads[0], pads[1], pads[1]
+    else:
+        pt, pb, pl, pr = pads
+
+    def _fn(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        oh = (v.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (v.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            v, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * kh * kw, oh * ow)
+    return run(_fn, x, name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    (x,) = to_tensor_args(x)
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    pads = _pair(paddings)
+    if len(pads) == 2:
+        pt, pb, pl, pr = pads[0], pads[0], pads[1], pads[1]
+    else:
+        pt, pb, pl, pr = pads
+
+    def _fn(v):
+        n, ckk, L = v.shape
+        c = ckk // (kh * kw)
+        hh, ww = oh + pt + pb, ow + pl + pr
+        lh = (hh - (dh * (kh - 1) + 1)) // sh + 1
+        lw = (ww - (dw * (kw - 1) + 1)) // sw + 1
+        out = jnp.zeros((n, c, hh, ww), v.dtype)
+        v6 = v.reshape(n, c, kh, kw, lh, lw)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wi = j * dw
+                out = out.at[:, :, hi:hi + sh * lh:sh,
+                             wi:wi + sw * lw:sw].add(v6[:, :, i, j])
+        return out[:, :, pt:pt + oh, pl:pl + ow]
+    return run(_fn, x, name="fold")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    (x,) = to_tensor_args(x)
+    chan_last = data_format[-1] == "C"
+    nd = x.ndim - 2
+    spatial = x.shape[1:-1] if chan_last else x.shape[2:]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in np.asarray(size.value)]
+        out_size = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                    for s in (size if isinstance(size, (list, tuple))
+                              else [size] * nd)]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * nd
+        out_size = [int(s * f) for s, f in zip(spatial, sf)]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear",
+             "cubic": "cubic"}[mode]
+
+    def _fn(v):
+        if chan_last:
+            shape = (v.shape[0],) + tuple(out_size) + (v.shape[-1],)
+        else:
+            shape = v.shape[:2] + tuple(out_size)
+        if jmode == "nearest":
+            return jax.image.resize(v, shape, method="nearest")
+        # jax.image linear matches align_corners=False (half-pixel centers)
+        if align_corners:
+            # explicit gather for align_corners semantics
+            idxs = []
+            sp_axes = list(range(1, 1 + nd)) if chan_last \
+                else list(range(2, 2 + nd))
+            out = v
+            for ax_i, ax in enumerate(sp_axes):
+                in_s, out_s = v.shape[ax], out_size[ax_i]
+                if out_s == 1:
+                    pos = jnp.zeros((1,), v.dtype)
+                else:
+                    pos = jnp.arange(out_s) * ((in_s - 1) / (out_s - 1))
+                lo = jnp.floor(pos).astype(jnp.int32)
+                hi = jnp.minimum(lo + 1, in_s - 1)
+                w = (pos - lo).astype(v.dtype)
+                shp = [1] * out.ndim
+                shp[ax] = out_s
+                w = w.reshape(shp)
+                out = (jnp.take(out, lo, axis=ax) * (1 - w)
+                       + jnp.take(out, hi, axis=ax) * w)
+            return out
+        return jax.image.resize(v, shape, method=jmode)
+    return run(_fn, x, name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    (x,) = to_tensor_args(x)
+    r = upscale_factor
+
+    def _fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return run(_fn, x, name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    (x,) = to_tensor_args(x)
+    r = downscale_factor
+
+    def _fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h // r, w // r, c * r * r)
+    return run(_fn, x, name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    (x,) = to_tensor_args(x)
+
+    def _fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, groups, c // groups, h, w)
+            return v.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, groups, c // groups)
+        return v.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return run(_fn, x, name="channel_shuffle")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    ts = to_tensor_args(x1, x2, weight) + (to_tensor_args(bias)
+                                           if bias is not None else ())
+
+    def _fn(a, b, w, *bs):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bs:
+            out = out + bs[0]
+        return out
+    return run(_fn, *ts, name="bilinear")

@@ -1,0 +1,168 @@
+"""Convolution functionals.
+
+Reference: `python/paddle/nn/functional/conv.py` → phi conv kernels (cuDNN).
+TPU-native: `jax.lax.conv_general_dilated` — XLA maps convs onto the MXU
+directly; NCHW layouts are accepted and internally transposed by XLA as
+needed (TPU prefers NHWC; Conv layers expose data_format for users who want
+the native layout end-to-end).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import run, to_tensor_args
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _padding(padding, n, strides, dilations, ksize):
+    """Normalize paddle padding spec to lax padding list or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    # nested [[lo, hi], ...] possibly including batch/channel dims
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        flat = [tuple(p) for p in padding]
+        if len(flat) == n + 2:
+            flat = flat[2:]
+        return flat
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, nd,
+          data_format, transpose=False, output_padding=0, output_size=None):
+    strides = _tuplize(stride, nd)
+    dilations = _tuplize(dilation, nd)
+    chan_last = data_format[-1] == "C"
+    if nd == 1:
+        dn_in = "NCH" if not chan_last else "NHC"
+        dn_out = dn_in
+        dn_k = "OIH"
+    elif nd == 2:
+        dn_in = "NCHW" if not chan_last else "NHWC"
+        dn_out = dn_in
+        dn_k = "OIHW"
+    else:
+        dn_in = "NCDHW" if not chan_last else "NDHWC"
+        dn_out = dn_in
+        dn_k = "OIDHW"
+    dnums = (dn_in, dn_k, dn_out)
+    ksize = tuple(weight.shape[2:])
+    pad = _padding(padding, nd, strides, dilations, ksize)
+
+    def _fn(v, w, *b):
+        if not transpose:
+            out = jax.lax.conv_general_dilated(
+                v, w, strides, pad, rhs_dilation=dilations,
+                dimension_numbers=dnums, feature_group_count=groups,
+                preferred_element_type=None)
+        else:
+            # conv_transpose: gradient of conv w.r.t. input.
+            # weight layout in paddle is [in, out//groups, *k]
+            opad = _tuplize(output_padding, nd)
+            if isinstance(pad, str):
+                pads = None
+            else:
+                pads = pad
+            if pads is None:
+                k_eff = [(k - 1) * d + 1 for k, d in zip(ksize, dilations)]
+                if pad == "SAME":
+                    pads = [((ke - 1) // 2, ke // 2) for ke in k_eff]
+                else:
+                    pads = [(0, 0)] * nd
+            k_eff = [(k - 1) * d + 1 for k, d in zip(ksize, dilations)]
+            tpads = [(ke - 1 - p[0], ke - 1 - p[1] + op)
+                     for ke, p, op in zip(k_eff, pads, opad)]
+            # flip spatial dims and swap in/out channels
+            wt = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+            wt = jnp.swapaxes(wt, 0, 1)  # [out//g, in, *k] → lax OIHW with
+            if groups > 1:
+                ci = w.shape[0]
+                co_g = w.shape[1]
+                wt = w.reshape(groups, ci // groups, co_g, *ksize)
+                wt = jnp.flip(wt, axis=tuple(range(3, 3 + nd)))
+                wt = jnp.swapaxes(wt, 1, 2)
+                wt = wt.reshape(groups * co_g, ci // groups, *ksize)
+            out = jax.lax.conv_general_dilated(
+                v, wt, (1,) * nd, tpads, lhs_dilation=strides,
+                rhs_dilation=dilations, dimension_numbers=dnums,
+                feature_group_count=groups)
+        if b:
+            bias_shape = [1] * out.ndim
+            c_ax = 1 if not chan_last else out.ndim - 1
+            bias_shape[c_ax] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    ts = to_tensor_args(*args)
+    out = run(_fn, *ts, name="conv_transpose" if transpose else "conv")
+    if transpose and output_size is not None:
+        want = tuple(int(s) for s in
+                     (output_size if isinstance(output_size, (list, tuple))
+                      else [output_size] * nd))
+        got = tuple(out.shape[2:]) if not chan_last else tuple(
+            out.shape[1:-1])
+        if want != got:
+            from ...tensor.manipulation import pad as _pad
+            extra = []
+            for w_, g_ in zip(want[::-1], got[::-1]):
+                extra += [0, w_ - g_]
+            out = _pad(out, extra, data_format=data_format)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format, transpose=True, output_padding=output_padding,
+                 output_size=output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, transpose=True, output_padding=output_padding,
+                 output_size=output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, transpose=True, output_padding=output_padding,
+                 output_size=output_size)

@@ -1,0 +1,125 @@
+"""Attention functionals.
+
+Reference: `python/paddle/nn/functional/flash_attention.py` (1608 LoC; sdp
+kernel selection at :37, `flash_attn`, `flash_attn_unpadded:593`, qkvpacked
+variants) wrapping the external flash-attn CUDA library via phi kernels.
+
+TPU-native: `paddle_tpu.ops.flash_attention` — a Pallas splash/flash kernel
+on TPU with an XLA reference path on CPU.  Layout follows the reference:
+q/k/v are [batch, seq, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import run, to_tensor_args
+from ...framework.tensor import Tensor
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_qkvpacked", "sdp_kernel", "flash_attn_unpadded"]
+
+
+def _sdpa_raw(q, k, v, mask=None, is_causal=False, dropout_p=0.0,
+              scale=None):
+    from ...ops import attention as ops_attention
+    return ops_attention(q, k, v, mask=mask, causal=is_causal,
+                        scale=scale, dropout_p=dropout_p)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Reference signature: nn/functional/flash_attention.py:scaled_dot_
+    product_attention.  Inputs [b, s, h, d]; returns [b, s, h, d]."""
+    query, key, value = to_tensor_args(query, key, value)
+    p = dropout_p if training else 0.0
+    if attn_mask is not None:
+        (attn_mask,) = to_tensor_args(attn_mask)
+        return run(lambda q, k, v, m: _sdpa_raw(q, k, v, mask=m,
+                                                is_causal=is_causal,
+                                                dropout_p=p),
+                   query, key, value, attn_mask, name="sdpa")
+    return run(lambda q, k, v: _sdpa_raw(q, k, v, is_causal=is_causal,
+                                         dropout_p=p),
+               query, key, value, name="sdpa")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """Reference: flash_attention.py flash_attn — returns (out, softmax)."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    return out, None
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """Reference: flash_attention.py:399 flash_attn_qkvpacked.
+    qkv: [b, s, 3, h, d]."""
+    (qkv,) = to_tensor_args(qkv)
+
+    def _fn(x):
+        q, k, v = x[:, :, 0], x[:, :, 1], x[:, :, 2]
+        return _sdpa_raw(q, k, v, is_causal=causal,
+                         dropout_p=dropout if training else 0.0)
+    return run(_fn, qkv, name="flash_attn_qkvpacked"), None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen attention (reference :593).  TPU-native: segment-ids mask over
+    the packed sequence (XLA-friendly static shapes)."""
+    query, key, value = to_tensor_args(query, key, value)
+    cu_q = cu_seqlens_q.value if isinstance(cu_seqlens_q, Tensor) \
+        else jnp.asarray(cu_seqlens_q)
+    cu_k = cu_seqlens_k.value if isinstance(cu_seqlens_k, Tensor) \
+        else jnp.asarray(cu_seqlens_k)
+
+    def _fn(q, k, v):
+        # build segment ids from cumulative seqlens: token i belongs to the
+        # segment whose [cu[j], cu[j+1]) contains i
+        tq = q.shape[0]
+        tk = k.shape[0]
+        seg_q = jnp.searchsorted(cu_q[1:], jnp.arange(tq), side="right")
+        seg_k = jnp.searchsorted(cu_k[1:], jnp.arange(tk), side="right")
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(tq) - jnp.take(cu_q, seg_q)
+            pos_k = jnp.arange(tk) - jnp.take(cu_k, seg_k)
+            mask = mask & (pos_q[:, None] >= pos_k[None, :])
+        s = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+        logits = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * s
+        logits = jnp.where(mask[None], logits, -1e30)
+        w = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("hqk,khd->qhd", w, v.astype(jnp.float32)
+                          ).astype(q.dtype)
+    return run(_fn, query, key, value, name="flash_attn_unpadded"), None
+
+
+class sdp_kernel:
+    """Context manager to force a kernel choice (reference :37).  On TPU the
+    choice is pallas-flash vs xla-reference; recorded for parity."""
+
+    def __init__(self, enable_flash=True, enable_math=True,
+                 enable_mem_efficient=True):
+        self.enable_flash = enable_flash
+        self.enable_math = enable_math
+
+    def __enter__(self):
+        from ... import ops
+        self._prev = ops.get_attention_backend()
+        ops.set_attention_backend(
+            "pallas" if self.enable_flash else "xla")
+        return self
+
+    def __exit__(self, *exc):
+        from ... import ops
+        ops.set_attention_backend(self._prev)
+        return False

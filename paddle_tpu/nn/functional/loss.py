@@ -1,0 +1,289 @@
+"""Loss functionals.
+
+Reference: `python/paddle/nn/functional/loss.py` (cross_entropy at :2458,
+softmax_with_cross_entropy, mse_loss, ...).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import run, to_tensor_args
+from ...framework.tensor import Tensor
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """Reference: nn/functional/loss.py cross_entropy → phi
+    softmax_with_cross_entropy kernel.  Computed as fused
+    log_softmax + gather; fp32 accumulation for bf16 logits."""
+    input, label = to_tensor_args(input, label)
+    has_w = weight is not None
+    if has_w:
+        (weight,) = to_tensor_args(weight)
+
+    lbl = label.value
+
+    def _fn(logits, *w):
+        x = logits.astype(jnp.float32) \
+            if logits.dtype in (jnp.bfloat16, jnp.float16) else logits
+        if use_softmax:
+            logp = jax.nn.log_softmax(x, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(x, 1e-10))
+        if soft_label or (lbl.ndim == logp.ndim and lbl.shape == logp.shape
+                          and jnp.issubdtype(lbl.dtype, jnp.floating)):
+            tgt = lbl.astype(logp.dtype)
+            if label_smoothing > 0:
+                k = logp.shape[axis]
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=axis)
+        else:
+            idx = lbl.astype(jnp.int32)
+            if idx.ndim == logp.ndim:
+                idx = jnp.squeeze(idx, axis)
+            safe_idx = jnp.where(idx == ignore_index, 0, idx)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe_idx, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis)
+            if label_smoothing > 0:
+                k = logp.shape[axis]
+                smooth = jnp.mean(logp, axis=axis)
+                loss = -((1 - label_smoothing) * picked
+                         + label_smoothing * smooth)
+            else:
+                loss = -picked
+            mask = (idx != ignore_index)
+            loss = jnp.where(mask, loss, 0.0)
+            if w:
+                wv = jnp.take(w[0].astype(logp.dtype), safe_idx)
+                loss = loss * jnp.where(mask, wv, 0.0)
+                if reduction == "mean":
+                    denom = jnp.sum(jnp.where(mask, wv, 0.0))
+                    return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+            if reduction == "mean":
+                denom = jnp.sum(mask.astype(logp.dtype))
+                return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+        return _reduce(loss, reduction)
+
+    args = (input,) + ((weight,) if has_w else ())
+    return run(_fn, *args, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from ...tensor.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    input, label = to_tensor_args(input, label)
+    lbl = label.value
+    has_w = weight is not None
+    if has_w:
+        (weight,) = to_tensor_args(weight)
+
+    def _fn(logp, *w):
+        idx = lbl.astype(jnp.int32)
+        safe_idx = jnp.where(idx == ignore_index, 0, idx)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe_idx, 1),
+                                     axis=1)
+        loss = -jnp.squeeze(picked, 1)
+        mask = idx != ignore_index
+        if w:
+            wv = jnp.take(w[0], safe_idx)
+            loss = loss * wv
+            loss = jnp.where(mask, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.sum(jnp.where(mask, wv, 0.0))
+        loss = jnp.where(mask, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(mask.astype(logp.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    args = (input,) + ((weight,) if has_w else ())
+    return run(_fn, *args, name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    input, label = to_tensor_args(input, label)
+    return run(lambda a, b: _reduce((a - b) ** 2, reduction), input, label,
+               name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    input, label = to_tensor_args(input, label)
+    return run(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label,
+               name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    input, label = to_tensor_args(input, label)
+
+    def _fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle multiplies by delta
+        return _reduce(loss * delta, reduction)
+    return run(_fn, input, label, name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    args = to_tensor_args(*( (input, label) +
+                             ((weight,) if weight is not None else ()) ))
+
+    def _fn(p, t, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-7)
+        loss = -(t * jnp.log(p) + (1 - t) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    return run(_fn, *args, name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    extra = ()
+    if weight is not None:
+        extra += (weight,)
+    if pos_weight is not None:
+        extra += (pos_weight,)
+    args = to_tensor_args(logit, label, *extra)
+
+    def _fn(x, t, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        # numerically stable: max(x,0) - x*t + log(1+exp(-|x|))
+        if pw is None:
+            loss = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        else:
+            logp = jax.nn.log_sigmoid(x)
+            lognp = jax.nn.log_sigmoid(-x)
+            loss = -(pw * t * logp + (1 - t) * lognp)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    return run(_fn, *args, name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    input, label = to_tensor_args(input, label)
+
+    def _fn(lp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - lp)
+        else:
+            loss = t * (jnp.log(jnp.clip(t, 1e-12)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+    return run(_fn, input, label, name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    input, other, label = to_tensor_args(input, other, label)
+    return run(lambda a, b, y: _reduce(
+        jnp.maximum(0.0, -y * (a - b) + margin), reduction), input, other,
+        label, name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    input, label = to_tensor_args(input, label)
+    return run(lambda x, y: _reduce(
+        jnp.where(y == 1, x, jnp.maximum(0.0, margin - x)), reduction),
+        input, label, name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    input1, input2, label = to_tensor_args(input1, input2, label)
+
+    def _fn(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return run(_fn, input1, input2, label, name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-06, swap=False, reduction="mean",
+                        name=None):
+    input, positive, negative = to_tensor_args(input, positive, negative)
+
+    def _fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p),
+                                     axis=-1), 1.0 / p)
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return run(_fn, input, positive, negative, name="triplet_margin_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    logit, label = to_tensor_args(logit, label)
+
+    def _fn(x, t):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if normalizer is not None:
+            nv = normalizer.value if isinstance(normalizer, Tensor) \
+                else normalizer
+            loss = loss / nv
+        return _reduce(loss, reduction)
+    return run(_fn, logit, label, name="sigmoid_focal_loss")
+
+
+def square_error_cost(input, label):
+    input, label = to_tensor_args(input, label)
+    return run(lambda a, b: (a - b) ** 2, input, label,
+               name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    input, label = to_tensor_args(input, label)
+    return run(lambda p, t: -t * jnp.log(p + epsilon)
+               - (1 - t) * jnp.log(1 - p + epsilon), input, label,
+               name="log_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    raise NotImplementedError(
+        "ctc_loss lands with the audio subsystem (tracked in SURVEY §2.2)")

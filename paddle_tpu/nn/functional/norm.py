@@ -1,0 +1,213 @@
+"""Normalization functionals.
+
+Reference: `python/paddle/nn/functional/norm.py` → phi batch_norm/layer_norm
+kernels; fused rms_norm in `python/paddle/incubate/nn/functional/`.
+TPU-native: explicit jnp math — XLA fuses the whole normalization into one
+pass; a Pallas fused rmsnorm (paddle_tpu/ops) covers the hot LLM path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import run, to_tensor_args
+from ...framework.tensor import Tensor
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    (x,) = to_tensor_args(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    nd = len(normalized_shape)
+    axes = tuple(range(x.ndim - nd, x.ndim))
+
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+
+    def _fn(v, *wb):
+        # stats in fp32 for bf16 inputs (reference computes in fp32 too)
+        vf = v.astype(jnp.float32) if v.dtype in (jnp.bfloat16, jnp.float16) \
+            else v
+        mean = jnp.mean(vf, axis=axes, keepdims=True)
+        var = jnp.var(vf, axis=axes, keepdims=True)
+        out = (vf - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(v.dtype)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+    return run(_fn, *to_tensor_args(*args), name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (reference: incubate/nn/functional/fused_rms_norm.py).
+    Dispatches to the Pallas kernel on TPU via paddle_tpu.ops."""
+    from ...ops import rms_norm as _rms_impl
+    (x,) = to_tensor_args(x)
+    if weight is not None:
+        (weight,) = to_tensor_args(weight)
+        return run(lambda v, w: _rms_impl(v, w, epsilon), x, weight,
+                   name="rms_norm")
+    return run(lambda v: _rms_impl(v, None, epsilon), x, name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    (x,) = to_tensor_args(x)
+    chan_last = data_format[-1] == "C" and x.ndim > 2
+    c_ax = x.ndim - 1 if chan_last else (1 if x.ndim > 1 else 0)
+    red_axes = tuple(a for a in range(x.ndim) if a != c_ax)
+    shape = [1] * x.ndim
+    shape[c_ax] = x.shape[c_ax]
+
+    use_batch = training and not use_global_stats
+
+    if use_batch:
+        vf = x.value.astype(jnp.float32)
+        bm = jnp.mean(vf, axis=red_axes)
+        bv = jnp.var(vf, axis=red_axes)
+        # update running stats in place (host-side, eager only — compiled
+        # trainers thread state functionally; see nn/layer/norm.py)
+        if running_mean is not None and not isinstance(
+                x.value, jax.core.Tracer):
+            rm = running_mean.value.astype(jnp.float32)
+            rv = running_var.value.astype(jnp.float32)
+            running_mean._value = (momentum * rm + (1 - momentum) * bm
+                                   ).astype(running_mean.value.dtype)
+            n = 1
+            for a in red_axes:
+                n *= x.shape[a]
+            unbiased = bv * n / max(n - 1, 1)
+            running_var._value = (momentum * rv + (1 - momentum) * unbiased
+                                  ).astype(running_var.value.dtype)
+        mean_arr, var_arr = bm, bv
+    else:
+        mean_arr = running_mean.value.astype(jnp.float32)
+        var_arr = running_var.value.astype(jnp.float32)
+
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+
+    def _fn(v, *wb):
+        vf = v.astype(jnp.float32)
+        out = (vf - mean_arr.reshape(shape)) * jax.lax.rsqrt(
+            var_arr.reshape(shape) + epsilon)
+        out = out.astype(v.dtype)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+    return run(_fn, *to_tensor_args(*args), name="batch_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    (x,) = to_tensor_args(x)
+    c_ax = 1
+    red_axes = tuple(range(2, x.ndim))
+    shape = [1] * x.ndim
+    shape[c_ax] = x.shape[c_ax]
+
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+
+    def _fn(v, *wb):
+        vf = v.astype(jnp.float32)
+        mean = jnp.mean(vf, axis=red_axes, keepdims=True)
+        var = jnp.var(vf, axis=red_axes, keepdims=True)
+        out = ((vf - mean) * jax.lax.rsqrt(var + eps)).astype(v.dtype)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+    return run(_fn, *to_tensor_args(*args), name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    (x,) = to_tensor_args(x)
+    chan_last = data_format[-1] == "C" and x.ndim > 2
+    c_ax = x.ndim - 1 if chan_last else 1
+    c = x.shape[c_ax]
+    shape = [1] * x.ndim
+    shape[c_ax] = c
+
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+
+    def _fn(v, *wb):
+        vf = v.astype(jnp.float32)
+        if chan_last:
+            vm = jnp.moveaxis(vf, -1, 1)
+        else:
+            vm = vf
+        n = vm.shape[0]
+        g = vm.reshape(n, num_groups, c // num_groups, *vm.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = (g - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.reshape(vm.shape)
+        if chan_last:
+            out = jnp.moveaxis(out, 1, -1)
+        out = out.astype(v.dtype)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+    return run(_fn, *to_tensor_args(*args), name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    (x,) = to_tensor_args(x)
+
+    def _fn(v):
+        sq = v * v
+        c_ax = 1 if data_format[1] == "C" else v.ndim - 1
+        sqm = jnp.moveaxis(sq, c_ax, -1)
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        padded = jnp.pad(sqm, [(0, 0)] * (sqm.ndim - 1) + [(pad_lo, pad_hi)])
+        windows = jnp.stack([padded[..., i:i + sqm.shape[-1]]
+                             for i in range(size)], axis=0)
+        summed = jnp.sum(windows, axis=0)
+        summed = jnp.moveaxis(summed, -1, c_ax)
+        div = jnp.power(k + alpha * summed, beta)
+        return v / div
+    return run(_fn, x, name="local_response_norm")

@@ -1,0 +1,175 @@
+"""Pooling functionals.
+
+Reference: `python/paddle/nn/functional/pooling.py` → phi pool kernels.
+TPU-native: `jax.lax.reduce_window`.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import run, to_tensor_args
+from .conv import _tuplize
+
+
+def _pool_pad(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if all(isinstance(p, int) for p in padding):
+        if len(padding) == nd:
+            return [(p, p) for p in padding]
+        if len(padding) == 2 * nd:
+            return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    flat = [tuple(p) for p in padding]
+    if len(flat) == nd + 2:
+        flat = flat[2:]
+    return flat
+
+
+def _reduce_window(v, init, op, window, strides, pads, chan_last, nd):
+    if chan_last:
+        full_window = (1,) + window + (1,)
+        full_strides = (1,) + strides + (1,)
+        full_pads = ((0, 0),) + tuple(pads) + ((0, 0),) \
+            if not isinstance(pads, str) else pads
+    else:
+        full_window = (1, 1) + window
+        full_strides = (1, 1) + strides
+        full_pads = ((0, 0), (0, 0)) + tuple(pads) \
+            if not isinstance(pads, str) else pads
+    return jax.lax.reduce_window(v, init, op, full_window, full_strides,
+                                 full_pads)
+
+
+def _pool(x, kernel_size, stride, padding, nd, data_format, mode,
+          ceil_mode=False, exclusive=True, count_include_pad=None):
+    (x,) = to_tensor_args(x)
+    window = _tuplize(kernel_size, nd)
+    strides = _tuplize(stride if stride is not None else kernel_size, nd)
+    pads = _pool_pad(padding, nd)
+    chan_last = data_format[-1] == "C"
+    if count_include_pad is not None:
+        exclusive = not count_include_pad
+
+    def _fn(v):
+        if mode == "max":
+            if jnp.issubdtype(v.dtype, jnp.integer):
+                init = int(jnp.iinfo(v.dtype).min)
+            else:
+                init = -jnp.inf
+            return _reduce_window(v, init, jax.lax.max, window, strides,
+                                  pads, chan_last, nd)
+        # avg
+        summed = _reduce_window(v, 0.0, jax.lax.add, window, strides, pads,
+                                chan_last, nd)
+        if isinstance(pads, str) or not exclusive:
+            denom = float(np.prod(window))
+            return summed / jnp.asarray(denom, v.dtype)
+        ones = jnp.ones(v.shape, v.dtype)
+        counts = _reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                pads, chan_last, nd)
+        return summed / counts
+    return run(_fn, x, name=f"{mode}_pool{nd}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format, "max",
+                 ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "max",
+                 ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "max",
+                 ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format, "avg",
+                 ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg",
+                 ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg",
+                 ceil_mode, exclusive)
+
+
+def _adaptive_pool(x, output_size, nd, data_format, mode):
+    (x,) = to_tensor_args(x)
+    chan_last = data_format[-1] == "C"
+    out_sizes = _tuplize(output_size, nd)
+
+    def _fn(v):
+        spatial_axes = list(range(1, 1 + nd)) if chan_last \
+            else list(range(2, 2 + nd))
+        out = v
+        for ax_i, ax in enumerate(spatial_axes):
+            osz = out_sizes[ax_i]
+            if osz is None:
+                continue
+            isz = out.shape[ax]
+            if isz % osz == 0:
+                k = isz // osz
+                shp = list(out.shape)
+                shp[ax:ax + 1] = [osz, k]
+                r = out.reshape(shp)
+                out = (jnp.max(r, axis=ax + 1) if mode == "max"
+                       else jnp.mean(r, axis=ax + 1))
+            else:
+                # general adaptive: variable windows via segment means
+                starts = (np.arange(osz) * isz) // osz
+                ends = ((np.arange(osz) + 1) * isz + osz - 1) // osz
+                pieces = []
+                for s, e in zip(starts, ends):
+                    seg = jnp.take(out, jnp.arange(s, e), axis=ax)
+                    red = (jnp.max(seg, axis=ax, keepdims=True)
+                           if mode == "max"
+                           else jnp.mean(seg, axis=ax, keepdims=True))
+                    pieces.append(red)
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+    return run(_fn, x, name=f"adaptive_{mode}_pool{nd}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", "max")

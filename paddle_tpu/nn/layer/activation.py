@@ -1,0 +1,90 @@
+"""Activation layers.  Reference: `python/paddle/nn/layer/activation.py`."""
+from __future__ import annotations
+
+from .layers import Layer
+from .. import functional as F
+from .. import initializer as I
+
+__all__ = ["ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax",
+           "LogSoftmax", "LeakyReLU", "PReLU", "ELU", "CELU", "SELU", "SiLU",
+           "Swish", "Mish", "Hardtanh", "Hardsigmoid", "Hardswish",
+           "Hardshrink", "Softshrink", "Softplus", "Softsign", "Tanhshrink",
+           "ThresholdedReLU", "LogSigmoid", "Maxout", "GLU", "RReLU"]
+
+
+def _mk(fname, cname, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = {**fixed}
+            sig_names = _SIGS.get(cname, [])
+            for n, a in zip(sig_names, args):
+                self._kwargs[n] = a
+            for k, v in kwargs.items():
+                if k != "name":
+                    self._kwargs[k] = v
+
+        def forward(self, x):
+            return getattr(F, fname)(x, **self._kwargs)
+    _Act.__name__ = cname
+    _Act.__qualname__ = cname
+    return _Act
+
+
+_SIGS = {
+    "Softmax": ["axis"], "LogSoftmax": ["axis"],
+    "LeakyReLU": ["negative_slope"], "ELU": ["alpha"], "CELU": ["alpha"],
+    "Hardtanh": ["min", "max"], "Hardshrink": ["threshold"],
+    "Softshrink": ["threshold"], "Softplus": ["beta", "threshold"],
+    "ThresholdedReLU": ["threshold", "value"], "Maxout": ["groups", "axis"],
+    "GLU": ["axis"], "GELU": ["approximate"], "RReLU": ["lower", "upper"],
+}
+
+ReLU = _mk("relu", "ReLU")
+ReLU6 = _mk("relu6", "ReLU6")
+GELU = _mk("gelu", "GELU")
+Sigmoid = _mk("sigmoid", "Sigmoid")
+Tanh = _mk("tanh", "Tanh")
+Softmax = _mk("softmax", "Softmax")
+LogSoftmax = _mk("log_softmax", "LogSoftmax")
+LeakyReLU = _mk("leaky_relu", "LeakyReLU")
+ELU = _mk("elu", "ELU")
+CELU = _mk("celu", "CELU")
+SELU = _mk("selu", "SELU")
+SiLU = _mk("silu", "SiLU")
+Swish = _mk("swish", "Swish")
+Mish = _mk("mish", "Mish")
+Hardtanh = _mk("hardtanh", "Hardtanh")
+Hardsigmoid = _mk("hardsigmoid", "Hardsigmoid")
+Hardswish = _mk("hardswish", "Hardswish")
+Hardshrink = _mk("hardshrink", "Hardshrink")
+Softshrink = _mk("softshrink", "Softshrink")
+Softplus = _mk("softplus", "Softplus")
+Softsign = _mk("softsign", "Softsign")
+Tanhshrink = _mk("tanhshrink", "Tanhshrink")
+ThresholdedReLU = _mk("thresholded_relu", "ThresholdedReLU")
+LogSigmoid = _mk("log_sigmoid", "LogSigmoid")
+Maxout = _mk("maxout", "Maxout")
+GLU = _mk("glu", "GLU")
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

@@ -1,0 +1,212 @@
+"""Recurrent layers.
+
+Reference: `python/paddle/nn/layer/rnn.py` (SimpleRNN/LSTM/GRU + cells).
+TPU-native: the time loop is `jax.lax.scan` — single compiled kernel, no
+per-step dispatch (the reference uses cuDNN fused RNNs; scan + XLA fusion is
+the TPU analog).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+from .. import initializer as I
+from ... import tensor as pten
+from ...framework.tensor import Tensor
+from ...framework.dispatch import run, to_tensor_args
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+           "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def _make_params(self, input_size, hidden_size, gates):
+        k = 1.0 / np.sqrt(hidden_size)
+        init = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter(
+            [gates * hidden_size, input_size], default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [gates * hidden_size, hidden_size], default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [gates * hidden_size], is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [gates * hidden_size], is_bias=True, default_initializer=init)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self._make_params(input_size, hidden_size, 1)
+
+    @staticmethod
+    def _step(x, h, wih, whh, bih, bhh, activation="tanh"):
+        act = jnp.tanh if activation == "tanh" else jax.nn.relu
+        return act(x @ wih.T + bih + h @ whh.T + bhh)
+
+    def forward(self, inputs, states=None):
+        (inputs,) = to_tensor_args(inputs)
+        if states is None:
+            states = pten.zeros([inputs.shape[0], self.hidden_size])
+        out = run(lambda x, h, a, b, c, d: self._step(
+            x, h, a, b, c, d, self.activation), inputs, states,
+            self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self._make_params(input_size, hidden_size, 4)
+
+    @staticmethod
+    def _step(x, h, c, wih, whh, bih, bhh):
+        z = x @ wih.T + bih + h @ whh.T + bhh
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+
+    def forward(self, inputs, states=None):
+        (inputs,) = to_tensor_args(inputs)
+        if states is None:
+            h = pten.zeros([inputs.shape[0], self.hidden_size])
+            c = pten.zeros([inputs.shape[0], self.hidden_size])
+        else:
+            h, c = states
+        h_new, c_new = run(self._step, inputs, h, c, self.weight_ih,
+                           self.weight_hh, self.bias_ih, self.bias_hh)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self._make_params(input_size, hidden_size, 3)
+
+    @staticmethod
+    def _step(x, h, wih, whh, bih, bhh):
+        zi = x @ wih.T + bih
+        zh = h @ whh.T + bhh
+        ri, ui, ci = jnp.split(zi, 3, axis=-1)
+        rh, uh, ch = jnp.split(zh, 3, axis=-1)
+        r = jax.nn.sigmoid(ri + rh)
+        u = jax.nn.sigmoid(ui + uh)
+        c = jnp.tanh(ci + r * ch)
+        return (1 - u) * c + u * h
+
+    def forward(self, inputs, states=None):
+        (inputs,) = to_tensor_args(inputs)
+        if states is None:
+            states = pten.zeros([inputs.shape[0], self.hidden_size])
+        h_new = run(self._step, inputs, states, self.weight_ih,
+                    self.weight_hh, self.bias_ih, self.bias_hh)
+        return h_new, h_new
+
+
+class RNN(Layer):
+    """Wrap a cell into a scan over time (reference: nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        (inputs,) = to_tensor_args(inputs)
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        outputs = []
+        states = initial_states
+        rng = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in rng:
+            xt = inputs[t] if self.time_major else inputs[:, t]
+            out, states = self.cell(xt, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        out = pten.stack(outputs, axis=time_axis)
+        return out, states
+
+
+class _MultiLayerRNN(Layer):
+    CELL = None
+    STATE_N = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        self.dropout = dropout
+        from .container import LayerList
+        cells_fw, cells_bw = [], []
+        for l in range(num_layers):
+            isz = input_size if l == 0 else hidden_size * (
+                2 if self.bidirect else 1)
+            cells_fw.append(self._make_cell(isz, hidden_size, activation))
+            if self.bidirect:
+                cells_bw.append(self._make_cell(isz, hidden_size, activation))
+        self.cells_fw = LayerList(cells_fw)
+        self.cells_bw = LayerList(cells_bw) if self.bidirect else None
+
+    def _make_cell(self, isz, hsz, activation):
+        if type(self).CELL is SimpleRNNCell:
+            return SimpleRNNCell(isz, hsz, activation)
+        return type(self).CELL(isz, hsz)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        final_h, final_c = [], []
+        for l in range(self.num_layers):
+            fw = RNN(self.cells_fw[l], time_major=self.time_major)
+            o_fw, s_fw = fw(out)
+            if self.bidirect:
+                bw = RNN(self.cells_bw[l], is_reverse=True,
+                         time_major=self.time_major)
+                o_bw, s_bw = bw(out)
+                out = pten.concat([o_fw, o_bw], axis=-1)
+                ss = [s_fw, s_bw]
+            else:
+                out = o_fw
+                ss = [s_fw]
+            for s in ss:
+                if isinstance(s, tuple):
+                    final_h.append(s[0])
+                    final_c.append(s[1])
+                else:
+                    final_h.append(s)
+        h = pten.stack(final_h, axis=0)
+        if final_c:
+            return out, (h, pten.stack(final_c, axis=0))
+        return out, h
+
+
+class SimpleRNN(_MultiLayerRNN):
+    CELL = SimpleRNNCell
+
+
+class LSTM(_MultiLayerRNN):
+    CELL = LSTMCell
+
+
+class GRU(_MultiLayerRNN):
+    CELL = GRUCell

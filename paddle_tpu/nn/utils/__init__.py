@@ -1,0 +1,106 @@
+"""nn.utils — weight_norm / spectral_norm / parameters_to_vector.
+
+Reference: `python/paddle/nn/utils/`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, Parameter
+from ... import tensor as pten
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v|| (reference: utils/weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    if dim is None:
+        norm = jnp.sqrt(jnp.sum(jnp.square(w.value)))
+        g0 = norm.reshape(())
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != dim)
+        g0 = jnp.sqrt(jnp.sum(jnp.square(w.value), axis=axes))
+    v = Parameter(w.value)
+    g = Parameter(g0)
+    layer.add_parameter(name + "_v", v)
+    layer.add_parameter(name + "_g", g)
+    del layer._parameters[name]
+
+    def _compute():
+        vv = layer._parameters[name + "_v"]
+        gg = layer._parameters[name + "_g"]
+        if dim is None:
+            nrm = pten.norm(vv)
+            return pten.multiply(pten.divide(vv, nrm), gg)
+        axes = [i for i in range(vv.ndim) if i != dim]
+        nrm = pten.sqrt(pten.sum(pten.multiply(vv, vv), axis=axes,
+                                 keepdim=True))
+        shape = [1] * vv.ndim
+        shape[dim] = -1
+        return pten.multiply(pten.divide(vv, nrm), pten.reshape(gg, shape))
+
+    def pre_hook(l, inputs):
+        object.__setattr__(l, name, _compute())
+        return None
+    handle = layer.register_forward_pre_hook(pre_hook)
+    layer.__dict__["_weight_norm_handle_" + name] = handle
+    object.__setattr__(layer, name, _compute())
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    handle = layer.__dict__.pop("_weight_norm_handle_" + name, None)
+    if handle is not None:
+        handle.remove()
+    v = layer._parameters.pop(name + "_v")
+    g = layer._parameters.pop(name + "_g")
+    if g.ndim == 0:
+        w = (v.value / jnp.sqrt(jnp.sum(jnp.square(v.value)))) * g.value
+    else:
+        dim = next(i for i, s in enumerate(v.shape)
+                   if s == g.shape[0]) if g.ndim else 0
+        axes = tuple(i for i in range(v.ndim) if i != dim)
+        nrm = jnp.sqrt(jnp.sum(jnp.square(v.value), axis=axes,
+                               keepdims=True))
+        shape = [1] * v.ndim
+        shape[dim] = -1
+        w = v.value / nrm * g.value.reshape(shape)
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, Parameter(w))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    from ..layer.norm import SpectralNorm
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = SpectralNorm(w.shape, dim=dim, power_iters=n_power_iterations,
+                      epsilon=eps)
+    orig = Parameter(w.value)
+    layer.add_parameter(name + "_orig", orig)
+    del layer._parameters[name]
+    layer.add_sublayer(name + "_spectral_norm", sn)
+
+    def pre_hook(l, inputs):
+        object.__setattr__(l, name,
+                           sn(l._parameters[name + "_orig"]))
+        return None
+    layer.register_forward_pre_hook(pre_hook)
+    object.__setattr__(layer, name, sn(orig))
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    return pten.concat([pten.reshape(p, [-1]) for p in parameters])
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p._value = vec.value[offset:offset + n].reshape(p.value.shape)
+        offset += n

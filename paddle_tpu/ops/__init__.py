@@ -1,0 +1,178 @@
+"""paddle_tpu.ops — the hot-kernel layer.
+
+Reference: `paddle/phi/kernels/fusion/gpu/` (fused_attention, fused_rms_norm,
+fused_rope, flash_attn via external lib) — hand-written CUDA.
+
+TPU-native: each op has an XLA reference implementation (jnp) and, where it
+pays, a Pallas TPU kernel (paddle_tpu/ops/pallas/).  Dispatch picks Pallas on
+TPU backends and XLA elsewhere; `set_attention_backend` forces a choice
+(used by nn.functional.sdp_kernel).  All functions here take/return raw
+jax.Arrays — the Tensor wrapper layer calls them through dispatch.run so
+eager autograd and jit tracing both work.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["attention", "rms_norm", "layer_norm", "rope", "apply_rope",
+           "swiglu", "get_attention_backend", "set_attention_backend"]
+
+_attention_backend = "auto"  # auto | pallas | xla
+
+
+def get_attention_backend():
+    return _attention_backend
+
+
+def set_attention_backend(b):
+    global _attention_backend
+    _attention_backend = b
+
+
+def _on_tpu(*arrays) -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+def xla_attention(q, k, v, mask=None, causal=False, scale=None,
+                  dropout_p=0.0):
+    """Reference math of phi flash_attn kernel, XLA-fused.
+    q/k/v: [b, s, h, d] (paddle flash-attn layout).  fp32 softmax."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    hk = k.shape[2]
+    if hk != h:  # grouped-query attention: repeat kv heads
+        rep = h // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * s
+    if causal:
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm[None, None], logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    w = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0:
+        from ..framework.random import next_key
+        keep = jax.random.bernoulli(next_key(), 1.0 - dropout_p, w.shape)
+        w = w * keep / (1.0 - dropout_p)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def attention(q, k, v, mask=None, causal=False, scale=None, dropout_p=0.0):
+    backend = _attention_backend
+    if backend == "auto":
+        backend = "pallas" if (_on_tpu() and mask is None
+                               and dropout_p == 0.0) else "xla"
+    if backend == "pallas" and mask is None and dropout_p == 0.0:
+        try:
+            from .pallas.flash_attention import flash_attention as _pfa
+            return _pfa(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            pass
+    return xla_attention(q, k, v, mask, causal, scale, dropout_p)
+
+
+# ---------------------------------------------------------------------------
+# rms_norm / layer_norm
+# ---------------------------------------------------------------------------
+def xla_rms_norm(x, weight=None, epsilon=1e-6):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    """Reference: incubate fused_rms_norm (phi fused kernel).  Pallas kernel
+    on TPU for the [*, hidden] LLM case."""
+    if _on_tpu() and weight is not None and x.ndim >= 2:
+        try:
+            from .pallas.rms_norm import rms_norm as _prn
+            return _prn(x, weight, epsilon)
+        except Exception:
+            pass
+    return xla_rms_norm(x, weight, epsilon)
+
+
+def layer_norm(x, weight=None, bias=None, epsilon=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rotary position embedding
+# ---------------------------------------------------------------------------
+def rope_cos_sin(seq_len, head_dim, base=10000.0, dtype=jnp.float32,
+                 position_ids=None):
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                          dtype=jnp.float32) / head_dim))
+    pos = (jnp.arange(seq_len, dtype=jnp.float32) if position_ids is None
+           else position_ids.astype(jnp.float32))
+    freqs = jnp.einsum("...s,d->...sd", pos, inv_freq)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(q, k, cos, sin):
+    """Reference: incubate fused_rotary_position_embedding (NeoX-style
+    rotate-half, matching paddle's use_neox_rotary_style=True).
+    q/k: [b, s, h, d]; cos/sin: [s, d] or [b, s, d]."""
+    if cos.ndim == 2:      # [s, d] → [1, s, 1, d]
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    elif cos.ndim == 3:    # [b, s, d] → [b, s, 1, d]
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    cosf = cos.astype(jnp.float32)
+    sinf = sin.astype(jnp.float32)
+    q_out = (qf * cosf + _rotate_half(qf) * sinf).astype(q.dtype)
+    k_out = (kf * cosf + _rotate_half(kf) * sinf).astype(k.dtype)
+    return q_out, k_out
+
+
+def rope(q, k, seq_len=None, base=10000.0, position_ids=None):
+    sl = seq_len if seq_len is not None else q.shape[1]
+    cos, sin = rope_cos_sin(sl, q.shape[-1], base,
+                            position_ids=position_ids)
+    return apply_rope(q, k, cos, sin)
+
+
+# ---------------------------------------------------------------------------
+# swiglu
+# ---------------------------------------------------------------------------
+def swiglu(x, gate=None):
+    if gate is None:
+        half = x.shape[-1] // 2
+        x, gate = x[..., :half], x[..., half:]
+    return jax.nn.silu(x) * gate

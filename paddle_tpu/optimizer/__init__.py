@@ -1,0 +1,4 @@
+"""paddle_tpu.optimizer — reference: python/paddle/optimizer/."""
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW,  # noqa: F401
+                        Adagrad, Adadelta, RMSProp, Lamb)
+from . import lr  # noqa: F401

@@ -1,0 +1,427 @@
+"""Optimizer base + concrete optimizers.
+
+Reference: `python/paddle/optimizer/optimizer.py:127` (Optimizer —
+accumulators, `_apply_optimize`, grad-clip hook), `adamw.py:49` (AdamW),
+sgd/momentum/adam/lamb/adagrad/rmsprop, fused multi-tensor adamw phi kernel.
+
+TPU-native: each optimizer defines a PURE `update(param, grad, state, lr,
+...) -> (new_param, new_state)` in raw jnp — reused verbatim by (a) the
+eager `step()` here, fused across all params in ONE jitted call (the
+multi_tensor / fused-adamw analog: XLA fuses the whole update sweep), and
+(b) the compiled trainer (paddle_tpu.jit), where it runs inside the train
+step with donated buffers.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, Parameter
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+           "Adadelta", "RMSProp", "Lamb"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kwargs):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in dygraph mode (pass "
+                "model.parameters())")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay
+        self._accumulators: Dict[int, dict] = {}
+        self._step_count = 0
+        self._master_weights: Dict[int, jnp.ndarray] = {}
+        self._multi_precision = kwargs.get("multi_precision", False)
+        self._name = name
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    @property
+    def _learning_rate_scheduler(self):
+        return self._learning_rate if isinstance(
+            self._learning_rate, LRScheduler) else None
+
+    # -- state -------------------------------------------------------------
+    def _state_for(self, p: Parameter) -> dict:
+        key = id(p)
+        if key not in self._accumulators:
+            self._accumulators[key] = self._init_state(p)
+        return self._accumulators[key]
+
+    def _init_state(self, p: Parameter) -> dict:
+        return {}
+
+    # -- the pure update rule (override) -----------------------------------
+    @staticmethod
+    def _update(param, grad, state, lr, wd, step, **hp):
+        raise NotImplementedError
+
+    def _hyper(self) -> dict:
+        return {}
+
+    def _wd_value(self, p) -> float:
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if isinstance(wd, (int, float)):
+            return float(wd)
+        # L2Decay regularizer object
+        return float(getattr(wd, "_coeff", getattr(wd, "coeff", 0.0)))
+
+    # -- step --------------------------------------------------------------
+    def _collect_params_grads(self):
+        out = []
+        for p in self._parameter_list:
+            if p is None or p.stop_gradient:
+                continue
+            g = p.grad
+            if g is None:
+                continue
+            out.append((p, g))
+        return out
+
+    def step(self):
+        params_grads = self._collect_params_grads()
+        if not params_grads:
+            self._step_count += 1
+            return
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        lr = self.get_lr()
+        hp = self._hyper()
+        for p, g in params_grads:
+            state = self._state_for(p)
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0) \
+                if hasattr(p, "optimize_attr") else lr
+            wd = self._wd_value(p)
+            if hasattr(self, "_apply_decay_param_fun") \
+                    and self._apply_decay_param_fun is not None \
+                    and not self._apply_decay_param_fun(p.name or ""):
+                wd = 0.0
+            gval = g.value
+            pval = p.value
+            use_master = (self._multi_precision
+                          and pval.dtype in (jnp.float16, jnp.bfloat16))
+            if use_master:
+                mk = id(p)
+                if mk not in self._master_weights:
+                    self._master_weights[mk] = pval.astype(jnp.float32)
+                master = self._master_weights[mk]
+                new_master, new_state = type(self)._update(
+                    master, gval.astype(jnp.float32), state, plr, wd,
+                    self._step_count, **hp)
+                self._master_weights[mk] = new_master
+                p._value = new_master.astype(pval.dtype)
+            else:
+                new_p, new_state = type(self)._update(
+                    pval, gval, state, plr, wd, self._step_count, **hp)
+                p._value = new_p
+            self._accumulators[id(p)] = new_state
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            if p is not None:
+                p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def backward(self, loss, **kwargs):
+        loss.backward()
+        return self._collect_params_grads()
+
+    def apply_gradients(self, params_grads):
+        for p, g in params_grads:
+            p.grad = g
+        self.step()
+
+    # -- checkpoint --------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for i, p in enumerate(self._parameter_list):
+            if id(p) in self._accumulators:
+                name = p.name or f"param_{i}"
+                for k, v in self._accumulators[id(p)].items():
+                    sd[f"{name}.{k}"] = Tensor(v)
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        sd["@step"] = self._step_count
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("@step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(
+                self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list):
+            name = p.name or f"param_{i}"
+            state = self._init_state(p)
+            found = False
+            for k in list(state):
+                sk = f"{name}.{k}"
+                if sk in state_dict:
+                    v = state_dict[sk]
+                    state[k] = v.value if isinstance(v, Tensor) \
+                        else jnp.asarray(v)
+                    found = True
+            if found:
+                self._accumulators[id(p)] = state
+
+
+class SGD(Optimizer):
+    """Reference: optimizer/sgd.py."""
+
+    @staticmethod
+    def _update(param, grad, state, lr, wd, step):
+        g = grad
+        if wd:
+            g = g + wd * param
+        return param - lr * g.astype(param.dtype), state
+
+
+class Momentum(Optimizer):
+    """Reference: optimizer/momentum.py."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, **kw)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p.value)}
+
+    def _hyper(self):
+        return {"mu": self._momentum, "nesterov": self._nesterov}
+
+    @staticmethod
+    def _update(param, grad, state, lr, wd, step, mu=0.9, nesterov=False):
+        g = grad
+        if wd:
+            g = g + wd * param
+        v = mu * state["velocity"] + g
+        if nesterov:
+            upd = g + mu * v
+        else:
+            upd = v
+        return param - lr * upd.astype(param.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    """Reference: optimizer/adam.py (L2 regularization folded into grad)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision=multi_precision, **kw)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros_like(p.value, jnp.float32),
+                "moment2": jnp.zeros_like(p.value, jnp.float32)}
+
+    def _hyper(self):
+        return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon,
+                "decoupled": False}
+
+    @staticmethod
+    def _update(param, grad, state, lr, wd, step, b1=0.9, b2=0.999,
+                eps=1e-8, decoupled=True):
+        gf = grad.astype(jnp.float32)
+        pf = param.astype(jnp.float32)
+        if wd and not decoupled:
+            gf = gf + wd * pf
+        m = b1 * state["moment1"] + (1 - b1) * gf
+        v = b2 * state["moment2"] + (1 - b2) * gf * gf
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        upd = mhat / (jnp.sqrt(vhat) + eps)
+        if wd and decoupled:
+            upd = upd + wd * pf
+        new_p = pf - lr * upd
+        return new_p.astype(param.dtype), {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Reference: optimizer/adamw.py:49 — decoupled weight decay."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name, **kw)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _hyper(self):
+        return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon,
+                "decoupled": True}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, **kw)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p.value, self._init_acc,
+                                        dtype=jnp.float32)}
+
+    def _hyper(self):
+        return {"eps": self._epsilon}
+
+    @staticmethod
+    def _update(param, grad, state, lr, wd, step, eps=1e-6):
+        gf = grad.astype(jnp.float32)
+        if wd:
+            gf = gf + wd * param.astype(jnp.float32)
+        acc = state["moment"] + gf * gf
+        new_p = param.astype(jnp.float32) - lr * gf / (jnp.sqrt(acc) + eps)
+        return new_p.astype(param.dtype), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, **kw)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p.value, jnp.float32),
+                "avg_squared_update": jnp.zeros_like(p.value, jnp.float32)}
+
+    def _hyper(self):
+        return {"eps": self._epsilon, "rho": self._rho}
+
+    @staticmethod
+    def _update(param, grad, state, lr, wd, step, eps=1e-6, rho=0.95):
+        gf = grad.astype(jnp.float32)
+        if wd:
+            gf = gf + wd * param.astype(jnp.float32)
+        eg = rho * state["avg_squared_grad"] + (1 - rho) * gf * gf
+        upd = (jnp.sqrt(state["avg_squared_update"] + eps)
+               / jnp.sqrt(eg + eps)) * gf
+        eu = rho * state["avg_squared_update"] + (1 - rho) * upd * upd
+        new_p = param.astype(jnp.float32) - lr * upd
+        return new_p.astype(param.dtype), {"avg_squared_grad": eg,
+                                           "avg_squared_update": eu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, **kw)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_state(self, p):
+        return {"mean_square": jnp.zeros_like(p.value, jnp.float32),
+                "mean_grad": jnp.zeros_like(p.value, jnp.float32),
+                "momentum": jnp.zeros_like(p.value, jnp.float32)}
+
+    def _hyper(self):
+        return {"rho": self._rho, "eps": self._epsilon,
+                "mu": self._momentum, "centered": self._centered}
+
+    @staticmethod
+    def _update(param, grad, state, lr, wd, step, rho=0.95, eps=1e-6,
+                mu=0.0, centered=False):
+        gf = grad.astype(jnp.float32)
+        if wd:
+            gf = gf + wd * param.astype(jnp.float32)
+        ms = rho * state["mean_square"] + (1 - rho) * gf * gf
+        mg = state["mean_grad"]
+        if centered:
+            mg = rho * mg + (1 - rho) * gf
+            denom = jnp.sqrt(ms - mg * mg + eps)
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = mu * state["momentum"] + lr * gf / denom
+        new_p = param.astype(jnp.float32) - mom
+        return new_p.astype(param.dtype), {"mean_square": ms,
+                                           "mean_grad": mg, "momentum": mom}
+
+
+class Lamb(Optimizer):
+    """Reference: optimizer/lamb.py — layerwise-adaptive AdamW."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, name, **kw)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros_like(p.value, jnp.float32),
+                "moment2": jnp.zeros_like(p.value, jnp.float32)}
+
+    def _hyper(self):
+        return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon}
+
+    @staticmethod
+    def _update(param, grad, state, lr, wd, step, b1=0.9, b2=0.999,
+                eps=1e-6):
+        gf = grad.astype(jnp.float32)
+        pf = param.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * gf
+        v = b2 * state["moment2"] + (1 - b2) * gf * gf
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * pf
+        w_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = pf - lr * ratio * r
+        return new_p.astype(param.dtype), {"moment1": m, "moment2": v}

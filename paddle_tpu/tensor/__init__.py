@@ -1,0 +1,82 @@
+"""paddle_tpu.tensor — the flat op namespace.
+
+Reference: `python/paddle/tensor/__init__.py` exposes ~600 functions and
+monkey-patches them onto Tensor as methods.  We do the same: every public
+function whose first parameter is a tensor becomes a Tensor method, so
+`x.matmul(y)`, `x.sum()`, `x.reshape([...])` work as in the reference.
+"""
+from __future__ import annotations
+
+import inspect
+
+from ..framework.tensor import Tensor, Parameter, to_tensor
+
+from . import creation
+from . import math
+from . import manipulation
+from . import linalg
+from . import logic
+from . import random
+from . import search
+from . import stat
+from . import einsum as einsum_mod
+from . import attribute
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .attribute import rank, is_floating_point, is_integer, is_complex  # noqa: F401
+
+# names that must not shadow Tensor's own properties/attrs
+_SKIP_METHODS = {
+    "shape", "dtype", "place", "grad", "name", "value", "to_tensor", "rank",
+    "clone", "numel", "T", "item", "tolist", "astype", "cast",
+}
+
+
+def _patch_tensor_methods():
+    mods = [creation, math, manipulation, linalg, logic, random, search,
+            stat, einsum_mod, attribute]
+    for mod in mods:
+        for fname in dir(mod):
+            if fname.startswith("_"):
+                continue
+            fn = getattr(mod, fname)
+            if not callable(fn) or inspect.isclass(fn):
+                continue
+            if fname in _SKIP_METHODS:
+                continue
+            if getattr(Tensor, fname, None) is not None and fname not in (
+                    "where",):
+                # don't clobber explicitly-defined dunders/methods
+                if fname in Tensor.__dict__ or fname in (
+                        "detach", "backward", "numpy"):
+                    continue
+            try:
+                params = list(inspect.signature(fn).parameters)
+            except (ValueError, TypeError):
+                continue
+            if not params:
+                continue
+            setattr(Tensor, fname, fn)
+    # explicit method aliases
+    Tensor.cast = manipulation.cast
+    Tensor.astype = manipulation.cast
+    Tensor.mean = math.mean
+    Tensor.sum = math.sum
+    Tensor.max = math.max
+    Tensor.min = math.min
+    Tensor.abs = math.abs
+    Tensor.clip = math.clip
+    Tensor.clone = creation.clone
+    Tensor.dim = lambda self: self.ndim
+    Tensor.unbind = manipulation.unstack
+
+
+_patch_tensor_methods()

@@ -1,0 +1,42 @@
+"""Tensor attribute helpers.  Reference: `python/paddle/tensor/attribute.py`."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.dispatch import to_tensor_args
+
+
+def rank(input):
+    (input,) = to_tensor_args(input)
+    return Tensor(jnp.asarray(input.ndim, jnp.int32))
+
+
+def shape(input):
+    (input,) = to_tensor_args(input)
+    return Tensor(jnp.asarray(input.shape, jnp.int32))
+
+
+def is_floating_point(x):
+    (x,) = to_tensor_args(x)
+    return x.dtype.is_floating_point()
+
+
+def is_integer(x):
+    (x,) = to_tensor_args(x)
+    return x.dtype.is_integer()
+
+
+def is_complex(x):
+    (x,) = to_tensor_args(x)
+    return x.dtype.is_complex()
+
+
+def imag(x, name=None):
+    from .math import imag as _imag
+    return _imag(x)
+
+
+def real(x, name=None):
+    from .math import real as _real
+    return _real(x)

@@ -1,0 +1,202 @@
+"""Tensor creation ops.
+
+Reference: `python/paddle/tensor/creation.py` (to_tensor, zeros, ones, full,
+arange, linspace, eye, tril/triu, meshgrid, diag, ...).  TPU-native: all
+lower to jnp constructors; default float dtype is float32 (paddle default),
+int dtype int64.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, to_tensor
+from ..framework import dtypes
+from ..framework.dispatch import run, to_tensor_args
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "tril", "triu", "meshgrid", "diag", "diagflat", "assign", "clone",
+    "tril_indices", "triu_indices", "complex", "polar", "one_hot",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape.value)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def _jdt(dtype, default="float32"):
+    return dtypes.to_jax(dtype if dtype is not None else default)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), _jdt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_list(shape), _jdt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = "int64"
+        else:
+            dtype = "float32"
+    return Tensor(jnp.full(_shape_list(shape), fill_value, _jdt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype, name)
+
+
+def zeros_like(x, dtype=None, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jnp.zeros_like(x.value, dtype=_jdt(dtype) if dtype else None))
+
+
+def ones_like(x, dtype=None, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jnp.ones_like(x.value, dtype=_jdt(dtype) if dtype else None))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jnp.full_like(x.value, fill_value,
+                                dtype=_jdt(dtype) if dtype else None))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(a):
+        return a.item() if isinstance(a, Tensor) else a
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dtype = "float32"
+        else:
+            dtype = "int64"
+    return Tensor(jnp.arange(start, end, step, dtype=_jdt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(a):
+        return a.item() if isinstance(a, Tensor) else a
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=_jdt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(a):
+        return a.item() if isinstance(a, Tensor) else a
+    return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)),
+                               base=_v(base), dtype=_jdt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_jdt(dtype)))
+
+
+def tril(x, diagonal=0, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.tril(v, k=diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.triu(v, k=diagonal), x, name="triu")
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_jdt(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_jdt(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    ts = to_tensor_args(*args)
+    outs = run(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *ts,
+               name="meshgrid")
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    (x,) = to_tensor_args(x)
+
+    def _fn(v):
+        if v.ndim == 1 and padding_value != 0:
+            n = v.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, v.dtype)
+            return base + jnp.diag(v - 0, k=offset) - jnp.diag(
+                jnp.full(v.shape, padding_value, v.dtype), k=offset) + 0
+        return jnp.diag(v, k=offset)
+    return run(_fn, x, name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.diagflat(v, k=offset), x, name="diagflat")
+
+
+def assign(x, output=None):
+    """paddle.assign — copy semantics."""
+    if not isinstance(x, Tensor):
+        x = to_tensor(x)
+    out = run(lambda v: v + jnp.zeros((), v.dtype) if _is_float(v.dtype)
+              else jnp.array(v), x, name="assign")
+    if output is not None:
+        output._value = out._value
+        output._set_ref(out._ref)
+        output.stop_gradient = out.stop_gradient
+        return output
+    return out
+
+
+def _is_float(d):
+    import ml_dtypes
+    return d == ml_dtypes.bfloat16 or jnp.issubdtype(d, jnp.floating)
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def complex(real, imag, name=None):
+    real, imag = to_tensor_args(real, imag)
+    return run(jax.lax.complex, real, imag, name="complex")
+
+
+def polar(abs_, angle, name=None):
+    abs_, angle = to_tensor_args(abs_, angle)
+    return run(lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)),
+               abs_, angle, name="polar")
+
+
+def one_hot(x, num_classes, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jax.nn.one_hot(x.value, num_classes, dtype=jnp.float32))

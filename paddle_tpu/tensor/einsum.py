@@ -1,0 +1,15 @@
+"""einsum.  Reference: `python/paddle/tensor/einsum.py` (1.1K LoC custom
+planner).  TPU-native: jnp.einsum — XLA's dot_general fusion beats a
+hand-rolled plan on MXU."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.dispatch import run, to_tensor_args
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    ts = to_tensor_args(*operands)
+    return run(lambda *vs: jnp.einsum(equation, *vs), *ts, name="einsum")

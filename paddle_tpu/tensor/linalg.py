@@ -1,0 +1,249 @@
+"""Linear algebra ops.
+
+Reference: `python/paddle/tensor/linalg.py`.  Decompositions lower to
+jnp.linalg (XLA custom calls on TPU); matmul-family ops stay on the MXU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.dispatch import run, to_tensor_args
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    (x,) = to_tensor_args(x)
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+
+    def _fn(v):
+        if axis is None:
+            flat = v.reshape(-1)
+            if p == "fro" or p == 2:
+                return jnp.sqrt(jnp.sum(flat * flat))
+            if p == np.inf or p == "inf":
+                return jnp.max(jnp.abs(flat))
+            if p == -np.inf:
+                return jnp.min(jnp.abs(flat))
+            if p == 0:
+                return jnp.sum((flat != 0).astype(v.dtype))
+            if p == 1:
+                return jnp.sum(jnp.abs(flat))
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p)), 1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(v * v, axis=ax, keepdims=keepdim))
+        if p == np.inf or p == "inf":
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        if p == 1:
+            return jnp.sum(jnp.abs(v), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=ax,
+                                 keepdims=keepdim), 1.0 / p)
+    return run(_fn, x, name="norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p, axis, keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.linalg.norm(v, ord=p, axis=tuple(axis),
+                                         keepdims=keepdim), x,
+               name="matrix_norm")
+
+
+def dist(x, y, p=2, name=None):
+    x, y = to_tensor_args(x, y)
+    return norm(x - y, p)
+
+
+def cond(x, p=None, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.linalg.cond(v, p=p), x, name="cond")
+
+
+def inverse(x, name=None):
+    (x,) = to_tensor_args(x)
+    return run(jnp.linalg.inv, x, name="inverse")
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian),
+               x, name="pinv")
+
+
+def det(x, name=None):
+    (x,) = to_tensor_args(x)
+    return run(jnp.linalg.det, x, name="det")
+
+
+def slogdet(x, name=None):
+    (x,) = to_tensor_args(x)
+    sign, logdet = run(lambda v: tuple(jnp.linalg.slogdet(v)), x,
+                       name="slogdet")
+    from .manipulation import stack
+    return stack([sign, logdet], axis=0)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    (x,) = to_tensor_args(x)
+    t = tol.item() if isinstance(tol, Tensor) else tol
+    return Tensor(jnp.linalg.matrix_rank(x.value, rtol=t).astype(jnp.int64))
+
+
+def matrix_power(x, n, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.linalg.matrix_power(v, n), x,
+               name="matrix_power")
+
+
+def qr(x, mode="reduced", name=None):
+    (x,) = to_tensor_args(x)
+    if mode == "r":
+        return run(lambda v: jnp.linalg.qr(v, mode="r"), x, name="qr")
+    q, r = run(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), x, name="qr")
+    return q, r
+
+
+def svd(x, full_matrices=False, name=None):
+    (x,) = to_tensor_args(x)
+    u, s, vh = run(lambda v: tuple(jnp.linalg.svd(
+        v, full_matrices=full_matrices)), x, name="svd")
+    # paddle returns V not V^H
+    from .manipulation import swapaxes
+    return u, s, swapaxes(vh, -1, -2)
+
+
+def svdvals(x, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.linalg.svd(v, compute_uv=False), x,
+               name="svdvals")
+
+
+def eig(x, name=None):
+    (x,) = to_tensor_args(x)
+    w, v = np.linalg.eig(np.asarray(x.value, np.float64
+                                    if x.value.dtype != jnp.complex64
+                                    else None))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    (x,) = to_tensor_args(x)
+    w, v = run(lambda u: tuple(jnp.linalg.eigh(u, UPLO=UPLO)), x, name="eigh")
+    return w, v
+
+
+def eigvals(x, name=None):
+    (x,) = to_tensor_args(x)
+    w = np.linalg.eigvals(np.asarray(x.value))
+    return Tensor(jnp.asarray(w))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x,
+               name="eigvalsh")
+
+
+def cholesky(x, upper=False, name=None):
+    (x,) = to_tensor_args(x)
+
+    def _fn(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return run(_fn, x, name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    x, y = to_tensor_args(x, y)
+
+    def _fn(b, chol):
+        c = jnp.swapaxes(chol, -1, -2) if upper else chol
+        return jax.scipy.linalg.cho_solve((c, True), b)
+    return run(_fn, x, y, name="cholesky_solve")
+
+
+def solve(x, y, name=None):
+    x, y = to_tensor_args(x, y)
+    return run(jnp.linalg.solve, x, y, name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    x, y = to_tensor_args(x, y)
+    return run(lambda a, b: jax.scipy.linalg.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular), x, y, name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = to_tensor_args(x, y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x.value, y.value, rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(rank.astype(jnp.int64)),
+            Tensor(sv))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x_t, = to_tensor_args(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(x_t.value)
+    piv = piv.astype(jnp.int32) + 1  # paddle returns 1-based pivots
+    info = jnp.zeros(x_t.value.shape[:-2], jnp.int32)
+    if get_infos:
+        return Tensor(lu_), Tensor(piv), Tensor(info)
+    return Tensor(lu_), Tensor(piv)
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = to_tensor_args(x, y)
+    if axis == 9:
+        cands = [i for i, s in enumerate(x.shape) if s == 3]
+        axis = cands[0] if cands else -1
+    return run(lambda a, b: jnp.cross(a, b, axis=axis), x, y, name="cross")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    (x,) = to_tensor_args(x)
+    w = np.asarray(weights.value) if weights is not None else None
+    hist, edges = np.histogramdd(np.asarray(x.value), bins=bins,
+                                 range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def multi_dot(x, name=None):
+    ts = to_tensor_args(*x)
+    return run(lambda *vs: jnp.linalg.multi_dot(vs), *ts, name="multi_dot")
+
+
+def matrix_exp(x, name=None):
+    (x,) = to_tensor_args(x)
+    return run(jax.scipy.linalg.expm, x, name="matrix_exp")
+
+
+def householder_product(x, tau, name=None):
+    x, tau = to_tensor_args(x, tau)
+
+    def _fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[:, i].at[i].set(1.0))
+            v = v.at[i].set(1.0)
+            h = eye - t[i] * jnp.outer(v, v)
+            return q @ h
+        q = jax.lax.fori_loop(0, n, body, eye)
+        return q[:, :n]
+    return run(_fn, x, tau, name="householder_product")

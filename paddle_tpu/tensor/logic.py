@@ -1,0 +1,72 @@
+"""Comparison / logical / bitwise ops.
+
+Reference: `python/paddle/tensor/logic.py`.  All outputs are
+non-differentiable (bool/int), so they bypass the tape.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.dispatch import to_tensor_args
+
+
+def _cmp(jfn, opname):
+    def op(x, y, name=None):
+        x, y = to_tensor_args(x, y)
+        return Tensor(jfn(x.value, y.value))
+    op.__name__ = opname
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+bitwise_left_shift = _cmp(jnp.left_shift, "bitwise_left_shift")
+bitwise_right_shift = _cmp(jnp.right_shift, "bitwise_right_shift")
+
+
+def logical_not(x, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jnp.logical_not(x.value))
+
+
+def bitwise_not(x, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jnp.bitwise_not(x.value))
+
+
+def equal_all(x, y, name=None):
+    x, y = to_tensor_args(x, y)
+    return Tensor(jnp.array_equal(x.value, y.value))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = to_tensor_args(x, y)
+    return Tensor(jnp.allclose(x.value, y.value, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = to_tensor_args(x, y)
+    return Tensor(jnp.isclose(x.value, y.value, rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+def is_empty(x, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,571 @@
+"""Shape/layout manipulation ops.
+
+Reference: `python/paddle/tensor/manipulation.py` (reshape, concat, split,
+squeeze, stack, tile, gather, scatter, ...).  TPU-native: static-shape jnp
+lowerings; advanced indexing maps to `.at[]` functional updates (XLA scatter),
+replacing in-place CUDA kernels.
+"""
+from __future__ import annotations
+
+import builtins
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework import dtypes
+from ..framework.dispatch import run, to_tensor_args
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape.value))
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def reshape(x, shape, name=None):
+    (x,) = to_tensor_args(x)
+    shp = _static_shape(shape)
+    return run(lambda v: jnp.reshape(v, shp), x, name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._value = out._value
+    x._set_ref(out._ref)
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    (x,) = to_tensor_args(x)
+    jd = dtypes.to_jax(shape_or_dtype)
+    return Tensor(x.value.view(jd))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    (x,) = to_tensor_args(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    ea = stop_axis % nd if nd else 0
+    shp = x.shape
+    new_shape = tuple(shp[:sa]) + (-1,) + tuple(shp[ea + 1:])
+    if nd == 0:
+        new_shape = (1,)
+    return run(lambda v: jnp.reshape(v, new_shape), x, name="flatten")
+
+
+def transpose(x, perm, name=None):
+    (x,) = to_tensor_args(x)
+    p = tuple(int(v) for v in perm)
+    return run(lambda v: jnp.transpose(v, p), x, name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.moveaxis(v, source, destination), x,
+               name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.swapaxes(v, axis0, axis1), x, name="swapaxes")
+
+
+transpose_ = transpose
+
+
+def t(x, name=None):
+    (x,) = to_tensor_args(x)
+    if x.ndim < 2:
+        return run(lambda v: v, x)
+    return run(lambda v: v.T, x, name="t")
+
+
+def unsqueeze(x, axis, name=None):
+    (x,) = to_tensor_args(x)
+    if isinstance(axis, Tensor):
+        axis = np.asarray(axis.value).tolist()
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return run(lambda v: jnp.expand_dims(v, ax), x, name="unsqueeze")
+
+
+unsqueeze_ = unsqueeze
+
+
+def squeeze(x, axis=None, name=None):
+    (x,) = to_tensor_args(x)
+
+    def _fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        ax = tuple(a for a in (axis if isinstance(axis, (list, tuple))
+                               else [axis]) if v.shape[a] == 1)
+        return jnp.squeeze(v, axis=ax) if ax else v
+    return run(_fn, x, name="squeeze")
+
+
+squeeze_ = squeeze
+
+
+def concat(x, axis=0, name=None):
+    ts = to_tensor_args(*x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return run(lambda *vs: jnp.concatenate(vs, axis=axis), *ts, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    ts = to_tensor_args(*x)
+    return run(lambda *vs: jnp.stack(vs, axis=axis), *ts, name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    (x,) = to_tensor_args(x)
+    n = num if num is not None else x.shape[axis]
+    outs = run(lambda v: tuple(jnp.moveaxis(v, axis, 0)[i] for i in range(n)),
+               x, name="unstack")
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    (x,) = to_tensor_args(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                    for s in num_or_sections]
+        n_unknown = sections.count(-1)
+        if n_unknown:
+            known = builtins.sum(s for s in sections if s != -1)
+            sections = [dim - known if s == -1 else s for s in sections]
+    offsets = np.cumsum([0] + sections)
+
+    def _fn(v):
+        return tuple(jax.lax.slice_in_dim(v, int(offsets[i]),
+                                          int(offsets[i + 1]), axis=axis)
+                     for i in range(len(sections)))
+    outs = run(_fn, x, name="split")
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    (x,) = to_tensor_args(x)
+    reps = _static_shape(repeat_times)
+    return run(lambda v: jnp.tile(v, reps), x, name="tile")
+
+
+def expand(x, shape, name=None):
+    (x,) = to_tensor_args(x)
+    shp = list(_static_shape(shape))
+    cur = x.shape
+    # -1 entries keep the original size (paddle semantics)
+    off = len(shp) - len(cur)
+    for i, s in enumerate(shp):
+        if s == -1:
+            shp[i] = cur[i - off]
+    return run(lambda v: jnp.broadcast_to(v, tuple(shp)), x, name="expand")
+
+
+def expand_as(x, y, name=None):
+    (x,) = to_tensor_args(x)
+    shp = tuple(y.shape)
+    return run(lambda v: jnp.broadcast_to(v, shp), x, name="expand_as")
+
+
+def broadcast_to(x, shape, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.broadcast_to(v, _static_shape(shape)), x,
+               name="broadcast_to")
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = to_tensor_args(*inputs)
+    outs = run(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *ts,
+               name="broadcast_tensors")
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def flip(x, axis, name=None):
+    (x,) = to_tensor_args(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return run(lambda v: jnp.flip(v, ax), x, name="flip")
+
+
+def roll(x, shifts, axis=None, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.roll(v, shifts, axis=axis), x, name="roll")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x, name="rot90")
+
+
+def slice(input, axes, starts, ends, name=None):
+    (input,) = to_tensor_args(input)
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+
+    def _fn(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(st, en)
+        return v[tuple(idx)]
+    return run(_fn, input, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    (x,) = to_tensor_args(x)
+
+    def _fn(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(int(st), int(en), int(sd))
+        return v[tuple(idx)]
+    return run(_fn, x, name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    (x,) = to_tensor_args(x)
+    shp = _static_shape(shape)
+    offs = _static_shape(offsets) if offsets is not None else (0,) * x.ndim
+    return run(lambda v: jax.lax.dynamic_slice(v, offs, shp), x, name="crop")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    (x,) = to_tensor_args(x)
+    if isinstance(pad, Tensor):
+        pad = np.asarray(pad.value).tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+
+    if len(pad) == 2 * nd:
+        # full per-dim spec, paddle order: dim0_lo, dim0_hi, ...
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec applies to trailing spatial dims, reversed pairs
+        # (paddle: [left, right, top, bottom, front, back] on last dims)
+        k = len(pad) // 2
+        width = [(0, 0)] * nd
+        if data_format.endswith("C") and nd >= 3:  # NLC/NHWC/NDHWC
+            spatial = list(range(1, 1 + k))
+        else:
+            spatial = list(range(nd - k, nd))
+        for i in range(k):
+            dim = spatial[k - 1 - i]
+            width[dim] = (pad[2 * i], pad[2 * i + 1])
+
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def _fn(v):
+        if jmode == "constant":
+            return jnp.pad(v, width, mode="constant", constant_values=value)
+        return jnp.pad(v, width, mode=jmode)
+    return run(_fn, x, name="pad")
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = to_tensor_args(x, index)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return run(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=axis), x,
+               index, name="gather")
+
+
+def gather_nd(x, index, name=None):
+    x, index = to_tensor_args(x, index)
+
+    def _fn(v, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return v[flat_idx]
+    return run(_fn, x, index, name="gather_nd")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = to_tensor_args(arr, indices)
+
+    def _fn(v, i):
+        i = i.astype(jnp.int32)
+        if broadcast:
+            tgt = list(v.shape)
+            tgt[axis] = i.shape[axis]
+            i = jnp.broadcast_to(i, tgt)
+        return jnp.take_along_axis(v, i, axis=axis)
+    return run(_fn, arr, indices, name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    arr, indices, values = to_tensor_args(arr, indices, values)
+
+    def _fn(v, i, val):
+        i = i.astype(jnp.int32)
+        val = jnp.broadcast_to(val, i.shape).astype(v.dtype)
+        dims = [jnp.arange(s) for s in i.shape]
+        mesh = jnp.meshgrid(*dims, indexing="ij")
+        mesh[axis] = i
+        idx = tuple(mesh)
+        at = v.at[idx]
+        if reduce == "assign":
+            return at.set(val)
+        if reduce in ("add", "sum"):
+            return at.add(val)
+        if reduce in ("mul", "multiply"):
+            return at.multiply(val)
+        if reduce == "amax":
+            return at.max(val)
+        if reduce == "amin":
+            return at.min(val)
+        raise ValueError(f"unknown reduce {reduce}")
+    return run(_fn, arr, indices, values, name="put_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = to_tensor_args(x, index, updates)
+
+    def _fn(v, i, u):
+        i = i.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return v.at[i].set(u.astype(v.dtype))
+        return v.at[i].set(jnp.zeros_like(u, v.dtype)).at[i].add(
+            u.astype(v.dtype))
+    return run(_fn, x, index, updates, name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._value = out._value
+    x._set_ref(out._ref)
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = to_tensor_args(x, index, updates)
+
+    def _fn(v, idx, u):
+        idx = idx.astype(jnp.int32)
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return v.at[flat_idx].add(u.astype(v.dtype))
+    return run(_fn, x, index, updates, name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = to_tensor_args(index, updates)
+    z = Tensor(jnp.zeros(_static_shape(shape), updates.value.dtype))
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = to_tensor_args(x, index)
+    return run(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=axis), x,
+               index, name="index_select")
+
+
+def index_sample(x, index):
+    x, index = to_tensor_args(x, index)
+    return run(lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32),
+                                                axis=1), x, index,
+               name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = to_tensor_args(x, index, value)
+
+    def _fn(v, i, u):
+        i = i.astype(jnp.int32)
+        vm = jnp.moveaxis(v, axis, 0)
+        um = jnp.moveaxis(u.astype(v.dtype), axis, 0)
+        return jnp.moveaxis(vm.at[i].add(um), 0, axis)
+    return run(_fn, x, index, value, name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x, value = to_tensor_args(x, value)
+    idx_ts = to_tensor_args(*indices)
+
+    def _fn(v, u, *idx):
+        idx = tuple(i.astype(jnp.int32) if i.dtype != jnp.bool_ else i
+                    for i in idx)
+        if accumulate:
+            return v.at[idx].add(u.astype(v.dtype))
+        return v.at[idx].set(u.astype(v.dtype))
+    return run(_fn, x, value, *idx_ts, name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    x, mask = to_tensor_args(x, mask)
+    # dynamic output shape — host-side (not jittable), like reference dygraph
+    return Tensor(x.value[np.asarray(mask.value)])
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = to_tensor_args(x, mask)
+    v = value.item() if isinstance(value, Tensor) else value
+    return run(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), x, mask,
+               name="masked_fill")
+
+
+def masked_scatter(x, mask, value, name=None):
+    x, mask, value = to_tensor_args(x, mask, value)
+    m = np.asarray(mask.value)
+    out = np.asarray(x.value).copy()
+    out[m] = np.asarray(value.value).reshape(-1)[: int(m.sum())]
+    return Tensor(jnp.asarray(out))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    (x,) = to_tensor_args(x)
+    res = np.unique(np.asarray(x.value), return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    (x,) = to_tensor_args(x)
+    arr = np.asarray(x.value)
+    if axis is None:
+        arr = arr.reshape(-1)
+        change = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        raise NotImplementedError("unique_consecutive with axis")
+    vals = arr[change]
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(np.cumsum(change) - 1)))
+    if return_counts:
+        idx = np.flatnonzero(change)
+        counts = np.diff(np.concatenate([idx, [arr.size]]))
+        outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    (x,) = to_tensor_args(x)
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats.value)
+        return Tensor(jnp.repeat(x.value, jnp.asarray(reps), axis=axis))
+    return run(lambda v: jnp.repeat(v, repeats, axis=axis), x,
+               name="repeat_interleave")
+
+
+def cast(x, dtype):
+    (x,) = to_tensor_args(x)
+    jd = dtypes.to_jax(dtype)
+    return run(lambda v: v.astype(jd), x, name="cast")
+
+
+def cast_(x, dtype):
+    out = cast(x, dtype)
+    x._value = out._value
+    x._set_ref(out._ref)
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def as_real(x, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jnp.stack([jnp.real(x.value), jnp.imag(x.value)], axis=-1))
+
+
+def as_complex(x, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x,
+               name="as_complex")
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = to_tensor_args(x, y)
+    if isinstance(axes, Tensor):
+        axes = np.asarray(axes.value).tolist()
+    return run(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y,
+               name="tensordot")
+
+
+def tolist(x):
+    return np.asarray(x.value).tolist()
+
+
+def numel(x, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jnp.asarray(int(np.prod(x.value.shape or (1,))), jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    (input,) = to_tensor_args(input)
+    size = index_num // nshards
+
+    def _fn(v):
+        shard = v // size
+        return jnp.where(shard == shard_id, v % size, ignore_value)
+    return run(_fn, input, name="shard_index")
+
+
+# -------------------------------------------------------------------------
+# __getitem__ / __setitem__ (reference: paddle/fluid/pybind/slice_utils.h)
+# -------------------------------------------------------------------------
+def _norm_index(idx):
+    """Convert Tensors inside an index expression to jax arrays."""
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx))
+    if isinstance(idx, Tensor):
+        v = idx.value
+        return v if v.dtype == jnp.bool_ else v.astype(jnp.int32)
+    if isinstance(idx, np.ndarray):
+        return jnp.asarray(idx)
+    return idx
+
+
+def _has_bool_mask(idx):
+    if isinstance(idx, tuple):
+        return builtins.any(_has_bool_mask(i) for i in idx)
+    return (hasattr(idx, "dtype") and getattr(idx, "dtype", None) == jnp.bool_
+            and getattr(idx, "ndim", 0) > 0)
+
+
+def _getitem(x, idx):
+    nidx = _norm_index(idx)
+    if _has_bool_mask(nidx):
+        # dynamic result shape → host-side gather (dygraph-only, like reference)
+        return Tensor(jnp.asarray(np.asarray(x.value)[
+            jax.tree_util.tree_map(lambda a: np.asarray(a)
+                                   if hasattr(a, "dtype") else a, nidx)]))
+    return run(lambda v: v[nidx], x, name="getitem")
+
+
+def _setitem(x, idx, value):
+    from ..framework.dispatch import run as _run
+    nidx = _norm_index(idx)
+    if not isinstance(value, Tensor):
+        value = Tensor(jnp.asarray(value))
+
+    def _fn(v, u):
+        return v.at[nidx].set(u.astype(v.dtype))
+    out = _run(_fn, x, value, name="setitem")
+    x._value = out._value
+    x._set_ref(out._ref)
+    x.stop_gradient = out.stop_gradient
+    return x

@@ -1,0 +1,443 @@
+"""Elementwise + reduction math ops.
+
+Reference: `python/paddle/tensor/math.py` (~6K LoC dispatching `_C_ops.*`).
+TPU-native: one-liner lowerings to jnp; autograd via the vjp tape in
+framework/dispatch.py.  Reductions keep paddle semantics (keepdim arg,
+axis=None → all axes).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework import dtypes
+from ..framework.dispatch import run, run_inplace, to_tensor_args
+
+
+def _unary(jfn, opname):
+    def op(x, name=None):
+        (x,) = to_tensor_args(x)
+        return run(jfn, x, name=opname)
+    op.__name__ = opname
+    op.__qualname__ = opname
+    return op
+
+
+def _binary(jfn, opname):
+    def op(x, y, name=None):
+        x, y = to_tensor_args(x, y)
+        return run(jfn, x, y, name=opname)
+    op.__name__ = opname
+    op.__qualname__ = opname
+    return op
+
+
+def _inplace_of(op, opname):
+    def ip(x, *args, **kwargs):
+        out = op(x, *args, **kwargs)
+        x._value = out._value
+        x._set_ref(out._ref)
+        x.stop_gradient = out.stop_gradient
+        return x
+    ip.__name__ = opname
+    return ip
+
+
+# ---- elementwise unary ----------------------------------------------------
+abs = _unary(jnp.abs, "abs")
+acos = _unary(jnp.arccos, "acos")
+acosh = _unary(jnp.arccosh, "acosh")
+asin = _unary(jnp.arcsin, "asin")
+asinh = _unary(jnp.arcsinh, "asinh")
+atan = _unary(jnp.arctan, "atan")
+atanh = _unary(jnp.arctanh, "atanh")
+ceil = _unary(jnp.ceil, "ceil")
+cos = _unary(jnp.cos, "cos")
+cosh = _unary(jnp.cosh, "cosh")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+floor = _unary(jnp.floor, "floor")
+frac = _unary(lambda v: v - jnp.trunc(v), "frac")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+log = _unary(jnp.log, "log")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+log2 = _unary(jnp.log2, "log2")
+neg = _unary(jnp.negative, "neg")
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+round = _unary(jnp.round, "round")
+rsqrt = _unary(jax.lax.rsqrt, "rsqrt")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+sign = _unary(jnp.sign, "sign")
+sin = _unary(jnp.sin, "sin")
+sinh = _unary(jnp.sinh, "sinh")
+sqrt = _unary(jnp.sqrt, "sqrt")
+square = _unary(jnp.square, "square")
+tan = _unary(jnp.tan, "tan")
+tanh = _unary(jnp.tanh, "tanh")
+trunc = _unary(jnp.trunc, "trunc")
+angle = _unary(jnp.angle, "angle")
+conj = _unary(jnp.conj, "conj")
+real = _unary(jnp.real, "real")
+imag = _unary(jnp.imag, "imag")
+i0 = _unary(jax.scipy.special.i0, "i0")
+i0e = _unary(jax.scipy.special.i0e, "i0e")
+i1 = _unary(jax.scipy.special.i1, "i1")
+i1e = _unary(jax.scipy.special.i1e, "i1e")
+
+exp_ = _inplace_of(exp, "exp_")
+sqrt_ = _inplace_of(sqrt, "sqrt_")
+rsqrt_ = _inplace_of(rsqrt, "rsqrt_")
+reciprocal_ = _inplace_of(reciprocal, "reciprocal_")
+sigmoid_ = _inplace_of(sigmoid, "sigmoid_")
+tanh_ = _inplace_of(tanh, "tanh_")
+round_ = _inplace_of(round, "round_")
+ceil_ = _inplace_of(ceil, "ceil_")
+floor_ = _inplace_of(floor, "floor_")
+neg_ = _inplace_of(neg, "neg_")
+abs_ = _inplace_of(abs, "abs_")
+
+# ---- elementwise binary ---------------------------------------------------
+add = _binary(jnp.add, "add")
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+divide = _binary(jnp.divide, "divide")
+floor_divide = _binary(jnp.floor_divide, "floor_divide")
+remainder = _binary(jnp.remainder, "remainder")
+mod = remainder
+floor_mod = remainder
+pow = _binary(jnp.power, "pow")
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+fmax = _binary(jnp.fmax, "fmax")
+fmin = _binary(jnp.fmin, "fmin")
+atan2 = _binary(jnp.arctan2, "atan2")
+hypot = _binary(jnp.hypot, "hypot")
+logaddexp = _binary(jnp.logaddexp, "logaddexp")
+nextafter = _binary(jnp.nextafter, "nextafter")
+copysign = _binary(jnp.copysign, "copysign")
+heaviside = _binary(jnp.heaviside, "heaviside")
+gcd = _binary(jnp.gcd, "gcd")
+lcm = _binary(jnp.lcm, "lcm")
+
+add_ = _inplace_of(add, "add_")
+subtract_ = _inplace_of(subtract, "subtract_")
+multiply_ = _inplace_of(multiply, "multiply_")
+divide_ = _inplace_of(divide, "divide_")
+remainder_ = _inplace_of(remainder, "remainder_")
+pow_ = _inplace_of(pow, "pow_")
+
+elementwise_add = add
+elementwise_sub = subtract
+elementwise_mul = multiply
+elementwise_div = divide
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    (x,) = to_tensor_args(x)
+    s = scale.item() if isinstance(scale, Tensor) else scale
+
+    def _fn(v):
+        if bias_after_scale:
+            return v * jnp.asarray(s, v.dtype) + jnp.asarray(bias, v.dtype)
+        return (v + jnp.asarray(bias, v.dtype)) * jnp.asarray(s, v.dtype)
+    out = run(_fn, x, name="scale")
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+scale_ = _inplace_of(scale, "scale_")
+
+
+def clip(x, min=None, max=None, name=None):
+    (x,) = to_tensor_args(x)
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return run(lambda v: jnp.clip(v, mn, mx), x, name="clip")
+
+
+clip_ = _inplace_of(clip, "clip_")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        x, y = to_tensor_args(x, y)
+        return run(lambda a, b: a + weight * (b - a), x, y, name="lerp")
+    x, y, weight = to_tensor_args(x, y, weight)
+    return run(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: scale_b * jnp.tanh(scale_a * v), x, name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    ts = to_tensor_args(*inputs)
+    (index,) = to_tensor_args(index)
+    return run(lambda idx, *vs: jnp.stack(vs)[idx.reshape(-1),
+                                              jnp.arange(vs[0].shape[0])],
+               index, *ts, name="multiplex")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    input, x, y = to_tensor_args(input, x, y)
+    return run(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y,
+               name="addmm")
+
+
+def inner(x, y, name=None):
+    x, y = to_tensor_args(x, y)
+    return run(jnp.inner, x, y, name="inner")
+
+
+def outer(x, y, name=None):
+    x, y = to_tensor_args(x, y)
+    return run(lambda a, b: jnp.outer(a, b), x, y, name="outer")
+
+
+def kron(x, y, name=None):
+    x, y = to_tensor_args(x, y)
+    return run(jnp.kron, x, y, name="kron")
+
+
+# ---- reductions -----------------------------------------------------------
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        ax = np.asarray(axis.value).tolist()
+        return tuple(ax) if isinstance(ax, list) else int(ax)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduction(jfn, opname, int_out=False):
+    def op(x, axis=None, keepdim=False, name=None):
+        (x,) = to_tensor_args(x)
+        ax = _norm_axis(axis)
+        return run(lambda v: jfn(v, axis=ax, keepdims=keepdim), x, name=opname)
+    op.__name__ = opname
+    return op
+
+
+mean = _reduction(jnp.mean, "mean")
+prod = _reduction(jnp.prod, "prod")
+max = _reduction(jnp.max, "max")
+min = _reduction(jnp.min, "min")
+amax = _reduction(jnp.max, "amax")
+amin = _reduction(jnp.min, "amin")
+nansum = _reduction(jnp.nansum, "nansum")
+nanmean = _reduction(jnp.nanmean, "nanmean")
+logsumexp = _reduction(jax.scipy.special.logsumexp, "logsumexp")
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    (x,) = to_tensor_args(x)
+    ax = _norm_axis(axis)
+    jd = dtypes.to_jax(dtype) if dtype is not None else None
+    # paddle promotes bool/int sums to int64
+    if jd is None and x.value.dtype in (jnp.bool_,):
+        jd = jnp.int64
+    return run(lambda v: jnp.sum(v, axis=ax, dtype=jd, keepdims=keepdim), x,
+               name="sum")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    (x,) = to_tensor_args(x)
+    ax = _norm_axis(axis)
+    return Tensor(jnp.count_nonzero(x.value, axis=ax, keepdims=keepdim)
+                  .astype(jnp.int64))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    (x,) = to_tensor_args(x)
+    jd = dtypes.to_jax(dtype) if dtype is not None else None
+
+    def _fn(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=jd)
+        return jnp.cumsum(v, axis=axis, dtype=jd)
+    return run(_fn, x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    (x,) = to_tensor_args(x)
+    jd = dtypes.to_jax(dtype) if dtype is not None else None
+    return run(lambda v: jnp.cumprod(v, axis=dim, dtype=jd), x, name="cumprod")
+
+
+def _cum_extreme(x, axis, dtype, cmp, opname):
+    """cummax/cummin: values (differentiable) + running argextreme indices.
+
+    Index recurrence runs as a lax.scan along the axis — compiler-friendly
+    (static shapes, no host loop), per XLA control-flow guidance.
+    """
+    (x,) = to_tensor_args(x)
+    flat = axis is None
+    ax = 0 if flat else axis
+
+    def _vals(v):
+        u = v.reshape(-1) if flat else v
+        return jax.lax.associative_scan(
+            jnp.maximum if cmp == "max" else jnp.minimum, u, axis=ax)
+
+    values = run(_vals, x, name=opname)
+
+    v = x.value.reshape(-1) if flat else x.value
+    vm = jnp.moveaxis(v, ax, 0)
+
+    def step(carry, inp):
+        best_val, best_idx, i = carry
+        cur = inp
+        better = cur > best_val if cmp == "max" else cur < best_val
+        best_val = jnp.where(better, cur, best_val)
+        best_idx = jnp.where(better, i, best_idx)
+        return (best_val, best_idx, i + 1), best_idx
+
+    init = (vm[0], jnp.zeros(vm.shape[1:], jnp.int64), jnp.asarray(1, jnp.int64))
+    _, idxs = jax.lax.scan(step, init, vm[1:])
+    idxs = jnp.concatenate([jnp.zeros((1,) + vm.shape[1:], jnp.int64), idxs], 0)
+    idxs = jnp.moveaxis(idxs, 0, ax)
+    return values, Tensor(idxs.astype(dtypes.to_jax(dtype)))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, "max", "cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, "min", "cummin")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    (x,) = to_tensor_args(x)
+
+    def _fn(v):
+        u = v if axis is not None else v.reshape(-1)
+        ax = axis if axis is not None else 0
+        return jax.lax.associative_scan(jnp.logaddexp, u, axis=ax)
+    return run(_fn, x, name="logcumsumexp")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2),
+               x, name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1,
+                                      axis2=axis2), x, name="diagonal")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    (x,) = to_tensor_args(x)
+    pre = prepend.value if isinstance(prepend, Tensor) else prepend
+    app = append.value if isinstance(append, Tensor) else append
+    return run(lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app),
+               x, name="diff")
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """MXU path — keep operands as-is, XLA tiles onto the systolic array.
+    Reference: static_ops.yaml matmul → phi MatmulKernel (cuBLAS)."""
+    x, y = to_tensor_args(x, y)
+
+    def _fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return run(_fn, x, y, name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    x, y = to_tensor_args(x, y)
+    return run(lambda a, b: jnp.sum(a * b, axis=-1), x, y, name="dot")
+
+
+def mv(x, vec, name=None):
+    x, vec = to_tensor_args(x, vec)
+    return run(jnp.matmul, x, vec, name="mv")
+
+
+def isfinite(x, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jnp.isfinite(x.value))
+
+
+def isinf(x, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jnp.isinf(x.value))
+
+
+def isnan(x, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jnp.isnan(x.value))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                        neginf=neginf), x, name="nan_to_num")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jnp.all(x.value, axis=_norm_axis(axis), keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jnp.any(x.value, axis=_norm_axis(axis), keepdims=keepdim))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def increment(x, value=1.0, name=None):
+    return run_inplace(x, lambda v: v + jnp.asarray(value, v.dtype),
+                       name="increment")
+
+
+def deg2rad(x, name=None):
+    (x,) = to_tensor_args(x)
+    return run(jnp.deg2rad, x, name="deg2rad")
+
+
+def rad2deg(x, name=None):
+    (x,) = to_tensor_args(x)
+    return run(jnp.rad2deg, x, name="rad2deg")
+
+
+def take(x, index, mode="raise", name=None):
+    x, index = to_tensor_args(x, index)
+    m = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return run(lambda v, i: jnp.take(v.reshape(-1), i, mode=m), x, index,
+               name="take")
+
+
+def log_normalize(x, axis=-1):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: v - jax.scipy.special.logsumexp(v, axis=axis,
+                                                         keepdims=True), x)

@@ -1,0 +1,132 @@
+"""Random sampling ops.
+
+Reference: `python/paddle/tensor/random.py` backed by phi Generator
+(seed+Philox offset).  TPU-native: jax counter-based PRNG keys from
+`framework.random.next_key()` — deterministic, SPMD-safe (the key is data).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework import dtypes
+from ..framework.random import next_key
+from ..framework.dispatch import to_tensor_args, run
+from .creation import _shape_list
+
+
+def _jdt(dtype, default="float32"):
+    return dtypes.to_jax(dtype if dtype is not None else default)
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape_list(shape),
+                                     _jdt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), _shape_list(shape),
+                                    _jdt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape_list(shape), _jdt(dtype),
+                                     minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._value = jax.random.uniform(next_key(), x.value.shape, x.value.dtype,
+                                  minval=min, maxval=max)
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        mean_t, std_t = to_tensor_args(mean, std)
+        shp = np.broadcast_shapes(tuple(mean_t.shape), tuple(std_t.shape))
+        n = jax.random.normal(next_key(), shp, jnp.float32)
+        return run(lambda m, s: m + s * n, mean_t, std_t, name="normal")
+    shp = _shape_list(shape) if shape is not None else []
+    return Tensor(mean + std * jax.random.normal(next_key(), shp, jnp.float32))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._value = (mean + std * jax.random.normal(next_key(), x.value.shape)
+                ).astype(x.value.dtype)
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(mean + std * jax.random.normal(key, _shape_list(shape),
+                                                 _jdt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype, name)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape_list(shape), low,
+                                     high, _jdt(dtype, "int64")))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    (x,) = to_tensor_args(x)
+    if high is None:
+        low, high = 0, low
+    d = _jdt(dtype, None) if dtype else x.value.dtype
+    return Tensor(jax.random.randint(next_key(), x.value.shape, low, high, d))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), n).astype(_jdt(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    (x,) = to_tensor_args(x)
+    p = x.value / jnp.sum(x.value, axis=-1, keepdims=True)
+    if replacement:
+        out = jax.random.categorical(next_key(), jnp.log(p),
+                                     shape=p.shape[:-1] + (num_samples,))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(next_key(), p.shape)
+        _, out = jax.lax.top_k(jnp.log(p) + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    (x,) = to_tensor_args(x)
+    u = jax.random.uniform(next_key(), x.value.shape)
+    return Tensor((u < x.value).astype(x.value.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    u = jax.random.uniform(next_key(), x.value.shape)
+    x._value = (u < p).astype(x.value.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    (x,) = to_tensor_args(x)
+    return Tensor(jax.random.poisson(next_key(), x.value).astype(
+        x.value.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    e = jax.random.exponential(next_key(), x.value.shape) / lam
+    x._value = e.astype(x.value.dtype)
+    return x
+
+
+def binomial(count, prob, name=None):
+    count, prob = to_tensor_args(count, prob)
+    out = jax.random.binomial(next_key(), count.value.astype(jnp.float32),
+                              prob.value)
+    return Tensor(out.astype(jnp.int64))

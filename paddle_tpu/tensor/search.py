@@ -1,0 +1,155 @@
+"""Search / sort / selection ops.
+
+Reference: `python/paddle/tensor/search.py`.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework import dtypes
+from ..framework.dispatch import run, to_tensor_args
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    (x,) = to_tensor_args(x)
+    v = x.value
+    if axis is None:
+        out = jnp.argmax(v.reshape(-1))
+        if keepdim:
+            out = out.reshape((1,) * v.ndim)
+    else:
+        out = jnp.argmax(v, axis=axis, keepdims=keepdim)
+    return Tensor(out.astype(dtypes.to_jax(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    (x,) = to_tensor_args(x)
+    v = x.value
+    if axis is None:
+        out = jnp.argmin(v.reshape(-1))
+        if keepdim:
+            out = out.reshape((1,) * v.ndim)
+    else:
+        out = jnp.argmin(v, axis=axis, keepdims=keepdim)
+    return Tensor(out.astype(dtypes.to_jax(dtype)))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    (x,) = to_tensor_args(x)
+    v = x.value
+    idx = jnp.argsort(v, axis=axis, stable=stable,
+                      descending=descending)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.sort(v, axis=axis, stable=stable,
+                                  descending=descending), x, name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    (x,) = to_tensor_args(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def _fn(v):
+        u = jnp.moveaxis(v, axis, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(u, k)
+        else:
+            vals, idx = jax.lax.top_k(-u, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+    vals, idx = run(_fn, x, name="topk")
+    return vals, Tensor(idx.value.astype(jnp.int64))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    condition, x, y = to_tensor_args(condition, x, y)
+    return run(lambda a, b: jnp.where(condition.value, a, b), x, y,
+               name="where")
+
+
+def where_(condition, x, y, name=None):
+    out = where(condition, x, y)
+    x._value = out._value
+    x._set_ref(out._ref)
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def nonzero(x, as_tuple=False):
+    (x,) = to_tensor_args(x)
+    # dynamic shape → host computation (reference dygraph does a D2H sync too)
+    nz = np.nonzero(np.asarray(x.value))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    sorted_sequence, values = to_tensor_args(sorted_sequence, values)
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence.value, values.value, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.value.reshape(-1, sorted_sequence.shape[-1]),
+            values.value.reshape(-1, values.shape[-1]))
+        out = out.reshape(values.value.shape)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask, name)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    (x,) = to_tensor_args(x)
+
+    def _fn(v):
+        u = jnp.moveaxis(v, axis, -1)
+        vals, idx = jax.lax.top_k(-u, k)
+        out = -vals[..., -1]
+        oidx = idx[..., -1]
+        if keepdim:
+            out = jnp.expand_dims(out, axis)
+            oidx = jnp.expand_dims(oidx, axis)
+        return out, oidx
+    vals, idx = run(_fn, x, name="kthvalue")
+    return vals, Tensor(idx.value.astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    (x,) = to_tensor_args(x)
+    arr = np.asarray(x.value)
+    arr_m = np.moveaxis(arr, axis, -1)
+    flat = arr_m.reshape(-1, arr_m.shape[-1])
+    vals = np.empty(flat.shape[0], arr.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uq, counts = np.unique(row, return_counts=True)
+        v = uq[np.argmax(counts[::-1].cumsum()[::-1] * 0 + counts)]
+        # paddle picks the largest value among modes' last occurrence
+        best = uq[counts == counts.max()].max()
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    shp = arr_m.shape[:-1]
+    vals = vals.reshape(shp)
+    idxs = idxs.reshape(shp)
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        idxs = np.expand_dims(idxs, axis)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))

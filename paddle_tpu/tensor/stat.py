@@ -1,0 +1,106 @@
+"""Statistics ops.  Reference: `python/paddle/tensor/stat.py`."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.dispatch import run, to_tensor_args
+from .math import _norm_axis
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    (x,) = to_tensor_args(x)
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return run(lambda v: jnp.std(v, axis=ax, ddof=ddof, keepdims=keepdim), x,
+               name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    (x,) = to_tensor_args(x)
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return run(lambda v: jnp.var(v, axis=ax, ddof=ddof, keepdims=keepdim), x,
+               name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    (x,) = to_tensor_args(x)
+    ax = _norm_axis(axis)
+    if mode == "avg":
+        return run(lambda v: jnp.median(v, axis=ax, keepdims=keepdim), x,
+                   name="median")
+    # mode="min": lower of the two middles, matching paddle
+    def _fn(v):
+        u = jnp.sort(v, axis=-1 if ax is None else ax) if ax is not None \
+            else jnp.sort(v.reshape(-1))
+        n = u.shape[-1 if ax is None else ax]
+        k = (n - 1) // 2
+        out = jnp.take(u, k, axis=-1 if ax is None else ax)
+        if keepdim and ax is not None:
+            out = jnp.expand_dims(out, ax)
+        return out
+    return run(_fn, x, name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    (x,) = to_tensor_args(x)
+    ax = _norm_axis(axis)
+    return run(lambda v: jnp.nanmedian(v, axis=ax, keepdims=keepdim), x,
+               name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    (x,) = to_tensor_args(x)
+    ax = _norm_axis(axis)
+    qv = q.value if isinstance(q, Tensor) else jnp.asarray(q)
+    return run(lambda v: jnp.quantile(v, qv, axis=ax, keepdims=keepdim,
+                                      method=interpolation), x,
+               name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    (x,) = to_tensor_args(x)
+    ax = _norm_axis(axis)
+    qv = q.value if isinstance(q, Tensor) else jnp.asarray(q)
+    return run(lambda v: jnp.nanquantile(v, qv, axis=ax, keepdims=keepdim,
+                                         method=interpolation), x,
+               name="nanquantile")
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    (input,) = to_tensor_args(input)
+    v = np.asarray(input.value)
+    if min == 0 and max == 0:
+        mn, mx = float(v.min()), float(v.max())
+    else:
+        mn, mx = float(min), float(max)
+    w = np.asarray(weight.value) if weight is not None else None
+    hist, _ = np.histogram(v, bins=bins, range=(mn, mx), weights=w,
+                           density=density)
+    return Tensor(jnp.asarray(hist if density or w is not None
+                              else hist.astype(np.int64)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    (x,) = to_tensor_args(x)
+    w = np.asarray(weights.value) if weights is not None else None
+    out = np.bincount(np.asarray(x.value), weights=w, minlength=minlength)
+    return Tensor(jnp.asarray(out))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: jnp.corrcoef(v, rowvar=rowvar), x, name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    (x,) = to_tensor_args(x)
+    fw = np.asarray(fweights.value) if fweights is not None else None
+    aw = np.asarray(aweights.value) if aweights is not None else None
+    return run(lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
+                                 fweights=fw, aweights=aw), x, name="cov")

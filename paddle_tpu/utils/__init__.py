@@ -1,0 +1,58 @@
+"""paddle_tpu.utils — misc helpers (reference: python/paddle/utils/)."""
+from __future__ import annotations
+
+__all__ = ["deprecated", "try_import", "require_version", "unique_name",
+           "download"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def wrapper(fn):
+        return fn
+    return wrapper
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"module {module_name} not found")
+
+
+def require_version(min_version, max_version=None):
+    return True
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self.ids = {}
+
+    def __call__(self, prefix):
+        i = self.ids.get(prefix, 0)
+        self.ids[prefix] = i + 1
+        return f"{prefix}_{i}"
+
+
+class unique_name:
+    _gen = _UniqueNameGenerator()
+
+    @staticmethod
+    def generate(prefix):
+        return unique_name._gen(prefix)
+
+    @staticmethod
+    def guard(new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _g():
+            yield
+        return _g()
+
+
+class download:
+    @staticmethod
+    def get_weights_path_from_url(url, md5sum=None):
+        raise RuntimeError(
+            "no network egress in this environment; place weights locally "
+            "and pass the path instead")

@@ -1,0 +1,145 @@
+"""Vision datasets.
+
+Reference: `python/paddle/vision/datasets/` (cifar.py — baseline config 1
+uses Cifar10; mnist.py, flowers.py).
+
+Offline environment: datasets load from a local archive when present
+(same file formats as the reference), else generate a deterministic
+synthetic set with identical shapes/dtypes so training pipelines and
+benchmarks run without network egress.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST"]
+
+
+class _SyntheticImageDataset(Dataset):
+    """Deterministic synthetic stand-in (seeded per split)."""
+
+    def __init__(self, n, shape, num_classes, transform=None, seed=0):
+        rng = np.random.RandomState(seed)
+        self.images = rng.randint(0, 256, (n,) + shape).astype(np.uint8)
+        self.labels = rng.randint(0, num_classes, (n,)).astype(np.int64)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)
+        return img, int(self.labels[idx])
+
+
+class Cifar10(Dataset):
+    """Reference: vision/datasets/cifar.py Cifar10 (same pickle batches
+    format when a local archive exists)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, n_synthetic=2048):
+        self.mode = mode.lower()
+        self.transform = transform
+        data = None
+        if data_file and os.path.exists(data_file):
+            data = self._load_archive(data_file)
+        if data is None:
+            syn = _SyntheticImageDataset(
+                n_synthetic if self.mode == "train" else n_synthetic // 4,
+                (3, 32, 32), 10, None,
+                seed=0 if self.mode == "train" else 1)
+            self.images = syn.images
+            self.labels = syn.labels
+        else:
+            self.images, self.labels = data
+
+    def _load_archive(self, path):
+        imgs, lbls = [], []
+        names = ([f"data_batch_{i}" for i in range(1, 6)]
+                 if self.mode == "train" else ["test_batch"])
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if base in names:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    imgs.append(np.asarray(d[b"data"]).reshape(-1, 3, 32, 32))
+                    lbls.append(np.asarray(d[b"labels"]))
+        if not imgs:
+            return None
+        return (np.concatenate(imgs).astype(np.uint8),
+                np.concatenate(lbls).astype(np.int64))
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)
+        return img, int(self.labels[idx])
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, n_synthetic=2048):
+        self.mode = mode.lower()
+        self.transform = transform
+        syn = _SyntheticImageDataset(
+            n_synthetic if self.mode == "train" else n_synthetic // 4,
+            (3, 32, 32), 100, None, seed=2 if self.mode == "train" else 3)
+        self.images = syn.images
+        self.labels = syn.labels
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None,
+                 n_synthetic=2048):
+        self.mode = mode.lower()
+        self.transform = transform
+        loaded = False
+        if image_path and os.path.exists(image_path):
+            import gzip
+            with gzip.open(image_path, "rb") as f:
+                f.read(16)
+                buf = f.read()
+                self.images = np.frombuffer(buf, np.uint8).reshape(
+                    -1, 1, 28, 28)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), np.uint8).astype(
+                    np.int64)
+            loaded = True
+        if not loaded:
+            syn = _SyntheticImageDataset(
+                n_synthetic if self.mode == "train" else n_synthetic // 4,
+                (1, 28, 28), 10, None, seed=4 if self.mode == "train" else 5)
+            self.images = syn.images
+            self.labels = syn.labels
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)
+        return img, int(self.labels[idx])
+
+
+class FashionMNIST(MNIST):
+    pass

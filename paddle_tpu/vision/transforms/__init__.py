@@ -1,0 +1,255 @@
+"""Vision transforms (numpy/host-side).
+
+Reference: `python/paddle/vision/transforms/` — Compose, ToTensor,
+Normalize, Resize, RandomCrop/Flip, etc.  Transforms run on host numpy in
+DataLoader workers (same as the reference's PIL/cv2 backends) so the device
+only sees ready batches.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "Pad", "RandomResizedCrop"]
+
+
+def _to_chw(img):
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img.transpose(2, 0, 1)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        if arr.dtype == np.uint8 or arr.max() > 1.5:
+            arr = arr / 255.0
+        if self.data_format == "CHW":
+            arr = _to_chw(arr)
+        return arr.astype(np.float32)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW",
+                 to_rgb=False, keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            m = self.mean.reshape(-1, 1, 1)
+            s = self.std.reshape(-1, 1, 1)
+        else:
+            m = self.mean
+            s = self.std
+        return (arr - m) / s
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) \
+            and arr.shape[0] < arr.shape[-1]
+        import jax.image
+        import jax.numpy as jnp
+        if chw:
+            shape = (arr.shape[0],) + self.size
+        elif arr.ndim == 3:
+            shape = self.size + (arr.shape[2],)
+        else:
+            shape = self.size
+        out = jax.image.resize(jnp.asarray(arr, jnp.float32), shape,
+                               method="linear")
+        return np.asarray(out).astype(arr.dtype if arr.dtype != np.uint8
+                                      else np.float32)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) \
+            and arr.shape[0] < arr.shape[-1]
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        if self.padding:
+            p = self.padding
+            if isinstance(p, int):
+                p = (p, p, p, p)
+            width = [(0, 0)] * arr.ndim
+            width[h_ax] = (p[1], p[3]) if len(p) == 4 else (p[1], p[1])
+            width[w_ax] = (p[0], p[2]) if len(p) == 4 else (p[0], p[0])
+            arr = np.pad(arr, width)
+        h, w = arr.shape[h_ax], arr.shape[w_ax]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        sl = [slice(None)] * arr.ndim
+        sl[h_ax] = slice(i, i + th)
+        sl[w_ax] = slice(j, j + tw)
+        return arr[tuple(sl)]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) \
+            and arr.shape[0] < arr.shape[-1]
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        h, w = arr.shape[h_ax], arr.shape[w_ax]
+        th, tw = self.size
+        i = (h - th) // 2
+        j = (w - tw) // 2
+        sl = [slice(None)] * arr.ndim
+        sl[h_ax] = slice(i, i + th)
+        sl[w_ax] = slice(j, j + tw)
+        return arr[tuple(sl)]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() < self.prob:
+            chw = arr.ndim == 3 and arr.shape[0] in (1, 3) \
+                and arr.shape[0] < arr.shape[-1]
+            return arr[..., ::-1] if not chw else arr[:, :, ::-1]
+        return arr
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() < self.prob:
+            chw = arr.ndim == 3 and arr.shape[0] in (1, 3) \
+                and arr.shape[0] < arr.shape[-1]
+            return arr[:, ::-1] if not chw else arr[:, ::-1, :]
+        return arr
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip(arr * alpha, 0, 255 if arr.max() > 1.5 else 1.0)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        p = self.padding
+        if isinstance(p, int):
+            p = (p, p, p, p)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) \
+            and arr.shape[0] < arr.shape[-1]
+        width = [(0, 0)] * arr.ndim
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        width[h_ax] = (p[1], p[3]) if len(p) == 4 else (p[1], p[1])
+        width[w_ax] = (p[0], p[2]) if len(p) == 4 else (p[0], p[0])
+        return np.pad(arr, width)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size, interpolation)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) \
+            and arr.shape[0] < arr.shape[-1]
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        h, w = arr.shape[h_ax], arr.shape[w_ax]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = np.random.randint(0, h - th + 1)
+                j = np.random.randint(0, w - tw + 1)
+                sl = [slice(None)] * arr.ndim
+                sl[h_ax] = slice(i, i + th)
+                sl[w_ax] = slice(j, j + tw)
+                return self._resize(arr[tuple(sl)])
+        return self._resize(arr)

@@ -1,0 +1,31 @@
+"""Test config: force CPU with 8 virtual devices BEFORE jax imports.
+
+Mirrors the reference's fake-cluster strategy (SURVEY §4: multi-process on
+localhost) — here SPMD needs no processes, just a virtual 8-device mesh via
+xla_force_host_platform_device_count.
+"""
+import os
+
+# force CPU unconditionally: unit tests must not burn (or depend on) the
+# real TPU; the driver's bench run uses the chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the axon TPU plugin overrides JAX_PLATFORMS; force CPU via config too
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield

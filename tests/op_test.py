@@ -1,0 +1,72 @@
+"""OpTest harness — numpy-reference op checks.
+
+Reference: `test/legacy_test/op_test.py:418` — check_output (:2925)
+compares against a numpy reference per place/dtype, check_grad (:3129)
+compares analytic vs numeric gradients with per-dtype tolerances.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+# float32 tolerances account for XLA:CPU's vectorized transcendental
+# approximations (same spirit as the reference's per-op white lists in
+# test/white_list/op_accuracy_white_list.py)
+DTYPE_ATOL = {"float64": 1e-10, "float32": 1e-4, "float16": 1e-2,
+              "bfloat16": 2e-2}
+DTYPE_RTOL = {"float64": 1e-7, "float32": 1e-4, "float16": 1e-2,
+              "bfloat16": 2e-2}
+
+
+def check_output(paddle_fn, numpy_fn, inputs, atol=None, rtol=None,
+                 dtype="float32"):
+    """Run op on Tensors and compare with numpy_fn on ndarrays."""
+    t_inputs = [paddle.to_tensor(np.asarray(a, dtype)) for a in inputs]
+    out = paddle_fn(*t_inputs)
+    ref = numpy_fn(*[np.asarray(a, dtype) for a in inputs])
+    atol = atol if atol is not None else DTYPE_ATOL[dtype]
+    rtol = rtol if rtol is not None else DTYPE_RTOL[dtype]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(o.value, np.float64),
+                                   np.asarray(r, np.float64),
+                                   atol=atol, rtol=rtol)
+
+
+def check_grad(paddle_fn, inputs, dtype="float32", eps=1e-3, atol=5e-3,
+               rtol=5e-3, seed_output_index=0):
+    """Numeric vs analytic gradient (central differences), matching the
+    reference's get_numeric_gradient strategy."""
+    arrays = [np.asarray(a, dtype) for a in inputs]
+
+    def scalar_loss(arrs):
+        ts = [paddle.to_tensor(a) for a in arrs]
+        for t in ts:
+            t.stop_gradient = False
+        out = paddle_fn(*ts)
+        if isinstance(out, (list, tuple)):
+            out = out[seed_output_index]
+        return ts, paddle.sum(out * out)  # smooth scalarization
+
+    ts, loss = scalar_loss(arrays)
+    loss.backward()
+    analytic = [np.asarray(t.grad.value) if t.grad is not None else
+                np.zeros_like(a) for t, a in zip(ts, arrays)]
+
+    for idx, base in enumerate(arrays):
+        numeric = np.zeros_like(base, np.float64)
+        flat = base.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            _, lp = scalar_loss(arrays)
+            flat[i] = orig - eps
+            _, lm = scalar_loss(arrays)
+            flat[i] = orig
+            numeric.reshape(-1)[i] = (float(lp) - float(lm)) / (2 * eps)
+        np.testing.assert_allclose(analytic[idx].astype(np.float64),
+                                   numeric, atol=atol, rtol=rtol,
+                                   err_msg=f"grad mismatch for input {idx}")

@@ -1,0 +1,150 @@
+"""Autograd tape tests (reference: test/legacy_test grad checks +
+eager autograd behavior)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_scalar_backward():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x + 2.0 * x
+    y.backward()
+    assert np.isclose(float(x.grad), 2 * 3.0 + 2.0)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    for _ in range(3):
+        y = paddle.sum(x * x)
+        y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3 * 2 * x.numpy())
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0])  # stop_gradient=True
+    z = paddle.sum(x * y)
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), y.numpy())
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).detach()
+    z = y * x
+    paddle.sum(z).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])  # only through z
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._ref.node is None
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = paddle.sum(paddle.exp(x))
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), np.exp(x.numpy()), rtol=1e-5)
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.random.rand(4, 6).astype(np.float32),
+                         stop_gradient=False)
+    a, b = paddle.split(x, 2, axis=1)
+    loss = paddle.sum(a) + 2.0 * paddle.sum(b)
+    loss.backward()
+    g = x.grad.numpy()
+    np.testing.assert_allclose(g[:, :3], 1.0)
+    np.testing.assert_allclose(g[:, 3:], 2.0)
+
+
+def test_inplace_versioning():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2.0
+    y.add_(paddle.to_tensor([1.0, 1.0]))
+    loss = paddle.sum(y * y)
+    loss.backward()
+    # y = 2x+1, loss = sum((2x+1)^2), dloss/dx = 2*(2x+1)*2
+    expect = 4 * (2 * x.numpy() + 1)
+    np.testing.assert_allclose(x.grad.numpy(), expect)
+
+
+def test_backward_non_scalar_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3.0
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 1.5])
+
+
+def test_backward_non_scalar_raises():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3.0
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    y = x * 2.0
+    y.register_hook(lambda g: g * 10.0)
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0, 20.0])
+
+
+def test_retain_grads():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2.0
+    y.retain_grads()
+    z = y * 3.0
+    z.backward()
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Square(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, gy):
+            (x,) = ctx.saved_tensor
+            return gy * 2.0 * x
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Square.apply(x)
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_grad_through_integer_blocked():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    idx = paddle.argmax(x)  # int output → no grad path
+    assert idx.stop_gradient
+
+
+def test_diamond_graph():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    a = x * 3.0
+    b = x * 4.0
+    y = a * b  # y = 12 x^2, dy/dx = 24x
+    y.backward()
+    assert np.isclose(float(x.grad), 24 * 2.0)
+
+
+def test_clear_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    paddle.sum(x * x).backward()
+    assert x.grad is not None
+    x.clear_grad()
+    assert x.grad is None

@@ -1,0 +1,318 @@
+"""nn.Layer + layers + functional tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def r(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+class TestLayerBase:
+    def test_parameters_and_naming(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(self.fc1(x))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+        assert len(net.parameters()) == 4
+        assert len(net.sublayers()) == 2
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Linear(3, 5)
+        sd = net.state_dict()
+        net2 = nn.Linear(3, 5)
+        net2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+        np.testing.assert_allclose(net2.weight.numpy(), net.weight.numpy())
+
+    def test_train_eval_mode(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100])
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+        d.train()
+        out = d(x).numpy()
+        assert (out == 0).any()  # some dropped
+
+    def test_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        net.register_forward_pre_hook(lambda l, inp: calls.append("pre"))
+        net.register_forward_post_hook(
+            lambda l, inp, out: calls.append("post"))
+        net(paddle.ones([1, 2]))
+        assert calls == ["pre", "post"]
+
+    def test_buffers(self):
+        net = nn.BatchNorm1D(4)
+        buf_names = [n for n, _ in net.named_buffers()]
+        assert "_mean" in buf_names and "_variance" in buf_names
+        sd = net.state_dict()
+        assert "_mean" in sd
+
+    def test_to_dtype(self):
+        net = nn.Linear(2, 2)
+        net.to(dtype="bfloat16")
+        assert net.weight.dtype == paddle.bfloat16
+
+    def test_containers(self):
+        seq = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+        out = seq(paddle.ones([1, 2]))
+        assert out.shape == [1, 1]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3 and len(ll.parameters()) == 6
+
+
+class TestFunctional:
+    def test_activations(self):
+        x = np.array([-2.0, -0.5, 0.0, 1.5], np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(
+            F.sigmoid(t).numpy(), 1 / (1 + np.exp(-x)), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.softmax(t).numpy(),
+            np.exp(x) / np.exp(x).sum(), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.leaky_relu(t, 0.1).numpy(),
+            np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+
+    def test_linear_layout(self):
+        # weight [in, out] (reference layout)
+        x = r(2, 3)
+        w = r(3, 4)
+        b = r(4)
+        out = F.linear(paddle.to_tensor(x), paddle.to_tensor(w),
+                       paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+
+    def test_layer_norm_values(self):
+        x = r(2, 5)
+        out = F.layer_norm(paddle.to_tensor(x), 5)
+        mean = out.numpy().mean(-1)
+        std = out.numpy().std(-1)
+        np.testing.assert_allclose(mean, 0.0, atol=1e-5)
+        np.testing.assert_allclose(std, 1.0, atol=1e-3)
+
+    def test_rms_norm(self):
+        x = r(2, 8)
+        w = np.ones(8, np.float32)
+        out = F.rms_norm(paddle.to_tensor(x), paddle.to_tensor(w))
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = r(4, 5)
+        labels = np.array([0, 1, -100, 3])
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels))
+        # manual
+        lp = logits - logits.max(-1, keepdims=True)
+        lp = lp - np.log(np.exp(lp).sum(-1, keepdims=True))
+        vals = [-lp[i, l] for i, l in enumerate(labels) if l != -100]
+        np.testing.assert_allclose(float(out), np.mean(vals), rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = r(3, 4)
+        soft = np.full((3, 4), 0.25, np.float32)
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(soft), soft_label=True)
+        lp = logits - logits.max(-1, keepdims=True)
+        lp = lp - np.log(np.exp(lp).sum(-1, keepdims=True))
+        ref = -(soft * lp).sum(-1).mean()
+        np.testing.assert_allclose(float(out), ref, rtol=1e-5)
+
+    def test_mse_l1(self):
+        a, b = r(3, 4), r(3, 4)
+        np.testing.assert_allclose(
+            float(F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b))),
+            ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b))),
+            np.abs(a - b).mean(), rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        x, t = r(6) * 4 - 2, (r(6) > 0.5).astype(np.float32)
+        out = F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(x), paddle.to_tensor(t))
+        p = 1 / (1 + np.exp(-x))
+        ref = -(t * np.log(p) + (1 - t) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(float(out), ref, rtol=1e-4)
+
+    def test_embedding(self):
+        w = r(10, 4)
+        idx = np.array([[1, 3], [5, 9]])
+        out = F.embedding(paddle.to_tensor(idx), paddle.to_tensor(w))
+        np.testing.assert_allclose(out.numpy(), w[idx])
+
+    def test_embedding_grad_scatter(self):
+        w = paddle.to_tensor(r(5, 3))
+        w.stop_gradient = False
+        idx = paddle.to_tensor(np.array([1, 1, 2]))
+        out = F.embedding(idx, w)
+        paddle.sum(out).backward()
+        g = w.grad.numpy()
+        assert g[1].sum() == pytest.approx(6.0)  # row 1 used twice
+        assert g[0].sum() == 0
+
+    def test_dropout_scaling(self):
+        x = paddle.ones([10000])
+        out = F.dropout(x, 0.3, training=True)
+        # upscale_in_train: E[out] == 1
+        assert abs(out.numpy().mean() - 1.0) < 0.05
+
+    def test_interpolate_nearest(self):
+        x = r(1, 1, 2, 2)
+        out = F.interpolate(paddle.to_tensor(x), size=[4, 4],
+                            mode="nearest")
+        assert out.shape == [1, 1, 4, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0, :2, :2].mean(),
+                                   x[0, 0, 0, 0], rtol=1e-5)
+
+
+class TestConvPool:
+    def test_conv2d_identity(self):
+        x = r(1, 1, 5, 5)
+        w = np.zeros((1, 1, 3, 3), np.float32)
+        w[0, 0, 1, 1] = 1.0  # identity kernel
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1)
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-5)
+
+    def test_conv2d_vs_numpy(self):
+        x = r(2, 3, 8, 8)
+        w = r(4, 3, 3, 3)
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
+        assert out.shape == [2, 4, 6, 6]
+        # check one output position against manual correlation
+        ref = (x[0, :, 0:3, 0:3] * w[1]).sum()
+        np.testing.assert_allclose(out.numpy()[0, 1, 0, 0], ref, rtol=1e-4)
+
+    def test_conv_groups(self):
+        x = r(1, 4, 6, 6)
+        w = r(4, 2, 3, 3)
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), groups=2)
+        assert out.shape == [1, 4, 4, 4]
+
+    def test_conv_transpose_shape(self):
+        x = r(1, 3, 4, 4)
+        w = r(3, 5, 3, 3)  # [in, out, kh, kw]
+        out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                 stride=2)
+        assert out.shape == [1, 5, 9, 9]
+
+    def test_pools(self):
+        x = r(1, 2, 4, 4)
+        mp = F.max_pool2d(paddle.to_tensor(x), 2)
+        ref = x.reshape(1, 2, 2, 2, 2, 2).max((3, 5))
+        np.testing.assert_allclose(mp.numpy(), ref, rtol=1e-6)
+        ap = F.avg_pool2d(paddle.to_tensor(x), 2)
+        refa = x.reshape(1, 2, 2, 2, 2, 2).mean((3, 5))
+        np.testing.assert_allclose(ap.numpy(), refa, rtol=1e-6)
+
+    def test_adaptive_pool(self):
+        x = r(1, 3, 8, 8)
+        out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 1)
+        np.testing.assert_allclose(out.numpy()[..., 0, 0],
+                                   x.mean((2, 3)), rtol=1e-5)
+
+
+class TestNorms:
+    def test_batch_norm_train_stats(self):
+        bn = nn.BatchNorm1D(4)
+        x = paddle.to_tensor(r(16, 4) * 3 + 1)
+        bn.train()
+        out = bn(x)
+        np.testing.assert_allclose(out.numpy().mean(0), 0, atol=1e-4)
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0)
+
+    def test_batch_norm_eval_uses_running(self):
+        bn = nn.BatchNorm1D(2)
+        bn.eval()
+        x = paddle.to_tensor(r(4, 2))
+        out = bn(x)  # running mean 0, var 1 → identity-ish
+        np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-4)
+
+    def test_group_norm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = paddle.to_tensor(r(2, 4, 3, 3))
+        out = gn(x)
+        v = out.numpy().reshape(2, 2, -1)
+        np.testing.assert_allclose(v.mean(-1), 0, atol=1e-4)
+
+    def test_layer_norm_layer(self):
+        ln = nn.LayerNorm(6)
+        out = ln(paddle.to_tensor(r(2, 6)))
+        np.testing.assert_allclose(out.numpy().mean(-1), 0, atol=1e-5)
+
+
+class TestTransformer:
+    def test_mha_shapes(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(r(2, 5, 16))
+        out = mha(x, x, x)
+        assert out.shape == [2, 5, 16]
+
+    def test_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.to_tensor(r(2, 5, 16))
+        out = enc(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_sdpa_matches_naive(self):
+        q = r(1, 4, 2, 8)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q))
+        # naive
+        qq = q.transpose(0, 2, 1, 3)  # b h s d
+        logits = qq @ qq.transpose(0, 1, 3, 2) / np.sqrt(8)
+        w = np.exp(logits - logits.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        ref = (w @ qq).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+
+    def test_causal_mask(self):
+        q = r(1, 4, 1, 4)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            is_causal=True)
+        # first position can only attend to itself → output == value[0]
+        np.testing.assert_allclose(out.numpy()[0, 0], q[0, 0], atol=1e-5)
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(8, 16, num_layers=2)
+        x = paddle.to_tensor(r(4, 6, 8))
+        out, (h, c) = lstm(x)
+        assert out.shape == [4, 6, 16]
+        assert h.shape == [2, 4, 16]
+
+    def test_gru_cell(self):
+        cell = nn.GRUCell(4, 8)
+        out, h = cell(paddle.to_tensor(r(2, 4)))
+        assert out.shape == [2, 8]
+
+
+class TestClip:
+    def test_global_norm_clip(self):
+        from paddle_tpu.nn import ClipGradByGlobalNorm
+        p1 = paddle.framework.Parameter(np.zeros(3, np.float32))
+        g1 = paddle.to_tensor(np.array([3.0, 0.0, 0.0], np.float32))
+        p2 = paddle.framework.Parameter(np.zeros(1, np.float32))
+        g2 = paddle.to_tensor(np.array([4.0], np.float32))
+        clip = ClipGradByGlobalNorm(1.0)
+        out = clip([(p1, g1), (p2, g2)])
+        total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)

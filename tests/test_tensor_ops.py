@@ -1,0 +1,321 @@
+"""Op correctness vs numpy (reference: test/legacy_test OpTest pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+
+def r(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+class TestElementwise:
+    def test_add(self):
+        check_output(paddle.add, np.add, [r(3, 4), r(3, 4)])
+        check_grad(paddle.add, [r(2, 3), r(2, 3)])
+
+    def test_broadcast_add(self):
+        check_output(paddle.add, np.add, [r(3, 4), r(4)])
+
+    def test_subtract(self):
+        check_output(paddle.subtract, np.subtract, [r(3, 4), r(3, 4)])
+
+    def test_multiply(self):
+        check_output(paddle.multiply, np.multiply, [r(3, 4), r(3, 4)])
+        check_grad(paddle.multiply, [r(2, 3), r(2, 3)])
+
+    def test_divide(self):
+        check_output(paddle.divide, np.divide,
+                     [r(3, 4), r(3, 4) + 0.5])
+
+    def test_pow(self):
+        check_output(lambda x: paddle.pow(x, 2.0), lambda x: x ** 2,
+                     [r(3, 4)])
+
+    def test_maximum_minimum(self):
+        check_output(paddle.maximum, np.maximum, [r(3), r(3)])
+        check_output(paddle.minimum, np.minimum, [r(3), r(3)])
+
+    def test_exp_log(self):
+        check_output(paddle.exp, np.exp, [r(5)])
+        check_output(paddle.log, np.log, [r(5) + 0.1])
+        check_grad(paddle.exp, [r(4)])
+
+    def test_sqrt_rsqrt(self):
+        check_output(paddle.sqrt, np.sqrt, [r(5) + 0.1])
+        check_output(paddle.rsqrt, lambda x: 1 / np.sqrt(x), [r(5) + 0.1])
+
+    def test_trig(self):
+        check_output(paddle.sin, np.sin, [r(5)])
+        check_output(paddle.cos, np.cos, [r(5)])
+        check_output(paddle.tanh, np.tanh, [r(5)])
+
+    def test_clip(self):
+        check_output(lambda x: paddle.clip(x, 0.2, 0.8),
+                     lambda x: np.clip(x, 0.2, 0.8), [r(10)])
+
+    def test_scale(self):
+        check_output(lambda x: paddle.scale(x, 2.0, 1.0),
+                     lambda x: 2.0 * x + 1.0, [r(4)])
+
+
+class TestMatmul:
+    def test_matmul(self):
+        check_output(paddle.matmul, np.matmul, [r(3, 4), r(4, 5)])
+        check_grad(paddle.matmul, [r(2, 3), r(3, 2)])
+
+    def test_matmul_transpose(self):
+        check_output(lambda a, b: paddle.matmul(a, b, transpose_y=True),
+                     lambda a, b: a @ b.T, [r(3, 4), r(5, 4)])
+
+    def test_batched(self):
+        check_output(paddle.matmul, np.matmul, [r(2, 3, 4), r(2, 4, 5)])
+
+    def test_dot(self):
+        check_output(paddle.dot, lambda a, b: np.sum(a * b, -1),
+                     [r(4), r(4)])
+
+
+class TestReductions:
+    def test_sum(self):
+        check_output(paddle.sum, np.sum, [r(3, 4)])
+        check_output(lambda x: paddle.sum(x, axis=1),
+                     lambda x: np.sum(x, 1), [r(3, 4)])
+        check_output(lambda x: paddle.sum(x, axis=1, keepdim=True),
+                     lambda x: np.sum(x, 1, keepdims=True), [r(3, 4)])
+        check_grad(paddle.sum, [r(3, 3)])
+
+    def test_mean_max_min_prod(self):
+        check_output(paddle.mean, np.mean, [r(3, 4)])
+        check_output(paddle.max, np.max, [r(3, 4)])
+        check_output(paddle.min, np.min, [r(3, 4)])
+        check_output(paddle.prod, np.prod, [r(6)])
+
+    def test_cumsum(self):
+        check_output(lambda x: paddle.cumsum(x, axis=1),
+                     lambda x: np.cumsum(x, 1), [r(3, 4)])
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp
+        check_output(paddle.logsumexp, logsumexp, [r(3, 4)])
+
+    def test_std_var(self):
+        check_output(lambda x: paddle.std(x),
+                     lambda x: np.std(x, ddof=1), [r(10)])
+        check_output(lambda x: paddle.var(x, unbiased=False),
+                     lambda x: np.var(x), [r(10)])
+
+
+class TestManipulation:
+    def test_reshape(self):
+        check_output(lambda x: paddle.reshape(x, [4, 3]),
+                     lambda x: x.reshape(4, 3), [r(3, 4)])
+        check_grad(lambda x: paddle.reshape(x, [-1]), [r(2, 3)])
+
+    def test_transpose(self):
+        check_output(lambda x: paddle.transpose(x, [1, 0]),
+                     lambda x: x.T, [r(3, 4)])
+
+    def test_concat_stack_split(self):
+        check_output(lambda a, b: paddle.concat([a, b], axis=0),
+                     lambda a, b: np.concatenate([a, b], 0),
+                     [r(2, 3), r(4, 3)])
+        check_output(lambda a, b: paddle.stack([a, b], axis=1),
+                     lambda a, b: np.stack([a, b], 1), [r(2, 3), r(2, 3)])
+        x = paddle.to_tensor(r(6, 4))
+        parts = paddle.split(x, 3, axis=0)
+        assert len(parts) == 3 and parts[0].shape == [2, 4]
+        parts = paddle.split(x, [1, 2, -1], axis=0)
+        assert [p.shape[0] for p in parts] == [1, 2, 3]
+
+    def test_squeeze_unsqueeze(self):
+        check_output(lambda x: paddle.unsqueeze(x, 0),
+                     lambda x: x[None], [r(3)])
+        check_output(lambda x: paddle.squeeze(x, 0),
+                     lambda x: x.squeeze(0), [r(1, 3)])
+
+    def test_tile_expand(self):
+        check_output(lambda x: paddle.tile(x, [2, 3]),
+                     lambda x: np.tile(x, (2, 3)), [r(2, 2)])
+        check_output(lambda x: paddle.expand(x, [3, 4]),
+                     lambda x: np.broadcast_to(x, (3, 4)), [r(1, 4)])
+
+    def test_gather(self):
+        x = paddle.to_tensor(r(5, 3))
+        idx = paddle.to_tensor(np.array([0, 2, 4]))
+        out = paddle.gather(x, idx)
+        np.testing.assert_allclose(out.numpy(),
+                                   x.numpy()[[0, 2, 4]], rtol=1e-6)
+
+    def test_getitem_setitem(self):
+        x = paddle.to_tensor(r(4, 5))
+        np.testing.assert_allclose(x[1:3, ::2].numpy(),
+                                   x.numpy()[1:3, ::2])
+        y = paddle.to_tensor(r(4, 5))
+        y[0] = 1.0
+        assert np.allclose(y.numpy()[0], 1.0)
+
+    def test_getitem_grad(self):
+        check_grad(lambda x: x[1:, :2], [r(3, 3)])
+
+    def test_flip_roll(self):
+        check_output(lambda x: paddle.flip(x, [0]),
+                     lambda x: np.flip(x, 0), [r(3, 4)])
+        check_output(lambda x: paddle.roll(x, 2, 0),
+                     lambda x: np.roll(x, 2, 0), [r(5, 2)])
+
+    def test_pad(self):
+        check_output(lambda x: paddle.nn.functional.pad(
+            x, [1, 2], value=0.5),
+            lambda x: np.pad(x, ((0, 0), (1, 2)),
+                             constant_values=0.5), [r(2, 3)])
+
+    def test_cast(self):
+        x = paddle.to_tensor(r(3))
+        assert paddle.cast(x, "float16").dtype == paddle.float16
+        assert x.astype("int32").dtype == paddle.int32
+
+    def test_scatter_ops(self):
+        x = paddle.zeros([4, 3])
+        idx = paddle.to_tensor(np.array([1, 3]))
+        upd = paddle.to_tensor(np.ones((2, 3), np.float32))
+        out = paddle.scatter(x, idx, upd)
+        expect = np.zeros((4, 3), np.float32)
+        expect[[1, 3]] = 1
+        np.testing.assert_allclose(out.numpy(), expect)
+
+
+class TestSearchSort:
+    def test_argmax_argmin(self):
+        a = r(4, 5)
+        x = paddle.to_tensor(a)
+        assert int(paddle.argmax(x)) == int(np.argmax(a))
+        np.testing.assert_array_equal(paddle.argmax(x, axis=1).numpy(),
+                                      np.argmax(a, 1))
+
+    def test_sort_argsort(self):
+        a = r(4, 5)
+        np.testing.assert_allclose(paddle.sort(paddle.to_tensor(a)).numpy(),
+                                   np.sort(a), rtol=1e-6)
+        np.testing.assert_array_equal(
+            paddle.argsort(paddle.to_tensor(a)).numpy(), np.argsort(a))
+
+    def test_topk(self):
+        a = r(3, 10)
+        vals, idx = paddle.topk(paddle.to_tensor(a), 3)
+        ref = np.sort(a, axis=-1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+    def test_where(self):
+        a, b = r(3, 4), r(3, 4)
+        cond = a > b
+        out = paddle.where(paddle.to_tensor(cond), paddle.to_tensor(a),
+                           paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), np.where(cond, a, b))
+
+    def test_nonzero(self):
+        a = (r(4, 4) > 0.5).astype(np.float32)
+        out = paddle.nonzero(paddle.to_tensor(a))
+        ref = np.stack(np.nonzero(a), 1)
+        np.testing.assert_array_equal(out.numpy(), ref)
+
+    def test_unique(self):
+        a = np.array([1, 3, 1, 2, 3], np.int64)
+        out = paddle.unique(paddle.to_tensor(a))
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+
+
+class TestCreation:
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], "int64").dtype == paddle.int64
+        assert paddle.full([2, 2], 7).numpy()[0, 0] == 7
+        np.testing.assert_array_equal(paddle.arange(5).numpy(),
+                                      np.arange(5))
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5),
+            rtol=1e-6)
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+
+    def test_tril_triu(self):
+        check_output(paddle.tril, np.tril, [r(4, 4)])
+        check_output(paddle.triu, np.triu, [r(4, 4)])
+
+    def test_like(self):
+        x = paddle.to_tensor(r(2, 3))
+        assert paddle.zeros_like(x).shape == [2, 3]
+        assert paddle.full_like(x, 3.0).numpy()[0, 0] == 3.0
+
+    def test_default_dtypes(self):
+        assert paddle.to_tensor(1.5).dtype == paddle.float32
+        assert paddle.to_tensor(2).dtype == paddle.int64
+        assert paddle.to_tensor([True]).dtype == paddle.bool_
+
+
+class TestLinalg:
+    def test_inverse_solve(self):
+        a = r(3, 3) + 3 * np.eye(3, dtype=np.float32)
+        check_output(paddle.linalg.inv, np.linalg.inv, [a], atol=1e-4)
+        b = r(3, 2)
+        out = paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), np.linalg.solve(a, b),
+                                   atol=1e-4)
+
+    def test_norm(self):
+        a = r(3, 4)
+        assert np.isclose(float(paddle.linalg.norm(paddle.to_tensor(a))),
+                          np.linalg.norm(a), rtol=1e-5)
+
+    def test_svd_qr_cholesky(self):
+        a = r(4, 3)
+        u, s, v = paddle.linalg.svd(paddle.to_tensor(a))
+        rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(rec, a, atol=1e-4)
+        spd = a.T @ a + np.eye(3, dtype=np.float32)
+        l = paddle.linalg.cholesky(paddle.to_tensor(spd))
+        np.testing.assert_allclose(l.numpy() @ l.numpy().T, spd, atol=1e-4)
+
+    def test_einsum(self):
+        a, b = r(3, 4), r(4, 5)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                            paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+class TestLogic:
+    def test_compare(self):
+        a, b = r(3), r(3)
+        x, y = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_array_equal((x < y).numpy(), a < b)
+        np.testing.assert_array_equal((x >= y).numpy(), a >= b)
+        assert bool(paddle.allclose(x, x))
+
+    def test_isnan_isinf(self):
+        a = np.array([1.0, np.nan, np.inf], np.float32)
+        x = paddle.to_tensor(a)
+        np.testing.assert_array_equal(paddle.isnan(x).numpy(),
+                                      np.isnan(a))
+        np.testing.assert_array_equal(paddle.isinf(x).numpy(),
+                                      np.isinf(a))
+
+
+class TestRandom:
+    def test_shapes_dtypes(self):
+        assert paddle.rand([3, 4]).shape == [3, 4]
+        assert paddle.randn([2]).dtype == paddle.float32
+        ri = paddle.randint(0, 10, [100])
+        assert int(ri.numpy().min()) >= 0 and int(ri.numpy().max()) < 10
+        p = paddle.randperm(10).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(10))
+
+    def test_seed_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([4]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_uniform_range(self):
+        u = paddle.uniform([1000], min=2.0, max=3.0).numpy()
+        assert u.min() >= 2.0 and u.max() <= 3.0
