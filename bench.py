@@ -61,7 +61,7 @@ def main():
                           intermediate_size=5632, num_hidden_layers=7,
                           num_attention_heads=16, num_key_value_heads=16,
                           max_position_embeddings=2048, dtype="bfloat16")
-        batch, seq, steps = 2, 2048, 10
+        batch, seq, steps = 8, 2048, 10
     else:  # CPU smoke path so the script always runs
         cfg = LlamaConfig(vocab_size=256, hidden_size=128,
                           intermediate_size=384, num_hidden_layers=2,
@@ -76,7 +76,7 @@ def main():
                                  weight_decay=0.1, multi_precision=True)
     mesh = build_mesh(devices=jax.devices()[:1])
     step = ShardedTrainStep(model, opt, mesh, sharding_stage=0,
-                            rematerialize=True)
+                            rematerialize=False)
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)

@@ -79,11 +79,11 @@ def attention(q, k, v, mask=None, causal=False, scale=None, dropout_p=0.0):
         backend = "pallas" if (_on_tpu() and mask is None
                                and dropout_p == 0.0) else "xla"
     if backend == "pallas" and mask is None and dropout_p == 0.0:
+        from .pallas.flash_attention import flash_attention as _pfa
         try:
-            from .pallas.flash_attention import flash_attention as _pfa
             return _pfa(q, k, v, causal=causal, scale=scale)
-        except Exception:
-            pass
+        except ValueError:
+            pass  # unsupported shape → XLA path; real errors propagate
     return xla_attention(q, k, v, mask, causal, scale, dropout_p)
 
 
@@ -104,11 +104,11 @@ def rms_norm(x, weight=None, epsilon=1e-6):
     """Reference: incubate fused_rms_norm (phi fused kernel).  Pallas kernel
     on TPU for the [*, hidden] LLM case."""
     if _on_tpu() and weight is not None and x.ndim >= 2:
+        from .pallas.rms_norm import rms_norm as _prn
         try:
-            from .pallas.rms_norm import rms_norm as _prn
             return _prn(x, weight, epsilon)
-        except Exception:
-            pass
+        except ValueError:
+            pass  # tiling-incompatible shape → XLA path
     return xla_rms_norm(x, weight, epsilon)
 
 

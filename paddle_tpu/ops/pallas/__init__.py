@@ -1,0 +1,10 @@
+"""Pallas TPU kernels — the hand-written hot set.
+
+Reference equivalents: `paddle/phi/kernels/fusion/gpu/` (flash_attn via the
+external flash-attention CUDA library, fused_rms_norm) and
+`paddle/phi/kernels/gpu/flash_attn_kernel.cu`.
+
+Kernels here follow the TPU playbook (/opt/skills/guides/pallas_guide.md):
+block shapes aligned to (16,128) bf16 tiles, fp32 accumulation in VMEM
+scratch, custom_vjp with Pallas backward kernels.
+"""

@@ -1,0 +1,314 @@
+"""Flash attention — Pallas TPU kernel, forward + backward.
+
+Reference: the reference wraps the external flash-attention CUDA library
+(`cmake/external/flashattn.cmake`, `phi/kernels/gpu/flash_attn_kernel.cu`);
+this is the TPU-native equivalent, written directly against the MXU:
+
+  - online-softmax forward (one pass over K blocks per Q block, fp32
+    running max/denominator in VMEM), returns out + logsumexp
+  - recompute backward: dq kernel (loops K blocks per Q block) and dkv
+    kernel (loops Q blocks per K block) — no s×s matrix ever hits HBM
+  - causal masking skips whole K blocks past the diagonal (dynamic
+    fori_loop bound on the Q-block index)
+
+Layout contract: [b, s, h, d] at the API (paddle flash-attn layout),
+transposed to [b*h, s, d] for contiguous sequence tiles.  Requires
+s % block == 0 and d % 128 == 0 — callers (paddle_tpu.ops.attention) fall
+back to the XLA path otherwise.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+INTERPRET = None  # resolved lazily: True off-TPU (CPU tests)
+
+
+def _interpret():
+    global INTERPRET
+    if INTERPRET is None:
+        INTERPRET = jax.default_backend() != "tpu"
+    return INTERPRET
+
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)          # [BQ, D]
+
+    # all index arithmetic in int32: mosaic rejects mixed i32/i64 (python
+    # ints are weak int64 under jax_enable_x64)
+    i32 = lambda v: jnp.asarray(v, jnp.int32)
+    num_kb = i32(seq_k // block_k)
+    if causal:
+        # K blocks through the diagonal of the block's LAST query row
+        num_kb = jnp.minimum(
+            num_kb,
+            ((qi + i32(1)) * i32(block_q) - i32(1)) // i32(block_k) + i32(1))
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * i32(block_k), block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * i32(block_k), block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * i32(block_q) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * i32(block_k) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    d = q_ref.shape[-1]
+    init = (jnp.zeros((block_q, d), jnp.float32),
+            jnp.full((block_q,), NEG_INF, jnp.float32),
+            jnp.zeros((block_q,), jnp.float32))
+    acc, m, l = jax.lax.fori_loop(i32(0), num_kb, body, init)
+    l = jnp.maximum(l, jnp.float32(1e-30))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, None]
+
+
+def _fwd(q3, k3, v3, scale, causal, block_q, block_k):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    grid = (bh, sq // block_q)
+    # mosaic rejects the i64/f64 weak constants x64 mode produces; trace the
+    # kernel with x64 off (all operands are explicitly typed anyway)
+    with jax.enable_x64(False):
+        out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_k=sk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+            interpret=_interpret(),
+        )(q3, k3, v3)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward: dq  (grid over Q blocks, loop over K blocks)
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_q, block_k, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, 0]
+    delta = delta_ref[0][:, 0]
+
+    i32 = lambda v: jnp.asarray(v, jnp.int32)
+    num_kb = i32(seq_k // block_k)
+    if causal:
+        num_kb = jnp.minimum(
+            num_kb,
+            ((qi + i32(1)) * i32(block_q) - i32(1)) // i32(block_k) + i32(1))
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * i32(block_k), block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * i32(block_k), block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * i32(block_q) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = j * i32(block_k) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * jnp.float32(scale)
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    d = q_ref.shape[-1]
+    dq = jax.lax.fori_loop(i32(0), num_kb, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# backward: dk/dv  (grid over K blocks, loop over Q blocks)
+# ---------------------------------------------------------------------------
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                    seq_q):
+    kj = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+
+    i32 = lambda v: jnp.asarray(v, jnp.int32)
+    num_qb = i32(seq_q // block_q)
+    if causal:
+        start_qb = kj * i32(block_k) // i32(block_q)
+    else:
+        start_qb = i32(0)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * i32(block_q), block_q), :].astype(
+            jnp.float32) * jnp.float32(scale)
+        do = do_ref[0, pl.ds(i * i32(block_q), block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * i32(block_q), block_q), 0]
+        delta = delta_ref[0, pl.ds(i * i32(block_q), block_q), 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = i * i32(block_q) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * i32(block_k) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
+        p = jnp.exp(s - lse[:, None])                       # [BQ, BK]
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [BK, D]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        # q above is pre-multiplied by scale, so ds needs no extra factor:
+        # dk_true = scale · dlᵀq = dsᵀ · (q·scale)
+        ds = p * (dp - delta[:, None])                      # [BQ, BK]
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    d = k_ref.shape[-1]
+    init = (jnp.zeros((block_k, d), jnp.float32),
+            jnp.zeros((block_k, d), jnp.float32))
+    dk, dv = jax.lax.fori_loop(start_qb, num_qb, body, init)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, res, do3):
+    q3, k3, v3, out, lse = res
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    delta = jnp.sum(do3.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)                 # [bh, sq, 1]
+
+    with jax.enable_x64(False):
+        dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_k=sk),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            interpret=_interpret(),
+        )(q3, k3, v3, do3, lse, delta)
+
+        dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=sq),
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+        ],
+            interpret=_interpret(),
+        )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry (custom_vjp over [bh, s, d] tensors)
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash3(q3, k3, v3, scale, causal, block_q, block_k):
+    out, _ = _fwd(q3, k3, v3, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash3_fwd(q3, k3, v3, scale, causal, block_q, block_k):
+    out, lse = _fwd(q3, k3, v3, scale, causal, block_q, block_k)
+    return out, (q3, k3, v3, out, lse)
+
+
+def _flash3_bwd(scale, causal, block_q, block_k, res, do3):
+    return _bwd(scale, causal, block_q, block_k, res, do3)
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """q/k/v: [b, s, h, d] (paddle layout).  Returns [b, s, h, d]."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    hk = k.shape[2]
+    if hk != h:  # GQA: repeat kv heads
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k or d % 128 or sq % block_k:
+        raise ValueError("unsupported shape for pallas flash attention")
+    if causal and sq != sk:
+        # the kernel masks top-left aligned; the framework convention
+        # (ops.xla_attention) is bottom-right for cross lengths — refuse and
+        # let dispatch fall back rather than silently diverge
+        raise ValueError("causal cross-attention not supported by the "
+                         "pallas kernel (sq != sk)")
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    out = _flash3(q3, k3, v3, float(s), bool(causal), block_q, block_k)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

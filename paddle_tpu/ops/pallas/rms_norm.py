@@ -1,0 +1,124 @@
+"""Fused RMSNorm — Pallas TPU kernel with custom VJP.
+
+Reference: `python/paddle/incubate/nn/functional/fused_rms_norm.py` → phi
+fused CUDA kernel.  TPU-native: one VMEM pass per row block, fp32 stats;
+backward recomputes the inverse rms (cheaper than saving it) and reduces
+dw across row blocks with a fp32 accumulator output.
+
+  y   = x * rsqrt(mean(x², -1) + eps) * w
+  dx  = r*(g*w) - r³/H * x * Σ(g*w*x)      (r = rsqrt(mean x² + eps))
+  dw  = Σ_rows g * x * r
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_ROWS = 256
+
+INTERPRET = None
+
+
+def _interpret():
+    global INTERPRET
+    if INTERPRET is None:
+        INTERPRET = jax.default_backend() != "tpu"
+    return INTERPRET
+
+
+def _fwd_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True)
+                      + jnp.float32(eps))
+    o_ref[:] = (x * r * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _bwd_kernel(x_ref, w_ref, g_ref, dx_ref, dw_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    h = x.shape[-1]
+    r = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True)
+                      + jnp.float32(eps))
+    gw = g * w
+    dot = jnp.mean(gw * x, axis=-1, keepdims=True)
+    dx = r * gw - (r * r * r) * x * dot
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+    # per-block partial dw, reduced outside (grid dim 0 = row blocks)
+    dw_ref[0, 0] = jnp.sum(g * x * r, axis=0)
+
+
+def _pick_block_rows(rows):
+    """Largest divisor of rows that is ≤ BLOCK_ROWS and sublane-aligned
+    (multiple of 8), so blocks always satisfy TPU tiling and fit VMEM."""
+    for br in range(min(BLOCK_ROWS, rows), 7, -1):
+        if rows % br == 0 and br % 8 == 0:
+            return br
+    if rows <= BLOCK_ROWS:
+        return rows
+    raise ValueError(f"no tiling-compatible row block for {rows} rows")
+
+
+def _rms2(x2, w, eps):
+    rows, h = x2.shape
+    br = _pick_block_rows(rows)
+    grid = (rows // br,)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_fwd_kernel, eps=eps),
+            grid=grid,
+            in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                      pl.BlockSpec((h,), lambda i: (0,))],
+            out_specs=pl.BlockSpec((br, h), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct(
+                (rows, h), jnp.promote_types(x2.dtype, w.dtype)),
+            interpret=_interpret(),
+        )(x2, w)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rms_core(x2, w, eps):
+    return _rms2(x2, w, eps)
+
+
+def _rms_fwd(x2, w, eps):
+    return _rms2(x2, w, eps), (x2, w)
+
+
+def _rms_bwd(eps, res, g2):
+    x2, w = res
+    rows, h = x2.shape
+    br = _pick_block_rows(rows)
+    nblocks = rows // br
+    with jax.enable_x64(False):
+        dx, dw_part = pl.pallas_call(
+            functools.partial(_bwd_kernel, eps=eps),
+            grid=(nblocks,),
+            in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                      pl.BlockSpec((h,), lambda i: (0,)),
+                      pl.BlockSpec((br, h), lambda i: (i, 0))],
+            out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                       pl.BlockSpec((1, 1, h), lambda i: (i, 0, 0))],
+            out_shape=[jax.ShapeDtypeStruct((rows, h), x2.dtype),
+                       jax.ShapeDtypeStruct((nblocks, 1, h), jnp.float32)],
+            interpret=_interpret(),
+        )(x2, w, g2)
+    dw = jnp.sum(dw_part, axis=(0, 1)).astype(w.dtype)
+    return dx, dw
+
+
+_rms_core.defvjp(_rms_fwd, _rms_bwd)
+
+
+def rms_norm(x, weight, epsilon=1e-6):
+    """x: [..., H]; weight: [H]."""
+    shape = x.shape
+    h = shape[-1]
+    x2 = x.reshape(-1, h)
+    out = _rms_core(x2, weight, float(epsilon))
+    return out.reshape(shape)
