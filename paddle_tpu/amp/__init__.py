@@ -152,6 +152,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled_opts = set()  # id(optimizer) already unscale_()d
 
     def scale(self, var):
         if not self._enable or self._scale == 1.0:
@@ -161,6 +162,11 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable or self._scale == 1.0:
             return
+        if id(optimizer) in self._unscaled_opts:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update()")
+        self._unscaled_opts.add(id(optimizer))
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list:
@@ -174,7 +180,7 @@ class GradScaler:
         if not self._enable:
             optimizer.step()
             return
-        if self._scale != 1.0:
+        if self._scale != 1.0 and id(optimizer) not in self._unscaled_opts:
             self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
@@ -184,6 +190,7 @@ class GradScaler:
         self.update()
 
     def update(self):
+        self._unscaled_opts.clear()
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

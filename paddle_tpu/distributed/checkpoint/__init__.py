@@ -64,16 +64,21 @@ def load_state_dict(state_dict, path, process_group=None,
     current NamedSharding via device_put."""
     files = [f for f in os.listdir(path) if f.endswith(".distcp")]
     loaded = {}
+    meta = None
     for fname in sorted(files):
         with open(os.path.join(path, fname), "rb") as f:
             part = pickle.load(f)
         for k, v in part.items():
             if isinstance(v, dict) and "local" in v:
-                meta_path = os.path.join(path, "metadata.json")
-                with open(meta_path) as mf:
-                    meta = json.load(mf)
-                full = np.zeros(meta[k]["global_shape"],
-                                np.dtype(meta[k]["dtype"]))
+                if meta is None:
+                    with open(os.path.join(path, "metadata.json")) as mf:
+                        meta = json.load(mf)
+                # accumulate shards from every rank file into ONE array:
+                # each rank's file carries only its addressable shards
+                full = loaded.get(k)
+                if full is None:
+                    full = np.zeros(meta[k]["global_shape"],
+                                    np.dtype(meta[k]["dtype"]))
                 for local, index in zip(v["local"], v["index"]):
                     sl = tuple(slice(s, e) for s, e in index)
                     full[sl] = local

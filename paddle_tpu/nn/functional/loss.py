@@ -48,6 +48,18 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                 k = logp.shape[axis]
                 tgt = (1 - label_smoothing) * tgt + label_smoothing / k
             loss = -jnp.sum(tgt * logp, axis=axis)
+            if w:
+                # reference soft_label branch: per-sample weight is the
+                # target-probability-weighted class weight (matmul(label,
+                # weight)), multiplying the unweighted loss
+                shape = [1] * logp.ndim
+                shape[axis] = logp.shape[axis]
+                wv = w[0].astype(logp.dtype).reshape(shape)
+                wsample = jnp.sum(wv * tgt, axis=axis)
+                loss = loss * wsample
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wsample),
+                                                       1e-12)
         else:
             idx = lbl.astype(jnp.int32)
             if idx.ndim == logp.ndim:

@@ -185,6 +185,13 @@ class Layer:
                     continue
                 seen.add(id(p))
                 full = f"{layer_prefix}.{pname}" if layer_prefix else pname
+                if p.name is None:
+                    # auto-name with the hierarchical key (reference
+                    # auto-generates unique names at creation) so name-based
+                    # policies (exclude_from_weight_decay_fn,
+                    # apply_decay_param_fun) see the same string in the
+                    # eager optimizer and the sharded trainer
+                    p.name = full
                 yield full, p
 
     def named_buffers(self, prefix="", include_sublayers=True):

@@ -121,6 +121,12 @@ class Optimizer:
                     and self._apply_decay_param_fun is not None \
                     and not self._apply_decay_param_fun(p.name or ""):
                 wd = 0.0
+            exclude_fn = getattr(self, "_exclude_fn", None)
+            if exclude_fn is not None and exclude_fn(p.name or ""):
+                wd = 0.0
+            lr_ratio = getattr(self, "_lr_ratio", None)
+            if lr_ratio is not None:
+                plr = plr * float(lr_ratio(p))
             gval = g.value
             pval = p.value
             use_master = (self._multi_precision

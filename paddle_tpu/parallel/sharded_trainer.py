@@ -138,13 +138,19 @@ class ShardedTrainStep:
         hp = opt._hyper()
         upd = type(opt)._update
         sd = model.state_dict()
-        wds = []
+        wds, lr_scales = [], []
         for n in names:
             p = sd[n]
             wd = opt._wd_value(p)
             decay_fn = getattr(opt, "_apply_decay_param_fun", None)
             if decay_fn is not None and not decay_fn(p.name or n):
                 wd = 0.0
+            exclude_fn = getattr(opt, "_exclude_fn", None)
+            if exclude_fn is not None and exclude_fn(p.name or n):
+                wd = 0.0
+            lr_ratio = getattr(opt, "_lr_ratio", None)
+            lr_scales.append(float(lr_ratio(p)) if lr_ratio is not None
+                             else 1.0)
             wds.append(wd)
         remat = self.remat
 
@@ -168,8 +174,10 @@ class ShardedTrainStep:
             loss, grads = jax.value_and_grad(loss_of)(param_vals, buf_vals,
                                                       key, batch)
             new_params, new_states = [], []
-            for p, g, s, wd in zip(param_vals, grads, opt_states, wds):
-                np_, ns = upd(p, g, s, lr, wd, step_i, **hp)
+            for p, g, s, wd, ls in zip(param_vals, grads, opt_states, wds,
+                                       lr_scales):
+                np_, ns = upd(p, g, s, lr if ls == 1.0 else lr * ls, wd,
+                              step_i, **hp)
                 new_params.append(np_)
                 new_states.append(ns)
             return loss, new_params, new_states
