@@ -174,7 +174,9 @@ class GradScaler:
                 g = p.grad.value.astype(jnp.float32) * inv
                 found = bool(found or not jnp.all(jnp.isfinite(g)))
                 p.grad._value = g.astype(p.grad.value.dtype)
-        self._found_inf = found
+        # OR with prior optimizers' result: one overflow anywhere in the
+        # iteration must trigger the scale decrease in update()
+        self._found_inf = self._found_inf or found
 
     def step(self, optimizer):
         if not self._enable:

@@ -228,9 +228,11 @@ class SegmentParallel(_MetaParallelBase):
     pass
 
 
-# Pipeline building blocks land fully in the PP milestone; the descriptors
-# are defined here so model code can already be written against them.
 class LayerDesc:
+    """Reference: pp_layers.py LayerDesc — deferred layer construction so
+    each process builds only its own stage.  Single-controller TPU builds
+    all stages (params then live on per-stage submeshes)."""
+
     def __init__(self, layer_cls, *inputs, **kwargs):
         self.layer_cls = layer_cls
         self.inputs = inputs
@@ -241,6 +243,9 @@ class LayerDesc:
 
 
 class SharedLayerDesc(LayerDesc):
+    """Reference: pp_layers.py SharedLayerDesc — tied weights across stages
+    (e.g. embedding reused as the output projection)."""
+
     def __init__(self, key, layer_cls, forward_func=None,
                  shared_weight_attr="weight", *inputs, **kwargs):
         super().__init__(layer_cls, *inputs, **kwargs)
@@ -249,11 +254,25 @@ class SharedLayerDesc(LayerDesc):
         self.shared_weight_attr = shared_weight_attr
 
 
+class _SharedForward(Layer):
+    """Wraps a SharedLayerDesc occurrence whose forward is a custom
+    function of (layer, input) — e.g. x @ embedding.weight.T for the tied
+    output head."""
+
+    def __init__(self, inner, fn):
+        super().__init__()
+        self.inner = inner
+        self._fn = fn
+
+    def forward(self, *args):
+        return self._fn(self.inner, *args)
+
+
 class PipelineLayer(Layer):
     """Reference: meta_parallel/parallel_layers/pp_layers.py — a model
-    described as a flat list of LayerDescs partitioned into stages.  In
-    this build every stage lives in one process; stage assignment maps to
-    the 'pp' mesh axis in the compiled pipeline (see parallel/pipeline)."""
+    described as a flat list of LayerDescs partitioned into stages.  Stage
+    assignment maps segments onto the 'pp' mesh axis; the schedule runs in
+    paddle_tpu.parallel.pipeline.PipelineEngine."""
 
     def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
                  seg_method="uniform", recompute_interval=0, **kwargs):
@@ -261,14 +280,28 @@ class PipelineLayer(Layer):
         self.descs = layers
         self.loss_fn = loss_fn
         self._num_stages = num_stages or 1
+        self._seg_method = seg_method
         from ....nn import LayerList
         built = []
+        shared_masters = {}
         for d in layers:
-            if isinstance(d, LayerDesc):
+            if isinstance(d, SharedLayerDesc):
+                l = d.build_layer()
+                if d.layer_name in shared_masters:
+                    # tie to the SAME Parameter object: eager backward
+                    # accumulates both uses' grads; the pipeline engine
+                    # keeps per-stage placed copies in sync
+                    setattr(l, d.shared_weight_attr,
+                            shared_masters[d.layer_name])
+                else:
+                    w = getattr(l, d.shared_weight_attr)
+                    w._shared_key = d.layer_name
+                    shared_masters[d.layer_name] = w
+                built.append(_SharedForward(l, d.forward_func)
+                             if d.forward_func is not None else l)
+            elif isinstance(d, LayerDesc):
                 built.append(d.build_layer())
-            elif isinstance(d, Layer):
-                built.append(d)
-            else:  # plain callable (e.g. lambda reshape)
+            else:  # already-built Layer or plain callable (lambda reshape)
                 built.append(d)
         self.run_function = built
         self._layers_list = LayerList([l for l in built
@@ -280,26 +313,58 @@ class PipelineLayer(Layer):
     def forward(self, input):
         x = input
         for fn in self.run_function:
-            x = fn(x)
+            x = fn(*x) if isinstance(x, (tuple, list)) else fn(x)
         return x
 
 
 class PipelineParallel(_MetaParallelBase):
-    """Host-driven micro-batch schedule shell (full 1F1B in
-    paddle_tpu.parallel.pipeline)."""
+    """Reference: meta_parallel/pipeline_parallel.py:255 — train_batch
+    drives the micro-batch schedule (1F1B by default, FThenB selectable via
+    strategy pipeline_configs["schedule_mode"]), accumulates grads, then
+    steps the optimizer."""
 
     def __init__(self, layers, hcg=None, strategy=None, **kwargs):
         super().__init__(layers, hcg)
         self._strategy = strategy
+        self._engine = None
+        cfg = getattr(strategy, "pipeline_configs", None) or {}
+        self._accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self._schedule = cfg.get("schedule_mode", "1F1B")
+
+    def _get_engine(self):
+        if self._engine is None:
+            from ....parallel.pipeline import PipelineEngine
+            mesh = self._hcg.mesh if self._hcg is not None else None
+            self._engine = PipelineEngine(self._layers, mesh=mesh)
+        return self._engine
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        engine = self._get_engine()
+        return engine.train_batch(data, self._accumulate_steps,
+                                  schedule=self._schedule)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        from ....framework.tensor import Tensor
-        x, y = data
-        out = self._layers(x)
-        loss = self._layers.loss_fn(out, y)
-        loss.backward()
-        optimizer.step()
+        loss = self.forward_backward_pipeline(data, scaler)
+        if scaler is not None:
+            if scaler.is_enable() and scaler._scale != 1.0:
+                # the engine produces UNSCALED grads; re-scale them so the
+                # scaler's unscale_/inf-check/update protocol stays exact
+                from ....framework.tensor import Tensor
+                for p in self._layers.parameters():
+                    if p.grad is not None:
+                        p.grad = Tensor(p.grad._value * scaler._scale)
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
         return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        # must route through the engine's per-stage programs: once stages
+        # are committed to disjoint pp submeshes, a single eager pass would
+        # mix devices
+        engine = self._get_engine()
+        return engine.eval_batch(data, compute_loss=compute_loss)

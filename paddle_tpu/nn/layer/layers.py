@@ -103,6 +103,12 @@ class Layer:
                 if d is not None:
                     d.pop(name, None)
             params[name] = value
+            if value.name is None:
+                # auto-name like the reference ("linear_0.weight"): unique
+                # via the layer's full_name counter, and carries the class
+                # name for name-based decay policies. First owner wins
+                # (tied params keep their original name).
+                value.name = f"{self._full_name}.{name}"
             self.__dict__.pop(name, None)
         elif isinstance(value, Layer):
             if layers is None:
@@ -154,6 +160,8 @@ class Layer:
         if parameter is not None and not isinstance(parameter, Parameter):
             raise TypeError("add_parameter expects a Parameter")
         self._parameters[name] = parameter
+        if parameter is not None and parameter.name is None:
+            parameter.name = f"{self._full_name}.{name}"
         return parameter
 
     def add_sublayer(self, name, sublayer):
@@ -185,13 +193,6 @@ class Layer:
                     continue
                 seen.add(id(p))
                 full = f"{layer_prefix}.{pname}" if layer_prefix else pname
-                if p.name is None:
-                    # auto-name with the hierarchical key (reference
-                    # auto-generates unique names at creation) so name-based
-                    # policies (exclude_from_weight_decay_fn,
-                    # apply_decay_param_fun) see the same string in the
-                    # eager optimizer and the sharded trainer
-                    p.name = full
                 yield full, p
 
     def named_buffers(self, prefix="", include_sublayers=True):

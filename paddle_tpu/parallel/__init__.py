@@ -17,3 +17,4 @@ Reference files being replaced: fleet/meta_optimizers/dygraph_optimizer/
 group_sharded_stage{2,3}.py, fleet/utils/hybrid_parallel_util.py.
 """
 from .sharded_trainer import ShardedTrainStep, make_batch_sharding  # noqa: F401
+from .pipeline import PipelineEngine  # noqa: F401

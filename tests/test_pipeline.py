@@ -1,0 +1,161 @@
+"""Pipeline parallelism: loss equality vs non-pipelined execution.
+
+Reference test pattern: test/collective/fleet/hybrid_parallel_pp_*.py —
+a PipelineLayer trained through train_batch must match the same model
+trained unpipelined on one device (same init, same data).
+"""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc, SharedLayerDesc, PipelineLayer, PipelineParallel)
+from paddle_tpu.distributed.topology import (
+    HybridCommunicateGroup, set_hybrid_communicate_group)
+from paddle_tpu.parallel.pipeline import (
+    PipelineEngine, partition_uniform, partition_by_params)
+
+
+class Block(nn.Layer):
+    def __init__(self, d):
+        super().__init__()
+        self.fc1 = nn.Linear(d, 2 * d)
+        self.fc2 = nn.Linear(2 * d, d)
+        self.norm = nn.LayerNorm(d)
+
+    def forward(self, x):
+        return self.norm(x + self.fc2(nn.functional.gelu(self.fc1(x))))
+
+
+def _mse(out, y):
+    return ((out - y) ** 2).mean()
+
+
+def _make_descs(d, depth):
+    return [LayerDesc(Block, d) for _ in range(depth)] + [
+        LayerDesc(nn.Linear, d, d)]
+
+
+def _data(d, batch=8):
+    rng = np.random.RandomState(7)
+    x = rng.randn(batch, d).astype(np.float32)
+    y = rng.randn(batch, d).astype(np.float32)
+    return paddle.to_tensor(x), paddle.to_tensor(y)
+
+
+def _train_ref(model, data, steps, lr=0.05):
+    """Unpipelined baseline: same loss (mean over full batch) + SGD."""
+    opt = paddle.optimizer.SGD(lr, parameters=model.parameters())
+    x, y = data
+    losses = []
+    for _ in range(steps):
+        loss = _mse(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss.value)))
+    return losses
+
+
+@pytest.mark.parametrize("pp,micro,schedule", [
+    (2, 4, "1F1B"), (4, 8, "1F1B"), (2, 4, "FThenB"),
+])
+def test_pp_loss_matches_single_device(pp, micro, schedule):
+    d, depth, steps = 8, 3, 3
+    paddle.seed(42)
+    ref = PipelineLayer(_make_descs(d, depth), loss_fn=_mse)
+    paddle.seed(42)
+    pl = PipelineLayer(_make_descs(d, depth), loss_fn=_mse)
+
+    data = _data(d)
+    ref_losses = _train_ref(ref, data, steps)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"pp_degree": pp}
+    strategy.pipeline_configs = {"accumulate_steps": micro,
+                                 "schedule_mode": schedule}
+    hcg = HybridCommunicateGroup(pp_degree=pp)
+    set_hybrid_communicate_group(hcg)
+    model = PipelineParallel(pl, hcg=hcg, strategy=strategy)
+    opt = paddle.optimizer.SGD(0.05, parameters=pl.parameters())
+    pp_losses = [float(np.asarray(
+        model.train_batch(data, opt).value)) for _ in range(steps)]
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-5, atol=1e-6)
+
+
+def test_pp_param_count_partition():
+    weights = [100, 100, 100, 1, 1, 1, 100, 100]
+    b = partition_by_params(weights, 2)
+    assert b[0] == 0 and b[-1] == 8 and len(b) == 3
+    left = sum(weights[:b[1]])
+    right = sum(weights[b[1]:])
+    assert abs(left - right) <= 150  # roughly balanced
+
+    assert partition_uniform(10, 3) == [0, 4, 7, 10]
+
+
+def test_pp_shared_embedding_tied():
+    """Tied first/last weights (SharedLayerDesc) stay in sync and get
+    summed gradients."""
+    d, vocab = 8, 16
+
+    def head_fwd(layer, x):
+        return paddle.matmul(x, layer.weight, transpose_y=True)
+
+    def make():
+        return PipelineLayer(
+            [SharedLayerDesc("embed", nn.Embedding, None, "weight",
+                             vocab, d),
+             LayerDesc(Block, d),
+             SharedLayerDesc("embed", nn.Embedding, head_fwd, "weight",
+                             vocab, d)],
+            loss_fn=lambda out, y: paddle.nn.functional.cross_entropy(
+                out, y))
+
+    paddle.seed(3)
+    ref = make()
+    paddle.seed(3)
+    pl = make()
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randint(0, vocab, (8, 4)).astype(np.int64))
+    y = paddle.to_tensor(rng.randint(0, vocab, (8, 4)).astype(np.int64))
+
+    ref_losses = []
+    opt_ref = paddle.optimizer.SGD(0.1, parameters=ref.parameters())
+    for _ in range(2):
+        loss = ref.loss_fn(ref(x), y)
+        loss.backward()
+        opt_ref.step()
+        opt_ref.clear_grad()
+        ref_losses.append(float(np.asarray(loss.value)))
+
+    hcg = HybridCommunicateGroup(pp_degree=2)
+    set_hybrid_communicate_group(hcg)
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    model = PipelineParallel(pl, hcg=hcg, strategy=strategy)
+    opt = paddle.optimizer.SGD(0.1, parameters=pl.parameters())
+    pp_losses = [float(np.asarray(
+        model.train_batch([x, y], opt).value)) for _ in range(2)]
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-5, atol=1e-6)
+
+
+def test_pp_1f1b_in_flight_bound():
+    """1F1B order: stage 0 of a 4-stage pipeline never holds more than
+    pp in-flight forwards (vs m for FThenB)."""
+    hcg = HybridCommunicateGroup(pp_degree=4)
+    set_hybrid_communicate_group(hcg)
+    pl = PipelineLayer(_make_descs(8, 3), loss_fn=_mse)
+    eng = PipelineEngine(pl, mesh=hcg.mesh)
+    m = 8
+    order = eng._stage_order(0, m, "1F1B")
+    in_flight = peak = 0
+    for kind, _ in order:
+        in_flight += 1 if kind == "f" else -1
+        peak = max(peak, in_flight)
+    assert peak == 4
+    assert [k for k, _ in eng._stage_order(0, m, "FThenB")].count("f") == m
