@@ -33,8 +33,8 @@ from ... import topology as topo
 __all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
            "RowParallelLinear", "ParallelCrossEntropy", "RNGStatesTracker",
            "get_rng_state_tracker", "TensorParallel", "ShardingParallel",
-           "SegmentParallel", "PipelineLayer", "LayerDesc",
-           "SharedLayerDesc", "PipelineParallel"]
+           "SegmentParallel", "sep_alltoall_attention", "PipelineLayer",
+           "LayerDesc", "SharedLayerDesc", "PipelineParallel"]
 
 
 def _current_mesh():
@@ -225,7 +225,42 @@ class ShardingParallel(_MetaParallelBase):
 
 
 class SegmentParallel(_MetaParallelBase):
-    pass
+    """Reference: meta_parallel/segment_parallel.py:26 — the wrapper's only
+    job there is param broadcast + grad allreduce over the sep group, which
+    GSPMD does implicitly for replicated params.  The model-side attention
+    uses `sep_alltoall_attention` below (the part the reference leaves to
+    the model)."""
+
+
+def sep_alltoall_attention(q, k, v, causal=False, scale=None,
+                           seq_axis="sep"):
+    """Ulysses-style segment-parallel attention.
+
+    Reference: the 'sep' axis machinery (fleet/base/topology.py:199-255)
+    plus model-side all2all the reference expects users to write.  Here:
+    q/k/v [b, s, h, d] arrive seq-sharded on `seq_axis`; constraining them
+    head-sharded for the attention makes GSPMD emit the all_to_all pair
+    (seq↔heads), and the output constraint restores seq sharding."""
+    from ....framework.dispatch import run, to_tensor_args
+    from ....ops import xla_attention
+    from ..utils.sequence_parallel_utils import _reshard_val
+    q, k, v = to_tensor_args(q, k, v)
+
+    def fn(qv, kv, vv):
+        if kv.shape[2] != qv.shape[2]:
+            # GQA: repeat kv heads to the query head count so the head dim
+            # divides the sep degree (kv_heads < sep_degree is the common
+            # long-context config)
+            rep = qv.shape[2] // kv.shape[2]
+            kv = jnp.repeat(kv, rep, axis=2)
+            vv = jnp.repeat(vv, rep, axis=2)
+        head = (None, None, seq_axis, None)
+        seq = (None, seq_axis, None, None)
+        qh, kh, vh = (_reshard_val(a, head) for a in (qv, kv, vv))
+        out = xla_attention(qh, kh, vh, causal=causal, scale=scale)
+        return _reshard_val(out, seq)
+
+    return run(fn, q, k, v, name="sep_alltoall_attention")
 
 
 class LayerDesc:

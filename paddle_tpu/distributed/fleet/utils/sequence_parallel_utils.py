@@ -1,0 +1,174 @@
+"""Megatron-style sequence parallelism (SP).
+
+Reference: `python/paddle/distributed/fleet/utils/sequence_parallel_utils.py`
+— ScatterOp:85 / GatherOp:97 / AllGatherOp:111 / ReduceScatterOp:127 (hand
+written collective PyLayers), ColumnSequenceParallelLinear:427,
+RowSequenceParallelLinear:562, register_sequence_parallel_allreduce_hooks:192.
+
+TPU-native redesign: SP is a SHARDING ANNOTATION pattern, not a collective
+library.  Activations between the row- and column-parallel linears are
+sharded along the sequence dim over the 'mp' axis; XLA GSPMD then emits
+exactly the reference's collectives (allgather before the column matmul,
+reduce-scatter after the row matmul) — and fuses/overlaps them with compute.
+The Op classes survive as resharding markers so reference model code ports
+verbatim; gradients of a reshard are the transposed reshard, which jax
+derives automatically (no hand-written backward pairs needed).
+
+Layout note: the reference uses [s, b, h] for SP activations; here the seq
+dim index is explicit (`axis`, default 1 for the framework's native
+[b, s, h]) — pass axis=0 for ported [s, b, h] code.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....framework.tensor import Tensor
+from ....framework.dispatch import run, to_tensor_args
+from ... import topology as topo
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+
+def _mesh():
+    hcg = topo.get_hybrid_communicate_group()
+    return hcg.mesh if hcg is not None else None
+
+
+def _reshard_val(arr, spec):
+    """Sharding annotation that works both traced (constraint → GSPMD
+    collective) and eager (device_put reshard)."""
+    mesh = _mesh()
+    if mesh is None:
+        return arr
+    ns = NamedSharding(mesh, P(*spec))
+    if isinstance(arr, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(arr, ns)
+    try:
+        return jax.device_put(arr, ns)
+    except Exception:
+        return arr
+
+
+def _seq_spec(ndim, axis, axis_name="mp"):
+    spec = [None] * ndim
+    spec[axis] = axis_name
+    return spec
+
+
+class _ReshardOp:
+    """Base for the four SP markers: forward is a reshard; backward is the
+    reshard jax derives for the transpose."""
+
+    seq_sharded_out = True
+
+    @classmethod
+    def apply(cls, x, axis=1):
+        (x,) = to_tensor_args(x)
+
+        def fn(v):
+            spec = (_seq_spec(v.ndim, axis) if cls.seq_sharded_out
+                    else [None] * v.ndim)
+            return _reshard_val(v, spec)
+
+        return run(fn, x, name=cls.__name__.lower())
+
+
+class ScatterOp(_ReshardOp):
+    """Reference :85 — split activation along seq across the mp group
+    (grad: allgather)."""
+    seq_sharded_out = True
+
+
+class ReduceScatterOp(_ReshardOp):
+    """Reference :127 — reduce partial sums and scatter along seq
+    (grad: allgather).  Under GSPMD the reduce half is implied by the
+    producer's partial values."""
+    seq_sharded_out = True
+
+
+class GatherOp(_ReshardOp):
+    """Reference :97 — allgather along seq (grad: scatter)."""
+    seq_sharded_out = False
+
+
+class AllGatherOp(_ReshardOp):
+    """Reference :111 — allgather along seq (grad: reduce-scatter)."""
+    seq_sharded_out = False
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_allreduce=False):
+    """Reference :192 registers grad allreduce hooks over the mp group for
+    SP params (layernorms).  Under GSPMD, replicated params automatically
+    receive summed gradients from seq-sharded activations — no hook needed;
+    kept for API parity."""
+    return None
+
+
+class ColumnSequenceParallelLinear:
+    """Reference :427 — allgather(seq) → column-parallel matmul.
+
+    Implemented as input/output sharding annotations around a
+    ColumnParallelLinear; GSPMD inserts the seq allgather."""
+
+    def __new__(cls, in_features, out_features, weight_attr=None,
+                has_bias=None, gather_output=False, fuse_matmul_bias=False,
+                mp_group=None, name=None, axis=1):
+        if gather_output:
+            raise ValueError(
+                "ColumnSequenceParallelLinear requires gather_output=False "
+                "(the reference asserts the same: its output stays "
+                "mp-sharded for the following row-parallel linear)")
+        from ..meta_parallel import ColumnParallelLinear
+
+        class _Wrapped(ColumnParallelLinear):
+            def forward(self, x, _axis=axis):
+                # input arrives seq-sharded; constrain, then let the
+                # matmul consume the allgathered value
+                (x,) = to_tensor_args(x)
+                x = run(lambda v: _reshard_val(
+                    v, _seq_spec(v.ndim, _axis)), x, name="sp_in")
+                out = super().forward(x)
+                (out,) = to_tensor_args(out)
+                return run(lambda v: _reshard_val(
+                    v, [None] * (v.ndim - 1) + ["mp"]), out,
+                    name="sp_col_out")
+
+        return _Wrapped(in_features, out_features, weight_attr=weight_attr,
+                        has_bias=has_bias, gather_output=False,
+                        fuse_matmul_bias=fuse_matmul_bias,
+                        mp_group=mp_group, name=name)
+
+
+class RowSequenceParallelLinear:
+    """Reference :562 — row-parallel matmul → reduce-scatter(seq)."""
+
+    def __new__(cls, in_features, out_features, weight_attr=None,
+                has_bias=True, input_is_parallel=True,
+                fuse_matmul_bias=False, mp_group=None, name=None, axis=1):
+        if not input_is_parallel:
+            raise ValueError(
+                "RowSequenceParallelLinear requires input_is_parallel=True "
+                "(reference sequence_parallel_utils.py:562 asserts this)")
+        from ..meta_parallel import RowParallelLinear
+
+        class _Wrapped(RowParallelLinear):
+            def forward(self, x, _axis=axis):
+                out = super().forward(x)
+                (out,) = to_tensor_args(out)
+                return run(lambda v: _reshard_val(
+                    v, _seq_spec(v.ndim, _axis)), out, name="sp_row_out")
+
+        return _Wrapped(in_features, out_features, weight_attr=weight_attr,
+                        has_bias=has_bias, input_is_parallel=True,
+                        fuse_matmul_bias=fuse_matmul_bias,
+                        mp_group=mp_group, name=name)
