@@ -6,8 +6,8 @@ train step whose in/out shardings encode the strategy:
 
   dp        → batch sharded on 'dp'; grad psum inserted by XLA
   sharding1 → opt states sharded on 'sharding' (ZeRO-1)
-  sharding2 → + grads reduce-scattered (XLA does this when opt-state
-              shardings force it)
+  sharding2 → + grads constrained to materialize sharded (explicit
+              with_sharding_constraint → reduce-scatter on TPU)
   sharding3 → + params sharded, allgathered per-layer by XLA (ZeRO-3)
   mp        → param NamedShardings from the model (TP)
   sep       → sequence axis sharding (context parallel, ring attention)

@@ -170,9 +170,22 @@ class ShardedTrainStep:
                 fwd = jax.checkpoint(fwd)
             return fwd(param_vals)
 
+        # stage 2 (ZeRO-2): force grads to MATERIALIZE sharded on the
+        # 'sharding' axis — XLA must emit a reduce-scatter for the grad
+        # reduction instead of an all-reduce (reference:
+        # DygraphShardingOptimizerV2:585 / group_sharded_stage2.py grad
+        # slicing).  Stage 1 keeps replicated grads (all-reduce) and only
+        # shards optimizer state.
+        grad_shardings = None
+        if self.stage == 2 and self.mesh.shape.get("sharding", 1) > 1:
+            grad_shardings = [self._opt_shardings[n] for n in names]
+
         def step(param_vals, opt_states, buf_vals, lr, step_i, key, batch):
             loss, grads = jax.value_and_grad(loss_of)(param_vals, buf_vals,
                                                       key, batch)
+            if grad_shardings is not None:
+                grads = [jax.lax.with_sharding_constraint(g, gs)
+                         for g, gs in zip(grads, grad_shardings)]
             new_params, new_states = [], []
             for p, g, s, wd, ls in zip(param_vals, grads, opt_states, wds,
                                        lr_scales):
@@ -192,8 +205,23 @@ class ShardedTrainStep:
                 step, donate_argnums=donate,
                 out_shardings=(None, param_sh, opt_sh))
 
-    # -- run ---------------------------------------------------------------
-    def __call__(self, *batch):
+    def compiled_hlo(self, *batch, optimized: bool = True) -> str:
+        """Compile the step for `batch` (without executing) and return the
+        HLO — lets tests and users assert the collective pattern their
+        sharding stage implies.  optimized=False returns the pre-SPMD
+        StableHLO, where explicit sharding constraints (e.g. stage-2 grad
+        shardings) are still visible as @Sharding custom calls."""
+        param_vals, buf_vals, batch_vals = self._prepare(batch)
+        lowered = self._compiled.lower(
+            param_vals, self._opt_states, buf_vals,
+            jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.int32),
+            jax.random.key(0), batch_vals)
+        return lowered.compile().as_text() if optimized \
+            else lowered.as_text()
+
+    def _prepare(self, batch):
+        """Shared prologue of __call__ and compiled_hlo: gather current
+        values, lazily init opt states / build, shard the batch."""
         sd = self.model.state_dict()
         param_vals = [sd[n]._value for n in self._names]
         buf_vals = [sd[n]._value for n in self._buf_names]
@@ -201,12 +229,18 @@ class ShardedTrainStep:
             self._opt_states = self._init_opt_states()
         if self._compiled is None:
             self._build()
-        self.optimizer._step_count += 1
-        lr = self.optimizer.get_lr()
-        key = prandom.next_key()
         batch_vals = tuple(
             self._shard_batch(b.value if isinstance(b, Tensor)
                               else jnp.asarray(b)) for b in batch)
+        return param_vals, buf_vals, batch_vals
+
+    # -- run ---------------------------------------------------------------
+    def __call__(self, *batch):
+        sd = self.model.state_dict()
+        param_vals, buf_vals, batch_vals = self._prepare(batch)
+        self.optimizer._step_count += 1
+        lr = self.optimizer.get_lr()
+        key = prandom.next_key()
         loss, new_params, new_states = self._compiled(
             param_vals, self._opt_states, buf_vals,
             jnp.asarray(lr, jnp.float32),
