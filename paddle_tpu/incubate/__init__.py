@@ -5,6 +5,7 @@ Reference: `python/paddle/incubate/` — nn/functional fused transformer ops
 fused_matmul_bias, memory_efficient_attention), MoE models.
 """
 from . import nn  # noqa: F401
+from . import distributed  # noqa: F401
 
 
 class autograd:

@@ -1,0 +1,3 @@
+"""Gate namespace for reference-path parity
+(`incubate/distributed/models/moe/gate/`)."""
+from .. import NaiveGate, SwitchGate, GShardGate  # noqa: F401

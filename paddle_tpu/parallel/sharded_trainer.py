@@ -222,7 +222,7 @@ class ShardedTrainStep:
     def _prepare(self, batch):
         """Shared prologue of __call__ and compiled_hlo: gather current
         values, lazily init opt states / build, shard the batch."""
-        sd = self.model.state_dict()
+        sd = self._sd = self.model.state_dict()
         param_vals = [sd[n]._value for n in self._names]
         buf_vals = [sd[n]._value for n in self._buf_names]
         if self._opt_states is None:
@@ -236,8 +236,8 @@ class ShardedTrainStep:
 
     # -- run ---------------------------------------------------------------
     def __call__(self, *batch):
-        sd = self.model.state_dict()
         param_vals, buf_vals, batch_vals = self._prepare(batch)
+        sd = self._sd
         self.optimizer._step_count += 1
         lr = self.optimizer.get_lr()
         key = prandom.next_key()
