@@ -1,0 +1,42 @@
+"""Flat generated-op namespace.
+
+Reference: `paddle.base.core` / `_C_ops` — the generated python C bindings
+(`eager/auto_code_generator/generator/python_c_gen.py:113` emits one
+`eager_api_{op}` per YAML entry).  Here the same flat surface resolves to
+the public functions (registry-generated or hand-written) via PEP 562
+module __getattr__ — there is no separate binding layer to generate
+because dispatch already goes straight to XLA.
+"""
+from __future__ import annotations
+
+
+def _resolve(name):
+    import paddle_tpu
+    for mod in (paddle_tpu, paddle_tpu.nn.functional):
+        f = getattr(mod, name, None)
+        if f is not None:
+            return f
+    return None
+
+
+def __getattr__(name):
+    f = _resolve(name)
+    if f is not None:
+        return f
+    if name.endswith("_") and not name.endswith("__"):
+        # trailing underscore = INPLACE variant (reference _C_ops
+        # contract): run the base op, write the result back into the
+        # first tensor argument, return it
+        base = _resolve(name[:-1])
+        if base is not None:
+            def inplace(target, *args, **kwargs):
+                from paddle_tpu.framework.tensor import Tensor
+                out = base(target, *args, **kwargs)
+                if isinstance(target, Tensor) and isinstance(out, Tensor):
+                    target._value = out._value
+                    target._set_ref(out._ref)
+                    return target
+                return out
+            inplace.__name__ = name
+            return inplace
+    raise AttributeError(f"_C_ops has no op {name!r}")

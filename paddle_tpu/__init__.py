@@ -96,3 +96,9 @@ def get_default_dtype():
 
 
 _default_dtype = float32
+
+# ---------------------------------------------------------------------------
+# registry-generated op long tail (reference: ops.yaml -> generated API;
+# see paddle_tpu/ops/registry.py)
+from .ops.registry import build_ops as _build_ops  # noqa: E402
+_registry_ops = _build_ops(globals(), tensor_cls=Tensor)

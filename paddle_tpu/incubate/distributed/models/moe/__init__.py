@@ -100,15 +100,6 @@ class _GateBase(Layer):
         return max(self.top_k,
                    int(math.ceil(cf * num_tokens / self.num_experts)))
 
-    def route(self, tokens):
-        """tokens: [S, d] raw values → (dispatch, combine, aux)."""
-        logits = tokens @ self.weight._value
-        gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        cap = self.capacity(tokens.shape[0])
-        d, c, aux = _topk_dispatch(gates, self.top_k, cap)
-        if not self.use_aux:
-            aux = jnp.zeros((), jnp.float32)
-        return d, c, aux
 
 
 class NaiveGate(_GateBase):
@@ -236,6 +227,25 @@ class MoELayer(Layer):
                 logits = tokens.astype(jnp.float32) @ gwv.astype(
                     jnp.float32)
                 gates = jax.nn.softmax(logits, axis=-1)
+                if not gate.use_capacity:
+                    # no-drop top-k: run every expert on every token and
+                    # combine with the [S, E] top-k weights — avoids the
+                    # [S, E, S] dispatch tensor an uncapped capacity
+                    # formulation would need (O(S²E) memory)
+                    topv, topi = jax.lax.top_k(gates, gate.top_k)
+                    normv = topv / jnp.maximum(
+                        jnp.sum(topv, -1, keepdims=True), 1e-9)
+                    cmb = jnp.zeros_like(gates)
+                    for j in range(gate.top_k):
+                        cmb = cmb + normv[:, j, None] * jax.nn.one_hot(
+                            topi[:, j], gates.shape[-1])
+                    h = jax.nn.gelu(
+                        jnp.einsum("sm,emh->esh", tokens, w1) + b1)
+                    expert_out = jnp.einsum("esh,ehm->esm", h, w2) + b2
+                    y = jnp.einsum("se,esm->sm",
+                                   cmb.astype(xv.dtype), expert_out)
+                    aux = jnp.zeros((), jnp.float32)
+                    return y.reshape(shape), aux
                 cap = gate.capacity(tokens.shape[0])
                 dispatch, combine, aux = _topk_dispatch(
                     gates, gate.top_k, cap)

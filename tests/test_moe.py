@@ -119,6 +119,25 @@ def test_moe_expert_parallel_on_mesh():
     set_hybrid_communicate_group(None)
 
 
+def test_naive_gate_dense_path_equals_dense():
+    """NaiveGate (no capacity) uses the dense no-drop path; with identical
+    experts it must equal the dense MLP."""
+    set_hybrid_communicate_group(None)
+    paddle.seed(6)
+    d, h, E = 8, 16, 4
+    moe = MoELayer(d_model=d, d_hidden=h, num_experts=E, gate="naive",
+                   top_k=2)
+    for p in (moe.w1, moe.b1, moe.w2, moe.b2):
+        arr = np.array(p.value)
+        arr[1:] = arr[0]
+        p.set_value(arr)
+    x = np.random.RandomState(6).randn(2, 5, d).astype(np.float32)
+    out = moe(paddle.to_tensor(x))
+    expect = _dense_mlp_from_moe(moe)(x.reshape(-1, d)).reshape(x.shape)
+    np.testing.assert_allclose(np.asarray(out.value), expect, rtol=2e-5,
+                               atol=2e-5)
+
+
 def test_reference_style_expert_list():
     set_hybrid_communicate_group(None)
     paddle.seed(5)

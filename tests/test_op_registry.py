@@ -1,0 +1,123 @@
+"""Generated OpTest coverage for every registry op.
+
+Single-source principle (SURVEY §1): each OpSpec carries its numpy
+reference and sample inputs, so this file is ONE parametrized test that
+grows automatically with the registry — the TPU analog of the reference's
+ops.yaml-driven OpTest matrix.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.registry import REGISTRY
+from op_test import check_output, check_grad, DTYPE_ATOL
+
+
+_SPECS = {s.name: s for s in REGISTRY}
+
+
+def _flat_inputs(spec, arrays):
+    """Variadic specs carry their tensor list as arrays[0]; flatten for
+    the harness and rebuild the list inside the called fns."""
+    return list(arrays[0]) if spec.n_tensors == -1 else arrays
+
+
+@pytest.mark.parametrize("name", sorted(_SPECS))
+def test_registry_op_output(name):
+    spec = _SPECS[name]
+    fn = getattr(paddle, name)
+    arrays, attrs = spec.samples()
+
+    def paddle_fn(*ts):
+        if spec.n_tensors == -1:
+            return fn(list(ts), **attrs)
+        return fn(*ts, **attrs)
+
+    def numpy_fn(*arrs):
+        if spec.n_tensors == -1:
+            return spec.np_ref(list(arrs), **attrs)
+        return spec.np_ref(*arrs, **attrs)
+
+    atol = spec.atol if spec.atol is not None else DTYPE_ATOL["float32"]
+    check_output(paddle_fn, numpy_fn, _flat_inputs(spec, arrays),
+                 atol=atol)
+
+
+@pytest.mark.parametrize(
+    "name", sorted(n for n, s in _SPECS.items() if s.grad))
+def test_registry_op_grad(name):
+    spec = _SPECS[name]
+    fn = getattr(paddle, name)
+    arrays, attrs = spec.samples()
+
+    def paddle_fn(*ts):
+        if spec.n_tensors == -1:
+            out = fn(list(ts), **attrs)
+        else:
+            out = fn(*ts, **attrs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return out
+
+    atol = spec.grad_atol if spec.grad_atol is not None else 5e-3
+    check_grad(paddle_fn, _flat_inputs(spec, arrays), atol=atol,
+               rtol=atol)
+
+
+def test_c_ops_namespace():
+    """_C_ops resolves registry ops, hand-written ops, and functional."""
+    from paddle_tpu import _C_ops
+    assert _C_ops.erf is not None
+    assert _C_ops.matmul is not None
+    assert _C_ops.relu is not None
+    with pytest.raises(AttributeError):
+        _C_ops.definitely_not_an_op
+
+
+def test_c_ops_inplace_alias_mutates():
+    from paddle_tpu import _C_ops
+    x = paddle.to_tensor(np.array([0.5, -0.5], np.float32))
+    out = _C_ops.erf_(x)
+    assert out is x
+    np.testing.assert_allclose(np.asarray(x.value),
+                               [0.5204999, -0.5204999], rtol=1e-5)
+
+
+def test_bitwise_invert_int64_and_bool():
+    x = paddle.to_tensor(np.array([2 ** 40], np.int64))
+    np.testing.assert_array_equal(
+        np.asarray(paddle.bitwise_invert(x).value), [-(2 ** 40) - 1])
+    b = paddle.to_tensor(np.array([True, False]))
+    np.testing.assert_array_equal(
+        np.asarray(paddle.bitwise_invert(b).value), [False, True])
+
+
+def test_tensor_methods_from_registry():
+    x = paddle.to_tensor(np.array([0.1, 0.5], np.float32))
+    np.testing.assert_allclose(np.asarray(x.erf().value),
+                               [0.1124629, 0.5204999], rtol=1e-5)
+    assert hasattr(x, "lgamma") and hasattr(x, "hypot")
+
+
+def test_registry_size():
+    """The registry must OWN (generate, not merely re-test) ≥50 ops that
+    had no hand-written implementation (VERDICT round-1 item 7)."""
+    owned = [s.name for s in REGISTRY
+             if "op registry" in (getattr(paddle, s.name).__doc__ or "")]
+    assert len(owned) >= 50, (len(owned), sorted(owned))
+
+
+def test_cdist_inf_norm():
+    x = paddle.to_tensor(np.array([[0., 0.], [1., 3.]], np.float32))
+    y = paddle.to_tensor(np.array([[2., 1.]], np.float32))
+    out = paddle.cdist(x, y, p=float("inf"))
+    np.testing.assert_allclose(np.asarray(out.value), [[2.], [2.]])
+
+
+def test_index_fill_negative_axis():
+    x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    out = paddle.index_fill(x, paddle.to_tensor(np.array([1])), axis=-1,
+                            value=7.0)
+    expect = np.zeros((2, 3), np.float32)
+    expect[:, 1] = 7.0
+    np.testing.assert_array_equal(np.asarray(out.value), expect)
