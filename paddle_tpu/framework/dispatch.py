@@ -86,6 +86,25 @@ def run(raw_fn, *tensors: Tensor, name: str = "", n_outs: Optional[int] = None):
     single = not isinstance(outs, (tuple, list))
     outs_t = (outs,) if single else tuple(outs)
 
+    # NaN/Inf sentinel (reference: FLAGS_check_nan_inf →
+    # CheckVarHasNanOrInf in nan_inf_utils_detail.h:70, scanning every
+    # kernel output).  Skipped under traces — jit paths use
+    # jax.debug_nans/checkify (see paddle_tpu.amp.debugging).
+    from .flags import get_flag
+    if get_flag("check_nan_inf"):
+        for o in outs_t:
+            if _is_tracer(o) or not _is_float_dtype(o.dtype):
+                continue
+            if not bool(jnp.all(jnp.isfinite(o))):
+                level = get_flag("check_nan_inf_level", 0)
+                msg = (f"Operator '{name or raw_fn.__name__}' output "
+                       f"contains NaN/Inf (shape={tuple(o.shape)}, "
+                       f"dtype={o.dtype})")
+                if level == 0:
+                    raise FloatingPointError(msg)
+                import warnings
+                warnings.warn(msg)
+
     out_tensors = []
     out_refs = []
     out_avals = []
