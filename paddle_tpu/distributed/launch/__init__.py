@@ -1,0 +1,82 @@
+"""Distributed job launcher: ``python -m paddle_tpu.distributed.launch``.
+
+Reference: `python/paddle/distributed/launch/main.py:23` (CLI surface) and
+`launch/context/args_envs.py` (PADDLE_* env pickup).  TPU-native: one
+worker process per host drives all local chips (``--nproc_per_node``
+defaults to 1); rendezvous is an HTTP KV master instead of etcd; elastic
+fault-tolerance = heartbeat lease + gang relaunch (``--max_restart``).
+
+Usage::
+
+    python -m paddle_tpu.distributed.launch \
+        --master=10.0.0.1:8090 --nnodes=4 train.py --lr 3e-4
+"""
+from __future__ import annotations
+
+import os
+from argparse import REMAINDER, ArgumentParser
+
+from .controller import CollectiveController
+from .master import KVClient, KVServer
+
+__all__ = ["launch", "parse_args", "CollectiveController",
+           "KVServer", "KVClient"]
+
+# env var -> (arg name, type); subset of reference args_envs.py mapping
+ENV_ARGS = {
+    "PADDLE_MASTER": ("master", str),
+    "PADDLE_NNODES": ("nnodes", str),
+    "PADDLE_NPROC_PER_NODE": ("nproc_per_node", int),
+    "PADDLE_JOB_ID": ("job_id", str),
+    "PADDLE_RANK": ("rank", int),
+    "PADDLE_LOG_DIR": ("log_dir", str),
+    "PADDLE_MAX_RESTART": ("max_restart", int),
+    "PADDLE_ELASTIC_TIMEOUT": ("elastic_timeout", int),
+    "PADDLE_DEVICES": ("devices", str),
+}
+
+
+def parse_args(argv=None):
+    p = ArgumentParser(prog="paddle_tpu.distributed.launch")
+    p.add_argument("--master", type=str, default=None,
+                   help="rendezvous KV server host:port (http)")
+    p.add_argument("--rank", type=int, default=-1,
+                   help="node rank; -1 = assigned by rendezvous order")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="number of nodes, or MIN:MAX for elastic")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes per node (TPU default: 1 "
+                        "process drives all local chips)")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--devices", type=str, default=None)
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_timeout", type=int, default=60)
+    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=REMAINDER)
+    args = p.parse_args(argv)
+    # env pickup (CLI wins; reference reads env first then overrides)
+    for env, (name, typ) in ENV_ARGS.items():
+        if env in os.environ and p.get_default(name) == getattr(args, name):
+            setattr(args, name, typ(os.environ[env]))
+    # elastic range "2:4" -> use min as the rendezvous count
+    ns = str(args.nnodes)
+    if ":" in ns:
+        lo, _, hi = ns.partition(":")
+        args.nnodes_min, args.nnodes_max = int(lo), int(hi)
+        args.nnodes = int(lo)
+    else:
+        args.nnodes = int(ns)
+        args.nnodes_min = args.nnodes_max = args.nnodes
+    return args
+
+
+def launch(argv=None) -> int:
+    args = parse_args(argv)
+    if args.run_mode != "collective":
+        raise NotImplementedError(
+            f"run_mode={args.run_mode!r}: TPU jobs are collective-only "
+            "(no parameter-server mode; reference ps/rpc modes are "
+            "CPU-cluster specific)")
+    return CollectiveController(args).run()

@@ -1,0 +1,271 @@
+"""Collective controller: spawn, watch, relaunch.
+
+Reference design: `python/paddle/distributed/launch/controllers/controller.py`
+(Controller.run / watch loop), `controllers/collective.py` (env wiring per
+trainer) and `fleet/elastic/manager.py:125` (heartbeat lease + fault
+tolerance).  TPU-native differences:
+
+* One worker process per host drives every local TPU chip via SPMD, so
+  ``nproc_per_node`` defaults to 1 on TPU (the reference defaults to one
+  proc per GPU).  CPU fake-clusters may set it higher for testing.
+* Rendezvous is the stdlib HTTP KV master (`master.py`), not etcd; node
+  rank 0 doubles as the jax.distributed coordinator.
+* Fault tolerance: each pod leases a heartbeat key; the watch loop kills
+  and relaunches the local procs (up to --max_restart) when a child dies,
+  and reports peer death when a lease lapses.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import uuid
+
+from .master import KVClient, KVServer
+
+__all__ = ["CollectiveController", "ProcEntry"]
+
+HEARTBEAT_INTERVAL = 2.0
+HEARTBEAT_TTL = 10.0
+
+
+class ProcEntry:
+    def __init__(self, cmd, env, log_path, local_rank):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.local_rank = local_rank
+        self.proc = None
+        self._log_f = None
+
+    def start(self):
+        self._log_f = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            self.cmd, env=self.env, stdout=self._log_f,
+            stderr=subprocess.STDOUT)
+
+    def poll(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self, grace=3.0):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=grace)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        if self._log_f:
+            self._log_f.close()
+            self._log_f = None
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _this_host():
+    return os.environ.get("POD_IP") or socket.gethostbyname(
+        socket.gethostname())
+
+
+class CollectiveController:
+    """Drives one node of a collective job end to end:
+    rendezvous -> spawn -> watch -> (relaunch | exit)."""
+
+    def __init__(self, args):
+        self.args = args
+        self.pod_id = f"{_this_host()}-{uuid.uuid4().hex[:6]}"
+        self.job_id = args.job_id
+        self.restarts = 0
+        self.procs: list[ProcEntry] = []
+        self.master_server = None  # KVServer if this node hosts it
+        self.kv = None             # KVClient if multi-node
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    # ---------------- rendezvous ----------------
+
+    def _maybe_start_master(self):
+        """If --master names this host (or localhost), try to serve it.
+        Reference: master.py HTTPStore self-starts on the matching node."""
+        master = self.args.master
+        for scheme in ("http://", "https://", "etcd://"):
+            if master.startswith(scheme):
+                master = master[len(scheme):]
+        host, _, port = master.partition(":")
+        port = int(port or 8090)
+        me = {_this_host(), "127.0.0.1", "localhost", "0.0.0.0"}
+        if host in me:
+            try:
+                self.master_server = KVServer(port).start()
+            except OSError:
+                pass  # already running (another launcher got there first)
+
+    def rendezvous(self):
+        """Register this pod, wait for nnodes peers, derive node_rank and
+        the jax coordinator address.  Single-node jobs skip the master."""
+        a = self.args
+        if a.nnodes <= 1 and not a.master:
+            self.node_rank, self.peers = 0, [f"{_this_host()}:0"]
+            self.coordinator = None
+            return
+        if not a.master:
+            raise ValueError("--master is required when nnodes > 1")
+        self._maybe_start_master()
+        self.kv = KVClient(a.master)
+        deadline = time.time() + 30
+        while not self.kv.alive():
+            if time.time() > deadline:
+                raise TimeoutError(f"master {a.master} unreachable")
+            time.sleep(0.5)
+        coord_port = _free_port()
+        my_key = f"{self.job_id}/pods/{time.time():020.6f}-{self.pod_id}"
+        self.kv.put(my_key, f"{_this_host()}:{coord_port}")
+        got = self.kv.wait_n(f"{self.job_id}/pods", a.nnodes,
+                             timeout=a.elastic_timeout)
+        order = sorted(got)[: a.nnodes]
+        self.peers = [got[k] for k in order]
+        self.node_rank = order.index(my_key)
+        if a.rank >= 0:
+            self.node_rank = a.rank
+        # node 0's registered endpoint doubles as jax coordinator
+        self.coordinator = self.peers[0]
+
+    # ---------------- spawn ----------------
+
+    def _child_env(self, local_rank):
+        a = self.args
+        nproc = a.nproc_per_node
+        global_rank = self.node_rank * nproc + local_rank
+        world = a.nnodes * nproc
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(global_rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_LOCAL_SIZE": str(nproc),
+            "PADDLE_NNODES": str(a.nnodes),
+            "PADDLE_NODE_RANK": str(self.node_rank),
+            "PADDLE_JOB_ID": self.job_id,
+            "PADDLE_RESTART_CNT": str(self.restarts),
+        })
+        if self.coordinator:
+            env["PADDLE_MASTER"] = self.coordinator
+            env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(self.peers)
+        if a.devices:
+            env["TPU_VISIBLE_DEVICES"] = a.devices
+        return env
+
+    def build_procs(self):
+        a = self.args
+        self.procs = []
+        if a.training_script.endswith(".py"):
+            cmd = [sys.executable, "-u", a.training_script,
+                   *a.training_script_args]
+        else:  # bare executable, mirror reference behavior
+            cmd = [a.training_script, *a.training_script_args]
+        for lr in range(a.nproc_per_node):
+            grank = self.node_rank * a.nproc_per_node + lr
+            log = os.path.join(
+                a.log_dir, f"workerlog.{self.job_id}.{grank}")
+            self.procs.append(
+                ProcEntry(cmd, self._child_env(lr), log, lr))
+
+    def launch(self):
+        self.build_procs()
+        for p in self.procs:
+            p.start()
+
+    # ---------------- heartbeat / elastic ----------------
+
+    def _heartbeat_loop(self):
+        while not self._hb_stop.wait(HEARTBEAT_INTERVAL):
+            self.kv.put(f"{self.job_id}/heartbeat/{self.pod_id}",
+                        f"{time.time()}")
+
+    def start_heartbeat(self):
+        if self.kv is None:
+            return
+        self.kv.put(f"{self.job_id}/heartbeat/{self.pod_id}",
+                    f"{time.time()}")
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True)
+        self._hb_thread.start()
+
+    def dead_peers(self):
+        """Pods whose heartbeat lease lapsed (reference:
+        elastic/manager.py lease_heartbeat)."""
+        if self.kv is None:
+            return []
+        now = time.time()
+        hb = self.kv.prefix(f"{self.job_id}/heartbeat")
+        return [k.rsplit("/", 1)[-1] for k, v in hb.items()
+                if now - float(v) > HEARTBEAT_TTL]
+
+    # ---------------- watch ----------------
+
+    def watch(self) -> int:
+        """Poll children; on a bad exit, kill the gang and relaunch up to
+        --max_restart times (reference: controller.py watch +
+        elastic ElasticLevel.FAULT_TOLERANCE)."""
+        a = self.args
+        while True:
+            time.sleep(0.5)
+            codes = [p.poll() for p in self.procs]
+            if all(c == 0 for c in codes):
+                return 0
+            bad = [c for c in codes if c not in (None, 0)]
+            if bad:
+                for p in self.procs:
+                    p.terminate()
+                if self.restarts < a.max_restart:
+                    self.restarts += 1
+                    print(f"[launch] child failed (exit {bad[0]}); "
+                          f"restart {self.restarts}/{a.max_restart}",
+                          file=sys.stderr)
+                    self.launch()
+                    continue
+                return int(bad[0])
+            dead = self.dead_peers()
+            if dead:
+                print(f"[launch] peer heartbeat lost: {dead}; "
+                      "stopping local procs", file=sys.stderr)
+                for p in self.procs:
+                    p.terminate()
+                return 1
+
+    def stop(self):
+        self._hb_stop.set()
+        for p in self.procs:
+            p.terminate()
+        if self.kv is not None:
+            self.kv.delete(f"{self.job_id}/heartbeat/{self.pod_id}")
+        if self.master_server is not None:
+            self.master_server.stop()
+
+    # ---------------- entry ----------------
+
+    def run(self) -> int:
+        def _sig(signum, frame):
+            self.stop()
+            sys.exit(128 + signum)
+        try:
+            signal.signal(signal.SIGTERM, _sig)
+            signal.signal(signal.SIGINT, _sig)
+        except ValueError:
+            pass  # not main thread (tests)
+        self.rendezvous()
+        self.start_heartbeat()
+        self.launch()
+        try:
+            return self.watch()
+        finally:
+            self.stop()

@@ -1,0 +1,150 @@
+"""Rendezvous master: a tiny HTTP key-value store.
+
+Reference design: `python/paddle/distributed/launch/controllers/master.py`
+(HTTPStore/ETCDStore masters) and the C++ TCPStore
+(`paddle/phi/core/distributed/store/tcp_store.h`).  The reference offers
+http:// and etcd:// backends; here a single stdlib HTTP KV store covers
+rendezvous, barrier and heartbeat for multi-host jobs.  TPU jobs are one
+process per host (each process drives all local chips), so the KV traffic
+is tiny — a ThreadingHTTPServer is plenty.
+
+Protocol (all values are opaque bytes):
+  PUT  /kv/<key>        body -> store[key]=body
+  GET  /kv/<key>        -> 200 body | 404
+  GET  /prefix/<p>      -> JSON {key: value-as-str} for keys under p/
+  DELETE /kv/<key>      -> drop key
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+import urllib.error
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["KVServer", "KVClient"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # silence request logging
+        pass
+
+    def _send(self, code, body=b""):
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def do_PUT(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        key = self.path.lstrip("/")
+        if key.startswith("kv/"):
+            with self.server._lock:
+                self.server._store[key[3:]] = body
+            self._send(200)
+        else:
+            self._send(404)
+
+    def do_GET(self):
+        key = self.path.lstrip("/")
+        with self.server._lock:
+            if key.startswith("kv/"):
+                v = self.server._store.get(key[3:])
+                if v is None:
+                    self._send(404)
+                else:
+                    self._send(200, v)
+            elif key.startswith("prefix/"):
+                p = key[len("prefix/"):].rstrip("/") + "/"
+                out = {k: v.decode("utf-8", "replace")
+                       for k, v in self.server._store.items()
+                       if k.startswith(p)}
+                self._send(200, json.dumps(out).encode())
+            else:
+                self._send(404)
+
+    def do_DELETE(self):
+        key = self.path.lstrip("/")
+        if key.startswith("kv/"):
+            with self.server._lock:
+                self.server._store.pop(key[3:], None)
+            self._send(200)
+        else:
+            self._send(404)
+
+
+class KVServer:
+    """In-process rendezvous master.  Started by the node whose address
+    matches --master (reference: master.py HTTPStore 'self-start')."""
+
+    def __init__(self, port: int, host: str = "0.0.0.0"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd._store = {}
+        self._httpd._lock = threading.Lock()
+        self.port = self._httpd.server_address[1]
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class KVClient:
+    """Client side of the rendezvous store."""
+
+    def __init__(self, endpoint: str):
+        if not endpoint.startswith("http"):
+            endpoint = "http://" + endpoint
+        self.endpoint = endpoint.rstrip("/")
+
+    def _req(self, method, path, body=None, timeout=5):
+        req = urllib.request.Request(
+            f"{self.endpoint}/{path}", data=body, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, b""
+        except (urllib.error.URLError, ConnectionError, OSError):
+            return 0, b""
+
+    def put(self, key: str, value: str) -> bool:
+        code, _ = self._req("PUT", f"kv/{key}", value.encode())
+        return code == 200
+
+    def get(self, key: str):
+        code, body = self._req("GET", f"kv/{key}")
+        return body.decode() if code == 200 else None
+
+    def delete(self, key: str) -> bool:
+        code, _ = self._req("DELETE", f"kv/{key}")
+        return code == 200
+
+    def prefix(self, p: str) -> dict:
+        code, body = self._req("GET", f"prefix/{p}")
+        return json.loads(body) if code == 200 else {}
+
+    def wait_n(self, prefix: str, n: int, timeout: float = 60.0) -> dict:
+        """Block until >= n keys exist under prefix/ (rendezvous barrier)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            got = self.prefix(prefix)
+            if len(got) >= n:
+                return got
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"rendezvous: waited {timeout}s for {n} pods under "
+            f"'{prefix}/', have {len(self.prefix(prefix))}")
+
+    def alive(self) -> bool:
+        code, _ = self._req("GET", "kv/__ping__")
+        return code in (200, 404)

@@ -1,0 +1,173 @@
+"""Launcher / elastic tests: KV rendezvous, env wiring, gang relaunch.
+
+Model: reference `test/collective/fleet/test_launch_coverage.py` and the
+CPU fake-cluster strategy (SURVEY §4) — children are plain python scripts
+that dump their PADDLE_* env to disk; no jax import needed in children.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.distributed.launch import (
+    CollectiveController, KVClient, KVServer, parse_args)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _dump_script(tmp_path):
+    p = tmp_path / "worker.py"
+    p.write_text(textwrap.dedent("""
+        import json, os, sys
+        keys = [k for k in os.environ if k.startswith("PADDLE_")]
+        out = {k: os.environ[k] for k in keys}
+        path = os.path.join(os.environ["DUMP_DIR"],
+                            "env.%s.json" % os.environ["PADDLE_TRAINER_ID"])
+        with open(path, "w") as f:
+            json.dump(out, f)
+    """))
+    return str(p)
+
+
+class TestKVStore:
+    def test_put_get_prefix(self):
+        srv = KVServer(0).start()
+        try:
+            kv = KVClient(f"127.0.0.1:{srv.port}")
+            assert kv.alive()
+            assert kv.put("job/pods/a", "h1:1")
+            assert kv.put("job/pods/b", "h2:2")
+            assert kv.get("job/pods/a") == "h1:1"
+            assert kv.get("missing") is None
+            assert kv.prefix("job/pods") == {
+                "job/pods/a": "h1:1", "job/pods/b": "h2:2"}
+            got = kv.wait_n("job/pods", 2, timeout=5)
+            assert len(got) == 2
+            kv.delete("job/pods/a")
+            assert kv.get("job/pods/a") is None
+        finally:
+            srv.stop()
+
+    def test_wait_n_timeout(self):
+        srv = KVServer(0).start()
+        try:
+            kv = KVClient(f"127.0.0.1:{srv.port}")
+            with pytest.raises(TimeoutError):
+                kv.wait_n("nobody", 2, timeout=0.5)
+        finally:
+            srv.stop()
+
+
+class TestSingleNode:
+    def test_two_procs_env_wiring(self, tmp_path):
+        script = _dump_script(tmp_path)
+        os.environ["DUMP_DIR"] = str(tmp_path)
+        try:
+            args = parse_args([
+                "--nproc_per_node=2", f"--log_dir={tmp_path}/log",
+                "--job_id=t1", script])
+            rc = CollectiveController(args).run()
+        finally:
+            del os.environ["DUMP_DIR"]
+        assert rc == 0
+        envs = {}
+        for r in (0, 1):
+            with open(tmp_path / f"env.{r}.json") as f:
+                envs[r] = json.load(f)
+        for r in (0, 1):
+            assert envs[r]["PADDLE_TRAINER_ID"] == str(r)
+            assert envs[r]["PADDLE_TRAINERS_NUM"] == "2"
+            assert envs[r]["PADDLE_LOCAL_RANK"] == str(r)
+            assert envs[r]["PADDLE_NODE_RANK"] == "0"
+            assert envs[r]["PADDLE_JOB_ID"] == "t1"
+
+    def test_relaunch_on_failure(self, tmp_path):
+        # child fails until PADDLE_RESTART_CNT >= 2
+        script = tmp_path / "flaky.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys
+            cnt = int(os.environ["PADDLE_RESTART_CNT"])
+            with open(os.path.join(os.environ["DUMP_DIR"],
+                                   "attempt.%d" % cnt), "w") as f:
+                f.write("x")
+            sys.exit(0 if cnt >= 2 else 7)
+        """))
+        os.environ["DUMP_DIR"] = str(tmp_path)
+        try:
+            args = parse_args([
+                "--max_restart=3", f"--log_dir={tmp_path}/log",
+                "--job_id=t2", str(script)])
+            rc = CollectiveController(args).run()
+        finally:
+            del os.environ["DUMP_DIR"]
+        assert rc == 0
+        assert (tmp_path / "attempt.0").exists()
+        assert (tmp_path / "attempt.1").exists()
+        assert (tmp_path / "attempt.2").exists()
+
+    def test_exhausted_restarts_propagates_exit(self, tmp_path):
+        script = tmp_path / "dead.py"
+        script.write_text("import sys; sys.exit(9)\n")
+        args = parse_args([
+            "--max_restart=1", f"--log_dir={tmp_path}/log",
+            "--job_id=t3", str(script)])
+        rc = CollectiveController(args).run()
+        assert rc == 9
+
+
+class TestTwoNodeRendezvous:
+    def test_fake_cluster_through_cli(self, tmp_path):
+        """Two launcher processes on localhost rendezvous via the KV
+        master, assign node ranks, and wire coordinator env into workers
+        (VERDICT #8 done-criterion)."""
+        import socket
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        script = _dump_script(tmp_path)
+        env = dict(os.environ, DUMP_DIR=str(tmp_path),
+                   PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                       "PYTHONPATH", ""))
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               f"--master=127.0.0.1:{port}", "--nnodes=2",
+               f"--log_dir={tmp_path}/log", "--job_id=t4",
+               "--elastic_timeout=30", script]
+        procs = [subprocess.Popen(cmd, env=env, cwd=str(tmp_path),
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT)
+                 for _ in range(2)]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out.decode())
+        assert all(p.returncode == 0 for p in procs), outs
+        envs = {}
+        for r in (0, 1):
+            with open(tmp_path / f"env.{r}.json") as f:
+                envs[r] = json.load(f)
+        for r in (0, 1):
+            assert envs[r]["PADDLE_TRAINER_ID"] == str(r)
+            assert envs[r]["PADDLE_TRAINERS_NUM"] == "2"
+            assert "PADDLE_MASTER" in envs[r]
+            eps = envs[r]["PADDLE_TRAINER_ENDPOINTS"].split(",")
+            assert len(eps) == 2
+            # coordinator is node 0's registered endpoint on both nodes
+            assert envs[0]["PADDLE_MASTER"] == envs[1]["PADDLE_MASTER"]
+            assert envs[r]["PADDLE_MASTER"] == eps[0]
+
+    def test_dead_peer_detection(self):
+        srv = KVServer(0).start()
+        try:
+            kv = KVClient(f"127.0.0.1:{srv.port}")
+            args = parse_args(["--job_id=t5", "--nnodes=1", "x.py"])
+            c = CollectiveController(args)
+            c.kv = kv
+            kv.put("t5/heartbeat/peerA", str(time.time()))
+            kv.put("t5/heartbeat/peerB", str(time.time() - 99))
+            assert c.dead_peers() == ["peerB"]
+        finally:
+            srv.stop()
