@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["attention", "rms_norm", "layer_norm", "rope", "apply_rope",
-           "swiglu", "get_attention_backend", "set_attention_backend"]
+           "swiglu", "get_attention_backend", "set_attention_backend",
+           "gqa_scores", "gqa_weighted_v"]
 
 _attention_backend = "auto"  # auto | pallas | xla
 
@@ -42,6 +43,35 @@ def _on_tpu(*arrays) -> bool:
 # ---------------------------------------------------------------------------
 # attention
 # ---------------------------------------------------------------------------
+def gqa_scores(q, k):
+    """q·kᵀ logits [b, h, sq, sk] (fp32) for q [b, sq, h, d] against
+    k [b, sk, hk, d] where hk may divide h (GQA/MQA) — WITHOUT
+    materialising repeated KV: the group is folded into an extra q dim and
+    the contraction batches over the kv head, so KV HBM traffic stays
+    ∝ num_kv_heads."""
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if hk == h:
+        return jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                          preferred_element_type=jnp.float32)
+    qg = q.reshape(b, sq, hk, h // hk, d)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                        preferred_element_type=jnp.float32)
+    return logits.reshape(b, h, sq, sk)
+
+
+def gqa_weighted_v(w, v):
+    """Σₖ w·v → [b, h, sq, d] for weights w [b, h, sq, sk] against
+    v [b, sk, hk, d] with hk dividing h; GQA handled as in gqa_scores."""
+    b, h, sq, sk = w.shape
+    hk, d = v.shape[2], v.shape[3]
+    if hk == h:
+        return jnp.einsum("bhqk,bkhd->bhqd", w, v)
+    wg = w.reshape(b, hk, h // hk, sq, sk)
+    out = jnp.einsum("bhgqk,bkhd->bhgqd", wg, v)
+    return out.reshape(b, h, sq, d)
+
+
 def xla_attention(q, k, v, mask=None, causal=False, scale=None,
                   dropout_p=0.0):
     """Reference math of phi flash_attn kernel, XLA-fused.
@@ -49,13 +79,7 @@ def xla_attention(q, k, v, mask=None, causal=False, scale=None,
     b, sq, h, d = q.shape
     sk = k.shape[1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
-    hk = k.shape[2]
-    if hk != h:  # grouped-query attention: repeat kv heads
-        rep = h // hk
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * s
+    logits = gqa_scores(q, k) * s
     if causal:
         cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         logits = jnp.where(cm[None, None], logits, -1e30)
@@ -69,8 +93,8 @@ def xla_attention(q, k, v, mask=None, causal=False, scale=None,
         from ..framework.random import next_key
         keep = jax.random.bernoulli(next_key(), 1.0 - dropout_p, w.shape)
         w = w * keep / (1.0 - dropout_p)
-    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
-    return out.astype(q.dtype)
+    out = gqa_weighted_v(w.astype(v.dtype), v)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 def attention(q, k, v, mask=None, causal=False, scale=None, dropout_p=0.0):

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import gqa_scores, gqa_weighted_v
+
 __all__ = ["ring_attention", "ring_attention_local"]
 
 
@@ -38,10 +40,9 @@ def ring_attention_local(q, k, v, axis_name, causal=False, scale=None):
     """Per-device body; call inside shard_map. q/k/v: [b, s_loc, h, d]
     local blocks of a sequence sharded over `axis_name`."""
     b, s_loc, h, d = q.shape
-    hk = k.shape[2]
-    rep = h // hk  # GQA: kv stays at hk heads in the ring carry so each
-    # ppermute moves only the original kv bytes; repeat happens per-step
-    # inside the body (compute, not comm)
+    # GQA: kv stays at its own head count in the ring carry so each
+    # ppermute moves only the original kv bytes; the group fold happens
+    # per-step inside gqa_scores/gqa_weighted_v (compute, not comm)
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
@@ -54,11 +55,7 @@ def ring_attention_local(q, k, v, axis_name, causal=False, scale=None):
     def body(carry, t):
         o, m, l, kc, vc = carry
         src = (idx - t) % n
-        kr = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
-        vr = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                            kr.astype(jnp.float32),
-                            preferred_element_type=jnp.float32)
+        logits = gqa_scores(qf, kc.astype(jnp.float32))
         if causal:
             k_pos = src * s_loc + jnp.arange(s_loc)
             keep = (q_pos[:, None] >= k_pos[None, :])  # [sq, sk]
@@ -74,8 +71,8 @@ def ring_attention_local(q, k, v, axis_name, causal=False, scale=None):
         corr = jnp.where(jnp.isneginf(m), 0.0,
                          jnp.exp(m - safe_m))
         l_new = l * corr + jnp.sum(p, axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vr.astype(jnp.float32))
+        o_new = o * corr[..., None] + gqa_weighted_v(
+            p, vc.astype(jnp.float32))
         k_nxt = jax.lax.ppermute(kc, axis_name, perm)
         v_nxt = jax.lax.ppermute(vc, axis_name, perm)
         return (o_new, m_new, l_new, k_nxt, v_nxt), None
