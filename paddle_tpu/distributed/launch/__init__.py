@@ -35,37 +35,51 @@ ENV_ARGS = {
     "PADDLE_DEVICES": ("devices", str),
 }
 
+# applied only when neither the CLI nor the environment set the value
+ARG_DEFAULTS = {
+    "master": None, "rank": -1, "nnodes": "1", "nproc_per_node": 1,
+    "log_dir": "log", "job_id": "default", "devices": None,
+    "max_restart": 3, "elastic_timeout": 60,
+}
+
 
 def parse_args(argv=None):
+    # every optional defaults to None so an explicitly passed flag is
+    # distinguishable from an unset one: precedence CLI > env > default
+    # (the reference reads env first, then lets flags override)
     p = ArgumentParser(prog="paddle_tpu.distributed.launch")
     p.add_argument("--master", type=str, default=None,
                    help="rendezvous KV server host:port (http)")
-    p.add_argument("--rank", type=int, default=-1,
+    p.add_argument("--rank", type=int, default=None,
                    help="node rank; -1 = assigned by rendezvous order")
-    p.add_argument("--nnodes", type=str, default="1",
+    p.add_argument("--nnodes", type=str, default=None,
                    help="number of nodes, or MIN:MAX for elastic")
-    p.add_argument("--nproc_per_node", type=int, default=1,
+    p.add_argument("--nproc_per_node", type=int, default=None,
                    help="worker processes per node (TPU default: 1 "
                         "process drives all local chips)")
-    p.add_argument("--log_dir", type=str, default="log")
-    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--job_id", type=str, default=None)
     p.add_argument("--devices", type=str, default=None)
-    p.add_argument("--max_restart", type=int, default=3)
-    p.add_argument("--elastic_timeout", type=int, default=60)
+    p.add_argument("--max_restart", type=int, default=None)
+    p.add_argument("--elastic_timeout", type=int, default=None)
     p.add_argument("--run_mode", type=str, default="collective")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=REMAINDER)
     args = p.parse_args(argv)
-    # env pickup (CLI wins; reference reads env first then overrides)
     for env, (name, typ) in ENV_ARGS.items():
-        if env in os.environ and p.get_default(name) == getattr(args, name):
+        if getattr(args, name) is None and env in os.environ:
             setattr(args, name, typ(os.environ[env]))
-    # elastic range "2:4" -> use min as the rendezvous count
+    for name, default in ARG_DEFAULTS.items():
+        if getattr(args, name) is None:
+            setattr(args, name, default)
+    # elastic range "2:4": rendezvous admits between MIN and MAX pods
     ns = str(args.nnodes)
     if ":" in ns:
         lo, _, hi = ns.partition(":")
         args.nnodes_min, args.nnodes_max = int(lo), int(hi)
-        args.nnodes = int(lo)
+        if args.nnodes_min > args.nnodes_max:
+            raise ValueError(f"--nnodes={ns}: MIN exceeds MAX")
+        args.nnodes = args.nnodes_min
     else:
         args.nnodes = int(ns)
         args.nnodes_min = args.nnodes_max = args.nnodes

@@ -16,6 +16,7 @@ tolerance).  TPU-native differences:
 """
 from __future__ import annotations
 
+import json
 import os
 import signal
 import socket
@@ -31,6 +32,7 @@ __all__ = ["CollectiveController", "ProcEntry"]
 
 HEARTBEAT_INTERVAL = 2.0
 HEARTBEAT_TTL = 10.0
+ELASTIC_SETTLE = 2.0   # absorb late joiners up to nnodes_max for this long
 
 
 class ProcEntry:
@@ -84,6 +86,7 @@ class CollectiveController:
         self.pod_id = f"{_this_host()}-{uuid.uuid4().hex[:6]}"
         self.job_id = args.job_id
         self.restarts = 0
+        self.world_nodes = args.nnodes
         self.procs: list[ProcEntry] = []
         self.master_server = None  # KVServer if this node hosts it
         self.kv = None             # KVClient if multi-node
@@ -109,13 +112,41 @@ class CollectiveController:
             except OSError:
                 pass  # already running (another launcher got there first)
 
+    def _live_pods(self):
+        """Pods under <job>/pods whose heartbeat lease is current; stale
+        entries (e.g. left by a SIGKILLed launcher) are reaped from the
+        store so a relaunched pod can rejoin cleanly.  Heartbeats are
+        STAMPED with the master's clock and compared against the master's
+        clock, so cross-host skew cannot reap healthy peers."""
+        pods = self.kv.prefix(f"{self.job_id}/pods")
+        hb = self.kv.prefix(f"{self.job_id}/heartbeat")
+        now = self.kv.time()
+        if now is None:
+            return {}  # master unreachable: judge nothing
+        live = {}
+        for key, val in pods.items():
+            try:
+                rec = json.loads(val)
+            except ValueError:
+                self.kv.delete(key)
+                continue
+            beat = hb.get(f"{self.job_id}/heartbeat/{rec['pod']}")
+            if beat is not None and now - float(beat) <= HEARTBEAT_TTL:
+                live[key] = rec
+            else:
+                self.kv.delete(key)
+                self.kv.delete(f"{self.job_id}/heartbeat/{rec['pod']}")
+        return live
+
     def rendezvous(self):
-        """Register this pod, wait for nnodes peers, derive node_rank and
-        the jax coordinator address.  Single-node jobs skip the master."""
+        """Register this pod, wait for [nnodes_min, nnodes_max] live
+        peers, derive node_rank and the jax coordinator address.
+        Single-node jobs skip the master."""
         a = self.args
         if a.nnodes <= 1 and not a.master:
             self.node_rank, self.peers = 0, [f"{_this_host()}:0"]
             self.coordinator = None
+            self.world_nodes = 1
             return
         if not a.master:
             raise ValueError("--master is required when nnodes > 1")
@@ -126,16 +157,83 @@ class CollectiveController:
             if time.time() > deadline:
                 raise TimeoutError(f"master {a.master} unreachable")
             time.sleep(0.5)
+        # heartbeat starts BEFORE registration so liveness filtering never
+        # sees a pod key without a lease
+        self.start_heartbeat()
         coord_port = _free_port()
-        my_key = f"{self.job_id}/pods/{time.time():020.6f}-{self.pod_id}"
-        self.kv.put(my_key, f"{_this_host()}:{coord_port}")
-        got = self.kv.wait_n(f"{self.job_id}/pods", a.nnodes,
-                             timeout=a.elastic_timeout)
-        order = sorted(got)[: a.nnodes]
-        self.peers = [got[k] for k in order]
+        # explicit --rank embeds in the key so lexicographic order == rank
+        # order; auto pods sort by registration time after any explicit
+        # ones ('r' < 't')
+        tag = (f"r{a.rank:08d}" if a.rank >= 0
+               else f"t{time.time():020.6f}")
+        my_key = self.my_key = f"{self.job_id}/pods/{tag}.{self.pod_id}"
+        my_rec = {"endpoint": f"{_this_host()}:{coord_port}",
+                  "pod": self.pod_id}
+        my_val = json.dumps(my_rec)
+        self.kv.put(my_key, my_val)
+        # admit >= nnodes_min pods; once min is reached hold a short settle
+        # window to absorb late joiners up to nnodes_max (elastic range)
+        deadline = time.time() + a.elastic_timeout
+        settle = None
+        while True:
+            live = self._live_pods()
+            if my_key not in live:  # reaped by a peer during a GC pause?
+                self.kv.put(my_key, my_val)
+                live[my_key] = my_rec
+            if len(live) >= a.nnodes_max:
+                break
+            if len(live) >= a.nnodes_min:
+                settle = settle or time.time() + ELASTIC_SETTLE
+                if time.time() >= settle:
+                    break
+            else:
+                settle = None
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"rendezvous: waited {a.elastic_timeout}s for "
+                        f"{a.nnodes_min} pods, have {len(live)}")
+            time.sleep(0.2)
+        # ---- commit round: one pod publishes the membership so every pod
+        # adopts the SAME list even when their snapshots diverged around
+        # the settle-window expiry.  The pod sorting first in its own
+        # snapshot writes <job>/commit; everyone else adopts it (a stale
+        # commit from a previous job epoch won't contain this pod's key,
+        # so it is ignored and the wait continues).
+        order = sorted(live)[: a.nnodes_max]
+        if order and order[0] == my_key:
+            self.kv.put(f"{self.job_id}/commit", json.dumps(
+                {"order": order,
+                 "peers": [live[k]["endpoint"] for k in order],
+                 "pods": [live[k]["pod"] for k in order]}))
+            committed = {"order": order,
+                         "peers": [live[k]["endpoint"] for k in order],
+                         "pods": [live[k]["pod"] for k in order]}
+        else:
+            committed = None
+            commit_deadline = time.time() + max(30, ELASTIC_SETTLE * 5)
+            while time.time() < commit_deadline:
+                raw = self.kv.get(f"{self.job_id}/commit")
+                if raw:
+                    c = json.loads(raw)
+                    if my_key in c["order"]:
+                        committed = c
+                        break
+                time.sleep(0.2)
+            if committed is None:
+                raise RuntimeError(
+                    f"pod {self.pod_id} not admitted: membership was "
+                    f"committed without it (job full at "
+                    f"{a.nnodes_max} pods or joined too late)")
+        order = committed["order"]
+        self.peers = committed["peers"]
+        self.peer_pods = committed["pods"]
         self.node_rank = order.index(my_key)
-        if a.rank >= 0:
-            self.node_rank = a.rank
+        self.world_nodes = len(order)
+        if a.rank >= 0 and self.node_rank != a.rank:
+            raise RuntimeError(
+                f"explicit --rank={a.rank} inconsistent with rendezvous "
+                f"order (got slot {self.node_rank}); check for duplicate "
+                "ranks or a mix of explicit and auto-assigned ranks")
         # node 0's registered endpoint doubles as jax coordinator
         self.coordinator = self.peers[0]
 
@@ -145,14 +243,14 @@ class CollectiveController:
         a = self.args
         nproc = a.nproc_per_node
         global_rank = self.node_rank * nproc + local_rank
-        world = a.nnodes * nproc
+        world = self.world_nodes * nproc
         env = dict(os.environ)
         env.update({
             "PADDLE_TRAINER_ID": str(global_rank),
             "PADDLE_TRAINERS_NUM": str(world),
             "PADDLE_LOCAL_RANK": str(local_rank),
             "PADDLE_LOCAL_SIZE": str(nproc),
-            "PADDLE_NNODES": str(a.nnodes),
+            "PADDLE_NNODES": str(self.world_nodes),
             "PADDLE_NODE_RANK": str(self.node_rank),
             "PADDLE_JOB_ID": self.job_id,
             "PADDLE_RESTART_CNT": str(self.restarts),
@@ -188,27 +286,40 @@ class CollectiveController:
 
     def _heartbeat_loop(self):
         while not self._hb_stop.wait(HEARTBEAT_INTERVAL):
-            self.kv.put(f"{self.job_id}/heartbeat/{self.pod_id}",
-                        f"{time.time()}")
+            # stamped with the MASTER's clock so freshness comparisons are
+            # immune to cross-host skew
+            self.kv.stamp(f"{self.job_id}/heartbeat/{self.pod_id}")
 
     def start_heartbeat(self):
-        if self.kv is None:
+        if self.kv is None or self._hb_thread is not None:
             return
-        self.kv.put(f"{self.job_id}/heartbeat/{self.pod_id}",
-                    f"{time.time()}")
+        self.kv.stamp(f"{self.job_id}/heartbeat/{self.pod_id}")
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True)
         self._hb_thread.start()
 
     def dead_peers(self):
-        """Pods whose heartbeat lease lapsed (reference:
-        elastic/manager.py lease_heartbeat)."""
+        """ADMITTED pods whose heartbeat lease lapsed (reference:
+        elastic/manager.py lease_heartbeat).  Only the committed gang is
+        judged — a rejected straggler's leftover lease must not tear the
+        job down — and 'now' is the master's clock (skew-free)."""
         if self.kv is None:
             return []
-        now = time.time()
+        admitted = getattr(self, "peer_pods", None)
+        if not admitted:
+            return []
+        now = self.kv.time()
+        if now is None:
+            return []  # master unreachable: can't judge liveness
         hb = self.kv.prefix(f"{self.job_id}/heartbeat")
-        return [k.rsplit("/", 1)[-1] for k, v in hb.items()
-                if now - float(v) > HEARTBEAT_TTL]
+        dead = []
+        for pod in admitted:
+            if pod == self.pod_id:
+                continue
+            beat = hb.get(f"{self.job_id}/heartbeat/{pod}")
+            if beat is None or now - float(beat) > HEARTBEAT_TTL:
+                dead.append(pod)
+        return dead
 
     # ---------------- watch ----------------
 
@@ -233,7 +344,10 @@ class CollectiveController:
                           file=sys.stderr)
                     self.launch()
                     continue
-                return int(bad[0])
+                rc = int(bad[0])
+                # signal deaths (negative Popen codes) → conventional
+                # 128+N so sys.exit doesn't wrap into a misleading status
+                return 128 - rc if rc < 0 else rc
             dead = self.dead_peers()
             if dead:
                 print(f"[launch] peer heartbeat lost: {dead}; "
@@ -248,6 +362,10 @@ class CollectiveController:
             p.terminate()
         if self.kv is not None:
             self.kv.delete(f"{self.job_id}/heartbeat/{self.pod_id}")
+            if getattr(self, "my_key", None):
+                self.kv.delete(self.my_key)
+            if getattr(self, "node_rank", None) == 0:
+                self.kv.delete(f"{self.job_id}/commit")
         if self.master_server is not None:
             self.master_server.stop()
 
@@ -262,10 +380,13 @@ class CollectiveController:
             signal.signal(signal.SIGINT, _sig)
         except ValueError:
             pass  # not main thread (tests)
-        self.rendezvous()
-        self.start_heartbeat()
-        self.launch()
         try:
+            self.rendezvous()
+            self.start_heartbeat()
+            self.launch()
             return self.watch()
         finally:
+            # also reached when rendezvous raises (timeout / not
+            # admitted): the pod must withdraw its registration and lease
+            # so the admitted gang doesn't see a phantom dead peer
             self.stop()

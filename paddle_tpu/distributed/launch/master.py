@@ -45,6 +45,12 @@ class _Handler(BaseHTTPRequestHandler):
             with self.server._lock:
                 self.server._store[key[3:]] = body
             self._send(200)
+        elif key.startswith("stamp/"):
+            # store the MASTER's clock as the value: heartbeat freshness is
+            # then judged against a single clock, immune to cross-host skew
+            with self.server._lock:
+                self.server._store[key[6:]] = str(time.time()).encode()
+            self._send(200)
         else:
             self._send(404)
 
@@ -57,6 +63,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send(404)
                 else:
                     self._send(200, v)
+            elif key == "time":
+                self._send(200, str(time.time()).encode())
             elif key.startswith("prefix/"):
                 p = key[len("prefix/"):].rstrip("/") + "/"
                 out = {k: v.decode("utf-8", "replace")
@@ -120,6 +128,16 @@ class KVClient:
     def put(self, key: str, value: str) -> bool:
         code, _ = self._req("PUT", f"kv/{key}", value.encode())
         return code == 200
+
+    def stamp(self, key: str) -> bool:
+        """Store the MASTER's current time under key (skew-free lease)."""
+        code, _ = self._req("PUT", f"stamp/{key}", b"")
+        return code == 200
+
+    def time(self):
+        """The master's clock; None if unreachable."""
+        code, body = self._req("GET", "time")
+        return float(body) if code == 200 else None
 
     def get(self, key: str):
         code, body = self._req("GET", f"kv/{key}")

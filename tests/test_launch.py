@@ -166,8 +166,169 @@ class TestTwoNodeRendezvous:
             args = parse_args(["--job_id=t5", "--nnodes=1", "x.py"])
             c = CollectiveController(args)
             c.kv = kv
+            c.peer_pods = ["peerA", "peerB", "peerC"]
             kv.put("t5/heartbeat/peerA", str(time.time()))
             kv.put("t5/heartbeat/peerB", str(time.time() - 99))
-            assert c.dead_peers() == ["peerB"]
+            # peerC never heartbeat at all; an unadmitted pod's lease is
+            # not judged
+            kv.put("t5/heartbeat/straggler", str(time.time() - 99))
+            assert c.dead_peers() == ["peerB", "peerC"]
         finally:
             srv.stop()
+
+    def test_stale_pod_reaped_on_rendezvous(self):
+        """A SIGKILLed launcher's leftover pod key must not poison the
+        next rendezvous: entries with a lapsed heartbeat are reaped."""
+        import json as _json
+        srv = KVServer(0).start()
+        try:
+            kv = KVClient(f"127.0.0.1:{srv.port}")
+            # leftover registration from a killed pod (no live heartbeat)
+            kv.put("t6/pods/t00000000000001.000000.deadpod",
+                   _json.dumps({"endpoint": "10.0.0.9:1", "pod": "deadpod"}))
+            kv.put("t6/heartbeat/deadpod", str(time.time() - 99))
+            args = parse_args([
+                f"--master=127.0.0.1:{srv.port}", "--nnodes=1",
+                "--job_id=t6", "--elastic_timeout=10", "x.py"])
+            args.master = f"127.0.0.1:{srv.port}"
+            c = CollectiveController(args)
+            c.args.nnodes = 1  # force the master path despite nnodes==1
+            c.kv = kv
+            c.start_heartbeat()
+            # directly exercise the liveness filter
+            live = c._live_pods()
+            assert live == {}
+            assert kv.get("t6/pods/t00000000000001.000000.deadpod") is None
+            c.stop()
+        finally:
+            srv.stop()
+
+    def test_explicit_ranks_order_peers(self):
+        """--rank pins node_rank AND the peer/coordinator ordering
+        (previously peers stayed in registration order)."""
+        import threading
+        srv = KVServer(0).start()
+        results = {}
+        done = threading.Barrier(2, timeout=30)
+        try:
+            def run(rank):
+                args = parse_args([
+                    f"--master=127.0.0.1:{srv.port}", "--nnodes=2",
+                    f"--rank={rank}", "--job_id=t7",
+                    "--elastic_timeout=20", "x.py"])
+                c = CollectiveController(args)
+                c.rendezvous()
+                results[rank] = (c.node_rank, list(c.peers), c.coordinator)
+                done.wait()  # registration lives until all pods admitted
+                c.stop()
+            # register rank 1 FIRST so registration order disagrees with
+            # the explicit ranks
+            t1 = threading.Thread(target=lambda: run(1))
+            t1.start()
+            time.sleep(0.5)
+            t0 = threading.Thread(target=lambda: run(0))
+            t0.start()
+            t1.join(30)
+            t0.join(30)
+            assert results[0][0] == 0 and results[1][0] == 1
+            # both nodes agree on peer order and the coordinator is
+            # rank 0's endpoint
+            assert results[0][1] == results[1][1]
+            assert results[0][2] == results[0][1][0]
+        finally:
+            srv.stop()
+
+    def test_elastic_range_absorbs_extra_pod(self):
+        """--nnodes=MIN:MAX admits pods beyond MIN up to MAX."""
+        import threading
+        srv = KVServer(0).start()
+        results = []
+        done = threading.Barrier(3, timeout=30)
+        try:
+            def run():
+                args = parse_args([
+                    f"--master=127.0.0.1:{srv.port}", "--nnodes=2:4",
+                    "--job_id=t8", "--elastic_timeout=20", "x.py"])
+                c = CollectiveController(args)
+                c.rendezvous()
+                results.append((c.node_rank, c.world_nodes))
+                done.wait()
+                c.stop()
+            threads = [threading.Thread(target=run) for _ in range(3)]
+            for t in threads:
+                t.start()
+                time.sleep(0.2)
+            for t in threads:
+                t.join(30)
+            assert len(results) == 3
+            assert sorted(r[0] for r in results) == [0, 1, 2]
+            assert all(r[1] == 3 for r in results)
+        finally:
+            srv.stop()
+
+    def test_rejected_straggler_does_not_poison_gang(self):
+        """A pod beyond nnodes_max is rejected cleanly; the admitted gang
+        agrees on membership and sees no dead peers afterwards."""
+        import threading
+        srv = KVServer(0).start()
+        ok, rejected = [], []
+        done = threading.Barrier(2, timeout=40)
+        try:
+            def run():
+                args = parse_args([
+                    f"--master=127.0.0.1:{srv.port}", "--nnodes=2",
+                    "--job_id=t10", "--elastic_timeout=20", "x.py"])
+                c = CollectiveController(args)
+                try:
+                    c.rendezvous()
+                except RuntimeError:
+                    rejected.append(c.pod_id)
+                    c.stop()
+                    return
+                ok.append(c)
+                done.wait()
+            threads = [threading.Thread(target=run) for _ in range(3)]
+            for t in threads:
+                t.start()
+                time.sleep(0.3)
+            for t in threads:
+                t.join(40)
+            assert len(ok) == 2 and len(rejected) == 1
+            assert sorted(c.node_rank for c in ok) == [0, 1]
+            assert all(c.world_nodes == 2 for c in ok)
+            # the straggler's withdrawn lease must not read as a dead peer
+            time.sleep(0.5)
+            assert all(c.dead_peers() == [] for c in ok)
+            for c in ok:
+                c.stop()
+        finally:
+            srv.stop()
+
+    def test_signal_death_exit_code(self, tmp_path):
+        # child killed by SIGKILL → launcher exits 128+9, not 256-9
+        script = tmp_path / "sigdeath.py"
+        script.write_text(
+            "import os, signal; os.kill(os.getpid(), signal.SIGKILL)\n")
+        args = parse_args([
+            "--max_restart=0", f"--log_dir={tmp_path}/log",
+            "--job_id=t9", str(script)])
+        rc = CollectiveController(args).run()
+        assert rc == 137
+
+
+class TestArgPrecedence:
+    def test_cli_beats_env(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_NNODES", "4")
+        args = parse_args(["--nnodes=1", "x.py"])
+        assert args.nnodes == 1
+
+    def test_env_beats_default(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_NNODES", "4")
+        monkeypatch.setenv("PADDLE_JOB_ID", "fromenv")
+        args = parse_args(["--master=h:1", "x.py"])
+        assert args.nnodes == 4
+        assert args.job_id == "fromenv"
+
+    def test_bad_elastic_range_rejected(self):
+        with pytest.raises(ValueError):
+            parse_args(["--nnodes=4:2", "x.py"])
