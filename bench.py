@@ -55,13 +55,17 @@ def main():
     from paddle_tpu.distributed.topology import build_mesh
 
     if on_tpu:
-        # sized for v5e 16G HBM: ~390M params → weights bf16 0.8G +
-        # fp32 master/moments 4.7G + activations (remat) fits
-        cfg = LlamaConfig(vocab_size=8192, hidden_size=2048,
-                          intermediate_size=5632, num_hidden_layers=7,
-                          num_attention_heads=16, num_key_value_heads=16,
-                          max_position_embeddings=2048, dtype="bfloat16")
-        batch, seq, steps = 8, 2048, 10
+        # 1.0B-param GQA llama sized for v5e 16G HBM: bf16 weights 2.0G +
+        # fp32 master/moments 12.1G (multi_precision AdamW, fused Pallas
+        # update) + per-layer recompute keeps activations ~1.5G.
+        # Sharding stage 3 + ZeRO master shards (no-op on 1 chip, but the
+        # exact north-star code path: BASELINE.md config 3).
+        cfg = LlamaConfig(vocab_size=8192, hidden_size=2560,
+                          intermediate_size=6912, num_hidden_layers=14,
+                          num_attention_heads=20, num_key_value_heads=4,
+                          max_position_embeddings=2048, dtype="bfloat16",
+                          recompute=True)
+        batch, seq, steps = 5, 2048, 8
     else:  # CPU smoke path so the script always runs
         cfg = LlamaConfig(vocab_size=256, hidden_size=128,
                           intermediate_size=384, num_hidden_layers=2,
@@ -75,7 +79,7 @@ def main():
     opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters(),
                                  weight_decay=0.1, multi_precision=True)
     mesh = build_mesh(devices=jax.devices()[:1])
-    step = ShardedTrainStep(model, opt, mesh, sharding_stage=0,
+    step = ShardedTrainStep(model, opt, mesh, sharding_stage=3,
                             rematerialize=False)
 
     rng = np.random.RandomState(0)
@@ -98,12 +102,15 @@ def main():
     model_flops = 6.0 * n_params * tokens_per_sec  # fwd+bwd dense decoder
     peak = chip_peak_flops()
     mfu = model_flops / peak
+    # hardware utilization: full per-layer remat re-runs the forward in
+    # the backward (6N model flops -> 8N executed flops per token)
+    hw_util = mfu * (8.0 / 6.0) if cfg.recompute else mfu
 
     result = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
-        "unit": f"tokens/s/chip (mfu={mfu:.3f}, params={n_params/1e6:.0f}M, "
-                f"loss={final_loss:.3f})",
+        "unit": f"tokens/s/chip (mfu={mfu:.3f}, hw_util={hw_util:.3f}, "
+                f"params={n_params/1e6:.0f}M, loss={final_loss:.3f})",
         "vs_baseline": round(mfu / 0.40, 3),
     }
     print(json.dumps(result))

@@ -22,6 +22,7 @@ from . import meta_parallel  # noqa: F401
 from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
                             VocabParallelEmbedding, ParallelCrossEntropy,
                             get_rng_state_tracker)
+from .recompute import recompute, recompute_sequential  # noqa: F401
 
 _fleet_state = {"initialized": False, "strategy": None, "hcg": None}
 

@@ -1,0 +1,117 @@
+"""Activation recomputation (gradient checkpointing) — per-call API.
+
+Reference: `python/paddle/distributed/fleet/recompute/recompute.py:124`
+(RecomputeFunction PyLayer) and `:455 def recompute`; `recompute_hybrid.py`
+(mp-aware RNG).  TPU-native: the wrapped computation becomes ONE taped op
+whose rule is `jax.checkpoint` — under `jit` XLA rematerialises the
+activations in the backward pass, and in eager mode the tape's `jax.vjp`
+of the checkpointed function replays the forward exactly like the
+reference's PyLayer does.
+
+RNG determinism (the reference's `preserve_rng_state` /
+`get_rng_state_tracker`): paddle_tpu dropout draws from the functional key
+scope (`framework.random.key_scope`), and `jax.checkpoint` replays the
+SAME traced program with the SAME keys, so recomputed dropout masks match
+the first pass by construction — no state save/restore dance is needed.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+
+from ...framework.dispatch import run
+from ...framework.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def _layers_of(function):
+    from ...nn.layer.layers import Layer
+    if isinstance(function, Layer):
+        return [function]
+    bound = getattr(function, "__self__", None)
+    return [bound] if isinstance(bound, Layer) else []
+
+
+def _recompute_impl(function, layers, args, kwargs):
+    # thread every involved parameter/buffer through the taped op so
+    # eager autograd sees them (the reference PyLayer tracks them via the
+    # captured subgraph); under jit they are tracers either way
+    pnames, ptensors, owners = [], [], []
+    for li, layer in enumerate(layers):
+        seen = set()
+        for n, p in layer.named_parameters():
+            pnames.append(n)
+            ptensors.append(p)
+            owners.append(li)
+            seen.add(n)
+        for n, b in layer.state_dict().items():
+            if n not in seen:
+                pnames.append(n)
+                ptensors.append(b)
+                owners.append(li)
+    np_ = len(ptensors)
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensor_args = [args[i] for i in tensor_idx]
+
+    def pure(*vals):
+        from ...jit import _swapped_state
+        import contextlib
+        pvals = vals[:np_]
+        avals = vals[np_:]
+        call_args = list(args)
+        for i, v in zip(tensor_idx, avals):
+            call_args[i] = Tensor(v, stop_gradient=False)
+        with contextlib.ExitStack() as stack:
+            for li, layer in enumerate(layers):
+                names = [n for n, o in zip(pnames, owners) if o == li]
+                values = [v for v, o in zip(pvals, owners) if o == li]
+                stack.enter_context(_swapped_state(layer, names, values))
+            out = function(*call_args, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    ck = jax.checkpoint(pure)
+    return run(ck, *ptensors, *tensor_args, name="recompute")
+
+
+def recompute(function: Callable, *args, **kwargs):
+    """Run `function(*args, **kwargs)` without saving its internal
+    activations; the backward pass recomputes them.
+
+    function: a Layer, a bound method of a Layer, or a pure function of
+    Tensors (pass parameters as explicit Tensor args in that case).
+    Non-Tensor positional args and all kwargs are closed over statically.
+    """
+    return _recompute_impl(function, _layers_of(function), args, kwargs)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Reference: `recompute_sequential` — checkpoint a LayerList in
+    `ctx['segments']` chunks (default: one checkpoint per sub-layer)."""
+    from ...nn.layer.layers import Layer
+    funcs = list(functions)
+    n = len(funcs)
+    segments = int((ctx or {}).get("segments", 0)) or n
+    per = max(1, (n + segments - 1) // segments)
+
+    def make_seg(chunk):
+        def seg(*a, **kw):
+            cur = a
+            for f in chunk:
+                cur = f(*cur, **kw) if isinstance(cur, tuple) \
+                    else f(cur, **kw)
+            return cur
+        return seg
+
+    out = args
+    for s in range(0, n, per):
+        chunk = funcs[s:s + per]
+        layers = [f for f in chunk if isinstance(f, Layer)]
+        cur_args = out if isinstance(out, tuple) else (out,)
+        out = _recompute_impl(make_seg(chunk), layers, cur_args, kwargs)
+    return out

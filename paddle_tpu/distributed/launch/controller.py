@@ -193,37 +193,55 @@ class CollectiveController:
                         f"rendezvous: waited {a.elastic_timeout}s for "
                         f"{a.nnodes_min} pods, have {len(live)}")
             time.sleep(0.2)
-        # ---- commit round: one pod publishes the membership so every pod
-        # adopts the SAME list even when their snapshots diverged around
-        # the settle-window expiry.  The pod sorting first in its own
-        # snapshot writes <job>/commit; everyone else adopts it (a stale
-        # commit from a previous job epoch won't contain this pod's key,
-        # so it is ignored and the wait continues).
-        order = sorted(live)[: a.nnodes_max]
-        if order and order[0] == my_key:
-            self.kv.put(f"{self.job_id}/commit", json.dumps(
-                {"order": order,
-                 "peers": [live[k]["endpoint"] for k in order],
-                 "pods": [live[k]["pod"] for k in order]}))
-            committed = {"order": order,
-                         "peers": [live[k]["endpoint"] for k in order],
-                         "pods": [live[k]["pod"] for k in order]}
-        else:
-            committed = None
-            commit_deadline = time.time() + max(30, ELASTIC_SETTLE * 5)
-            while time.time() < commit_deadline:
-                raw = self.kv.get(f"{self.job_id}/commit")
-                if raw:
-                    c = json.loads(raw)
-                    if my_key in c["order"]:
-                        committed = c
-                        break
-                time.sleep(0.2)
-            if committed is None:
-                raise RuntimeError(
-                    f"pod {self.pod_id} not admitted: membership was "
-                    f"committed without it (job full at "
-                    f"{a.nnodes_max} pods or joined too late)")
+        # ---- commit round: exactly one pod publishes the membership
+        # (atomic put-if-absent on <job>/commit) so every pod adopts the
+        # SAME list even when their snapshots diverged around the
+        # settle-window expiry.  A pod finding a commit it is NOT part of
+        # checks whether that gang is still alive: a stale commit from a
+        # crashed epoch (all members' leases lapsed) is reaped and the
+        # election re-runs; a live gang means this pod is genuinely
+        # rejected.
+        commit_key = f"{self.job_id}/commit"
+        committed = None
+        commit_deadline = time.time() + max(30, ELASTIC_SETTLE * 5)
+        while committed is None:
+            raw = self.kv.get(commit_key)
+            if raw:
+                c = json.loads(raw)
+                if my_key in c["order"]:
+                    committed = c
+                    break
+                hb = self.kv.prefix(f"{self.job_id}/heartbeat")
+                now = self.kv.time()
+                gang_alive = now is not None and any(
+                    (b := hb.get(f"{self.job_id}/heartbeat/{pod}"))
+                    is not None and now - float(b) <= HEARTBEAT_TTL
+                    for pod in c["pods"])
+                if gang_alive:
+                    raise RuntimeError(
+                        f"pod {self.pod_id} not admitted: membership was "
+                        f"committed without it (job full at "
+                        f"{a.nnodes_max} pods or joined too late)")
+                self.kv.delete(commit_key)  # dead epoch: reap and re-run
+                continue
+            order = sorted(live)[: a.nnodes_max]
+            if order and order[0] == my_key:
+                payload = {"order": order,
+                           "peers": [live[k]["endpoint"] for k in order],
+                           "pods": [live[k]["pod"] for k in order]}
+                if self.kv.put_new(commit_key, json.dumps(payload)):
+                    committed = payload
+                    break
+                continue  # lost the election: adopt the winner's commit
+            if time.time() > commit_deadline:
+                raise TimeoutError(
+                    "rendezvous: no membership commit appeared within "
+                    f"{max(30, ELASTIC_SETTLE * 5):.0f}s")
+            time.sleep(0.2)
+            live = self._live_pods()
+            if my_key not in live:
+                self.kv.put(my_key, my_val)
+                live[my_key] = my_rec
         order = committed["order"]
         self.peers = committed["peers"]
         self.peer_pods = committed["pods"]

@@ -51,6 +51,15 @@ class _Handler(BaseHTTPRequestHandler):
             with self.server._lock:
                 self.server._store[key[6:]] = str(time.time()).encode()
             self._send(200)
+        elif key.startswith("new/"):
+            # put-if-absent (atomic under the store lock): 409 when the
+            # key exists — the rendezvous commit round's election
+            with self.server._lock:
+                if key[4:] in self.server._store:
+                    self._send(409)
+                else:
+                    self.server._store[key[4:]] = body
+                    self._send(200)
         else:
             self._send(404)
 
@@ -132,6 +141,11 @@ class KVClient:
     def stamp(self, key: str) -> bool:
         """Store the MASTER's current time under key (skew-free lease)."""
         code, _ = self._req("PUT", f"stamp/{key}", b"")
+        return code == 200
+
+    def put_new(self, key: str, value: str) -> bool:
+        """Atomic put-if-absent; False when the key already exists."""
+        code, _ = self._req("PUT", f"new/{key}", value.encode())
         return code == 200
 
     def time(self):

@@ -191,11 +191,13 @@ class TrainStep:
         self._opt_states = None
 
     def _init_opt_states(self, params):
+        from ..optimizer.jit_update import maybe_master_state
         opt = self.optimizer
+        sd = self.model.state_dict()
         states = []
         for n in self._names:
-            sd = self.model.state_dict()
-            states.append(opt._init_state(sd[n]))
+            st = opt._init_state(sd[n])
+            states.append(maybe_master_state(opt, sd[n], st))
         return states
 
     def _build(self, sample_args):
@@ -231,12 +233,14 @@ class TrainStep:
                 fwd = jax.checkpoint(fwd)
             return fwd(param_vals)
 
+        from ..optimizer.jit_update import apply_update
+
         def step(param_vals, opt_states, buf_vals, lr, step_i, key, *batch):
             loss, grads = jax.value_and_grad(loss_of)(
                 param_vals, buf_vals, key, *batch)
             new_params, new_states = [], []
             for p, g, s, wd in zip(param_vals, grads, opt_states, wds):
-                np_, ns = upd(p, g, s, lr, wd, step_i, **hp)
+                np_, ns = apply_update(upd, p, g, s, lr, wd, step_i, hp)
                 new_params.append(np_)
                 new_states.append(ns)
             return loss, new_params, new_states

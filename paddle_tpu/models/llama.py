@@ -53,6 +53,9 @@ class LlamaConfig:
     dtype: str = "bfloat16"
     use_flash_attention: bool = True
     recompute: bool = False
+    # checkpoint only the first N layers (None = all); lets memory-bound
+    # configs trade remat flops for activation memory per layer
+    recompute_layers: int | None = None
 
     @property
     def head_dim(self):
@@ -157,14 +160,26 @@ class LlamaMLP(nn.Layer):
 
 
 class LlamaDecoderLayer(nn.Layer):
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, layer_idx: int = 0):
         super().__init__(dtype=config.dtype)
+        self._recompute = config.recompute and (
+            config.recompute_layers is None
+            or layer_idx < config.recompute_layers)
         self.self_attn = LlamaAttention(config)
         self.mlp = LlamaMLP(config)
         self.input_layernorm = LlamaRMSNorm(config)
         self.post_attention_layernorm = LlamaRMSNorm(config)
 
     def forward(self, x, cos, sin):
+        if self._recompute:
+            # per-layer activation checkpointing (reference:
+            # fleet.recompute wrapping each decoder block) — only the
+            # residual-stream boundary survives the forward
+            from ..distributed.fleet.recompute import recompute
+            return recompute(self._block, x, cos, sin)
+        return self._block(x, cos, sin)
+
+    def _block(self, x, cos, sin):
         x = x + self.self_attn(self.input_layernorm(x), cos, sin)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -179,8 +194,8 @@ class LlamaModel(nn.Layer):
         self.embed_tokens = Parameter(_init_weight(
             [config.vocab_size, config.hidden_size], std, config.dtype))
         self.layers = nn.LayerList(
-            [LlamaDecoderLayer(config)
-             for _ in range(config.num_hidden_layers)])
+            [LlamaDecoderLayer(config, i)
+             for i in range(config.num_hidden_layers)])
         self.norm = LlamaRMSNorm(config)
 
     def forward(self, input_ids):

@@ -43,8 +43,8 @@ def _interpret():
     return INTERPRET
 
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
@@ -191,12 +191,15 @@ def _bwd_dq_small_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_small_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, scale, causal, block_q,
-                          block_k, seq_q, group):
-    """Grid (b*h_kv, kv blocks); q/do/lse/delta blocks hold the whole GROUP
-    of query heads sharing this kv head ([group, seq_q, ·]); dk/dv
-    accumulate over both q blocks and group heads in the loop carry."""
-    kj = pl.program_id(1)
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                          block_q, block_k, seq_q, group):
+    """Grid (b*h_kv, group, kv blocks); each step holds ONE query head's
+    q/do row resident (constant over the inner kv-block sweep) and
+    accumulates that head's contribution to kv-block kj into full-row
+    fp32 VMEM scratch; the last group head flushes scratch to the
+    (1, sk, d) output rows."""
+    g = pl.program_id(1)
+    kj = pl.program_id(2)
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
 
@@ -207,44 +210,57 @@ def _bwd_dkv_small_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         start_qb = i32(0)
 
-    def outer(g, carry):
-        def body(i, carry):
-            dk, dv = carry
-            q = q_ref[g, pl.ds(i * i32(block_q), block_q), :].astype(
-                jnp.float32) * jnp.float32(scale)
-            do = do_ref[g, pl.ds(i * i32(block_q), block_q), :].astype(
-                jnp.float32)
-            lse = lse_ref[g, pl.ds(i * i32(block_q), block_q), 0]
-            delta = delta_ref[g, pl.ds(i * i32(block_q), block_q), 0]
-            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
-            if causal:
-                q_pos = i * i32(block_q) + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                k_pos = kj * i32(block_k) + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
-            p = jnp.exp(s - lse[:, None])                   # [BQ, BK]
-            dv_new = dv + jax.lax.dot_general(
-                p, do, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)         # [BK, D]
-            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
-            # q above is pre-multiplied by scale, so ds needs no extra
-            # factor: dk_true = scale · dsᵀq = dsᵀ · (q·scale)
-            ds = p * (dp - delta[:, None])                  # [BQ, BK]
-            dk_new = dk + jax.lax.dot_general(
-                ds, q, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            return dk_new, dv_new
-        return jax.lax.fori_loop(start_qb, num_qb, body, carry)
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * i32(block_q), block_q), :].astype(
+            jnp.float32) * jnp.float32(scale)
+        do = do_ref[0, pl.ds(i * i32(block_q), block_q), :].astype(
+            jnp.float32)
+        lse = lse_ref[0, pl.ds(i * i32(block_q), block_q), 0]
+        delta = delta_ref[0, pl.ds(i * i32(block_q), block_q), 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = i * i32(block_q) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * i32(block_k) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
+        p = jnp.exp(s - lse[:, None])                   # [BQ, BK]
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [BK, D]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        # q above is pre-multiplied by scale, so ds needs no extra
+        # factor: dk_true = scale · dsᵀq = dsᵀ · (q·scale)
+        ds = p * (dp - delta[:, None])                  # [BQ, BK]
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
 
     d = k_ref.shape[-1]
     init = (jnp.zeros((block_k, d), jnp.float32),
             jnp.zeros((block_k, d), jnp.float32))
-    dk, dv = jax.lax.fori_loop(i32(0), i32(group), outer, init)
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk, dv = jax.lax.fori_loop(start_qb, num_qb, body, init)
+
+    sl = pl.ds(kj * i32(block_k), block_k)
+
+    @pl.when(g == 0)
+    def _set():
+        dk_acc[sl, :] = dk
+        dv_acc[sl, :] = dv
+
+    @pl.when(g != 0)
+    def _add():
+        dk_acc[sl, :] = dk_acc[sl, :] + dk
+        dv_acc[sl, :] = dv_acc[sl, :] + dv
+
+    @pl.when(g == group - 1)
+    def _flush():
+        dk_ref[0, sl, :] = dk_acc[sl, :].astype(dk_ref.dtype)
+        dv_ref[0, sl, :] = dv_acc[sl, :].astype(dv_ref.dtype)
 
 
 def _bwd_small(scale, causal, block_q, block_k, h, hk, res, do3):
@@ -276,29 +292,34 @@ def _bwd_small(scale, causal, block_q, block_k, h, hk, res, do3):
             interpret=_interpret(),
         )(q3, k2, v2, do3, lse, delta)
 
-        # rows [b*group, (b+1)*group) of the [b*h, sq, ·] arrays are exactly
-        # the query heads sharing kv row b, so a (group, sq, ·) block with
-        # index map b → (b, 0, 0) selects the whole group
+        # row b*group + g of the [b*h, sq, ·] arrays is query head g of the
+        # group sharing kv row b; full-row outputs + fp32 scratch let the
+        # group accumulate across grid steps
+        qg_spec = lambda b, g, j: (b * group + g, 0, 0)
         dk, dv = pl.pallas_call(
             functools.partial(_bwd_dkv_small_kernel, scale=scale,
                               causal=causal, block_q=block_q,
                               block_k=block_k, seq_q=sq, group=group),
-            grid=(bkv, sk // block_k),
+            grid=(bkv, group, sk // block_k),
             in_specs=[
-                pl.BlockSpec((group, sq, d), lambda b, j: (b, 0, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-                pl.BlockSpec((group, sq, d), lambda b, j: (b, 0, 0)),
-                pl.BlockSpec((group, sq, 1), lambda b, j: (b, 0, 0)),
-                pl.BlockSpec((group, sq, 1), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, sq, d), qg_spec),
+                pl.BlockSpec((1, block_k, d), lambda b, g, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, g, j: (b, j, 0)),
+                pl.BlockSpec((1, sq, d), qg_spec),
+                pl.BlockSpec((1, sq, 1), qg_spec),
+                pl.BlockSpec((1, sq, 1), qg_spec),
             ],
             out_specs=[
-                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, sk, d), lambda b, g, j: (b, 0, 0)),
+                pl.BlockSpec((1, sk, d), lambda b, g, j: (b, 0, 0)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((bkv, sk, d), k2.dtype),
                 jax.ShapeDtypeStruct((bkv, sk, d), v2.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((sk, d), jnp.float32),
+                pltpu.VMEM((sk, d), jnp.float32),
             ],
             interpret=_interpret(),
         )(q3, k2, v2, do3, lse, delta)
@@ -595,30 +616,40 @@ def _bwd(scale, causal, block_q, block_k, h, hk, res, do3):
 # ---------------------------------------------------------------------------
 # public entry (custom_vjp over [b*h, s, d] / [b*h_kv, s, d] tensors)
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash3(q3, k2, v2, scale, causal, block_q, block_k, h, hk, small):
-    fwd = _fwd_small if small else _fwd
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash3(q3, k2, v2, scale, causal, block_q, block_k, h, hk,
+            small_fwd, small_bwd):
+    fwd = _fwd_small if small_fwd else _fwd
     out, _ = fwd(q3, k2, v2, scale, causal, block_q, block_k, h, hk)
     return out
 
 
-def _flash3_fwd(q3, k2, v2, scale, causal, block_q, block_k, h, hk, small):
-    fwd = _fwd_small if small else _fwd
+def _flash3_fwd(q3, k2, v2, scale, causal, block_q, block_k, h, hk,
+                small_fwd, small_bwd):
+    fwd = _fwd_small if small_fwd else _fwd
     out, lse = fwd(q3, k2, v2, scale, causal, block_q, block_k, h, hk)
-    return out, (q3, k2, v2, out, lse)
+    # the kernels use a trailing size-1 dim for lse (Mosaic-friendly
+    # blocks), but a (bh, sq, 1) RESIDUAL would be stored 128-lane padded
+    # (128x memory) between forward and backward — keep it dense 2D and
+    # re-expand at the kernel boundary
+    return out, (q3, k2, v2, out, lse.reshape(lse.shape[:2]))
 
 
-def _flash3_bwd(scale, causal, block_q, block_k, h, hk, small, res, do3):
-    bwd = _bwd_small if small else _bwd
-    return bwd(scale, causal, block_q, block_k, h, hk, res, do3)
+def _flash3_bwd(scale, causal, block_q, block_k, h, hk, small_fwd,
+                small_bwd, res, do3):
+    q3, k2, v2, out, lse2 = res
+    res3 = (q3, k2, v2, out, lse2[..., None])
+    bwd = _bwd_small if small_bwd else _bwd
+    return bwd(scale, causal, block_q, block_k, h, hk, res3, do3)
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
-# resident-KV path budgets (VMEM is ~64-128 MiB/core; stay well clear to
-# leave room for double-buffered q/o tiles and the fp32 accumulators)
-SMALL_KV_BYTES = 4 * 1024 * 1024       # K+V for one kv head
-SMALL_GROUP_BYTES = 8 * 1024 * 1024    # q+do for one kv head's group (dkv)
+# resident-KV path budgets: the scoped VMEM limit is ~16 MiB and blocks
+# are double-buffered, so the resident operands must stay well under half
+SMALL_KV_BYTES = 4 * 1024 * 1024       # K+V for one kv head (fwd, dq)
+SMALL_DKV_SCRATCH_BYTES = 4 * 1024 * 1024  # fp32 dk+dv row scratch (dkv)
 
 
 def flash_attention(q, k, v, causal=False, scale=None,
@@ -647,11 +678,14 @@ def flash_attention(q, k, v, causal=False, scale=None,
 
     esize = jnp.dtype(q.dtype).itemsize
     group = h // hk
-    small = (2 * sk * d * esize <= SMALL_KV_BYTES
-             and 2 * group * sq * d * esize <= SMALL_GROUP_BYTES)
+    small_fwd = 2 * sk * d * esize <= SMALL_KV_BYTES
+    small_bwd = (small_fwd
+                 and 8 * sk * d <= SMALL_DKV_SCRATCH_BYTES
+                 and 2 * sq * d * esize <= SMALL_KV_BYTES)
 
     q3 = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     k2 = k.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
     v2 = v.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
-    out = _flash3(q3, k2, v2, float(s), bool(causal), bq, bk, h, hk, small)
+    out = _flash3(q3, k2, v2, float(s), bool(causal), bq, bk, h, hk,
+                  small_fwd, small_bwd)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

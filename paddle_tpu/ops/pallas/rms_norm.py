@@ -52,20 +52,22 @@ def _bwd_kernel(x_ref, w_ref, g_ref, dx_ref, dw_ref, *, eps):
     dw_ref[0, 0] = jnp.sum(g * x * r, axis=0)
 
 
-def _pick_block_rows(rows):
-    """Largest divisor of rows that is ≤ BLOCK_ROWS and sublane-aligned
-    (multiple of 8), so blocks always satisfy TPU tiling and fit VMEM."""
-    for br in range(min(BLOCK_ROWS, rows), 7, -1):
+def _pick_block_rows(rows, h):
+    """Largest divisor of rows that is sublane-aligned (multiple of 8) and
+    keeps the kernel's fp32 temporaries (~6 live [br, h] f32 buffers in
+    the backward) inside scoped VMEM."""
+    cap = min(BLOCK_ROWS, max(8, ((512 * 1024 // max(h, 1)) // 8) * 8))
+    for br in range(min(cap, rows), 7, -1):
         if rows % br == 0 and br % 8 == 0:
             return br
-    if rows <= BLOCK_ROWS:
+    if rows <= cap:
         return rows
     raise ValueError(f"no tiling-compatible row block for {rows} rows")
 
 
 def _rms2(x2, w, eps):
     rows, h = x2.shape
-    br = _pick_block_rows(rows)
+    br = _pick_block_rows(rows, h)
     grid = (rows // br,)
     with jax.enable_x64(False):
         out = pl.pallas_call(
@@ -93,7 +95,7 @@ def _rms_fwd(x2, w, eps):
 def _rms_bwd(eps, res, g2):
     x2, w = res
     rows, h = x2.shape
-    br = _pick_block_rows(rows)
+    br = _pick_block_rows(rows, h)
     nblocks = rows // br
     with jax.enable_x64(False):
         dx, dw_part = pl.pallas_call(

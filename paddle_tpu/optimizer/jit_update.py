@@ -1,0 +1,79 @@
+"""Shared optimizer-update plumbing for the jitted train steps.
+
+Reference: multi-precision (master weight) AdamW — `optimizer/adamw.py`
+`_multi_precision`/`_master_weights` and the fused CUDA kernels
+(`phi/kernels/gpu/adamw_kernel.cu` MultiPrecision variants).  TPU-native:
+the fp32 master lives INSIDE the optimizer state pytree, so it is donated,
+sharded by the trainer's ZeRO policy alongside the moments (ZeRO-1/2
+"master shards"), and checkpointed with the rest of the state.
+
+`apply_update` is used by both jit.TrainStep and parallel.ShardedTrainStep:
+
+  - state contains "master": the pure update rule runs on the fp32
+    master and the half-precision param is re-derived by a cast
+  - on TPU with Adam/AdamW hyper-params, dispatches to the Pallas
+    fused_adamw kernel (single pass, in-place moments/master)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.flags import get_flag, define_flag
+
+__all__ = ["apply_update", "maybe_master_state", "wants_master"]
+
+define_flag("use_fused_adamw", True,
+            "dispatch jitted Adam/AdamW updates to the fused Pallas kernel "
+            "on TPU")
+
+_HALF = (jnp.bfloat16, jnp.float16)
+
+
+def wants_master(optimizer, param_value) -> bool:
+    return (getattr(optimizer, "_multi_precision", False)
+            and jnp.dtype(param_value.dtype).type in
+            tuple(jnp.dtype(t).type for t in _HALF))
+
+
+def maybe_master_state(optimizer, param, state: dict) -> dict:
+    """Add the fp32 master copy to a freshly-initialised state dict."""
+    if wants_master(optimizer, param.value):
+        state = dict(state)
+        state["master"] = param.value.astype(jnp.float32)
+    return state
+
+
+def _is_adam_hp(hp):
+    return {"b1", "b2", "eps", "decoupled"} <= set(hp)
+
+
+def _fusable(hp, state):
+    return (_is_adam_hp(hp) and "master" in state
+            and {"moment1", "moment2", "master"} == set(state)
+            and get_flag("use_fused_adamw")
+            and jax.default_backend() == "tpu")
+
+
+def apply_update(upd, p, g, s, lr, wd, step_i, hp):
+    """One parameter's optimizer update inside a jitted step.
+
+    upd: the optimizer class's pure `_update(param, grad, state, lr, wd,
+    step, **hp)`.  Handles the master-weight indirection and the fused
+    TPU kernel; falls back to the pure rule everywhere else.
+    """
+    if _fusable(hp, s):
+        from ..ops.pallas.fused_adamw import fused_adamw
+        new_p, m, v, mst = fused_adamw(
+            g, s["moment1"], s["moment2"], s["master"], lr, step_i,
+            b1=hp["b1"], b2=hp["b2"], eps=hp["eps"], wd=wd,
+            decoupled=hp["decoupled"], out_dtype=p.dtype)
+        return new_p, {"moment1": m, "moment2": v, "master": mst}
+    if "master" in s:
+        rest = {k: v for k, v in s.items() if k != "master"}
+        new_master, ns = upd(s["master"], g.astype(jnp.float32), rest,
+                             lr, wd, step_i, **hp)
+        ns = dict(ns)
+        ns["master"] = new_master
+        return new_master.astype(p.dtype), ns
+    return upd(p, g, s, lr, wd, step_i, **hp)

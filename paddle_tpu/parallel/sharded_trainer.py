@@ -118,11 +118,15 @@ class ShardedTrainStep:
 
     # -- build -------------------------------------------------------------
     def _init_opt_states(self):
+        from ..optimizer.jit_update import maybe_master_state
         sd = self.model.state_dict()
         opt = self.optimizer
         states = []
         for n in self._names:
             st = opt._init_state(sd[n])
+            # multi_precision: the fp32 master joins the state pytree and
+            # is sharded by the same ZeRO policy as the moments
+            st = maybe_master_state(opt, sd[n], st)
             st = {k: jax.device_put(v, self._opt_shardings[n])
                   for k, v in st.items()}
             states.append(st)
@@ -180,6 +184,8 @@ class ShardedTrainStep:
         if self.stage == 2 and self.mesh.shape.get("sharding", 1) > 1:
             grad_shardings = [self._opt_shardings[n] for n in names]
 
+        from ..optimizer.jit_update import apply_update
+
         def step(param_vals, opt_states, buf_vals, lr, step_i, key, batch):
             loss, grads = jax.value_and_grad(loss_of)(param_vals, buf_vals,
                                                       key, batch)
@@ -189,8 +195,9 @@ class ShardedTrainStep:
             new_params, new_states = [], []
             for p, g, s, wd, ls in zip(param_vals, grads, opt_states, wds,
                                        lr_scales):
-                np_, ns = upd(p, g, s, lr if ls == 1.0 else lr * ls, wd,
-                              step_i, **hp)
+                np_, ns = apply_update(
+                    upd, p, g, s, lr if ls == 1.0 else lr * ls, wd,
+                    step_i, hp)
                 new_params.append(np_)
                 new_states.append(ns)
             return loss, new_params, new_states
