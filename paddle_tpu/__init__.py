@@ -62,6 +62,7 @@ from . import models  # noqa: E402
 from . import parallel  # noqa: E402
 from . import linalg  # noqa: E402
 from . import regularizer  # noqa: E402
+from . import inference  # noqa: E402
 from .framework.param_attr import ParamAttr  # noqa: E402
 
 from .hapi.model import Model  # noqa: E402

@@ -18,6 +18,9 @@ from .collective import (ReduceOp, all_reduce, all_gather, reduce,  # noqa: F401
 from .topology import (HybridCommunicateGroup, CommunicateTopology,  # noqa: F401
                        build_mesh, get_hybrid_communicate_group)
 from .auto_parallel import (ProcessMesh, Shard, Replicate, Partial,  # noqa: F401
+                            to_static, Strategy, DistModel, Engine,
+                            shard_optimizer, shard_dataloader,
+                            ShardingStage1, ShardingStage2, ShardingStage3,
                             shard_tensor, reshard, shard_layer, get_mesh,
                             set_mesh, dtensor_from_fn)
 from . import fleet  # noqa: F401

@@ -229,3 +229,162 @@ def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
         layer.register_forward_post_hook(
             lambda l, inp, out: output_fn(out, process_mesh))
     return layer
+
+
+# ---------------------------------------------------------------------------
+# shard_optimizer + sharding-stage placement policies (reference:
+# api.py:1613 shard_optimizer, :1323 ShardingStage1, :1410 ShardingStage2,
+# :1521 ShardingStage3)
+# ---------------------------------------------------------------------------
+class _ShardingStage:
+    """Placement policy passed as shard_fn: decides how each optimizer
+    accumulator (and for stage 3 the parameter) is placed."""
+
+    stage = 0
+
+    def __init__(self, sharding_mesh_dim=None, mesh: "ProcessMesh" = None):
+        self.mesh = mesh
+        self.dim = sharding_mesh_dim
+
+    def _axis(self, mesh):
+        if self.dim is not None:
+            return self.dim
+        for cand in ("sharding", "dp"):
+            if cand in mesh.dim_names:
+                return cand
+        return mesh.dim_names[0]
+
+    def placements_for(self, mesh, shape):
+        """Shard the largest evenly-divisible dim on the sharding axis;
+        replicate tensors nothing divides (tiny biases/scalars)."""
+        axis = self._axis(mesh)
+        size = mesh.get_dim_size(axis)
+        pl = [Replicate() for _ in mesh.dim_names]
+        for d in sorted(range(len(shape)), key=lambda i: -shape[i]):
+            if shape[d] % size == 0 and shape[d] > 1:
+                pl[mesh.dim_names.index(axis)] = Shard(d)
+                break
+        return pl
+
+
+class ShardingStage1(_ShardingStage):
+    stage = 1
+
+
+class ShardingStage2(_ShardingStage):
+    """TPU note: with one compiled step, stage 2's grad reduce-scatter is
+    a sharding constraint inside the program (see
+    parallel.ShardedTrainStep); state placement equals stage 1 here."""
+    stage = 2
+
+
+class ShardingStage3(_ShardingStage):
+    stage = 3
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Reference: api.py:1613 — optimizer accumulators (and the fp32
+    masters) materialise SHARDED per shard_fn; stage 3 also shards the
+    parameters themselves."""
+    mesh = get_mesh()
+    if mesh is None:
+        raise ValueError("call dist.set_mesh(...) before shard_optimizer")
+    policy = shard_fn if isinstance(shard_fn, _ShardingStage) \
+        else ShardingStage1()
+    if policy.mesh is not None:
+        mesh = policy.mesh
+
+    if policy.stage >= 3:
+        for p in optimizer._parameter_list or []:
+            pl = policy.placements_for(mesh, p.shape)
+            spec = placements_to_spec(mesh, pl, p.ndim)
+            p._value = jax.device_put(
+                p.value, NamedSharding(mesh.jax_mesh, spec))
+
+    orig_init = optimizer._init_state
+
+    def _place(v):
+        pl = policy.placements_for(mesh, v.shape)
+        spec = placements_to_spec(mesh, pl, v.ndim)
+        return jax.device_put(v, NamedSharding(mesh.jax_mesh, spec))
+
+    def sharded_init(p):
+        return {k: _place(v) for k, v in orig_init(p).items()}
+
+    class _ShardedMasters(dict):
+        """Eager multi_precision masters are created by direct
+        assignment in Optimizer.step (optimizer.py _master_weights[mk] =
+        ...), bypassing _init_state — intercept so the fp32 masters
+        (the LARGEST state) also materialise sharded."""
+
+        def __setitem__(self, k, v):
+            super().__setitem__(k, _place(v))
+
+    optimizer._init_state = sharded_init
+    masters = _ShardedMasters()
+    for k, v in getattr(optimizer, "_master_weights", {}).items():
+        masters[k] = v  # dict.update would bypass __setitem__
+    optimizer._master_weights = masters
+    optimizer._sharding_policy = policy
+    return optimizer
+
+
+# ---------------------------------------------------------------------------
+# shard_dataloader (reference: api.py:3230 — feeds each batch already
+# placed on the mesh with the batch dim sharded)
+# ---------------------------------------------------------------------------
+class _ShardDataLoader:
+    def __init__(self, loader, mesh: "ProcessMesh", shard_dims=None,
+                 input_keys=None):
+        self._loader = loader
+        self._mesh = mesh
+        self._dims = shard_dims
+        self._keys = input_keys
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _place(self, x, dim_name):
+        t = x if isinstance(x, Tensor) else Tensor(jax.numpy.asarray(
+            np.asarray(x)))
+        pl = [Replicate() for _ in self._mesh.dim_names]
+        if dim_name is not None and t.ndim:
+            pl[self._mesh.dim_names.index(dim_name)] = Shard(0)
+        return shard_tensor(t, self._mesh, pl)
+
+    def __iter__(self):
+        dims = self._dims
+        for batch in self._loader:
+            if isinstance(batch, dict):
+                keys = self._keys or list(batch)
+                yield {k: self._place(
+                    batch[k],
+                    dims.get(k) if isinstance(dims, dict) else dims)
+                    for k in keys}
+            else:
+                items = batch if isinstance(batch, (list, tuple)) \
+                    else [batch]
+                dn = dims if isinstance(dims, (str, type(None))) else None
+                yield type(items)(self._place(b, dn) for b in items) \
+                    if isinstance(items, tuple) \
+                    else [self._place(b, dn) for b in items]
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """Reference: api.py:3230.  meshes: a ProcessMesh (or list; the first
+    is used single-program).  shard_dims: mesh dim name for the batch
+    axis (default: first of 'dp'/'sharding' present, else replicate)."""
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    if shard_dims is None:
+        for cand in ("dp", "sharding"):
+            if cand in mesh.dim_names:
+                shard_dims = cand
+                break
+    return _ShardDataLoader(dataloader, mesh, shard_dims, input_keys)
+
+
+from .static_engine import Strategy, DistModel, to_static, Engine  # noqa: E402,F401
+
+__all__ += ["ShardingStage1", "ShardingStage2", "ShardingStage3",
+            "shard_optimizer", "shard_dataloader", "Strategy",
+            "DistModel", "to_static", "Engine"]

@@ -1,0 +1,320 @@
+"""Full-auto static engine: dist.to_static → DistModel, and Engine.
+
+Reference: `python/paddle/distributed/auto_parallel/api.py:2715`
+(`to_static` → `DistModel:2132`) and
+`auto_parallel/static/engine.py:100` (`Engine` — `_prepare_program`,
+completion/partitioner/reshard passes, `fit/evaluate/predict`).
+
+TPU-native redesign: the reference's whole static pipeline — sharding
+completion, program partition, reshard-op insertion, executor — is XLA
+GSPMD under one `jax.jit`.  DistModel therefore wraps the same
+whole-step compiled trainer the dygraph path uses (ShardedTrainStep),
+plus jitted eval/predict programs; "to_static" here means "the step is
+one compiled program with the strategy encoded in shardings", which is
+exactly what the reference's DistModel guarantees.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...framework.tensor import Tensor
+
+__all__ = ["Strategy", "DistModel", "to_static", "Engine"]
+
+
+class _Cfg:
+    """Attribute bag for strategy sub-configs (reference:
+    auto_parallel/strategy.py BaseConfig)."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+    def __repr__(self):
+        return f"_Cfg({self.__dict__})"
+
+
+class Strategy:
+    """Reference: auto_parallel/strategy.py Strategy — sharding /
+    pipeline / amp / recompute knobs for the static engine."""
+
+    def __init__(self, config=None):
+        config = config or {}
+
+        def cfg(name, **defaults):
+            defaults.update(config.get(name, {}))
+            return _Cfg(**defaults)
+
+        self.sharding = cfg("sharding", enable=False, stage=1, degree=-1)
+        self.pipeline = cfg("pipeline", enable=False,
+                            schedule_mode="1F1B", micro_batch_size=1,
+                            accumulate_steps=1)
+        self.amp = cfg("amp", enable=False, dtype="float16", level="O1")
+        self.recompute = cfg("recompute", enable=False)
+        self.gradient_merge = cfg("gradient_merge", enable=False,
+                                  k_steps=1)
+        self.fused_passes = cfg("fused_passes", enable=False,
+                                fused_passes_list=[])
+
+
+def _resolve_mesh(strategy: Strategy) -> Mesh:
+    """Mesh for the compiled program: the global ProcessMesh when set,
+    else all devices on (dp, sharding) per the strategy."""
+    from . import get_mesh
+    pm = get_mesh()
+    if pm is not None:
+        return pm.jax_mesh
+    from ..topology import build_mesh
+    n = len(jax.devices())
+    if strategy.sharding.enable:
+        deg = strategy.sharding.degree
+        deg = n if deg in (-1, 0, None) else min(deg, n)
+        return build_mesh(dp=n // deg, sharding=deg)
+    return build_mesh(dp=n)
+
+
+class DistModel:
+    """Reference: api.py:2132 — the compiled-with-strategy model.
+    Modes: train (returns loss, updates params), eval (loss only),
+    predict (outputs only).  Call with numpy arrays / Tensors."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy: Optional[Strategy] = None, metrics=None):
+        self.network = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._loader = loader
+        self._mesh = _resolve_mesh(self._strategy)
+        self._mode = None
+        self._train_step = None
+        self._eval_fn = None
+        self._predict_fn = None
+        if loss is not None and optimizer is not None:
+            self.train()
+        elif loss is not None:
+            self.eval()
+        else:
+            self.predict()
+
+    # -- mode switches (reference DistModel.train/eval/predict) ---------
+    def train(self):
+        if self._loss is None or self._optimizer is None:
+            raise ValueError("train mode needs loss and optimizer")
+        self._mode = "train"
+        if self._train_step is None:
+            from ...parallel import ShardedTrainStep
+            st = self._strategy
+            stage = st.sharding.stage if st.sharding.enable else 0
+            self._train_step = ShardedTrainStep(
+                self.network, self._optimizer, self._mesh,
+                loss_fn=self._wrap_loss(), sharding_stage=stage,
+                rematerialize=bool(st.recompute.enable))
+        return self
+
+    def eval(self):
+        if self._loss is None:
+            raise ValueError("eval mode needs a loss")
+        self._mode = "eval"
+        self._build_eval()
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        self._build_predict()
+        return self
+
+    def _wrap_loss(self):
+        loss = self._loss
+        if loss is None:
+            return None
+
+        def loss_fn(out, label):
+            return loss(out, label)
+        return loss_fn
+
+    # -- compiled eval / predict programs --------------------------------
+    def _pure_forward(self):
+        layer = self.network
+        from ...jit import _swapped_state
+        names = list(layer.state_dict().keys())
+
+        def fwd(state_vals, *in_vals):
+            with _swapped_state(layer, names, list(state_vals)):
+                out = layer(*[Tensor(v) for v in in_vals])
+            return jax.tree_util.tree_map(
+                lambda x: x._value if isinstance(x, Tensor) else x, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
+        return names, fwd
+
+    def _build_eval(self):
+        if self._eval_fn is not None:
+            return
+        names, fwd = self._pure_forward()
+        loss = self._loss
+
+        def eval_fn(state_vals, *batch):
+            out = fwd(state_vals, *batch[:-1])
+            lv = loss(Tensor(out) if not isinstance(out, Tensor) else out,
+                      Tensor(batch[-1]))
+            return lv._value if isinstance(lv, Tensor) else lv
+        with self._mesh:
+            self._eval_fn = (names, jax.jit(eval_fn))
+
+    def _build_predict(self):
+        if self._predict_fn is not None:
+            return
+        names, fwd = self._pure_forward()
+        with self._mesh:
+            self._predict_fn = (names, jax.jit(fwd))
+
+    def _batch_vals(self, data):
+        vals = []
+        axes = tuple(a for a in ("dp", "sharding")
+                     if a in self._mesh.axis_names
+                     and self._mesh.shape[a] > 1)
+        n = 1
+        for a in axes:
+            n *= self._mesh.shape[a]
+        for d in data:
+            v = d._value if isinstance(d, Tensor) else jnp.asarray(d)
+            spec = [None] * v.ndim
+            if axes and v.ndim and v.shape[0] % n == 0:
+                spec[0] = axes  # replicate when batch doesn't divide
+            vals.append(jax.device_put(
+                v, NamedSharding(self._mesh, P(*spec))))
+        return vals
+
+    def __call__(self, *data):
+        if self._mode == "train":
+            loss = self._train_step(*data)
+            return loss
+        sd = self.network.state_dict()
+        if self._mode == "eval":
+            names, fn = self._eval_fn
+            state_vals = [sd[n]._value for n in names]
+            out = fn(state_vals, *self._batch_vals(data))
+            return Tensor(out)
+        names, fn = self._predict_fn
+        state_vals = [sd[n]._value for n in names]
+        out = fn(state_vals, *self._batch_vals(data))
+        return jax.tree_util.tree_map(
+            lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out)
+
+    # -- state access ----------------------------------------------------
+    def state_dict(self, mode: str = "all"):
+        return self.network.state_dict()
+
+    def dist_main_program(self, mode=None):
+        return None  # programs are jaxprs; kept for API parity
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None,
+              strategy: Optional[Strategy] = None):
+    """Reference: api.py:2715 — build the compiled-with-strategy
+    DistModel from the dygraph layer."""
+    return DistModel(layer, loader=loader, loss=loss, optimizer=optimizer,
+                     strategy=strategy)
+
+
+class Engine:
+    """Reference: auto_parallel/static/engine.py:100 — high-level
+    fit/evaluate/predict driver over the compiled distributed program."""
+
+    def __init__(self, model, loss=None, optimizer=None, metrics=None,
+                 strategy: Optional[Strategy] = None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+            else ([metrics] if metrics is not None else [])
+        self._strategy = strategy or Strategy()
+        self._dist_model: Optional[DistModel] = None
+        self.history = None
+
+    def _ensure(self, mode):
+        if self._dist_model is None:
+            self._dist_model = DistModel(
+                self._model, loss=self._loss, optimizer=self._optimizer,
+                strategy=self._strategy)
+        getattr(self._dist_model, mode)()
+        return self._dist_model
+
+    def prepare(self, *args, mode="train", **kwargs):
+        self._ensure(mode)
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            log_freq=10, verbose=1, **kwargs):
+        from ...io import DataLoader, Dataset
+        dm = self._ensure("train")
+        loader = (train_data if hasattr(train_data, "__iter__")
+                  and not isinstance(train_data, Dataset)
+                  else DataLoader(train_data, batch_size=batch_size,
+                                  shuffle=True))
+        history = {"loss": []}
+        for epoch in range(epochs):
+            for step, batch in enumerate(loader):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                loss = dm(*batch)
+                history["loss"].append(float(np.asarray(loss.value)))
+                if verbose and step % log_freq == 0:
+                    print(f"epoch {epoch} step {step} "
+                          f"loss {history['loss'][-1]:.5f}")
+        self.history = history
+        return history
+
+    def evaluate(self, valid_data, batch_size=1, steps=None, verbose=0,
+                 **kwargs):
+        from ...io import DataLoader, Dataset
+        dm = self._ensure("eval")
+        loader = (valid_data if hasattr(valid_data, "__iter__")
+                  and not isinstance(valid_data, Dataset)
+                  else DataLoader(valid_data, batch_size=batch_size))
+        losses = []
+        for step, batch in enumerate(loader):
+            if steps is not None and step >= steps:
+                break
+            losses.append(float(np.asarray(dm(*batch).value)))
+        return {"loss": float(np.mean(losses)) if losses else None}
+
+    def predict(self, test_data, batch_size=1, steps=None, **kwargs):
+        from ...io import DataLoader, Dataset
+        dm = self._ensure("predict")
+        loader = (test_data if hasattr(test_data, "__iter__")
+                  and not isinstance(test_data, Dataset)
+                  else DataLoader(test_data, batch_size=batch_size))
+        outs = []
+        for step, batch in enumerate(loader):
+            if steps is not None and step >= steps:
+                break
+            if (self._loss is not None and isinstance(batch, (list, tuple))
+                    and len(batch) > 1):
+                batch = batch[:-1]  # drop the label for pure inference
+            outs.append(dm(*batch))
+        return outs
+
+    def save(self, path, training=True):
+        from ...framework.io import save as psave
+        psave(self._model.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        from ...framework.io import load as pload
+        self._model.set_state_dict(pload(path + ".pdparams"))
+        if load_optimizer:
+            import os
+            if os.path.exists(path + ".pdopt"):
+                self._optimizer.set_state_dict(pload(path + ".pdopt"))
+
+    @property
+    def main_program(self):
+        return None  # jaxpr-based; parity stub
+
+    def cost(self, *a, **k):
+        return None

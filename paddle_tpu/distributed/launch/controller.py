@@ -213,15 +213,26 @@ class CollectiveController:
                     break
                 hb = self.kv.prefix(f"{self.job_id}/heartbeat")
                 now = self.kv.time()
-                gang_alive = now is not None and any(
+                if now is None:
+                    # master clock unreadable: evidence of nothing —
+                    # retry rather than reap a possibly-live gang
+                    time.sleep(0.2)
+                    continue
+                gang_alive = any(
                     (b := hb.get(f"{self.job_id}/heartbeat/{pod}"))
                     is not None and now - float(b) <= HEARTBEAT_TTL
                     for pod in c["pods"])
                 if gang_alive:
-                    raise RuntimeError(
-                        f"pod {self.pod_id} not admitted: membership was "
-                        f"committed without it (job full at "
-                        f"{a.nnodes_max} pods or joined too late)")
+                    # could be a healthy running job (we are rejected) OR
+                    # a crashed epoch whose leases haven't lapsed yet —
+                    # keep polling; the deadline (> TTL) disambiguates
+                    if time.time() > commit_deadline:
+                        raise RuntimeError(
+                            f"pod {self.pod_id} not admitted: membership "
+                            f"was committed without it (job full at "
+                            f"{a.nnodes_max} pods or joined too late)")
+                    time.sleep(0.2)
+                    continue
                 self.kv.delete(commit_key)  # dead epoch: reap and re-run
                 continue
             order = sorted(live)[: a.nnodes_max]

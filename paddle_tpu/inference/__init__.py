@@ -1,0 +1,188 @@
+"""Inference serving API — Config / create_predictor / Predictor.
+
+Reference: `paddle/fluid/inference/api/analysis_predictor.h:105`
+(AnalysisPredictor), `paddle_inference_api.h` (Config, PaddleTensor,
+copy_from_cpu/copy_to_cpu handle protocol) and
+`python/paddle/inference/wrapper.py`.
+
+TPU-native: the "analysis + optimization passes" stage IS XLA — the
+artifact produced by `paddle.jit.save` is a serialized StableHLO function
+(jax.export) that XLA re-compiles (and re-optimises) for whatever device
+serves it.  The Predictor keeps the handle-based API so reference serving
+code ports 1:1:
+
+    config = Config("model.pdmodel", "model.pdiparams")
+    predictor = create_predictor(config)
+    h = predictor.get_input_handle(predictor.get_input_names()[0])
+    h.copy_from_cpu(batch_np)
+    predictor.run()
+    out = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Config", "Predictor", "create_predictor", "Tensor",
+           "PrecisionType", "PlaceType", "get_version"]
+
+
+def get_version() -> str:
+    from .. import __version__
+    return __version__
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3  # TPU serves through the default jax device
+
+
+class Config:
+    """Reference: AnalysisConfig (`analysis_config.cc`).  GPU/IR-pass
+    toggles are accepted for API parity; device placement follows the
+    jax backend (TPU when present)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._path = prog_file
+        self._params_file = params_file
+        self._enable_memory_optim = True
+        self._ir_optim = True  # XLA always optimises; kept for parity
+
+    def set_prog_file(self, p):
+        self._path = p[: -len(".pdmodel")] if p.endswith(".pdmodel") else p
+
+    def prog_file(self):
+        return self._path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        pass  # device selection is jax's; accepted for parity
+
+    def disable_gpu(self):
+        pass
+
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = flag
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def enable_mkldnn(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def summary(self):
+        return f"Config(path={self._path})"
+
+
+class Tensor:
+    """Handle protocol (reference: ZeroCopyTensor / paddle_infer.Tensor):
+    copy_from_cpu / copy_to_cpu move data host<->device."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = jnp.asarray(np.asarray(arr))
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"tensor '{self.name}' has no data; "
+                               "run() the predictor first")
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+    def type(self):
+        return str(self._value.dtype) if self._value is not None else None
+
+
+class Predictor:
+    """Reference: AnalysisPredictor — loads the artifact, owns
+    input/output handles, `run()` executes the compiled function."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+        if config._path is None:
+            raise ValueError("Config needs the model path")
+        self._layer = jit_load(config._path)
+        if self._layer._exported is None:
+            raise ValueError(
+                f"'{config._path}.pdmodel' holds no compiled function; "
+                "export with paddle.jit.save(layer, path, input_spec=...)")
+        names = self._layer.input_names or [
+            f"x{i}" for i in range(self._layer._exported.in_avals and
+                                   len(self._layer._exported.in_avals) - 1
+                                   or 1)]
+        self._inputs: Dict[str, Tensor] = {n: Tensor(n) for n in names}
+        self._outputs: Dict[str, Tensor] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[list] = None):
+        """Execute.  Either feed handles first (reference protocol) or
+        pass arrays directly (paddle_infer.Predictor.run(list) style)."""
+        if inputs is not None:
+            for h, a in zip(self._inputs.values(), inputs):
+                h.copy_from_cpu(np.asarray(a))
+        vals = []
+        for n, h in self._inputs.items():
+            if h._value is None:
+                raise RuntimeError(f"input '{n}' not set")
+            vals.append(h._value)
+        out = self._layer.forward(*vals)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        self._outputs = {}
+        result = []
+        for i, o in enumerate(outs):
+            t = Tensor(f"out{i}")
+            t._value = o._value if hasattr(o, "_value") else jnp.asarray(o)
+            self._outputs[t.name] = t
+            result.append(np.asarray(t._value))
+        return result
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs) or ["out0"]
+
+    def get_output_handle(self, name: str) -> Tensor:
+        if name not in self._outputs:
+            raise RuntimeError("run() the predictor before reading "
+                               f"output '{name}'")
+        return self._outputs[name]
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

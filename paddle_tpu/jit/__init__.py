@@ -275,34 +275,147 @@ class TrainStep:
 
 
 # ---------------------------------------------------------------------------
-# save / load (reference: paddle.jit.save — TranslatedLayer artifacts)
+# save / load (reference: paddle.jit.save / jit.load — TranslatedLayer
+# executable artifacts, jit/api.py + fluid/jit/layer.cc)
 # ---------------------------------------------------------------------------
+def _specs_to_avals(input_spec):
+    """InputSpec list → jax avals; -1/None dims become export-time
+    symbolic dimensions so one artifact serves any batch size."""
+    from jax import export as jexport
+    from ..static import InputSpec
+    scope = jexport.SymbolicScope()
+    avals = []
+    sym_names = iter("bcdefghij")
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            spec = InputSpec.from_tensor(spec)
+        shape = []
+        for s in spec.shape:
+            if s in (-1, None):
+                (dim,) = jexport.symbolic_shape(next(sym_names),
+                                                scope=scope)
+                shape.append(dim)
+            else:
+                shape.append(int(s))
+        dt = spec.dtype
+        dt = dt.name if hasattr(dt, "name") else str(dt)
+        avals.append(jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dt)))
+    return avals
+
+
 def save(layer, path, input_spec=None, **configs):
-    """Persist params + structure info.  Compiled-function export via
-    jax.export lands with the inference subsystem."""
+    """Serialize an EXECUTABLE artifact: params (`.pdiparams`) + a
+    jax.export StableHLO function of (params, *inputs) (`.pdmodel`).
+    `jit.load` returns a callable TranslatedLayer; the artifact is also
+    what `paddle_tpu.inference.Predictor` serves.
+
+    input_spec: list of InputSpec/Tensors describing the inputs; -1 or
+    None dims export symbolically (any size at run time).  Falls back to
+    the layer's `forward` StaticFunction input_spec when omitted.
+    """
     import pickle
     import os
-    state = {k: np.asarray(v.value)
-             for k, v in layer.state_dict().items()}
+    from jax import export as jexport
+
+    fn = layer.forward
+    if isinstance(fn, StaticFunction):
+        input_spec = input_spec or fn._input_spec
+        fn = fn._fn
+    if input_spec is None:
+        raise ValueError(
+            "jit.save needs input_spec (or a @to_static layer with one) "
+            "to trace the exported function")
+
+    names = list(layer.state_dict().keys())
+    state = {k: np.asarray(v.value) for k, v in layer.state_dict().items()}
+
+    def raw(state_vals, *in_vals):
+        with _swapped_state(layer, names, list(state_vals)):
+            out = fn(*[Tensor(v) for v in in_vals])
+        return _leaves_to_values(out)
+
+    param_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype)
+                   for v in state.values()]
+    in_avals = _specs_to_avals(list(input_spec))
+    with jax.enable_x64(False):
+        exported = jexport.export(jax.jit(raw))(param_avals, *in_avals)
+        blob = exported.serialize()
+
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path + ".pdparams", "wb") as f:
+    with open(path + ".pdiparams", "wb") as f:
         pickle.dump(state, f, protocol=4)
-    meta = {"class": type(layer).__name__}
+    in_names = [getattr(s, "name", None) or f"x{i}"
+                for i, s in enumerate(input_spec)]
+    meta = {"class": type(layer).__name__,
+            "format": "jax.export.v1",
+            "param_names": names,
+            "input_names": in_names,
+            "mlir": blob}
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump(meta, f, protocol=4)
+    # legacy alias kept for round-1 checkpoints
+    with open(path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
 
 
 class TranslatedLayer:
-    def __init__(self, state):
+    """Executable loaded artifact (reference: TranslatedLayer /
+    fluid/jit Layer): callable, with state_dict access."""
+
+    def __init__(self, state, exported=None, param_names=None,
+                 class_name="", input_names=None):
         self._state = state
+        self._exported = exported
+        self._param_names = param_names or list(state)
+        self._class_name = class_name
+        self.input_names = input_names or []
 
     def state_dict(self):
         return self._state
 
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        if self._exported is None:
+            raise RuntimeError(
+                "artifact has no compiled function (params-only "
+                "checkpoint); re-save with paddle.jit.save(..., "
+                "input_spec=...)")
+        in_vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                   for a in args]
+        state_vals = [self._state[n]._value for n in self._param_names]
+        with jax.enable_x64(False):
+            out = self._exported.call(state_vals, *in_vals)
+        return jax.tree_util.tree_map(
+            lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        return self
+
 
 def load(path, **configs):
     import pickle
-    with open(path + ".pdparams", "rb") as f:
+    import os
+    from jax import export as jexport
+    exported, param_names, class_name, input_names = None, None, "", None
+    if os.path.exists(path + ".pdmodel"):
+        with open(path + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+        if isinstance(meta, dict) and meta.get("mlir"):
+            exported = jexport.deserialize(meta["mlir"])
+            param_names = meta.get("param_names")
+            class_name = meta.get("class", "")
+            input_names = meta.get("input_names")
+    params_path = (path + ".pdiparams"
+                   if os.path.exists(path + ".pdiparams")
+                   else path + ".pdparams")
+    with open(params_path, "rb") as f:
         state = pickle.load(f)
     return TranslatedLayer({k: Tensor(jnp.asarray(v))
-                            for k, v in state.items()})
+                            for k, v in state.items()},
+                           exported=exported, param_names=param_names,
+                           class_name=class_name, input_names=input_names)

@@ -55,14 +55,19 @@ def _fusable(hp, state):
             and jax.default_backend() == "tpu")
 
 
-def apply_update(upd, p, g, s, lr, wd, step_i, hp):
+def apply_update(upd, p, g, s, lr, wd, step_i, hp, fused_ok=True):
     """One parameter's optimizer update inside a jitted step.
 
     upd: the optimizer class's pure `_update(param, grad, state, lr, wd,
     step, **hp)`.  Handles the master-weight indirection and the fused
     TPU kernel; falls back to the pure rule everywhere else.
+
+    fused_ok: callers running under a multi-device mesh MUST pass False
+    when the optimizer state is sharded — a pallas_call has no SPMD
+    partitioning rule, so GSPMD would all-gather (replicate) the fp32
+    master/moments on every chip, defeating ZeRO.
     """
-    if _fusable(hp, s):
+    if fused_ok and _fusable(hp, s):
         from ..ops.pallas.fused_adamw import fused_adamw
         new_p, m, v, mst = fused_adamw(
             g, s["moment1"], s["moment2"], s["master"], lr, step_i,

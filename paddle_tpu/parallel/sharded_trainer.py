@@ -108,8 +108,9 @@ class ShardedTrainStep:
         spec = [None] * arr.ndim
         axes = tuple(a for a in self.batch_axes
                      if a in self.mesh.axis_names and self.mesh.shape[a] > 1)
-        if axes:
-            spec[0] = axes
+        n = int(np.prod([self.mesh.shape[a] for a in axes])) if axes else 1
+        if axes and arr.ndim and arr.shape[0] % n == 0:
+            spec[0] = axes  # batch not divisible → keep replicated
         if self.seq_axis and self.seq_axis in self.mesh.axis_names \
                 and self.mesh.shape[self.seq_axis] > 1 \
                 and arr.ndim > self.seq_dim:
@@ -185,6 +186,10 @@ class ShardedTrainStep:
             grad_shardings = [self._opt_shardings[n] for n in names]
 
         from ..optimizer.jit_update import apply_update
+        # fused pallas update only when nothing is sharded across devices
+        # (a pallas_call can't be partitioned — GSPMD would replicate the
+        # fp32 state on every chip, defeating ZeRO/TP sharding)
+        fused_ok = self.mesh.size == 1
 
         def step(param_vals, opt_states, buf_vals, lr, step_i, key, batch):
             loss, grads = jax.value_and_grad(loss_of)(param_vals, buf_vals,
@@ -197,7 +202,7 @@ class ShardedTrainStep:
                                        lr_scales):
                 np_, ns = apply_update(
                     upd, p, g, s, lr if ls == 1.0 else lr * ls, wd,
-                    step_i, hp)
+                    step_i, hp, fused_ok=fused_ok)
                 new_params.append(np_)
                 new_states.append(ns)
             return loss, new_params, new_states

@@ -1,0 +1,108 @@
+"""jit.save / jit.load executable artifacts + inference Predictor.
+
+Reference test model: test_jit_save_load.py (save->load->run equality)
+and the inference C API tests (handle protocol).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import InputSpec
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _mk(tmp_path):
+    paddle.seed(3)
+    net = Net()
+    path = str(tmp_path / "m" / "net")
+    paddle.jit.save(net, path,
+                    input_spec=[InputSpec([None, 8], "float32", name="x")])
+    return net, path
+
+
+class TestJitSaveLoad:
+    def test_save_load_run_equality(self, tmp_path):
+        net, path = _mk(tmp_path)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        ref = np.asarray(net(x).value)
+        loaded = paddle.jit.load(path)
+        out = np.asarray(loaded(x).value)
+        np.testing.assert_allclose(out, ref, atol=1e-6, rtol=1e-6)
+
+    def test_symbolic_batch_dim(self, tmp_path):
+        net, path = _mk(tmp_path)
+        loaded = paddle.jit.load(path)
+        for b in (1, 7):
+            x = paddle.to_tensor(np.ones((b, 8), np.float32))
+            assert loaded(x).shape == [b, 4]
+
+    def test_artifact_survives_weight_mutation(self, tmp_path):
+        # the exported function is a snapshot: mutating the live layer
+        # after save must not change the artifact
+        net, path = _mk(tmp_path)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        ref = np.asarray(net(x).value)
+        net.fc1.weight.set_value(
+            np.zeros_like(np.asarray(net.fc1.weight.value)))
+        out = np.asarray(paddle.jit.load(path)(x).value)
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    def test_load_state_dict(self, tmp_path):
+        net, path = _mk(tmp_path)
+        loaded = paddle.jit.load(path)
+        sd = loaded.state_dict()
+        np.testing.assert_allclose(
+            np.asarray(sd["fc1.weight"].value),
+            np.asarray(net.fc1.weight.value))
+
+    def test_save_without_spec_raises(self, tmp_path):
+        net = Net()
+        with pytest.raises(ValueError):
+            paddle.jit.save(net, str(tmp_path / "x"))
+
+
+class TestInferencePredictor:
+    def test_handle_protocol(self, tmp_path):
+        net, path = _mk(tmp_path)
+        from paddle_tpu.inference import Config, create_predictor
+        config = Config(path + ".pdmodel", path + ".pdiparams")
+        pred = create_predictor(config)
+        names = pred.get_input_names()
+        assert names == ["x"]
+        h = pred.get_input_handle("x")
+        xin = np.random.RandomState(1).randn(3, 8).astype(np.float32)
+        h.copy_from_cpu(xin)
+        pred.run()
+        out = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        ref = np.asarray(net(paddle.to_tensor(xin)).value)
+        np.testing.assert_allclose(out, ref, atol=1e-6, rtol=1e-6)
+
+    def test_run_list_style(self, tmp_path):
+        net, path = _mk(tmp_path)
+        from paddle_tpu.inference import Config, Predictor
+        pred = Predictor(Config(path))
+        xin = np.ones((2, 8), np.float32)
+        outs = pred.run([xin])
+        assert outs[0].shape == (2, 4)
+
+    def test_params_only_artifact_rejected(self, tmp_path):
+        # a params-only save (framework.io) can't serve
+        import pickle
+        p = tmp_path / "legacy"
+        with open(str(p) + ".pdparams", "wb") as f:
+            pickle.dump({"w": np.ones((2, 2), np.float32)}, f)
+        from paddle_tpu.inference import Config, create_predictor
+        with pytest.raises(ValueError):
+            create_predictor(Config(str(p)))
