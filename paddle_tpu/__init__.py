@@ -63,6 +63,11 @@ from . import parallel  # noqa: E402
 from . import linalg  # noqa: E402
 from . import regularizer  # noqa: E402
 from . import inference  # noqa: E402
+from . import fft  # noqa: E402
+from . import distribution  # noqa: E402
+from . import quantization  # noqa: E402
+from . import text  # noqa: E402
+from . import geometric  # noqa: E402
 from .framework.param_attr import ParamAttr  # noqa: E402
 
 from .hapi.model import Model  # noqa: E402

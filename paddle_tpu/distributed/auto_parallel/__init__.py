@@ -282,6 +282,16 @@ class ShardingStage3(_ShardingStage):
     stage = 3
 
 
+class _CallablePolicy(_ShardingStage):
+    """Wraps a user shard_fn(key, param, value) -> placements."""
+
+    stage = 1
+
+    def __init__(self, fn):
+        super().__init__()
+        self.fn = fn
+
+
 def shard_optimizer(optimizer, shard_fn=None):
     """Reference: api.py:1613 — optimizer accumulators (and the fp32
     masters) materialise SHARDED per shard_fn; stage 3 also shards the
@@ -289,8 +299,18 @@ def shard_optimizer(optimizer, shard_fn=None):
     mesh = get_mesh()
     if mesh is None:
         raise ValueError("call dist.set_mesh(...) before shard_optimizer")
-    policy = shard_fn if isinstance(shard_fn, _ShardingStage) \
-        else ShardingStage1()
+    if shard_fn is None:
+        policy = ShardingStage1()
+    elif isinstance(shard_fn, _ShardingStage):
+        policy = shard_fn
+    elif callable(shard_fn):
+        # reference: a user callable deciding per-accumulator placement —
+        # shard_fn(key, param, accumulator_value) -> placements
+        policy = _CallablePolicy(shard_fn)
+    else:
+        raise TypeError(
+            "shard_fn must be a ShardingStage1/2/3 policy or a callable "
+            f"(key, param, value) -> placements; got {type(shard_fn)}")
     if policy.mesh is not None:
         mesh = policy.mesh
 
@@ -303,13 +323,17 @@ def shard_optimizer(optimizer, shard_fn=None):
 
     orig_init = optimizer._init_state
 
-    def _place(v):
-        pl = policy.placements_for(mesh, v.shape)
+    def _place(v, key=None, param=None):
+        if isinstance(policy, _CallablePolicy):
+            pl = policy.fn(key, param, Tensor(v))
+        else:
+            pl = policy.placements_for(mesh, v.shape)
         spec = placements_to_spec(mesh, pl, v.ndim)
         return jax.device_put(v, NamedSharding(mesh.jax_mesh, spec))
 
     def sharded_init(p):
-        return {k: _place(v) for k, v in orig_init(p).items()}
+        return {k: _place(v, key=k, param=p)
+                for k, v in orig_init(p).items()}
 
     class _ShardedMasters(dict):
         """Eager multi_precision masters are created by direct
@@ -348,7 +372,10 @@ class _ShardDataLoader:
         t = x if isinstance(x, Tensor) else Tensor(jax.numpy.asarray(
             np.asarray(x)))
         pl = [Replicate() for _ in self._mesh.dim_names]
-        if dim_name is not None and t.ndim:
+        # replicate non-divisible (e.g. final partial) batches instead of
+        # crashing mid-epoch — same policy as topology.batch_partition_spec
+        if (dim_name is not None and t.ndim
+                and t.shape[0] % self._mesh.get_dim_size(dim_name) == 0):
             pl[self._mesh.dim_names.index(dim_name)] = Shard(0)
         return shard_tensor(t, self._mesh, pl)
 

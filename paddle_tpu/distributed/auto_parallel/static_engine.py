@@ -173,18 +173,11 @@ class DistModel:
             self._predict_fn = (names, jax.jit(fwd))
 
     def _batch_vals(self, data):
+        from ..topology import batch_partition_spec
         vals = []
-        axes = tuple(a for a in ("dp", "sharding")
-                     if a in self._mesh.axis_names
-                     and self._mesh.shape[a] > 1)
-        n = 1
-        for a in axes:
-            n *= self._mesh.shape[a]
         for d in data:
             v = d._value if isinstance(d, Tensor) else jnp.asarray(d)
-            spec = [None] * v.ndim
-            if axes and v.ndim and v.shape[0] % n == 0:
-                spec[0] = axes  # replicate when batch doesn't divide
+            spec = batch_partition_spec(self._mesh, v.shape)
             vals.append(jax.device_put(
                 v, NamedSharding(self._mesh, P(*spec))))
         return vals

@@ -256,3 +256,23 @@ def set_hybrid_communicate_group(hcg):
 
 def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
     return _hcg
+
+
+def batch_partition_spec(mesh: Mesh, shape,
+                         batch_axes=("dp", "sharding")):
+    """PartitionSpec entries for a host batch: dim 0 sharded over the
+    present data-parallel axes when the size divides evenly, else
+    replicated (partial final batches must not crash mid-epoch).
+
+    Single source for ShardedTrainStep._shard_batch,
+    DistModel._batch_vals and shard_dataloader — keep them from
+    diverging."""
+    axes = tuple(a for a in batch_axes
+                 if a in mesh.axis_names and mesh.shape[a] > 1)
+    spec = [None] * len(shape)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    if axes and shape and shape[0] % n == 0:
+        spec[0] = axes
+    return spec

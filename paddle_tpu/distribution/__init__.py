@@ -1,0 +1,1502 @@
+"""paddle.distribution — probability distributions, transforms, KL.
+
+Reference: `python/paddle/distribution/` (Distribution base
+distribution.py, the families, `kl.py` kl_divergence/register_kl,
+`transform.py`).  TPU-native: every density/statistic is a taped op over
+jnp (+jax.scipy.stats where it exists), so log_prob differentiates w.r.t.
+BOTH the value and the distribution parameters (variational inference /
+policy gradients work under eager autograd and jit); sampling draws from
+the functional key scope (framework.random), so jitted sampling is
+reproducible and SPMD-safe.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..framework.dispatch import run
+from ..framework.tensor import Tensor
+from ..framework import random as prandom
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Normal", "Uniform", "Bernoulli",
+    "Categorical", "Beta", "Gamma", "Dirichlet", "Multinomial",
+    "Exponential", "Laplace", "LogNormal", "Gumbel", "Geometric",
+    "Cauchy", "Binomial", "Poisson", "StudentT", "Chi2",
+    "MultivariateNormal", "ContinuousBernoulli", "Independent",
+    "TransformedDistribution", "kl_divergence", "register_kl",
+    # transforms
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+def _t(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        return x
+    arr = jnp.asarray(x)
+    if jnp.issubdtype(arr.dtype, jnp.integer) and dtype is not None:
+        arr = arr.astype(dtype)
+    elif dtype is not None and jnp.issubdtype(arr.dtype, jnp.floating):
+        arr = arr.astype(dtype)
+    return Tensor(arr)
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _key():
+    return prandom.next_key()
+
+
+def _shape(sample_shape, batch_shape, event_shape=()):
+    return tuple(sample_shape) + tuple(batch_shape) + tuple(event_shape)
+
+
+class Distribution:
+    """Reference: distribution/distribution.py Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no reparameterized sampler")
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        lp = self.log_prob(value)
+        return run(jnp.exp, lp, name="prob")
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _extend(self, param, sample_shape):
+        """Broadcast a param against sample_shape + batch_shape."""
+        return jnp.broadcast_to(
+            _v(param), _shape(sample_shape, self.batch_shape,
+                              self.event_shape))
+
+
+# ---------------------------------------------------------------------------
+# continuous, location-scale
+# ---------------------------------------------------------------------------
+class Normal(Distribution):
+    """Reference: distribution/normal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(self.loc.value.shape,
+                                     self.scale.value.shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return run(lambda l, s: jnp.broadcast_to(l, self.batch_shape),
+                   self.loc, self.scale, name="normal_mean")
+
+    @property
+    def variance(self):
+        return run(lambda l, s: jnp.broadcast_to(s * s, self.batch_shape),
+                   self.loc, self.scale, name="normal_var")
+
+    @property
+    def stddev(self):
+        return run(lambda l, s: jnp.broadcast_to(s, self.batch_shape),
+                   self.loc, self.scale, name="normal_std")
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(_key(), _shape(shape, self.batch_shape))
+        return run(lambda l, s: l + s * eps, self.loc, self.scale,
+                   name="normal_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(
+            lambda x, l, s: -0.5 * ((x - l) / s) ** 2
+            - jnp.log(s) - 0.5 * math.log(2 * math.pi),
+            value, self.loc, self.scale, name="normal_log_prob")
+
+    def entropy(self):
+        return run(
+            lambda l, s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                self.batch_shape),
+            self.loc, self.scale, name="normal_entropy")
+
+    def cdf(self, value):
+        value = _t(value)
+        return run(lambda x, l, s: 0.5 * (1 + jsp.erf(
+            (x - l) / (s * math.sqrt(2)))), value, self.loc, self.scale,
+            name="normal_cdf")
+
+    def icdf(self, value):
+        value = _t(value)
+        return run(lambda q, l, s: l + s * math.sqrt(2) * jsp.erfinv(
+            2 * q - 1), value, self.loc, self.scale, name="normal_icdf")
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class LogNormal(Distribution):
+    """Reference: distribution/lognormal.py (TransformedDistribution of
+    Normal with ExpTransform)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return run(lambda l, s: jnp.exp(l + s * s / 2),
+                   self.loc, self.scale, name="lognormal_mean")
+
+    @property
+    def variance(self):
+        return run(lambda l, s: (jnp.exp(s * s) - 1)
+                   * jnp.exp(2 * l + s * s),
+                   self.loc, self.scale, name="lognormal_var")
+
+    def rsample(self, shape=()):
+        z = self._base.rsample(shape)
+        return run(jnp.exp, z, name="lognormal_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(
+            lambda x, l, s: -0.5 * ((jnp.log(x) - l) / s) ** 2
+            - jnp.log(x * s) - 0.5 * math.log(2 * math.pi),
+            value, self.loc, self.scale, name="lognormal_log_prob")
+
+    def entropy(self):
+        return run(lambda l, s: jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s) + l,
+            self.batch_shape), self.loc, self.scale,
+            name="lognormal_entropy")
+
+
+class Laplace(Distribution):
+    """Reference: distribution/laplace.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(self.loc.value.shape,
+                                     self.scale.value.shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return run(lambda l, s: jnp.broadcast_to(l, self.batch_shape),
+                   self.loc, self.scale, name="laplace_mean")
+
+    @property
+    def variance(self):
+        return run(lambda l, s: jnp.broadcast_to(2 * s * s,
+                                                 self.batch_shape),
+                   self.loc, self.scale, name="laplace_var")
+
+    @property
+    def stddev(self):
+        return run(lambda l, s: jnp.broadcast_to(math.sqrt(2) * s,
+                                                 self.batch_shape),
+                   self.loc, self.scale, name="laplace_std")
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self.batch_shape),
+                               minval=-0.5 + 1e-7, maxval=0.5)
+        return run(lambda l, s: l - s * jnp.sign(u)
+                   * jnp.log1p(-2 * jnp.abs(u)),
+                   self.loc, self.scale, name="laplace_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(lambda x, l, s: -jnp.abs(x - l) / s
+                   - jnp.log(2 * s),
+                   value, self.loc, self.scale, name="laplace_log_prob")
+
+    def entropy(self):
+        return run(lambda l, s: jnp.broadcast_to(1 + jnp.log(2 * s),
+                                                 self.batch_shape),
+                   self.loc, self.scale, name="laplace_entropy")
+
+    def cdf(self, value):
+        value = _t(value)
+        return run(
+            lambda x, l, s: 0.5 - 0.5 * jnp.sign(x - l)
+            * jnp.expm1(-jnp.abs(x - l) / s),
+            value, self.loc, self.scale, name="laplace_cdf")
+
+    def icdf(self, value):
+        value = _t(value)
+        return run(
+            lambda q, l, s: l - s * jnp.sign(q - 0.5)
+            * jnp.log1p(-2 * jnp.abs(q - 0.5)),
+            value, self.loc, self.scale, name="laplace_icdf")
+
+
+class Cauchy(Distribution):
+    """Reference: distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(self.loc.value.shape,
+                                     self.scale.value.shape)
+        super().__init__(shape)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self.batch_shape),
+                               minval=1e-7, maxval=1 - 1e-7)
+        return run(lambda l, s: l + s * jnp.tan(math.pi * (u - 0.5)),
+                   self.loc, self.scale, name="cauchy_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(
+            lambda x, l, s: -math.log(math.pi) - jnp.log(s)
+            - jnp.log1p(((x - l) / s) ** 2),
+            value, self.loc, self.scale, name="cauchy_log_prob")
+
+    def entropy(self):
+        return run(lambda l, s: jnp.broadcast_to(
+            math.log(4 * math.pi) + jnp.log(s), self.batch_shape),
+            self.loc, self.scale, name="cauchy_entropy")
+
+    def cdf(self, value):
+        value = _t(value)
+        return run(lambda x, l, s: jnp.arctan((x - l) / s) / math.pi
+                   + 0.5, value, self.loc, self.scale, name="cauchy_cdf")
+
+
+class Gumbel(Distribution):
+    """Reference: distribution/gumbel.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(self.loc.value.shape,
+                                     self.scale.value.shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return run(lambda l, s: l + s * np.euler_gamma,
+                   self.loc, self.scale, name="gumbel_mean")
+
+    @property
+    def variance(self):
+        return run(lambda l, s: jnp.broadcast_to(
+            (math.pi ** 2 / 6) * s * s, self.batch_shape),
+            self.loc, self.scale, name="gumbel_var")
+
+    @property
+    def stddev(self):
+        return run(lambda l, s: jnp.broadcast_to(
+            math.pi / math.sqrt(6) * s, self.batch_shape),
+            self.loc, self.scale, name="gumbel_std")
+
+    def rsample(self, shape=()):
+        g = jax.random.gumbel(_key(), _shape(shape, self.batch_shape))
+        return run(lambda l, s: l + s * g, self.loc, self.scale,
+                   name="gumbel_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(
+            lambda x, l, s: -(x - l) / s - jnp.exp(-(x - l) / s)
+            - jnp.log(s),
+            value, self.loc, self.scale, name="gumbel_log_prob")
+
+    def entropy(self):
+        return run(lambda l, s: jnp.broadcast_to(
+            jnp.log(s) + 1 + np.euler_gamma, self.batch_shape),
+            self.loc, self.scale, name="gumbel_entropy")
+
+
+class Uniform(Distribution):
+    """Reference: distribution/uniform.py."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        shape = jnp.broadcast_shapes(self.low.value.shape,
+                                     self.high.value.shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return run(lambda a, b: (a + b) / 2, self.low, self.high,
+                   name="uniform_mean")
+
+    @property
+    def variance(self):
+        return run(lambda a, b: (b - a) ** 2 / 12, self.low, self.high,
+                   name="uniform_var")
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self.batch_shape))
+        return run(lambda a, b: a + (b - a) * u, self.low, self.high,
+                   name="uniform_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(
+            lambda x, a, b: jnp.where(
+                (x >= a) & (x < b), -jnp.log(b - a), -jnp.inf),
+            value, self.low, self.high, name="uniform_log_prob")
+
+    def entropy(self):
+        return run(lambda a, b: jnp.log(b - a), self.low, self.high,
+                   name="uniform_entropy")
+
+    def cdf(self, value):
+        value = _t(value)
+        return run(lambda x, a, b: jnp.clip((x - a) / (b - a), 0.0, 1.0),
+                   value, self.low, self.high, name="uniform_cdf")
+
+
+# ---------------------------------------------------------------------------
+# exponential family
+# ---------------------------------------------------------------------------
+class ExponentialFamily(Distribution):
+    """Reference: distribution/exponential_family.py — entropy via the
+    Bregman divergence of the log-normalizer (subclasses that define
+    `_natural_parameters` and `_log_normalizer` inherit `entropy`)."""
+
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural):
+        raise NotImplementedError
+
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nat = self._natural_parameters()
+
+        def _ent(*nat_vals):
+            def ln(*ns):
+                return jnp.sum(self._log_normalizer(*ns))
+            g = jax.grad(ln, argnums=tuple(range(len(nat_vals))))(
+                *nat_vals)
+            ent = self._log_normalizer(*nat_vals)
+            for n, gn in zip(nat_vals, g):
+                ent = ent - n * gn
+            return ent - self._mean_carrier_measure()
+        return run(_ent, *nat, name="expfam_entropy")
+
+
+class Exponential(ExponentialFamily):
+    """Reference: distribution/exponential.py (rate parameterization)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate.value.shape)
+
+    @property
+    def mean(self):
+        return run(lambda r: 1.0 / r, self.rate, name="exp_mean")
+
+    @property
+    def variance(self):
+        return run(lambda r: 1.0 / (r * r), self.rate, name="exp_var")
+
+    def rsample(self, shape=()):
+        e = jax.random.exponential(_key(),
+                                   _shape(shape, self.batch_shape))
+        return run(lambda r: e / r, self.rate, name="exp_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(lambda x, r: jnp.log(r) - r * x, value, self.rate,
+                   name="exp_log_prob")
+
+    def entropy(self):
+        return run(lambda r: 1.0 - jnp.log(r), self.rate,
+                   name="exp_entropy")
+
+    def cdf(self, value):
+        value = _t(value)
+        return run(lambda x, r: -jnp.expm1(-r * x), value, self.rate,
+                   name="exp_cdf")
+
+
+class Gamma(ExponentialFamily):
+    """Reference: distribution/gamma.py (concentration, rate)."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        shape = jnp.broadcast_shapes(self.concentration.value.shape,
+                                     self.rate.value.shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return run(lambda a, r: a / r, self.concentration, self.rate,
+                   name="gamma_mean")
+
+    @property
+    def variance(self):
+        return run(lambda a, r: a / (r * r), self.concentration,
+                   self.rate, name="gamma_var")
+
+    def rsample(self, shape=()):
+        def _fn(a, r):
+            g = jax.random.gamma(_key(), jnp.broadcast_to(
+                a, _shape(shape, self.batch_shape)))
+            return g / r
+        return run(_fn, self.concentration, self.rate,
+                   name="gamma_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(
+            lambda x, a, r: a * jnp.log(r) + (a - 1) * jnp.log(x)
+            - r * x - jsp.gammaln(a),
+            value, self.concentration, self.rate, name="gamma_log_prob")
+
+    def entropy(self):
+        return run(
+            lambda a, r: a - jnp.log(r) + jsp.gammaln(a)
+            + (1 - a) * jsp.digamma(a),
+            self.concentration, self.rate, name="gamma_entropy")
+
+
+class Chi2(Gamma):
+    """Reference: distribution/chi2.py — Gamma(df/2, 1/2)."""
+
+    def __init__(self, df, name=None):
+        self.df = _t(df)
+        super().__init__(run(lambda d: d / 2, self.df),
+                         _t(0.5))
+
+
+class StudentT(Distribution):
+    """Reference: distribution/student_t.py (df, loc, scale)."""
+
+    def __init__(self, df, loc, scale, name=None):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        shape = jnp.broadcast_shapes(self.df.value.shape,
+                                     self.loc.value.shape,
+                                     self.scale.value.shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return run(lambda d, l, s: jnp.where(d > 1, l, jnp.nan),
+                   self.df, self.loc, self.scale, name="t_mean")
+
+    @property
+    def variance(self):
+        return run(
+            lambda d, l, s: jnp.where(
+                d > 2, s * s * d / (d - 2),
+                jnp.where(d > 1, jnp.inf, jnp.nan)),
+            self.df, self.loc, self.scale, name="t_var")
+
+    def rsample(self, shape=()):
+        t = jax.random.t(_key(), _v(self.df),
+                         _shape(shape, self.batch_shape))
+        return run(lambda d, l, s: l + s * t, self.df, self.loc,
+                   self.scale, name="t_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(
+            lambda x, d, l, s: jsp.gammaln((d + 1) / 2)
+            - jsp.gammaln(d / 2) - 0.5 * jnp.log(d * math.pi)
+            - jnp.log(s)
+            - (d + 1) / 2 * jnp.log1p(((x - l) / s) ** 2 / d),
+            value, self.df, self.loc, self.scale, name="t_log_prob")
+
+    def entropy(self):
+        return run(
+            lambda d, l, s: (d + 1) / 2
+            * (jsp.digamma((d + 1) / 2) - jsp.digamma(d / 2))
+            + 0.5 * jnp.log(d) + jsp.betaln(d / 2, 0.5) + jnp.log(s),
+            self.df, self.loc, self.scale, name="t_entropy")
+
+
+class Beta(ExponentialFamily):
+    """Reference: distribution/beta.py."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        shape = jnp.broadcast_shapes(self.alpha.value.shape,
+                                     self.beta.value.shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return run(lambda a, b: a / (a + b), self.alpha, self.beta,
+                   name="beta_mean")
+
+    @property
+    def variance(self):
+        return run(lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                   self.alpha, self.beta, name="beta_var")
+
+    def rsample(self, shape=()):
+        def _fn(a, b):
+            sh = _shape(shape, self.batch_shape)
+            ga = jax.random.gamma(_key(), jnp.broadcast_to(a, sh))
+            gb = jax.random.gamma(_key(), jnp.broadcast_to(b, sh))
+            return ga / (ga + gb)
+        return run(_fn, self.alpha, self.beta, name="beta_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(
+            lambda x, a, b: (a - 1) * jnp.log(x)
+            + (b - 1) * jnp.log1p(-x) - jsp.betaln(a, b),
+            value, self.alpha, self.beta, name="beta_log_prob")
+
+    def entropy(self):
+        return run(
+            lambda a, b: jsp.betaln(a, b)
+            - (a - 1) * jsp.digamma(a) - (b - 1) * jsp.digamma(b)
+            + (a + b - 2) * jsp.digamma(a + b),
+            self.alpha, self.beta, name="beta_entropy")
+
+
+class Dirichlet(ExponentialFamily):
+    """Reference: distribution/dirichlet.py."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        shape = self.concentration.value.shape
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return run(lambda c: c / jnp.sum(c, -1, keepdims=True),
+                   self.concentration, name="dirichlet_mean")
+
+    @property
+    def variance(self):
+        def _fn(c):
+            c0 = jnp.sum(c, -1, keepdims=True)
+            m = c / c0
+            return m * (1 - m) / (c0 + 1)
+        return run(_fn, self.concentration, name="dirichlet_var")
+
+    def rsample(self, shape=()):
+        def _fn(c):
+            sh = _shape(shape, self.batch_shape, self.event_shape)
+            g = jax.random.gamma(_key(), jnp.broadcast_to(c, sh))
+            return g / jnp.sum(g, -1, keepdims=True)
+        return run(_fn, self.concentration, name="dirichlet_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(
+            lambda x, c: jnp.sum((c - 1) * jnp.log(x), -1)
+            + jsp.gammaln(jnp.sum(c, -1))
+            - jnp.sum(jsp.gammaln(c), -1),
+            value, self.concentration, name="dirichlet_log_prob")
+
+    def entropy(self):
+        def _fn(c):
+            c0 = jnp.sum(c, -1)
+            k = c.shape[-1]
+            return (jnp.sum(jsp.gammaln(c), -1) - jsp.gammaln(c0)
+                    + (c0 - k) * jsp.digamma(c0)
+                    - jnp.sum((c - 1) * jsp.digamma(c), -1))
+        return run(_fn, self.concentration, name="dirichlet_entropy")
+
+
+class MultivariateNormal(Distribution):
+    """Reference: distribution/multivariate_normal.py (loc +
+    covariance_matrix / precision_matrix / scale_tril)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _t(loc)
+        if scale_tril is not None:
+            self.scale_tril = _t(scale_tril)
+        elif covariance_matrix is not None:
+            cov = _t(covariance_matrix)
+            self.scale_tril = run(jnp.linalg.cholesky, cov,
+                                  name="mvn_chol")
+        elif precision_matrix is not None:
+            prec = _t(precision_matrix)
+            self.scale_tril = run(
+                lambda p: jnp.linalg.cholesky(jnp.linalg.inv(p)), prec,
+                name="mvn_chol")
+        else:
+            raise ValueError("one of covariance_matrix / precision_matrix"
+                             " / scale_tril is required")
+        d = self.loc.value.shape[-1]
+        super().__init__(self.loc.value.shape[:-1], (d,))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def covariance_matrix(self):
+        return run(lambda L: L @ jnp.swapaxes(L, -1, -2),
+                   self.scale_tril, name="mvn_cov")
+
+    @property
+    def variance(self):
+        return run(lambda L: jnp.sum(L * L, -1), self.scale_tril,
+                   name="mvn_var")
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(
+            _key(), _shape(shape, self.batch_shape, self.event_shape))
+        return run(lambda l, L: l + jnp.einsum("...ij,...j->...i", L, eps),
+                   self.loc, self.scale_tril, name="mvn_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = _t(value)
+
+        def _fn(x, l, L):
+            d = x.shape[-1]
+            diff = x - l
+            sol = jax.scipy.linalg.solve_triangular(
+                jnp.broadcast_to(L, diff.shape[:-1] + L.shape[-2:]),
+                diff[..., None], lower=True)[..., 0]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)),
+                             -1)
+            return (-0.5 * jnp.sum(sol * sol, -1) - logdet
+                    - 0.5 * d * math.log(2 * math.pi))
+        return run(_fn, value, self.loc, self.scale_tril,
+                   name="mvn_log_prob")
+
+    def entropy(self):
+        def _fn(L):
+            d = L.shape[-1]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)),
+                             -1)
+            return 0.5 * d * (1 + math.log(2 * math.pi)) + logdet
+        return run(_fn, self.scale_tril, name="mvn_entropy")
+
+
+# ---------------------------------------------------------------------------
+# discrete
+# ---------------------------------------------------------------------------
+class Bernoulli(ExponentialFamily):
+    """Reference: distribution/bernoulli.py (probs parameterization)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(self.probs.value.shape)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return run(lambda p: p * (1 - p), self.probs, name="bern_var")
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self.batch_shape))
+        return run(lambda p: (u < p).astype(jnp.float32), self.probs,
+                   name="bern_sample")
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax / binary concrete relaxation (reference
+        Bernoulli.rsample uses the same)."""
+        u = jax.random.uniform(_key(), _shape(shape, self.batch_shape),
+                               minval=1e-6, maxval=1 - 1e-6)
+        lg = jnp.log(u) - jnp.log1p(-u)
+        return run(
+            lambda p: jax.nn.sigmoid(
+                (jnp.log(p) - jnp.log1p(-p) + lg) / temperature),
+            self.probs, name="bern_rsample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(
+            lambda x, p: x * jnp.log(p) + (1 - x) * jnp.log1p(-p),
+            value, self.probs, name="bern_log_prob")
+
+    def entropy(self):
+        return run(
+            lambda p: -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)),
+            self.probs, name="bern_entropy")
+
+
+class ContinuousBernoulli(Distribution):
+    """Reference: distribution/continuous_bernoulli.py."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(self.probs.value.shape)
+
+    def _log_norm(self, p):
+        # C(p) = 2*atanh(1-2p) / (1-2p), with the p≈1/2 limit C=2
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near, 0.25, p)
+        c = (2 * jnp.arctanh(1 - 2 * safe)) / (1 - 2 * safe)
+        # taylor around 1/2: C ≈ 2 + (1-2p)^2 * 2/3
+        t = 2 + (1 - 2 * p) ** 2 * (2.0 / 3)
+        return jnp.log(jnp.where(near, t, c))
+
+    @property
+    def mean(self):
+        def _fn(p):
+            near = (p > self._lims[0]) & (p < self._lims[1])
+            safe = jnp.where(near, 0.25, p)
+            m = safe / (2 * safe - 1) + 1 / (
+                2 * jnp.arctanh(1 - 2 * safe))
+            return jnp.where(near, 0.5, m)
+        return run(_fn, self.probs, name="cb_mean")
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self.batch_shape),
+                               minval=1e-6, maxval=1 - 1e-6)
+        return self.icdf(Tensor(u))
+
+    rsample = sample
+
+    def icdf(self, value):
+        value = _t(value)
+
+        def _fn(u, p):
+            near = (p > self._lims[0]) & (p < self._lims[1])
+            safe = jnp.where(near, 0.25, p)
+            x = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                 / (jnp.log(safe) - jnp.log1p(-safe)))
+            return jnp.where(near, u, x)
+        return run(_fn, value, self.probs, name="cb_icdf")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(
+            lambda x, p: x * jnp.log(p) + (1 - x) * jnp.log1p(-p)
+            + self._log_norm(p),
+            value, self.probs, name="cb_log_prob")
+
+
+class Categorical(Distribution):
+    """Reference: distribution/categorical.py (logits input)."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = _t(logits)
+            self._probs = None
+        else:
+            self._probs = _t(probs)
+            self.logits = run(jnp.log, self._probs,
+                              name="categorical_logits")
+        shape = self.logits.value.shape
+        super().__init__(shape[:-1])
+        self._n = shape[-1]
+
+    @property
+    def probs(self):
+        if self._probs is not None:
+            return self._probs
+        return run(lambda lg: jax.nn.softmax(lg, -1), self.logits,
+                   name="categorical_probs")
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(
+            _key(), _v(self.logits),
+            shape=_shape(shape, self.batch_shape))
+        return Tensor(out)
+
+    def log_prob(self, value):
+        value = _t(value, dtype=None)
+
+        def _fn(x, lg):
+            ls = jax.nn.log_softmax(lg, -1)
+            xi = x.astype(jnp.int32)
+            # value broadcasts against batch_shape: a 1-D value over a
+            # scalar-batch categorical is a batch of index lookups
+            ls = jnp.broadcast_to(ls, xi.shape + ls.shape[-1:])
+            return jnp.take_along_axis(ls, xi[..., None], -1)[..., 0]
+        return run(_fn, value, self.logits, name="categorical_log_prob")
+
+    def entropy(self):
+        return run(
+            lambda lg: -jnp.sum(jax.nn.softmax(lg, -1)
+                                * jax.nn.log_softmax(lg, -1), -1),
+            self.logits, name="categorical_entropy")
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Multinomial(Distribution):
+    """Reference: distribution/multinomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        shape = self.probs.value.shape
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return run(lambda p: self.total_count * p, self.probs,
+                   name="multinomial_mean")
+
+    @property
+    def variance(self):
+        return run(lambda p: self.total_count * p * (1 - p), self.probs,
+                   name="multinomial_var")
+
+    def sample(self, shape=()):
+        def draw(p):
+            logits = jnp.log(p)
+            idx = jax.random.categorical(
+                _key(), logits,
+                shape=(self.total_count,) + _shape(shape,
+                                                   self.batch_shape))
+            onehot = jax.nn.one_hot(idx, p.shape[-1])
+            return jnp.sum(onehot, axis=0)
+        return Tensor(draw(_v(self.probs)))
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(
+            lambda x, p: jsp.gammaln(jnp.asarray(
+                self.total_count + 1.0))
+            - jnp.sum(jsp.gammaln(x + 1), -1)
+            + jnp.sum(x * jnp.log(p), -1),
+            value, self.probs, name="multinomial_log_prob")
+
+    def entropy(self):
+        # no closed form; Monte-Carlo estimate (reference approximates
+        # numerically as well)
+        samples = self.sample((128,))
+        lp = self.log_prob(samples)
+        return run(lambda l: -jnp.mean(l, 0), lp,
+                   name="multinomial_entropy")
+
+
+class Geometric(Distribution):
+    """Reference: distribution/geometric.py — trials-before-success on
+    {0, 1, 2, ...}."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(self.probs.value.shape)
+
+    @property
+    def mean(self):
+        return run(lambda p: (1 - p) / p, self.probs, name="geom_mean")
+
+    @property
+    def variance(self):
+        return run(lambda p: (1 - p) / (p * p), self.probs,
+                   name="geom_var")
+
+    @property
+    def stddev(self):
+        return run(lambda p: jnp.sqrt(1 - p) / p, self.probs,
+                   name="geom_std")
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self.batch_shape),
+                               minval=1e-7, maxval=1 - 1e-7)
+        return run(lambda p: jnp.floor(jnp.log(u) / jnp.log1p(-p)),
+                   self.probs, name="geom_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(lambda x, p: x * jnp.log1p(-p) + jnp.log(p),
+                   value, self.probs, name="geom_log_prob")
+
+    def entropy(self):
+        return run(
+            lambda p: -((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p,
+            self.probs, name="geom_entropy")
+
+    def cdf(self, value):
+        value = _t(value)
+        return run(lambda x, p: 1 - (1 - p) ** (jnp.floor(x) + 1),
+                   value, self.probs, name="geom_cdf")
+
+
+class Binomial(Distribution):
+    """Reference: distribution/binomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count, dtype=jnp.float32)
+        self.probs = _t(probs)
+        shape = jnp.broadcast_shapes(self.total_count.value.shape,
+                                     self.probs.value.shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return run(lambda n, p: n * p, self.total_count, self.probs,
+                   name="binomial_mean")
+
+    @property
+    def variance(self):
+        return run(lambda n, p: n * p * (1 - p), self.total_count,
+                   self.probs, name="binomial_var")
+
+    def sample(self, shape=()):
+        n = int(np.max(np.asarray(_v(self.total_count))))
+        u = jax.random.uniform(
+            _key(), (n,) + _shape(shape, self.batch_shape))
+
+        def _fn(nc, p):
+            idx = jnp.arange(n).reshape((n,) + (1,) * (u.ndim - 1))
+            live = idx < nc
+            return jnp.sum((u < p) & live, axis=0).astype(jnp.float32)
+        return run(_fn, self.total_count, self.probs,
+                   name="binomial_sample")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(
+            lambda x, n, p: jsp.gammaln(n + 1) - jsp.gammaln(x + 1)
+            - jsp.gammaln(n - x + 1) + x * jnp.log(p)
+            + (n - x) * jnp.log1p(-p),
+            value, self.total_count, self.probs, name="binomial_log_prob")
+
+
+class Poisson(Distribution):
+    """Reference: distribution/poisson.py."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate.value.shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        out = jax.random.poisson(
+            _key(), _v(self.rate),
+            shape=_shape(shape, self.batch_shape))
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        value = _t(value)
+        return run(
+            lambda x, r: x * jnp.log(r) - r - jsp.gammaln(x + 1),
+            value, self.rate, name="poisson_log_prob")
+
+    def entropy(self):
+        # series approximation matching the reference's numeric entropy
+        samples = self.sample((256,))
+        lp = self.log_prob(samples)
+        return run(lambda l: -jnp.mean(l, 0), lp, name="poisson_entropy")
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+class Independent(Distribution):
+    """Reference: distribution/independent.py — reinterpret batch dims as
+    event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self._rank],
+                         bs[len(bs) - self._rank:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        if self._rank == 0:
+            return lp
+        return run(lambda l: jnp.sum(
+            l, axis=tuple(range(-self._rank, 0))), lp,
+            name="independent_log_prob")
+
+    def entropy(self):
+        e = self.base.entropy()
+        if self._rank == 0:
+            return e
+        return run(lambda x: jnp.sum(x, axis=tuple(range(-self._rank, 0))),
+                   e, name="independent_entropy")
+
+
+class TransformedDistribution(Distribution):
+    """Reference: distribution/transformed_distribution.py."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        value = _t(value)
+        lp = None
+        x = value
+        for t in reversed(self.transforms):
+            inv = t.inverse(x)
+            ladj = t.forward_log_det_jacobian(inv)
+            lp = ladj if lp is None else run(
+                lambda a, b: a + b, lp, ladj, name="td_ladj_sum")
+            x = inv
+        base_lp = self.base.log_prob(x)
+        return run(lambda b, l: b - l, base_lp, lp, name="td_log_prob")
+
+
+# ---------------------------------------------------------------------------
+# transforms (reference: distribution/transform.py)
+# ---------------------------------------------------------------------------
+class Transform:
+    _type = "bijection"
+
+    def forward(self, x):
+        x = _t(x)
+        return run(self._forward, x, name=f"{type(self).__name__}_fwd")
+
+    def inverse(self, y):
+        y = _t(y)
+        return run(self._inverse, y, name=f"{type(self).__name__}_inv")
+
+    def forward_log_det_jacobian(self, x):
+        x = _t(x)
+        return run(self._fldj, x, name=f"{type(self).__name__}_fldj")
+
+    def inverse_log_det_jacobian(self, y):
+        y = _t(y)
+        return run(lambda v: -self._fldj(self._inverse(v)), y,
+                   name=f"{type(self).__name__}_ildj")
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AbsTransform(Transform):
+    _type = "surjection"
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _v(_t(loc))
+        self.scale = _v(_t(scale))
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _v(_t(power))
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = "other"
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    def _forward(self, x):
+        # R^{K-1} -> simplex^K
+        offset = x.shape[-1] - jnp.arange(x.shape[-1])
+        z = jax.nn.sigmoid(x - jnp.log(offset.astype(x.dtype)))
+        zpad = jnp.concatenate(
+            [z, jnp.ones(x.shape[:-1] + (1,), x.dtype)], -1)
+        cum = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype),
+             jnp.cumprod(1 - z, -1)], -1)
+        return zpad * cum
+
+    def _inverse(self, y):
+        ycum = jnp.cumsum(y[..., :-1], -1)
+        rem = 1 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), ycum[..., :-1]], -1)
+        z = y[..., :-1] / rem
+        offset = y.shape[-1] - 1 - jnp.arange(y.shape[-1] - 1)
+        return jnp.log(z) - jnp.log1p(-z) \
+            + jnp.log(offset.astype(y.dtype))
+
+    def _fldj(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1])
+        xs = x - jnp.log(offset.astype(x.dtype))
+        z = jax.nn.sigmoid(xs)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z)
+                       + jnp.cumsum(jnp.log1p(-z), -1)
+                       - jnp.log1p(-z), -1)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            l = t.forward_log_det_jacobian(x)
+            total = l if total is None else run(
+                lambda a, b: a + b, total, l, name="chain_fldj")
+            x = t.forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        l = self.base.forward_log_det_jacobian(x)
+        return run(lambda v: jnp.sum(v, tuple(range(-self._rank, 0))), l,
+                   name="indep_fldj")
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def forward(self, x):
+        x = _t(x)
+        parts = [t.forward(Tensor(v)) for t, v in zip(
+            self.transforms,
+            jnp.moveaxis(_v(x), self.axis, 0))]
+        return run(lambda *vs: jnp.stack(vs, self.axis), *parts,
+                   name="stack_fwd")
+
+    def inverse(self, y):
+        y = _t(y)
+        parts = [t.inverse(Tensor(v)) for t, v in zip(
+            self.transforms,
+            jnp.moveaxis(_v(y), self.axis, 0))]
+        return run(lambda *vs: jnp.stack(vs, self.axis), *parts,
+                   name="stack_inv")
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (reference: distribution/kl.py)
+# ---------------------------------------------------------------------------
+_KL_REGISTRY: Dict[Tuple[type, type], callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Reference: kl.py register_kl decorator."""
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    """Reference: kl.py kl_divergence — dispatch on the most specific
+    registered (type(p), type(q)) pair."""
+    matches = [(pc, qc) for pc, qc in _KL_REGISTRY
+               if isinstance(p, pc) and isinstance(q, qc)]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL({type(p).__name__} || {type(q).__name__}) registered")
+    best = max(matches, key=lambda t: (  # most derived pair wins
+        len(t[0].__mro__), len(t[1].__mro__)))
+    return _KL_REGISTRY[best](p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    return run(
+        lambda pl, ps, ql, qs: jnp.log(qs / ps)
+        + (ps * ps + (pl - ql) ** 2) / (2 * qs * qs) - 0.5,
+        p.loc, p.scale, q.loc, q.scale, name="kl_normal")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return run(
+        lambda pa, pb, qa, qb: jnp.where(
+            (qa <= pa) & (pb <= qb),
+            jnp.log((qb - qa) / (pb - pa)), jnp.inf),
+        p.low, p.high, q.low, q.high, name="kl_uniform")
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    return run(
+        lambda pp, qp: pp * (jnp.log(pp) - jnp.log(qp))
+        + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)),
+        p.probs, q.probs, name="kl_bernoulli")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    return run(
+        lambda pl, ql: jnp.sum(
+            jax.nn.softmax(pl, -1)
+            * (jax.nn.log_softmax(pl, -1) - jax.nn.log_softmax(ql, -1)),
+            -1),
+        p.logits, q.logits, name="kl_categorical")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    return run(
+        lambda pa, pb, qa, qb: jsp.betaln(qa, qb) - jsp.betaln(pa, pb)
+        + (pa - qa) * jsp.digamma(pa) + (pb - qb) * jsp.digamma(pb)
+        + (qa - pa + qb - pb) * jsp.digamma(pa + pb),
+        p.alpha, p.beta, q.alpha, q.beta, name="kl_beta")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def _fn(pc, qc):
+        p0 = jnp.sum(pc, -1)
+        return (jsp.gammaln(p0) - jnp.sum(jsp.gammaln(pc), -1)
+                - jsp.gammaln(jnp.sum(qc, -1))
+                + jnp.sum(jsp.gammaln(qc), -1)
+                + jnp.sum((pc - qc) * (jsp.digamma(pc)
+                                       - jsp.digamma(p0)[..., None]), -1))
+    return run(_fn, p.concentration, q.concentration, name="kl_dirichlet")
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    return run(
+        lambda pa, pr, qa, qr: (pa - qa) * jsp.digamma(pa)
+        - jsp.gammaln(pa) + jsp.gammaln(qa)
+        + qa * (jnp.log(pr) - jnp.log(qr)) + pa * (qr - pr) / pr,
+        p.concentration, p.rate, q.concentration, q.rate,
+        name="kl_gamma")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return run(
+        lambda pr, qr: jnp.log(pr) - jnp.log(qr) + qr / pr - 1,
+        p.rate, q.rate, name="kl_exponential")
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    return run(
+        lambda pl, ps, ql, qs: jnp.log(qs / ps)
+        + jnp.abs(pl - ql) / qs
+        + ps / qs * jnp.exp(-jnp.abs(pl - ql) / ps) - 1,
+        p.loc, p.scale, q.loc, q.scale, name="kl_laplace")
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    return run(
+        lambda pp, qp: (1 - pp) / pp
+        * (jnp.log1p(-pp) - jnp.log1p(-qp))
+        + jnp.log(pp) - jnp.log(qp),
+        p.probs, q.probs, name="kl_geometric")
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return run(
+        lambda pr, qr: pr * (jnp.log(pr) - jnp.log(qr)) + qr - pr,
+        p.rate, q.rate, name="kl_poisson")
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    return _kl_normal(p, q)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    def _fn(pl, pL, ql, qL):
+        d = pl.shape[-1]
+        m = jax.scipy.linalg.solve_triangular(qL, pL, lower=True)
+        tr = jnp.sum(m * m, axis=(-2, -1))
+        diff = jax.scipy.linalg.solve_triangular(
+            qL, (ql - pl)[..., None], lower=True)[..., 0]
+        logdet = (jnp.sum(jnp.log(jnp.diagonal(qL, axis1=-2, axis2=-1)),
+                          -1)
+                  - jnp.sum(jnp.log(jnp.diagonal(pL, axis1=-2, axis2=-1)),
+                            -1))
+        return logdet + 0.5 * (tr + jnp.sum(diff * diff, -1) - d)
+    return run(_fn, p.loc, p.scale_tril, q.loc, q.scale_tril,
+               name="kl_mvn")

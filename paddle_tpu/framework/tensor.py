@@ -57,6 +57,31 @@ class Tensor:
         ref.tensor_wref = weakref.ref(self)
         self._ref = ref
 
+    def __deepcopy__(self, memo):
+        """deepcopy treats weakrefs as atomic, so the default copy would
+        keep a VarRef whose tensor_wref resolves to the ORIGINAL tensor —
+        backward would then write grads to the source object instead of
+        the copy.  Build a fresh leaf instead (jax arrays are immutable,
+        so the value itself is shared)."""
+        import copy as _copy
+        cls = type(self)
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        new._value = self._value
+        new.stop_gradient = self.stop_gradient
+        new._grad = None
+        new.name = self.name
+        new.persistable = self.persistable
+        new._retain_grads = False
+        new._grad_hooks = []
+        r = VarRef()
+        r.tensor_wref = weakref.ref(new)
+        new._ref = r
+        # subclass extras (Parameter's optimize_attr etc.) live in __dict__
+        for k, v in getattr(self, "__dict__", {}).items():
+            setattr(new, k, _copy.deepcopy(v, memo))
+        return new
+
     @property
     def value(self):
         return self._value

@@ -1,0 +1,128 @@
+"""paddle.geometric — graph message passing + segment ops.
+
+Reference: `python/paddle/geometric/` (message_passing/send_recv.py
+send_u_recv/send_ue_recv, math.py segment_sum/mean/max/min,
+reindex_graph, sample_neighbors).  TPU-native: every gather/scatter is
+jax.ops.segment_* (static num_segments → XLA scatter on-device); no
+dynamic shapes, so everything jits.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import run, to_tensor_args
+from ..framework.tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "segment_sum", "segment_mean",
+           "segment_max", "segment_min", "reindex_graph"]
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed from sum / count
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _idx(x):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    return v.astype(jnp.int32)
+
+
+def _segment(vals, seg, n, pool):
+    if pool == "mean":
+        s = jax.ops.segment_sum(vals, seg, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((vals.shape[0],), vals.dtype),
+                                  seg, num_segments=n)
+        return s / jnp.maximum(cnt, 1)[(...,) + (None,) * (s.ndim - 1)]
+    out = _REDUCERS[pool](vals, seg, num_segments=n)
+    if pool in ("max", "min"):
+        # empty segments come back +-inf; the reference zeroes them
+        out = jnp.where(jnp.isfinite(out), out, 0)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src], scatter-reduce onto dst (reference:
+    send_recv.py:33 graph_send_recv)."""
+    (x,) = to_tensor_args(x)
+    src = _idx(src_index)
+    dst = _idx(dst_index)
+    n = int(out_size) if out_size is not None else x.value.shape[0]
+    return run(lambda v: _segment(v[src], dst, n, reduce_op), x,
+               name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Edge-weighted variant (reference: send_recv.py send_ue_recv):
+    message = x[src] (op) y_edge, then scatter-reduce to dst."""
+    (x,) = to_tensor_args(x)
+    yv = y if isinstance(y, Tensor) else Tensor(jnp.asarray(np.asarray(y)))
+    src = _idx(src_index)
+    dst = _idx(dst_index)
+    n = int(out_size) if out_size is not None else x.value.shape[0]
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    mop = ops[message_op]
+
+    def _fn(v, e):
+        msg = mop(v[src], e if e.ndim == v.ndim else e[:, None]
+                  if v.ndim > 1 else e)
+        return _segment(msg, dst, n, reduce_op)
+    return run(_fn, x, yv, name="send_ue_recv")
+
+
+def segment_sum(data, segment_ids, name=None):
+    (data,) = to_tensor_args(data)
+    seg = _idx(segment_ids)
+    n = int(np.asarray(jax.device_get(seg)).max()) + 1 if seg.size else 0
+    return run(lambda v: jax.ops.segment_sum(v, seg, num_segments=n),
+               data, name="segment_sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    (data,) = to_tensor_args(data)
+    seg = _idx(segment_ids)
+    n = int(np.asarray(jax.device_get(seg)).max()) + 1 if seg.size else 0
+    return run(lambda v: _segment(v, seg, n, "mean"), data,
+               name="segment_mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    (data,) = to_tensor_args(data)
+    seg = _idx(segment_ids)
+    n = int(np.asarray(jax.device_get(seg)).max()) + 1 if seg.size else 0
+    return run(lambda v: _segment(v, seg, n, "max"), data,
+               name="segment_max")
+
+
+def segment_min(data, segment_ids, name=None):
+    (data,) = to_tensor_args(data)
+    seg = _idx(segment_ids)
+    n = int(np.asarray(jax.device_get(seg)).max()) + 1 if seg.size else 0
+    return run(lambda v: _segment(v, seg, n, "min"), data,
+               name="segment_min")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global ids to local ids (reference: reindex_graph).
+    Host-side (python) — graph preprocessing, not a jit path."""
+    xs = np.asarray(jax.device_get(_idx(x)))
+    nb = np.asarray(jax.device_get(_idx(neighbors)))
+    cnt = np.asarray(jax.device_get(_idx(count)))
+    order = {int(g): i for i, g in enumerate(xs)}
+    out_nodes = list(xs)
+    for g in nb:
+        if int(g) not in order:
+            order[int(g)] = len(out_nodes)
+            out_nodes.append(int(g))
+    reindex_nb = np.asarray([order[int(g)] for g in nb], np.int32)
+    reindex_dst = np.repeat(np.arange(len(cnt), dtype=np.int32), cnt)
+    return (Tensor(jnp.asarray(reindex_nb)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(np.asarray(out_nodes, np.int32))))

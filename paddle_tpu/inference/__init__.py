@@ -133,10 +133,10 @@ class Predictor:
             raise ValueError(
                 f"'{config._path}.pdmodel' holds no compiled function; "
                 "export with paddle.jit.save(layer, path, input_spec=...)")
+        n_inputs = (len(self._layer._exported.in_avals)
+                    - len(self._layer._param_names))
         names = self._layer.input_names or [
-            f"x{i}" for i in range(self._layer._exported.in_avals and
-                                   len(self._layer._exported.in_avals) - 1
-                                   or 1)]
+            f"x{i}" for i in range(max(n_inputs, 1))]
         self._inputs: Dict[str, Tensor] = {n: Tensor(n) for n in names}
         self._outputs: Dict[str, Tensor] = {}
 

@@ -105,12 +105,9 @@ class ShardedTrainStep:
                 self._opt_shardings[n] = self._param_shardings[n]
 
     def _shard_batch(self, arr):
-        spec = [None] * arr.ndim
-        axes = tuple(a for a in self.batch_axes
-                     if a in self.mesh.axis_names and self.mesh.shape[a] > 1)
-        n = int(np.prod([self.mesh.shape[a] for a in axes])) if axes else 1
-        if axes and arr.ndim and arr.shape[0] % n == 0:
-            spec[0] = axes  # batch not divisible → keep replicated
+        from ..distributed.topology import batch_partition_spec
+        spec = batch_partition_spec(self.mesh, arr.shape,
+                                    self.batch_axes)
         if self.seq_axis and self.seq_axis in self.mesh.axis_names \
                 and self.mesh.shape[self.seq_axis] > 1 \
                 and arr.ndim > self.seq_dim:

@@ -1,0 +1,207 @@
+"""paddle.quantization — QAT / PTQ toolchain.
+
+Reference: `python/paddle/quantization/` — QuantConfig (config.py),
+QAT (qat.py), PTQ (ptq.py), BaseQuanter/BaseObserver, quanters
+(FakeQuanterWithAbsMaxObserver) and observers (AbsmaxObserver), with
+quantize.py walking the model and swapping layers for quanted wrappers.
+
+TPU-native: fake-quantization is a straight-through estimator expressed
+directly in the taped op (x + stop_gradient(q(x) - x)), which XLA fuses
+into the surrounding matmul; the simulated int8 grid matches the
+reference's symmetric absmax scheme, so checkpoints/scales port 1:1.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, Optional, Type
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.dispatch import run, to_tensor_args
+from ..framework.tensor import Tensor
+
+from .quanters import (BaseQuanter, QuanterFactory, quanter,  # noqa: F401
+                       FakeQuanterWithAbsMaxObserver,
+                       FakeQuanterWithAbsMaxObserverLayer)
+from .observers import BaseObserver, AbsmaxObserver  # noqa: F401
+
+__all__ = ["QuantConfig", "BaseQuanter", "BaseObserver", "quanter",
+           "QAT", "PTQ"]
+
+
+class SingleLayerConfig:
+    def __init__(self, activation=None, weight=None):
+        self._activation = activation
+        self._weight = weight
+
+    @property
+    def activation(self):
+        return self._activation
+
+    @property
+    def weight(self):
+        return self._weight
+
+
+class QuantConfig:
+    """Reference: config.py QuantConfig — per-layer / per-name /
+    per-type quanter assignment with global default."""
+
+    def __init__(self, activation=None, weight=None):
+        self._global = SingleLayerConfig(activation, weight)
+        self._layer_configs: Dict[int, SingleLayerConfig] = {}
+        self._name_configs: Dict[str, SingleLayerConfig] = {}
+        self._type_configs: Dict[type, SingleLayerConfig] = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs[id(l)] = SingleLayerConfig(activation,
+                                                           weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = (layer_name if isinstance(layer_name, (list, tuple))
+                 else [layer_name])
+        for n in names:
+            self._name_configs[n] = SingleLayerConfig(activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._type_configs[t] = SingleLayerConfig(activation, weight)
+
+    def config_for(self, name, layer) -> Optional[SingleLayerConfig]:
+        """Priority (reference): layer > name > type > global."""
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        if name in self._name_configs:
+            return self._name_configs[name]
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        if self._global.activation or self._global.weight:
+            if isinstance(layer, (nn.Linear, nn.Conv2D)):
+                return self._global
+        return None
+
+
+def _make(factory):
+    if factory is None:
+        return None
+    return factory._instance() if isinstance(factory, QuanterFactory) \
+        else factory
+
+
+class QuantedLinear(nn.Layer):
+    """QAT wrapper (reference: nn/quant/qat/linear.py QuantedLinear):
+    fake-quant the activation and weight, then the float linear."""
+
+    def __init__(self, layer: "nn.Linear", q_config: SingleLayerConfig):
+        super().__init__()
+        self._inner = layer
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self.activation_quanter = _make(q_config.activation)
+        self.weight_quanter = _make(q_config.weight)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        out = nn.functional.linear(x, w, self.bias)
+        return out
+
+
+class QuantedConv2D(nn.Layer):
+    def __init__(self, layer: "nn.Conv2D", q_config: SingleLayerConfig):
+        super().__init__()
+        self._inner = layer
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self.activation_quanter = _make(q_config.activation)
+        self.weight_quanter = _make(q_config.weight)
+
+    def forward(self, x):
+        inner = self._inner
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return nn.functional.conv2d(
+            x, w, self.bias, stride=inner._stride,
+            padding=inner._padding, dilation=inner._dilation,
+            groups=inner._groups)
+
+
+_QAT_MAPPING: Dict[type, type] = {}
+
+
+def _default_mapping():
+    if not _QAT_MAPPING:
+        _QAT_MAPPING[nn.Linear] = QuantedLinear
+        _QAT_MAPPING[nn.Conv2D] = QuantedConv2D
+    return _QAT_MAPPING
+
+
+class Quantization:
+    """Reference: quantize.py Quantization — model walk + layer swap."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+        self._mapping = dict(_default_mapping())
+
+    def add_qat_layer_mapping(self, source, target):
+        self._mapping[source] = target
+
+    def _convert_layer(self, name, layer):
+        cfg = self._config.config_for(name, layer)
+        if cfg is None:
+            return None
+        for src, dst in self._mapping.items():
+            if isinstance(layer, src):
+                return dst(layer, cfg)
+        return None
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._swap(model, prefix="")
+        return model
+
+    def _swap(self, layer, prefix):
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            repl = self._convert_layer(full, sub)
+            if repl is not None:
+                layer._sub_layers[name] = repl
+            else:
+                self._swap(sub, full)
+
+
+class QAT(Quantization):
+    """Reference: qat.py — insert fake quanters for training."""
+
+
+class PTQ(Quantization):
+    """Reference: ptq.py — insert observers, calibrate, then convert.
+
+    Usage: q = PTQ(QuantConfig(activation=AbsmaxObserver(),
+    weight=AbsmaxObserver())); m = q.quantize(model); run calibration
+    batches through m; q.convert(m) freezes the observed scales into
+    fake-quant ops."""
+
+    def convert(self, model, inplace=True):
+        """Replace observers with fixed-scale fake quantizers."""
+        for _, sub in model.named_sublayers(include_self=True):
+            for attr in ("activation_quanter", "weight_quanter"):
+                q = getattr(sub, attr, None)
+                if isinstance(q, BaseObserver):
+                    setattr(sub, attr, q.to_quanter())
+        return model

@@ -1,0 +1,73 @@
+"""Observers — PTQ calibration statistics collectors.
+
+Reference: `python/paddle/quantization/observers/abs_max.py`
+(AbsmaxObserver: running max of |x| over calibration batches; convert()
+freezes the scale into a fixed fake-quant op).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.dispatch import to_tensor_args, run
+from ..framework.tensor import Tensor
+
+__all__ = ["BaseObserver", "AbsmaxObserver", "AbsmaxObserverLayer"]
+
+
+class BaseObserver(nn.Layer):
+    """Reference: base_observer.py — identity forward that records
+    statistics; to_quanter() freezes them."""
+
+    def cal_thresholds(self):
+        pass
+
+    def scales(self):
+        raise NotImplementedError
+
+    def to_quanter(self):
+        raise NotImplementedError
+
+
+class AbsmaxObserverLayer(BaseObserver):
+    def __init__(self, layer=None, quant_bits=8):
+        super().__init__()
+        self._bits = int(quant_bits)
+        self._max = 0.0
+
+    def forward(self, x):
+        (x,) = to_tensor_args(x)
+        self._max = max(self._max, float(np.asarray(jax.device_get(
+            jnp.max(jnp.abs(x._value))))))
+        return x
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._max, jnp.float32))
+
+    def to_quanter(self):
+        from .quanters import _fake_quant
+
+        class _Frozen(nn.Layer):
+            def __init__(self, scale, bits):
+                super().__init__()
+                self._scale = scale
+                self._bits = bits
+
+            def forward(self, x):
+                (x,) = to_tensor_args(x)
+                return run(lambda v: _fake_quant(
+                    v, jnp.float32(self._scale), self._bits), x,
+                    name="fake_quant_frozen")
+
+            def scales(self):
+                return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+        return _Frozen(self._max, self._bits)
+
+
+def AbsmaxObserver(quant_bits=8):
+    """Factory (reference: observers/abs_max.py AbsmaxObserver)."""
+    from .quanters import QuanterFactory
+    return QuanterFactory(AbsmaxObserverLayer, quant_bits=quant_bits)

@@ -1,0 +1,120 @@
+"""Quanters — trainable fake-quantization layers.
+
+Reference: `python/paddle/quantization/quanters/abs_max.py`
+(FakeQuanterWithAbsMaxObserver: moving-average absmax scale + round to
+the symmetric int grid with a straight-through gradient).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.dispatch import run, to_tensor_args
+from ..framework.tensor import Tensor
+
+__all__ = ["BaseQuanter", "QuanterFactory", "quanter",
+           "FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterWithAbsMaxObserverLayer"]
+
+
+class BaseQuanter(nn.Layer):
+    """Reference: base_quanter.py."""
+
+    def bit_length(self):
+        return getattr(self, "_bits", 8)
+
+    def quant_axis(self):
+        return getattr(self, "_quant_axis", None)
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+
+class QuanterFactory:
+    """Reference: factory.py QuanterFactory — defers layer construction
+    so one config object can instantiate many quanter layers."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self._cls = cls
+        self._args = args
+        self._kwargs = kwargs
+
+    def _instance(self):
+        return self._cls(*self._args, **self._kwargs)
+
+
+def quanter(name):
+    """Reference: factory.py quanter decorator — registers a factory
+    under `name` so configs can refer to quanters declaratively."""
+    def deco(cls):
+        def factory(*args, **kwargs):
+            return QuanterFactory(cls, *args, **kwargs)
+        factory.__name__ = name
+        import sys
+        setattr(sys.modules[cls.__module__], name, factory)
+        return cls
+    return deco
+
+
+def _fake_quant(x, scale, bits):
+    """Symmetric absmax fake quant with straight-through gradient."""
+    bnd = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * bnd), -bnd, bnd) * s / bnd
+    return x + jax.lax.stop_gradient(q - x)
+
+
+class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
+    """Reference: quanters/abs_max.py:96 — EMA of the absmax drives the
+    scale during training; the forward emits the fake-quantized value."""
+
+    def __init__(self, layer=None, moving_rate=0.9, bit_length=8,
+                 dtype="float32", name=None):
+        super().__init__()
+        self._bits = int(bit_length)
+        self._rate = float(moving_rate)
+        self._scale = None   # python-side EMA state (host scalar)
+        self._step = 0
+
+    def forward(self, x):
+        (x,) = to_tensor_args(x)
+        import numpy as np
+        cur = float(np.asarray(jax.device_get(
+            jnp.max(jnp.abs(jax.lax.stop_gradient(x._value)))))) \
+            if not self._tracing(x) else None
+        if cur is not None:
+            if self._scale is None:
+                self._scale = cur
+            else:
+                self._scale = (self._rate * self._scale
+                               + (1 - self._rate) * cur)
+            self._step += 1
+            scale = self._scale
+            return run(lambda v: _fake_quant(v, jnp.float32(scale),
+                                             self._bits),
+                       x, name="fake_quant_absmax")
+        # under jit tracing: derive the scale from the live batch
+        return run(lambda v: _fake_quant(
+            v, jnp.max(jnp.abs(jax.lax.stop_gradient(v))), self._bits),
+            x, name="fake_quant_absmax")
+
+    @staticmethod
+    def _tracing(t):
+        import jax.core as jc
+        return isinstance(t._value, jc.Tracer)
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._scale if self._scale is not None
+                                  else 0.0, jnp.float32))
+
+
+def FakeQuanterWithAbsMaxObserver(moving_rate=0.9, bit_length=8,
+                                  dtype="float32", name=None):
+    """Factory (reference: quanters/abs_max.py:27)."""
+    return QuanterFactory(FakeQuanterWithAbsMaxObserverLayer,
+                          moving_rate=moving_rate, bit_length=bit_length,
+                          dtype=dtype, name=name)

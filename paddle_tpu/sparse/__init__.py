@@ -1,64 +1,119 @@
-"""Sparse tensors (COO/CSR).
+"""Sparse tensors (COO/CSR) with REAL sparse compute.
 
-Reference: `python/paddle/sparse/` over phi SparseCoo/SparseCsr kernels.
-TPU-native: jax.experimental.sparse (BCOO) backs the COO path; XLA lowers
-sparse ops to gather/scatter/dense-matmul hybrids.  CSR is stored but
-converted through COO for compute (TPU has no native CSR kernels — the MXU
-prefers densified blocks anyway).
+Reference: `python/paddle/sparse/` over phi SparseCoo/SparseCsr kernels
+(unary ops keep the sparsity pattern; binary/matmul kernels consume the
+index structure directly).  TPU-native: jax.experimental.sparse BCOO
+backs the storage and the compute — `matmul` lowers to
+`bcoo_dot_general` (gather/segment-sum on the nonzeros, NOT a densified
+matmul), elementwise ops transform only the `nnz` value vector, and
+sparse+sparse addition concatenates and deduplicates index structure.
+CSR is stored with its crows/cols but computes through the same BCOO
+path (TPU has no native CSR kernels).
+
+Gradients: ops with dense outputs (matmul, to_dense) run through the
+tape over the VALUE vector, so d(loss)/d(values) and the dense operand's
+grad both flow.
 """
 from __future__ import annotations
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
 
 from ..framework.tensor import Tensor
+from ..framework.dispatch import run
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
-           "is_same_shape", "matmul", "add", "multiply"]
+           "SparseCsrTensor", "is_same_shape", "matmul", "masked_matmul",
+           "add", "subtract", "multiply", "divide", "relu", "sin", "tanh",
+           "sqrt", "abs", "neg", "pow", "square", "cast", "transpose"]
 
 
-class SparseCooTensor(Tensor):
-    def __init__(self, indices, values, shape):
-        self._indices = indices if isinstance(indices, jnp.ndarray) \
-            else jnp.asarray(np.asarray(indices))
-        self._sp_values = values if isinstance(values, jnp.ndarray) \
+class SparseCooTensor:
+    """COO sparse tensor backed by a jax BCOO (indices [nnz, ndim])."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_parts(cls, indices, values, shape):
+        idx = jnp.asarray(np.asarray(indices)).T  # paddle: [ndim, nnz]
+        vals = values._value if isinstance(values, Tensor) \
             else jnp.asarray(np.asarray(values))
-        self._dense_shape = tuple(int(s) for s in shape)
-        super().__init__(self._densify())
+        return cls(jsparse.BCOO((vals, idx.astype(jnp.int32)),
+                                shape=tuple(int(s) for s in shape)))
 
-    def _densify(self):
-        dense = jnp.zeros(self._dense_shape, self._sp_values.dtype)
-        idx = tuple(self._indices[i] for i in range(self._indices.shape[0]))
-        return dense.at[idx].add(self._sp_values)
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        from ..framework import dtypes
+        return dtypes.convert_np_dtype_to_dtype_(self._bcoo.dtype)
+
+    @property
+    def ndim(self):
+        return self._bcoo.ndim
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
 
     def indices(self):
-        return Tensor(self._indices)
+        return Tensor(self._bcoo.indices.T)  # [ndim, nnz] (paddle layout)
 
     def values(self):
-        return Tensor(self._sp_values)
+        return Tensor(self._bcoo.data)
 
     def to_dense(self):
-        return Tensor(self._densify())
+        idx = self._bcoo.indices
+        shape = self._bcoo.shape
+        return run(
+            lambda d: jsparse.BCOO((d, idx), shape=shape).todense(),
+            Tensor(self._bcoo.data), name="sparse_to_dense")
 
     def is_sparse_coo(self):
         return True
 
-    @property
-    def nnz(self):
-        return self._sp_values.shape[0]
+    def is_sparse_csr(self):
+        return False
+
+    def _with_values(self, fn):
+        out = fn(self._bcoo.data)
+        bcoo = jsparse.BCOO((out, self._bcoo.indices),
+                            shape=self._bcoo.shape)
+        if isinstance(self, SparseCsrTensor):
+            return SparseCsrTensor(bcoo, self._crows, self._cols)
+        return SparseCooTensor(bcoo)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self._bcoo.dtype})")
 
 
 class SparseCsrTensor(SparseCooTensor):
-    def __init__(self, crows, cols, values, shape):
-        crows = np.asarray(crows)
-        cols = np.asarray(cols)
-        vals = np.asarray(values)
-        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
-        indices = np.stack([rows, cols])
-        super().__init__(indices, vals, shape)
-        self._crows = jnp.asarray(crows)
-        self._cols = jnp.asarray(cols)
+    """CSR view: stores crows/cols, computes through the COO/BCOO path."""
+
+    def __init__(self, bcoo, crows=None, cols=None):
+        super().__init__(bcoo)
+        self._crows = crows
+        self._cols = cols
+
+    @classmethod
+    def from_csr(cls, crows, cols, values, shape):
+        crows_np = np.asarray(crows)
+        cols_np = np.asarray(cols)
+        vals = values._value if isinstance(values, Tensor) \
+            else jnp.asarray(np.asarray(values))
+        rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+        idx = jnp.asarray(np.stack([rows, cols_np], 1).astype(np.int32))
+        bcoo = jsparse.BCOO((vals, idx),
+                            shape=tuple(int(s) for s in shape))
+        return cls(bcoo, jnp.asarray(crows_np), jnp.asarray(cols_np))
 
     def crows(self):
         return Tensor(self._crows)
@@ -66,46 +121,197 @@ class SparseCsrTensor(SparseCooTensor):
     def cols(self):
         return Tensor(self._cols)
 
-    def is_sparse_csr(self):
-        return True
-
     def is_sparse_coo(self):
         return False
+
+    def is_sparse_csr(self):
+        return True
 
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
                       stop_gradient=True):
+    """Reference: sparse/creation.py sparse_coo_tensor."""
+    idx = np.asarray(indices)
     if shape is None:
-        idx = np.asarray(indices)
         shape = tuple(int(idx[i].max()) + 1 for i in range(idx.shape[0]))
-    return SparseCooTensor(indices, values, shape)
+    return SparseCooTensor.from_parts(idx, values, shape)
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True):
-    return SparseCsrTensor(crows, cols, values, shape)
+    return SparseCsrTensor.from_csr(crows, cols, values, shape)
 
 
 def is_same_shape(x, y):
     return tuple(x.shape) == tuple(y.shape)
 
 
+def _bcoo_of(x):
+    return x._bcoo if isinstance(x, SparseCooTensor) else None
+
+
+# ---------------------------------------------------------------------------
+# matmul: real sparse compute (bcoo_dot_general — no densification)
+# ---------------------------------------------------------------------------
 def matmul(x, y, name=None):
+    """sparse @ dense (or dense @ sparse): contraction over the nonzeros
+    only.  Reference: sparse/binary.py matmul → phi csr/coo matmul."""
+    xs, ys = _bcoo_of(x), _bcoo_of(y)
+    if xs is not None and ys is None:
+        yv = y if isinstance(y, Tensor) else Tensor(y)
+        idx, shape = xs.indices, xs.shape
+        return run(
+            lambda d, dn: jsparse.bcoo_dot_general(
+                jsparse.BCOO((d, idx), shape=shape), dn,
+                dimension_numbers=(((len(shape) - 1,), (0,)), ((), ()))),
+            Tensor(xs.data), yv, name="sparse_matmul")
+    if xs is None and ys is not None:
+        # dense @ sparse == (sparseᵀ @ denseᵀ)ᵀ — still nnz-structured
+        xv = x if isinstance(x, Tensor) else Tensor(x)
+        idx, shape = ys.indices, ys.shape
+        return run(
+            lambda dn, d: jsparse.bcoo_dot_general(
+                jsparse.bcoo_transpose(
+                    jsparse.BCOO((d, idx), shape=shape),
+                    permutation=(1, 0)), dn.T,
+                dimension_numbers=(((1,), (0,)), ((), ()))).T,
+            xv, Tensor(ys.data), name="sparse_matmul")
+    if xs is not None and ys is not None:
+        # sparse @ sparse: left stays structural; result dense
+        idx1, sh1 = xs.indices, xs.shape
+        idx2, sh2 = ys.indices, ys.shape
+        return run(
+            lambda d1, d2: jsparse.bcoo_dot_general(
+                jsparse.BCOO((d1, idx1), shape=sh1),
+                jsparse.BCOO((d2, idx2), shape=sh2).todense(),
+                dimension_numbers=(((len(sh1) - 1,), (0,)), ((), ()))),
+            Tensor(xs.data), Tensor(ys.data), name="sparse_matmul")
     from .. import tensor as pten
-    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
-    yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
-    return pten.matmul(xd, yd)
+    return pten.matmul(x, y)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated ONLY at mask's nonzero positions
+    (reference: sparse/binary.py masked_matmul → SDDMM)."""
+    m = _bcoo_of(mask)
+    idx = m.indices
+    xv = x if isinstance(x, Tensor) else Tensor(x)
+    yv = y if isinstance(y, Tensor) else Tensor(y)
+
+    def _fn(a, b):
+        rows = idx[:, 0]
+        cols = idx[:, 1]
+        return jnp.sum(a[rows, :] * b[:, cols].T, axis=-1)
+    vals = run(_fn, xv, yv, name="masked_matmul")
+    return SparseCooTensor(jsparse.BCOO(
+        (vals._value, idx), shape=m.shape))
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise (sparse ∘ sparse): index-structure arithmetic
+# ---------------------------------------------------------------------------
+def _concat_add(a, b):
+    data = jnp.concatenate([a.data, b.data])
+    idx = jnp.concatenate([a.indices, b.indices])
+    return jsparse.BCOO((data, idx), shape=a.shape)
+
+
+def _binary_operands(x, y, name):
+    xs, ys = _bcoo_of(x), _bcoo_of(y)
+    if xs is None or ys is None:
+        raise ValueError(f"sparse.{name} expects two sparse tensors")
+    if xs.shape != ys.shape:
+        # BCOO would silently DROP the larger operand's out-of-range
+        # indices; the reference raises on shape mismatch
+        raise ValueError(
+            f"sparse.{name}: operand shapes differ "
+            f"({tuple(xs.shape)} vs {tuple(ys.shape)})")
+    return xs, ys
 
 
 def add(x, y, name=None):
-    from .. import tensor as pten
-    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
-    yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
-    return pten.add(xd, yd)
+    xs, ys = _binary_operands(x, y, "add")
+    return SparseCooTensor(
+        jsparse.bcoo_sum_duplicates(_concat_add(xs, ys)))
+
+
+def subtract(x, y, name=None):
+    xs, ys = _binary_operands(x, y, "subtract")
+    return SparseCooTensor(
+        jsparse.bcoo_sum_duplicates(_concat_add(xs, -ys)))
 
 
 def multiply(x, y, name=None):
-    from .. import tensor as pten
+    xs, ys = _binary_operands(x, y, "multiply")
+    return SparseCooTensor(jsparse.bcoo_sum_duplicates(
+        jsparse.bcoo_multiply_sparse(xs, ys)))
+
+
+def divide(x, y, name=None):
+    """The reference divides densified (division is not
+    sparsity-preserving at zero); result is dense."""
     xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
     yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
-    return pten.multiply(xd, yd)
+    from .. import tensor as pten
+    return pten.divide(xd, yd)
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise: transform the nnz value vector only
+# ---------------------------------------------------------------------------
+def _unary(x, fn, name):
+    if not isinstance(x, SparseCooTensor):
+        raise ValueError(f"sparse.{name} expects a sparse tensor")
+    return x._with_values(fn)
+
+
+def relu(x, name=None):
+    return _unary(x, lambda v: jnp.maximum(v, 0), "relu")
+
+
+def sin(x, name=None):
+    return _unary(x, jnp.sin, "sin")
+
+
+def tanh(x, name=None):
+    return _unary(x, jnp.tanh, "tanh")
+
+
+def sqrt(x, name=None):
+    return _unary(x, jnp.sqrt, "sqrt")
+
+
+def abs(x, name=None):
+    return _unary(x, jnp.abs, "abs")
+
+
+def neg(x, name=None):
+    return _unary(x, jnp.negative, "neg")
+
+
+def pow(x, factor, name=None):
+    return _unary(x, lambda v: jnp.power(v, factor), "pow")
+
+
+def square(x, name=None):
+    return _unary(x, jnp.square, "square")
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    out = x._bcoo
+    data = out.data if value_dtype is None else out.data.astype(
+        value_dtype)
+    idx = out.indices if index_dtype is None else out.indices.astype(
+        index_dtype)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(
+            jsparse.BCOO((data, idx), shape=out.shape),
+            x._crows, x._cols)
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=out.shape))
+
+
+def transpose(x, perm, name=None):
+    if not isinstance(x, SparseCooTensor):
+        raise ValueError("sparse.transpose expects a sparse tensor")
+    out = jsparse.bcoo_transpose(x._bcoo, permutation=tuple(perm))
+    return SparseCooTensor(out)

@@ -1,0 +1,197 @@
+"""paddle.text — NLP datasets + sequence decode ops.
+
+Reference: `python/paddle/text/` (datasets Imdb/Imikolov/Movielens/
+UCIHousing/WMT14/WMT16, `viterbi_decode`/`ViterbiDecoder`).  Zero-egress
+environment: datasets fall back to deterministic synthetic corpora with
+the reference's shapes/dtypes (same policy as paddle_tpu.vision
+datasets); the Viterbi decoder is a lax.scan over the transition lattice.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import run, to_tensor_args
+from ..framework.tensor import Tensor
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14",
+           "WMT16", "viterbi_decode", "ViterbiDecoder"]
+
+
+# ---------------------------------------------------------------------------
+# viterbi decode (reference: python/paddle/text/viterbi_decode.py →
+# phi viterbi_decode kernel)
+# ---------------------------------------------------------------------------
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """potentials: [B, L, T] emission scores; transition_params: [T, T];
+    lengths: [B].  Returns (scores [B], paths [B, L]).
+
+    TPU-native: the per-step max-product recursion is a lax.scan (one
+    compiled loop, static shapes), backtracking a reverse scan over the
+    recorded argmaxes.
+    """
+    (potentials,) = to_tensor_args(potentials)
+    trans = (transition_params._value
+             if isinstance(transition_params, Tensor)
+             else jnp.asarray(transition_params))
+    lens = (lengths._value if isinstance(lengths, Tensor)
+            else jnp.asarray(lengths)).astype(jnp.int32)
+
+    def _fn(pot):
+        b, seq_len, n_tags = pot.shape
+        if include_bos_eos_tag:
+            # reference: last two tags are BOS/EOS; BOS->tag at step 0,
+            # tag->EOS at the end
+            bos = n_tags - 2
+            eos = n_tags - 1
+            init = pot[:, 0] + trans[bos][None, :]
+        else:
+            init = pot[:, 0]
+
+        def step(carry, t):
+            alpha, _ = carry
+            scores = alpha[:, :, None] + trans[None]  # [B, from, to]
+            best_from = jnp.argmax(scores, axis=1)    # [B, T]
+            best = jnp.max(scores, axis=1) + pot[:, t]
+            live = (t < lens)[:, None]
+            alpha_new = jnp.where(live, best, alpha)
+            return (alpha_new, None), jnp.where(
+                live, best_from, jnp.arange(n_tags)[None, :])
+
+        (alpha, _), back = jax.lax.scan(
+            step, (init, None), jnp.arange(1, seq_len))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, eos][None, :]
+        scores = jnp.max(alpha, -1)
+        last_tag = jnp.argmax(alpha, -1)              # [B]
+
+        # backtrack (reverse scan over the recorded argmax pointers)
+        def backstep(tag, bk_t):
+            bk, t = bk_t
+            prev = jnp.take_along_axis(bk, tag[:, None], 1)[:, 0]
+            use = (t < lens)  # steps beyond len keep the tag
+            return jnp.where(use, prev, tag), tag
+
+        ts = jnp.arange(1, seq_len)[::-1]
+        tag0, path_rev = jax.lax.scan(
+            backstep, last_tag, (back[::-1], ts))
+        path = jnp.concatenate(
+            [tag0[:, None], path_rev[::-1].T], axis=1)   # [B, L]
+        return scores, path.astype(jnp.int64)
+
+    return run(_fn, potentials, name="viterbi_decode", n_outs=2)
+
+
+class ViterbiDecoder:
+    """Reference: text/viterbi_decode.py ViterbiDecoder layer."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+# ---------------------------------------------------------------------------
+# datasets (synthetic fallbacks; reference shapes/dtypes)
+# ---------------------------------------------------------------------------
+class Imdb(Dataset):
+    """Reference: text/datasets/imdb.py — (word-id sequence, 0/1 label)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 n_synthetic=512, seq_len=64, vocab=5000):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.docs = rng.randint(1, vocab,
+                                (n_synthetic, seq_len)).astype(np.int64)
+        self.labels = rng.randint(0, 2, (n_synthetic,)).astype(np.int64)
+        self.word_idx = {f"w{i}": i for i in range(vocab)}
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+
+class Imikolov(Dataset):
+    """Reference: text/datasets/imikolov.py — n-gram LM tuples."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, n_synthetic=1024,
+                 vocab=2000):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.data = rng.randint(0, vocab,
+                                (n_synthetic, window_size)).astype(np.int64)
+        self.word_idx = {f"w{i}": i for i in range(vocab)}
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        row = self.data[i]
+        return tuple(row[j] for j in range(row.shape[0]))
+
+
+class Movielens(Dataset):
+    """Reference: text/datasets/movielens.py — (user, movie, rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, n_synthetic=1024):
+        rng = np.random.RandomState(rand_seed)
+        self.users = rng.randint(1, 943, (n_synthetic,)).astype(np.int64)
+        self.movies = rng.randint(1, 1682, (n_synthetic,)).astype(np.int64)
+        self.ratings = rng.randint(1, 6, (n_synthetic,)).astype(np.float32)
+
+    def __len__(self):
+        return len(self.users)
+
+    def __getitem__(self, i):
+        return self.users[i], self.movies[i], self.ratings[i]
+
+
+class UCIHousing(Dataset):
+    """Reference: text/datasets/uci_housing.py — 13 features, 1 target."""
+
+    def __init__(self, data_file=None, mode="train", n_synthetic=404):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.x = rng.randn(n_synthetic, 13).astype(np.float32)
+        w = rng.randn(13, 1).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rng.randn(n_synthetic, 1)
+                  ).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class WMT14(Dataset):
+    """Reference: text/datasets/wmt14.py — (src ids, tgt ids, tgt next)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 n_synthetic=256, seq_len=16):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.src = rng.randint(0, dict_size,
+                               (n_synthetic, seq_len)).astype(np.int64)
+        self.tgt = rng.randint(0, dict_size,
+                               (n_synthetic, seq_len)).astype(np.int64)
+
+    def __len__(self):
+        return len(self.src)
+
+    def __getitem__(self, i):
+        return self.src[i], self.tgt[i], np.roll(self.tgt[i], -1)
+
+
+class WMT16(WMT14):
+    """Reference: text/datasets/wmt16.py."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=10000,
+                 trg_dict_size=10000, lang="en", **kw):
+        super().__init__(mode=mode, dict_size=src_dict_size, **kw)

@@ -1,0 +1,67 @@
+"""MobileNetV1.  Reference: python/paddle/vision/models/mobilenetv1.py
+(depthwise-separable conv stacks)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class ConvBNLayer(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel, stride, padding, groups=1):
+        super().__init__(
+            nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                      groups=groups, bias_attr=False),
+            nn.BatchNorm2D(out_c),
+            nn.ReLU(),
+        )
+
+
+class DepthwiseSeparable(nn.Sequential):
+    def __init__(self, in_c, out_c1, out_c2, stride, scale):
+        c1 = int(out_c1 * scale)
+        c2 = int(out_c2 * scale)
+        super().__init__(
+            ConvBNLayer(in_c, c1, 3, stride, 1, groups=in_c),
+            ConvBNLayer(c1, c2, 1, 1, 0),
+        )
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = lambda c: int(c * scale)
+        self.conv1 = ConvBNLayer(3, s(32), 3, 2, 1)
+        cfg = [  # in, out1, out2, stride
+            (s(32), 32, 64, 1), (s(64), 64, 128, 2),
+            (s(128), 128, 128, 1), (s(128), 128, 256, 2),
+            (s(256), 256, 256, 1), (s(256), 256, 512, 2),
+            (s(512), 512, 512, 1), (s(512), 512, 512, 1),
+            (s(512), 512, 512, 1), (s(512), 512, 512, 1),
+            (s(512), 512, 512, 1), (s(512), 512, 1024, 2),
+            (s(1024), 1024, 1024, 1),
+        ]
+        self.blocks = nn.Sequential(*[
+            DepthwiseSeparable(i, o1, o2, st, scale)
+            for i, o1, o2, st in cfg])
+        if with_pool:
+            self.pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.conv1(x)
+        x = self.blocks(x)
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            from ... import tensor as pten
+            x = pten.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

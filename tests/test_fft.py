@@ -1,0 +1,80 @@
+"""paddle.fft vs numpy.fft (the reference's kernels follow the same
+norm conventions)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft as pfft
+
+
+rng = np.random.RandomState(0)
+
+
+def a(t):
+    return np.asarray(t.value)
+
+
+class TestFFT:
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_fft_ifft_roundtrip(self, norm):
+        x = rng.randn(4, 16).astype(np.float32)
+        t = paddle.to_tensor(x)
+        y = pfft.fft(t, norm=norm)
+        back = pfft.ifft(y, norm=norm)
+        np.testing.assert_allclose(a(back).real, x, atol=1e-5)
+        np.testing.assert_allclose(a(y), np.fft.fft(x, norm=norm),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_rfft_irfft(self):
+        x = rng.randn(8, 32).astype(np.float32)
+        t = paddle.to_tensor(x)
+        y = pfft.rfft(t)
+        assert a(y).shape == (8, 17)
+        np.testing.assert_allclose(a(y), np.fft.rfft(x), rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(a(pfft.irfft(y)), x, atol=1e-5)
+
+    def test_hfft_ihfft(self):
+        x = rng.randn(16).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(a(pfft.hfft(t)), np.fft.hfft(x),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(a(pfft.ihfft(t)), np.fft.ihfft(x),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_2d_and_nd(self):
+        x = rng.randn(3, 8, 8).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(a(pfft.fft2(t)), np.fft.fft2(x),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(a(pfft.rfft2(t)), np.fft.rfft2(x),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(a(pfft.fftn(t)), np.fft.fftn(x),
+                                   rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(a(pfft.irfft2(pfft.rfft2(t))), x,
+                                   atol=1e-5)
+
+    def test_helpers(self):
+        np.testing.assert_allclose(a(pfft.fftfreq(8, 0.5)),
+                                   np.fft.fftfreq(8, 0.5))
+        np.testing.assert_allclose(a(pfft.rfftfreq(8, 0.5)),
+                                   np.fft.rfftfreq(8, 0.5))
+        x = rng.randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(a(pfft.fftshift(paddle.to_tensor(x))),
+                                   np.fft.fftshift(x))
+        np.testing.assert_allclose(
+            a(pfft.ifftshift(paddle.to_tensor(x))), np.fft.ifftshift(x))
+
+    def test_bad_norm_rejected(self):
+        with pytest.raises(ValueError):
+            pfft.fft(paddle.to_tensor(np.ones(4, np.float32)),
+                     norm="bogus")
+
+    def test_grad_through_rfft(self):
+        x = paddle.to_tensor(rng.randn(16).astype(np.float32))
+        x.stop_gradient = False
+        y = pfft.rfft(x)
+        loss = (y.abs() ** 2).sum()
+        loss.backward()
+        assert x.grad is not None
+        assert np.isfinite(a(x.grad)).all()

@@ -1,0 +1,129 @@
+"""QAT / PTQ: fake-quant numerics, STE gradients, config priority,
+observer calibration + convert.
+
+Reference test model: test/quantization/test_qat_*.py, test_ptq.py.
+"""
+import copy
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import QAT, PTQ, QuantConfig
+from paddle_tpu.quantization.quanters import (
+    FakeQuanterWithAbsMaxObserver, FakeQuanterWithAbsMaxObserverLayer,
+    _fake_quant)
+from paddle_tpu.quantization.observers import AbsmaxObserver
+
+
+def a(t):
+    return np.asarray(t.value if hasattr(t, "value") else t)
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+        self.conv = nn.Conv2D(3, 4, 3, padding=1)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+class TestFakeQuant:
+    def test_grid(self):
+        import jax.numpy as jnp
+        x = jnp.asarray(np.linspace(-1, 1, 11, dtype=np.float32))
+        q = _fake_quant(x, jnp.float32(1.0), 8)
+        # values land on the symmetric int8 grid scale/127
+        grid = np.round(np.asarray(q) * 127)
+        np.testing.assert_allclose(np.asarray(q), grid / 127, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(q), np.asarray(x),
+                                   atol=1.0 / 127)
+
+    def test_ste_gradient(self):
+        import jax, jax.numpy as jnp
+        g = jax.grad(lambda x: jnp.sum(
+            _fake_quant(x, jnp.float32(1.0), 8) ** 2))(
+            jnp.asarray([0.5, -0.25], jnp.float32))
+        # straight-through: d/dx sum(q^2) ~ 2q
+        assert np.isfinite(np.asarray(g)).all()
+        np.testing.assert_allclose(np.asarray(g),
+                                   2 * np.asarray([0.5, -0.25]),
+                                   atol=0.05)
+
+
+class TestQAT:
+    def test_quantize_swaps_layers(self):
+        paddle.seed(0)
+        net = Net()
+        q = FakeQuanterWithAbsMaxObserver(moving_rate=0.9)
+        qat = QAT(QuantConfig(activation=q, weight=q))
+        qnet = qat.quantize(net)
+        from paddle_tpu.quantization import QuantedLinear, QuantedConv2D
+        assert isinstance(qnet.fc1, QuantedLinear)
+        assert isinstance(qnet.conv, QuantedConv2D)
+        # original untouched (not inplace)
+        assert isinstance(net.fc1, nn.Linear)
+
+    def test_forward_close_and_trainable(self):
+        paddle.seed(0)
+        net = Net()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        ref = a(net(x))
+        q = FakeQuanterWithAbsMaxObserver()
+        qnet = QAT(QuantConfig(activation=q, weight=q)).quantize(net)
+        out = a(qnet(x))
+        # int8 fake quant stays close to float
+        assert np.abs(out - ref).max() < 0.15 * np.abs(ref).max() + 0.05
+        # gradients flow through STE to weights
+        loss = (qnet(x) ** 2).mean()
+        loss.backward()
+        assert qnet.fc1.weight.grad is not None
+        assert np.isfinite(a(qnet.fc1.weight.grad)).all()
+
+    def test_config_priority_name_over_type(self):
+        paddle.seed(0)
+        net = Net()
+        q = FakeQuanterWithAbsMaxObserver()
+        cfg = QuantConfig(activation=None, weight=None)
+        cfg.add_type_config(nn.Linear, activation=q, weight=q)
+        cfg.add_name_config("fc2", activation=None, weight=None)
+        qnet = QAT(cfg).quantize(net)
+        from paddle_tpu.quantization import QuantedLinear
+        assert isinstance(qnet.fc1, QuantedLinear)
+        # fc2's name config has no quanters -> swapped wrapper without
+        # quanters is fine, but weight_quanter must be None
+        assert qnet.fc2.weight_quanter is None \
+            if hasattr(qnet.fc2, "weight_quanter") \
+            else isinstance(qnet.fc2, nn.Linear)
+
+    def test_quanter_scale_tracks_ema(self):
+        q = FakeQuanterWithAbsMaxObserverLayer(moving_rate=0.5)
+        x1 = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+        q(x1)
+        assert abs(float(a(q.scales())) - 2.0) < 1e-6
+        x2 = paddle.to_tensor(np.array([4.0], np.float32))
+        q(x2)
+        assert abs(float(a(q.scales())) - 3.0) < 1e-6  # 0.5*2 + 0.5*4
+
+
+class TestPTQ:
+    def test_calibrate_convert(self):
+        paddle.seed(0)
+        net = Net()
+        obs = AbsmaxObserver(quant_bits=8)
+        ptq = PTQ(QuantConfig(activation=obs, weight=obs))
+        qnet = ptq.quantize(net)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(16, 8).astype(np.float32))
+        ref = a(net(x))
+        cal = a(qnet(x))  # observers are identity during calibration
+        np.testing.assert_allclose(cal, ref, atol=1e-6)
+        ptq.convert(qnet)
+        out = a(qnet(x))
+        assert not np.allclose(out, ref, atol=1e-7)  # now quantized
+        assert np.abs(out - ref).max() < 0.15 * np.abs(ref).max() + 0.05

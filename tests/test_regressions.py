@@ -145,3 +145,22 @@ def test_grad_scaler_two_optimizers_both_unscaled():
     scaler.update()
     np.testing.assert_allclose(np.asarray(p1.value), -g, rtol=1e-6)
     np.testing.assert_allclose(np.asarray(p2.value), -g, rtol=1e-6)
+
+
+def test_deepcopied_layer_gets_its_own_grads():
+    """deepcopy used to keep VarRefs whose weakrefs resolved to the
+    SOURCE tensors, so a copied model's backward wrote grads to the
+    original parameters and the copy never trained."""
+    import copy
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    net2 = copy.deepcopy(net)
+    x = paddle.to_tensor(np.ones((3, 4), np.float32))
+    loss = (net2(x) ** 2).mean()
+    loss.backward()
+    assert net2.weight.grad is not None
+    assert net.weight.grad is None  # original untouched

@@ -1,0 +1,143 @@
+"""Sparse COO/CSR: real sparse compute vs dense reference, no
+densification in matmul, gradient flow through values.
+
+Reference test model: test/legacy_test/test_sparse_*_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse as sp
+
+
+def a(t):
+    return np.asarray(t.value if hasattr(t, "value") else t)
+
+
+def mk_coo():
+    # 3x4 with 4 nonzeros
+    indices = np.array([[0, 0, 1, 2], [0, 3, 1, 2]])
+    values = np.array([1.0, 2.0, 3.0, -4.0], np.float32)
+    dense = np.zeros((3, 4), np.float32)
+    dense[indices[0], indices[1]] = values
+    return sp.sparse_coo_tensor(indices, values, (3, 4)), dense
+
+
+class TestCreation:
+    def test_coo_roundtrip(self):
+        t, dense = mk_coo()
+        assert t.is_sparse_coo() and not t.is_sparse_csr()
+        assert t.nnz == 4
+        np.testing.assert_allclose(a(t.to_dense()), dense)
+        assert a(t.indices()).shape == (2, 4)
+        np.testing.assert_allclose(a(t.values()),
+                                   [1.0, 2.0, 3.0, -4.0])
+
+    def test_csr_roundtrip(self):
+        crows = [0, 2, 3, 4]
+        cols = [0, 3, 1, 2]
+        vals = np.array([1.0, 2.0, 3.0, -4.0], np.float32)
+        t = sp.sparse_csr_tensor(crows, cols, vals, (3, 4))
+        assert t.is_sparse_csr()
+        dense = np.zeros((3, 4), np.float32)
+        dense[[0, 0, 1, 2], cols] = vals
+        np.testing.assert_allclose(a(t.to_dense()), dense)
+        np.testing.assert_allclose(a(t.crows()), crows)
+
+
+class TestCompute:
+    def test_spmm_matches_dense(self):
+        t, dense = mk_coo()
+        y = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        out = sp.matmul(t, paddle.to_tensor(y))
+        np.testing.assert_allclose(a(out), dense @ y, atol=1e-5)
+
+    def test_dense_at_sparse(self):
+        t, dense = mk_coo()
+        x = np.random.RandomState(1).randn(5, 3).astype(np.float32)
+        out = sp.matmul(paddle.to_tensor(x), t)
+        np.testing.assert_allclose(a(out), x @ dense, atol=1e-5)
+
+    def test_spmm_no_densify(self, monkeypatch):
+        """the sparse matmul path must NOT call todense on the lhs."""
+        from jax.experimental.sparse import BCOO
+        called = {"n": 0}
+        orig = BCOO.todense
+
+        def spy(self):
+            called["n"] += 1
+            return orig(self)
+        monkeypatch.setattr(BCOO, "todense", spy)
+        t, dense = mk_coo()
+        y = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        _ = sp.matmul(t, paddle.to_tensor(y))
+        assert called["n"] == 0
+
+    def test_add_subtract_multiply(self):
+        t1, d1 = mk_coo()
+        indices = np.array([[0, 1, 2], [0, 1, 3]])
+        values = np.array([5.0, -1.0, 2.0], np.float32)
+        t2 = sp.sparse_coo_tensor(indices, values, (3, 4))
+        d2 = np.zeros((3, 4), np.float32)
+        d2[indices[0], indices[1]] = values
+        np.testing.assert_allclose(a(sp.add(t1, t2).to_dense()), d1 + d2,
+                                   atol=1e-6)
+        np.testing.assert_allclose(a(sp.subtract(t1, t2).to_dense()),
+                                   d1 - d2, atol=1e-6)
+        np.testing.assert_allclose(a(sp.multiply(t1, t2).to_dense()),
+                                   d1 * d2, atol=1e-6)
+
+    def test_unary_keep_pattern(self):
+        t, dense = mk_coo()
+        r = sp.relu(t)
+        assert r.nnz == t.nnz
+        np.testing.assert_allclose(a(r.to_dense()), np.maximum(dense, 0))
+        np.testing.assert_allclose(a(sp.sin(t).to_dense()),
+                                   np.where(dense != 0, np.sin(dense), 0),
+                                   atol=1e-6)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 6).astype(np.float32)
+        y = rng.randn(6, 4).astype(np.float32)
+        t, mask = mk_coo()
+        out = sp.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), t)
+        full = x @ y
+        expect = np.where(mask != 0, full, 0)
+        np.testing.assert_allclose(a(out.to_dense()), expect, atol=1e-5)
+
+    def test_transpose(self):
+        t, dense = mk_coo()
+        tt = sp.transpose(t, [1, 0])
+        np.testing.assert_allclose(a(tt.to_dense()), dense.T)
+
+
+class TestGrad:
+    def test_grad_flows_to_dense_operand(self):
+        t, dense = mk_coo()
+        y = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 5).astype(np.float32))
+        y.stop_gradient = False
+        out = sp.matmul(t, y)
+        loss = (out ** 2).sum()
+        loss.backward()
+        assert y.grad is not None
+        ref = 2 * dense.T @ (dense @ a(y))
+        np.testing.assert_allclose(a(y.grad), ref, atol=1e-4)
+
+
+class TestShapesAndCsr:
+    def test_mismatched_add_raises(self):
+        t1, _ = mk_coo()
+        t2 = sp.sparse_coo_tensor(np.array([[0], [0]]),
+                                  np.array([7.0], np.float32), (5, 5))
+        with pytest.raises(ValueError):
+            sp.add(t1, t2)
+
+    def test_unary_preserves_csr(self):
+        t = sp.sparse_csr_tensor([0, 1, 2], [0, 1],
+                                 np.array([1.0, -2.0], np.float32),
+                                 (2, 2))
+        r = sp.relu(t)
+        assert r.is_sparse_csr()
+        np.testing.assert_allclose(a(r.crows()), [0, 1, 2])

@@ -193,3 +193,59 @@ class TestShardDataloader:
         x = batch[0]
         spec = x.value.sharding.spec
         assert spec and spec[0] == "dp"
+
+
+class TestShardDataloaderPartialBatch:
+    def test_partial_final_batch_replicated(self):
+        mesh = dist.ProcessMesh(np.arange(8).reshape(8),
+                                dim_names=["dp"])
+        # 20 samples, batch 16 -> final batch of 4 (not divisible by 8)
+        class D20(RegData):
+            def __init__(self):
+                super().__init__(n=20)
+        loader = DataLoader(D20(), batch_size=16, drop_last=False)
+        sl = dist.shard_dataloader(loader, mesh)
+        batches = list(sl)
+        assert len(batches) == 2
+        spec = batches[0][0].value.sharding.spec
+        assert spec and spec[0] == "dp"
+        spec_last = batches[1][0].value.sharding.spec
+        assert not spec_last or spec_last[0] is None
+
+
+class TestShardOptimizerCallable:
+    def test_custom_shard_fn(self):
+        mesh = dist.ProcessMesh(np.arange(8).reshape(8),
+                                dim_names=["dp"])
+        dist.auto_parallel.set_mesh(mesh)
+        try:
+            paddle.seed(0)
+            net = MLP()
+            opt = paddle.optimizer.AdamW(
+                1e-3, parameters=net.parameters())
+            seen = []
+
+            def fn(key, param, value):
+                seen.append(key)
+                return [dist.Replicate()]  # user forces replication
+
+            opt = dist.shard_optimizer(opt, fn)
+            st = opt._init_state(net.fc1.weight)
+            assert seen  # callable consulted
+            spec = st["moment1"].sharding.spec
+            assert not any(s == "dp" for s in spec if s)
+        finally:
+            dist.auto_parallel.set_mesh(None)
+
+    def test_bad_shard_fn_rejected(self):
+        mesh = dist.ProcessMesh(np.arange(8).reshape(8),
+                                dim_names=["dp"])
+        dist.auto_parallel.set_mesh(mesh)
+        try:
+            net = MLP()
+            opt = paddle.optimizer.AdamW(
+                1e-3, parameters=net.parameters())
+            with pytest.raises(TypeError):
+                dist.shard_optimizer(opt, "stage1")
+        finally:
+            dist.auto_parallel.set_mesh(None)

@@ -1,0 +1,47 @@
+"""Vision model zoo forward-shape checks.
+
+Reference test model: test/legacy_test/test_vision_models.py (construct
+each zoo model, forward a batch, check the logits shape).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _x(hw):
+    return paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 3, hw, hw).astype(np.float32))
+
+
+CASES = [
+    ("mobilenet_v2", lambda: models.mobilenet_v2(scale=0.25,
+                                                 num_classes=10), 64),
+    ("mobilenet_v1", lambda: models.mobilenet_v1(scale=0.25,
+                                                 num_classes=10), 64),
+    ("squeezenet1_1", lambda: models.squeezenet1_1(num_classes=10), 64),
+    ("squeezenet1_0", lambda: models.squeezenet1_0(num_classes=10), 96),
+    ("alexnet", lambda: models.alexnet(num_classes=10), 224),
+    ("vgg11", lambda: models.vgg11(num_classes=10), 224),
+    ("vgg11_bn", lambda: models.vgg11(batch_norm=True,
+                                      num_classes=10), 224),
+]
+
+
+@pytest.mark.parametrize("name,mk,hw", CASES, ids=[c[0] for c in CASES])
+def test_forward_shape(name, mk, hw):
+    paddle.seed(0)
+    m = mk()
+    out = m(_x(hw))
+    assert out.shape == [1, 10]
+
+
+def test_backward_through_mobilenet():
+    paddle.seed(0)
+    m = models.mobilenet_v2(scale=0.25, num_classes=4)
+    out = m(_x(64))
+    loss = (out ** 2).mean()
+    loss.backward()
+    grads = [p.grad for p in m.parameters() if not p.stop_gradient]
+    assert any(g is not None for g in grads)
