@@ -1,0 +1,72 @@
+"""Native (C++) runtime components, built lazily with the local
+toolchain.
+
+Reference: the reference ships compiled C++ for its runtime substrate
+(common/flags_native.cc, allocators, executors).  Here the compute path
+is XLA, but process-global runtime state keeps a native backing too:
+`paddle_tpu/csrc/*.cc` is compiled on first use with g++ (cached in the
+user cache dir) and loaded via ctypes — no pybind needed.  Import
+failures (missing toolchain, sandboxed FS) degrade silently: callers
+fall back to the pure-python implementations.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+
+
+def _build(name: str, sources):
+    cache = os.environ.get("PADDLE_EXTENSION_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    os.makedirs(cache, exist_ok=True)
+    tag = hashlib.sha1()
+    srcs = [os.path.join(_CSRC, s) for s in sources]
+    for s in srcs:
+        with open(s, "rb") as f:
+            tag.update(f.read())
+    out = os.path.join(cache, f"{name}-{tag.hexdigest()[:12]}.so")
+    if not os.path.exists(out):
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", *srcs,
+             "-o", out],
+            check=True, capture_output=True)
+    return out
+
+
+class _FlagsLib:
+    """ctypes facade over csrc/flags_native.cc."""
+
+    def __init__(self, cdll):
+        self._lib = cdll
+        cdll.pd_flags_define.argtypes = [ctypes.c_char_p] * 3
+        cdll.pd_flags_set.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        cdll.pd_flags_set.restype = ctypes.c_int
+        cdll.pd_flags_get.argtypes = [ctypes.c_char_p]
+        cdll.pd_flags_get.restype = ctypes.c_char_p
+        cdll.pd_flags_count.restype = ctypes.c_int
+
+    def define(self, name, default, help_str=""):
+        self._lib.pd_flags_define(name.encode(), str(default).encode(),
+                                  help_str.encode())
+
+    def set(self, name, value):
+        return bool(self._lib.pd_flags_set(name.encode(),
+                                           str(value).encode()))
+
+    def get(self, name):
+        out = self._lib.pd_flags_get(name.encode())
+        return out.decode() if out is not None else None
+
+    def count(self):
+        return int(self._lib.pd_flags_count())
+
+
+lib = None
+try:
+    lib = _FlagsLib(ctypes.CDLL(_build("pd_flags", ["flags_native.cc"])))
+except Exception:  # toolchain/cache unavailable: pure-python fallback
+    lib = None

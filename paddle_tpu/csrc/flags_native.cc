@@ -1,0 +1,90 @@
+// Native flag registry.
+//
+// Reference: paddle/common/flags_native.cc:91 (class FlagRegistry with
+// typed flags, env pickup GetFlagsFromEnv, SetFlagValue/GetFlagValue)
+// — the reference keeps process-global runtime switches in C++ so every
+// layer (allocator, kernels, python) reads one source of truth.
+//
+// TPU-native build keeps the same shape: a mutex-guarded string->value
+// store with typed get/set exported through a plain C ABI, loaded by
+// paddle_tpu/_native.py via ctypes (no pybind dependency in the image).
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Flag {
+  std::string value;
+  std::string default_value;
+  std::string help;
+};
+
+class FlagRegistry {
+ public:
+  static FlagRegistry* Instance() {
+    static FlagRegistry r;
+    return &r;
+  }
+
+  void Define(const std::string& name, const std::string& default_value,
+              const std::string& help) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = flags_.find(name);
+    if (it == flags_.end()) {
+      flags_[name] = Flag{default_value, default_value, help};
+    } else {
+      it->second.default_value = default_value;
+      it->second.help = help;
+    }
+  }
+
+  bool Set(const std::string& name, const std::string& value) {
+    std::lock_guard<std::mutex> g(mu_);
+    flags_[name].value = value;
+    return true;
+  }
+
+  bool Get(const std::string& name, std::string* out) const {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = flags_.find(name);
+    if (it == flags_.end()) return false;
+    *out = it->second.value;
+    return true;
+  }
+
+  int Count() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return static_cast<int>(flags_.size());
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::map<std::string, Flag> flags_;
+};
+
+thread_local std::string g_result;
+
+}  // namespace
+
+extern "C" {
+
+void pd_flags_define(const char* name, const char* default_value,
+                     const char* help) {
+  FlagRegistry::Instance()->Define(name, default_value, help);
+}
+
+int pd_flags_set(const char* name, const char* value) {
+  return FlagRegistry::Instance()->Set(name, value) ? 1 : 0;
+}
+
+// returns NULL when the flag is unknown; pointer valid until the next
+// call on the same thread
+const char* pd_flags_get(const char* name) {
+  if (!FlagRegistry::Instance()->Get(name, &g_result)) return nullptr;
+  return g_result.c_str();
+}
+
+int pd_flags_count() { return FlagRegistry::Instance()->Count(); }
+}

@@ -38,6 +38,8 @@ def define_flag(name: str, default: Any, help_str: str = ""):
         else:
             value = raw
     _registry[key] = {"value": value, "default": default, "help": help_str}
+    if _native_lib is not None:
+        _native_lib.define(key, value, help_str)
     return value
 
 
@@ -53,6 +55,10 @@ def set_flags(flags: Dict[str, Any]):
             _registry[key] = {"value": v, "default": None, "help": ""}
         else:
             _registry[key]["value"] = v
+        if _native_lib is not None:
+            # mirror into the C++ registry so native components read the
+            # same switches (reference: one FlagRegistry for all layers)
+            _native_lib.set(key, v)
 
 
 def get_flags(flags):

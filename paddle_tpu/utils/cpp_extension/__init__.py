@@ -1,0 +1,172 @@
+"""C++ custom-op toolchain (reference: python/paddle/utils/cpp_extension/
+— setup/CppExtension/load building PD_BUILD_OP libraries).
+
+TPU-native custom-op ABI: ops are XLA FFI handlers.  `load` compiles the
+sources with g++ against jaxlib's bundled XLA FFI headers, dlopens the
+result, walks the PD_REGISTER_OP registry, registers every handler with
+`jax.ffi.register_ffi_target`, and returns a module whose attributes are
+taped python wrappers — so custom C++ ops compose with eager autograd
+(via `register_vjp`) and with jit (XLA calls the handler as a custom
+call).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import types
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import run, to_tensor_args
+from ...framework.tensor import Tensor
+
+__all__ = ["load", "get_build_directory", "CppExtension", "CUDAExtension",
+           "setup", "include_paths"]
+
+_EXT_INCLUDE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "include")
+
+
+def include_paths():
+    from jax import ffi
+    return [ffi.include_dir(), _EXT_INCLUDE]
+
+
+def get_build_directory(verbose=False):
+    d = os.environ.get("PADDLE_EXTENSION_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _compile(name, sources, extra_cxx_flags, build_directory, verbose):
+    build_dir = build_directory or get_build_directory()
+    srcs = [os.path.abspath(s) for s in sources]
+    tag = hashlib.sha1()
+    for s in srcs:
+        with open(s, "rb") as f:
+            tag.update(f.read())
+    tag.update(" ".join(extra_cxx_flags or []).encode())
+    out = os.path.join(build_dir, f"{name}-{tag.hexdigest()[:12]}.so")
+    if not os.path.exists(out):
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+        for inc in include_paths():
+            cmd += ["-I", inc]
+        cmd += list(extra_cxx_flags or []) + srcs + ["-o", out]
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return out
+
+
+class _OpModule(types.ModuleType):
+    pass
+
+
+def _make_wrapper(target_name):
+    def op(*tensors, out_shapes=None, out_dtypes=None, **attrs):
+        """Call the custom op.  Default output: one array like the first
+        input; override with out_shapes/out_dtypes (lists for multi)."""
+        ts = to_tensor_args(*tensors)
+        first = ts[0]
+        if out_shapes is None:
+            out_types = jax.ShapeDtypeStruct(
+                tuple(first.value.shape),
+                first.value.dtype if out_dtypes is None
+                else jnp.dtype(out_dtypes))
+        else:
+            shapes = out_shapes if isinstance(out_shapes[0],
+                                              (list, tuple)) \
+                else [out_shapes]
+            dts = (out_dtypes if isinstance(out_dtypes, (list, tuple))
+                   else [out_dtypes or first.value.dtype] * len(shapes))
+            out_types = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                         for s, d in zip(shapes, dts)]
+
+        def raw(*vals):
+            return jax.ffi.ffi_call(target_name, out_types, **attrs)(*vals)
+        return run(raw, *ts, name=target_name)
+    op.__name__ = target_name
+    return op
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
+         extra_cuda_cflags=None, build_directory: Optional[str] = None,
+         verbose: bool = False, **kwargs):
+    """Compile + register a custom-op library; returns a module with one
+    python function per PD_REGISTER_OP entry (reference:
+    cpp_extension.load building PD_BUILD_OP .so files)."""
+    path = _compile(name, sources, extra_cxx_flags, build_directory,
+                    verbose)
+    lib = ctypes.CDLL(path)
+    lib.pd_num_ops.restype = ctypes.c_int
+    lib.pd_op_name.restype = ctypes.c_char_p
+    lib.pd_op_name.argtypes = [ctypes.c_int]
+    lib.pd_op_handler.restype = ctypes.c_void_p
+    lib.pd_op_handler.argtypes = [ctypes.c_int]
+
+    mod = _OpModule(name)
+    mod.__library__ = path
+    mod.__ops__ = []
+    for i in range(lib.pd_num_ops()):
+        op_name = lib.pd_op_name(i).decode()
+        handler = lib.pd_op_handler(i)
+        target = f"{name}.{op_name}"
+        fn_ptr = ctypes.cast(handler, ctypes.CFUNCTYPE(None))
+        jax.ffi.register_ffi_target(
+            target, jax.ffi.pycapsule(fn_ptr), platform="cpu")
+        wrapper = _make_wrapper(target)
+        wrapper.__name__ = op_name
+        setattr(mod, op_name, wrapper)
+        mod.__ops__.append(op_name)
+
+    def register_vjp(op_name, vjp_builder):
+        """Attach a custom gradient: vjp_builder(fwd_fn) must return a
+        jax.custom_vjp-decorated callable; the wrapper re-dispatches
+        through it so eager autograd and jit use the custom rule."""
+        base = getattr(mod, op_name)
+        custom = vjp_builder(lambda *vals: jax.ffi.ffi_call(
+            f"{name}.{op_name}",
+            jax.ShapeDtypeStruct(vals[0].shape, vals[0].dtype))(*vals))
+
+        def op(*tensors, **attrs):
+            ts = to_tensor_args(*tensors)
+            return run(custom, *ts, name=f"{name}.{op_name}")
+        op.__name__ = op_name
+        setattr(mod, op_name, op)
+    mod.register_vjp = register_vjp
+    return mod
+
+
+class CppExtension:
+    """setuptools-style extension description (reference:
+    cpp_extension.CppExtension)."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = list(sources)
+        self.kwargs = kwargs
+        self.name = kwargs.get("name")
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    """On TPU there is no CUDA toolchain; CUDA extension requests build
+    the C++ sources only (reference behavior when compiled WITH_GPU=OFF)."""
+    return CppExtension([s for s in sources
+                         if not str(s).endswith((".cu", ".cuh"))],
+                        *args, **kwargs)
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Build every extension eagerly into the cache dir and return the
+    loaded modules (the reference delegates to setuptools; here the
+    runtime loader IS the installer)."""
+    mods = []
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else ([ext_modules] if ext_modules else [])
+    for ext in exts:
+        mods.append(load(ext.name or name, ext.sources))
+    return mods

@@ -1,0 +1,47 @@
+// paddle_tpu custom-op ABI (reference: paddle/phi/capi + PD_BUILD_OP in
+// paddle/fluid/framework/custom_operator.cc).
+//
+// TPU-native: a custom op is an XLA FFI handler.  Write the kernel with
+// the xla::ffi binding API, then PD_REGISTER_OP(name, Handler); the
+// python loader (paddle_tpu.utils.cpp_extension.load) walks the
+// registry exported below, registers every handler with
+// jax.ffi.register_ffi_target, and synthesizes python wrappers that run
+// through the framework's taped dispatch.
+#pragma once
+
+#include <vector>
+
+#include "xla/ffi/api/ffi.h"
+
+struct PdOpEntry {
+  const char* name;
+  void* handler;
+};
+
+inline std::vector<PdOpEntry>& pd_registry() {
+  static std::vector<PdOpEntry> r;
+  return r;
+}
+
+struct PdOpRegistrar {
+  PdOpRegistrar(const char* n, void* h) { pd_registry().push_back({n, h}); }
+};
+
+#define PD_REGISTER_OP(op_name, handler)                                   \
+  static PdOpRegistrar _pd_reg_##op_name(                                  \
+      #op_name, reinterpret_cast<void*>(handler));
+
+// weak, not inline: the symbols must be EXPORTED from the shared
+// library for the ctypes loader, and weak linkage keeps multiple
+// translation units including this header link-compatible
+extern "C" {
+__attribute__((weak)) int pd_num_ops() {
+  return static_cast<int>(pd_registry().size());
+}
+__attribute__((weak)) const char* pd_op_name(int i) {
+  return pd_registry()[i].name;
+}
+__attribute__((weak)) void* pd_op_handler(int i) {
+  return pd_registry()[i].handler;
+}
+}

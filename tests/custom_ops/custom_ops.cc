@@ -1,0 +1,38 @@
+// Test custom ops for the paddle_tpu custom-op ABI (reference model:
+// test/custom_op/custom_relu_op.cc built through PD_BUILD_OP).
+#include <cmath>
+#include <cstdint>
+
+#include "paddle_tpu_ext.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error ReluImpl(ffi::Buffer<ffi::F32> x,
+                           ffi::ResultBuffer<ffi::F32> y) {
+  size_t n = x.element_count();
+  const float* in = x.typed_data();
+  float* out = y->typed_data();
+  for (size_t i = 0; i < n; ++i) out[i] = in[i] > 0.0f ? in[i] : 0.0f;
+  return ffi::Error::Success();
+}
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    ReluHandler, ReluImpl,
+    ffi::Ffi::Bind().Arg<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::F32>>());
+PD_REGISTER_OP(custom_relu, ReluHandler);
+
+static ffi::Error ScaleImpl(ffi::Buffer<ffi::F32> x,
+                            ffi::ResultBuffer<ffi::F32> y,
+                            float factor) {
+  size_t n = x.element_count();
+  const float* in = x.typed_data();
+  float* out = y->typed_data();
+  for (size_t i = 0; i < n; ++i) out[i] = in[i] * factor;
+  return ffi::Error::Success();
+}
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    ScaleHandler, ScaleImpl,
+    ffi::Ffi::Bind().Arg<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::F32>>()
+        .Attr<float>("factor"));
+PD_REGISTER_OP(custom_scale, ScaleHandler);

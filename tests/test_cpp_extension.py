@@ -1,0 +1,94 @@
+"""Custom-op ABI: C++ XLA-FFI kernels through cpp_extension.load.
+
+Reference test model: test/custom_op/test_custom_relu_op_setup.py —
+compile, load, run eager + jit, gradient via custom vjp.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "custom_ops", "custom_ops.cc")
+
+
+@pytest.fixture(scope="module")
+def mod(tmp_path_factory):
+    from paddle_tpu.utils.cpp_extension import load
+    build = str(tmp_path_factory.mktemp("ext"))
+    return load("pd_test_ops", [SRC], build_directory=build, verbose=False)
+
+
+class TestCustomOps:
+    def test_registry_discovered(self, mod):
+        assert set(mod.__ops__) == {"custom_relu", "custom_scale"}
+
+    def test_eager(self, mod):
+        x = paddle.to_tensor(
+            np.array([-1.0, 0.5, 2.0], np.float32))
+        out = mod.custom_relu(x)
+        np.testing.assert_allclose(np.asarray(out.value), [0.0, 0.5, 2.0])
+
+    def test_attr(self, mod):
+        x = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+        out = mod.custom_scale(x, factor=np.float32(3.0))
+        np.testing.assert_allclose(np.asarray(out.value), [3.0, -6.0])
+
+    def test_under_jit(self, mod):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(v):
+            return jax.ffi.ffi_call(
+                "pd_test_ops.custom_relu",
+                jax.ShapeDtypeStruct(v.shape, v.dtype))(v) * 2
+        out = f(jnp.asarray([-1.0, 4.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), [0.0, 8.0])
+
+    def test_custom_vjp(self, mod):
+        import jax
+
+        def build(fwd):
+            @jax.custom_vjp
+            def relu(x):
+                return fwd(x)
+
+            def f(x):
+                return fwd(x), x
+
+            def b(x, g):
+                return (jax.numpy.where(x > 0, g, 0.0),)
+            relu.defvjp(f, b)
+            return relu
+
+        mod.register_vjp("custom_relu", build)
+        x = paddle.to_tensor(np.array([-1.0, 0.5, 2.0], np.float32))
+        x.stop_gradient = False
+        out = mod.custom_relu(x)
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.value),
+                                   [0.0, 1.0, 1.0])
+
+    def test_cache_reuse(self, mod, tmp_path):
+        # same sources -> same artifact path (content-hash cache)
+        from paddle_tpu.utils.cpp_extension import load
+        m2 = load("pd_test_ops", [SRC],
+                  build_directory=os.path.dirname(mod.__library__))
+        assert m2.__library__ == mod.__library__
+
+
+def test_native_flags_registry():
+    """csrc/flags_native.cc builds and mirrors python set_flags."""
+    import paddle_tpu._native as native
+    if native.lib is None:
+        pytest.skip("toolchain unavailable")
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        assert native.lib.get("check_nan_inf") == "True"
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+    assert native.lib.get("check_nan_inf") == "False"
+    assert native.lib.count() >= 1
