@@ -20,6 +20,7 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...framework.dispatch import run, to_tensor_args
 from ...framework.tensor import Tensor
@@ -67,31 +68,50 @@ class _OpModule(types.ModuleType):
     pass
 
 
+def _resolve_out_types(first, out_shapes, out_dtypes):
+    """Output metadata: one array like the first input unless overridden
+    with out_shapes/out_dtypes (lists for multi-output)."""
+    if out_shapes is None:
+        return jax.ShapeDtypeStruct(
+            tuple(first.value.shape),
+            first.value.dtype if out_dtypes is None
+            else jnp.dtype(out_dtypes))
+    shapes = out_shapes if isinstance(out_shapes[0], (list, tuple)) \
+        else [out_shapes]
+    dts = (out_dtypes if isinstance(out_dtypes, (list, tuple))
+           else [out_dtypes or first.value.dtype] * len(shapes))
+    return [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+            for s, d in zip(shapes, dts)]
+
+
 def _make_wrapper(target_name):
     def op(*tensors, out_shapes=None, out_dtypes=None, **attrs):
-        """Call the custom op.  Default output: one array like the first
-        input; override with out_shapes/out_dtypes (lists for multi)."""
         ts = to_tensor_args(*tensors)
-        first = ts[0]
-        if out_shapes is None:
-            out_types = jax.ShapeDtypeStruct(
-                tuple(first.value.shape),
-                first.value.dtype if out_dtypes is None
-                else jnp.dtype(out_dtypes))
-        else:
-            shapes = out_shapes if isinstance(out_shapes[0],
-                                              (list, tuple)) \
-                else [out_shapes]
-            dts = (out_dtypes if isinstance(out_dtypes, (list, tuple))
-                   else [out_dtypes or first.value.dtype] * len(shapes))
-            out_types = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
-                         for s, d in zip(shapes, dts)]
+        out_types = _resolve_out_types(ts[0], out_shapes, out_dtypes)
 
         def raw(*vals):
-            return jax.ffi.ffi_call(target_name, out_types, **attrs)(*vals)
+            return jax.ffi.ffi_call(target_name, out_types)(*vals, **attrs)
         return run(raw, *ts, name=target_name)
     op.__name__ = target_name
     return op
+
+
+def _memo_key(attrs, out_shapes, out_dtypes):
+    """Hashable key over op attrs + output overrides, or None when a
+    value resists normalization (caller then builds uncached)."""
+    def norm(v):
+        if isinstance(v, np.ndarray):
+            return (v.dtype.str, v.shape, v.tobytes())
+        if isinstance(v, (list, tuple)):
+            return tuple(norm(x) for x in v)
+        return v
+    try:
+        key = (tuple(sorted((k, norm(v)) for k, v in attrs.items())),
+               norm(out_shapes), norm(out_dtypes))
+        hash(key)
+        return key
+    except TypeError:
+        return None
 
 
 def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
@@ -127,15 +147,31 @@ def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
     def register_vjp(op_name, vjp_builder):
         """Attach a custom gradient: vjp_builder(fwd_fn) must return a
         jax.custom_vjp-decorated callable; the wrapper re-dispatches
-        through it so eager autograd and jit use the custom rule."""
-        base = getattr(mod, op_name)
-        custom = vjp_builder(lambda *vals: jax.ffi.ffi_call(
-            f"{name}.{op_name}",
-            jax.ShapeDtypeStruct(vals[0].shape, vals[0].dtype))(*vals))
+        through it so eager autograd and jit use the custom rule.
+        Op attributes and output overrides are baked into the forward
+        closure per distinct (attrs, out_shapes, out_dtypes) set
+        (custom_vjp can't thread kwargs); the memo is bounded and falls
+        back to uncached builds for unhashable attr values."""
+        target = f"{name}.{op_name}"
+        customs = {}
 
-        def op(*tensors, **attrs):
+        def _build(first, out_shapes, out_dtypes, attrs):
+            out_types = _resolve_out_types(first, out_shapes, out_dtypes)
+            return vjp_builder(lambda *vals: jax.ffi.ffi_call(
+                target, out_types)(*vals, **attrs))
+
+        def op(*tensors, out_shapes=None, out_dtypes=None, **attrs):
             ts = to_tensor_args(*tensors)
-            return run(custom, *ts, name=f"{name}.{op_name}")
+            key = _memo_key(attrs, out_shapes, out_dtypes)
+            if key is None:
+                custom = _build(ts[0], out_shapes, out_dtypes, attrs)
+            elif key in customs:
+                custom = customs[key]
+            else:
+                custom = _build(ts[0], out_shapes, out_dtypes, attrs)
+                if len(customs) < 64:
+                    customs[key] = custom
+            return run(custom, *ts, name=target)
         op.__name__ = op_name
         setattr(mod, op_name, op)
     mod.register_vjp = register_vjp

@@ -72,6 +72,30 @@ class TestCustomOps:
         np.testing.assert_allclose(np.asarray(x.grad.value),
                                    [0.0, 1.0, 1.0])
 
+    def test_custom_vjp_with_attr(self, mod):
+        import jax
+
+        def build(fwd):
+            @jax.custom_vjp
+            def scale(x):
+                return fwd(x)
+
+            def f(x):
+                return fwd(x), x
+
+            def b(x, g):
+                return (g,)  # deliberately identity grad to spot the rule
+            scale.defvjp(f, b)
+            return scale
+
+        mod.register_vjp("custom_scale", build)
+        x = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+        x.stop_gradient = False
+        out = mod.custom_scale(x, factor=np.float32(3.0))
+        np.testing.assert_allclose(np.asarray(out.value), [3.0, -6.0])
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.value), [1.0, 1.0])
+
     def test_cache_reuse(self, mod, tmp_path):
         # same sources -> same artifact path (content-hash cache)
         from paddle_tpu.utils.cpp_extension import load
