@@ -34,7 +34,7 @@ def _layers_of(function):
     return [bound] if isinstance(bound, Layer) else []
 
 
-def _recompute_impl(function, layers, args, kwargs):
+def _recompute_impl(function, layers, args, kwargs, policy=None):
     # thread every involved parameter/buffer through the taped op so
     # eager autograd sees them (the reference PyLayer tracks them via the
     # captured subgraph); under jit they are tracers either way
@@ -75,7 +75,7 @@ def _recompute_impl(function, layers, args, kwargs):
                          for o in out)
         return out._value if isinstance(out, Tensor) else out
 
-    ck = jax.checkpoint(pure)
+    ck = jax.checkpoint(pure, policy=policy)
     return run(ck, *ptensors, *tensor_args, name="recompute")
 
 
@@ -86,8 +86,19 @@ def recompute(function: Callable, *args, **kwargs):
     function: a Layer, a bound method of a Layer, or a pure function of
     Tensors (pass parameters as explicit Tensor args in that case).
     Non-Tensor positional args and all kwargs are closed over statically.
+
+    policy: optional jax.checkpoint save policy (e.g.
+    `jax.checkpoint_policies.save_only_these_names(...)` over values
+    tagged with `jax.ad_checkpoint.checkpoint_name`) — selective
+    recompute: listed activations are saved, everything else replays.
+    The reference's recompute_granularity "full"/"core_attn" knob
+    (fleet/recompute/recompute.py:455) maps onto policies here.
     """
-    return _recompute_impl(function, _layers_of(function), args, kwargs)
+    policy = kwargs.pop("policy", None)
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
+    return _recompute_impl(function, _layers_of(function), args, kwargs,
+                           policy=policy)
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):

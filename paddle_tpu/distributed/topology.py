@@ -161,11 +161,71 @@ class HybridCommunicateGroup:
                                devices=devices)
         self.nranks = int(np.prod([dp_degree, mp_degree, pp_degree,
                                    sep_degree, sharding_degree]))
-        self.global_rank = 0
-        self._groups = {a: Group(a, self.mesh,
-                                 ranks=list(range(self._degree(a))),
-                                 nranks=self._degree(a))
-                        for a in AXIS_ORDER}
+        self.global_rank = self._derive_global_rank()
+        self._groups = {a: self._axis_group(a) for a in AXIS_ORDER}
+
+    # -- process identity --------------------------------------------------
+    def _derive_global_rank(self) -> int:
+        """This process's rank in the pp→sep→sharding→dp→mp coordinate
+        system.  Priority: launcher env (PADDLE_TRAINER_ID) when the
+        process world matches the mesh extent; then the mesh coordinate
+        shared by this process's jax devices (multi-process SPMD, e.g.
+        PP over hosts); else 0 (single controller owns every rank)."""
+        import os
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        if world > 1 and world == self.nranks:
+            return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        if jax.process_count() > 1:
+            coord = self._local_coord()
+            if coord is not None:
+                sizes = [self._degree(a) for a in AXIS_ORDER]
+                rank = 0
+                for c, n in zip(coord, sizes):
+                    rank = rank * n + c
+                return rank
+        return 0
+
+    def _local_coord(self):
+        """Mesh coordinate of this process's devices, per axis; None when
+        the local devices span several coordinates on every axis (single
+        controller) — callers then use rank 0."""
+        try:
+            pidx = jax.process_index()
+            coords = [idx for idx, d in np.ndenumerate(self.mesh.devices)
+                      if getattr(d, "process_index", 0) == pidx]
+        except Exception:
+            return None
+        if not coords:
+            return None
+        out = []
+        for ax in range(len(AXIS_ORDER)):
+            vals = {c[ax] for c in coords}
+            out.append(vals.pop() if len(vals) == 1 else 0)
+        return tuple(out)
+
+    def _axis_rank(self, axis) -> int:
+        """This process's rank along one mesh axis (reference
+        topology.get_coord); 0 under a single controller."""
+        sizes = [self._degree(a) for a in AXIS_ORDER]
+        rank = self.global_rank
+        for a, n in zip(reversed(AXIS_ORDER), reversed(sizes)):
+            if a == axis:
+                return rank % n
+            rank //= n
+        return 0
+
+    def _axis_group(self, axis) -> "Group":
+        """The global-rank list of this process's group along `axis`:
+        ranks whose coordinates differ only on that axis."""
+        sizes = {a: self._degree(a) for a in AXIS_ORDER}
+        ranks = []
+        for v in range(sizes[axis]):
+            rank = 0
+            for a in AXIS_ORDER:
+                c = v if a == axis else self._axis_rank(a)
+                rank = rank * sizes[a] + c
+            ranks.append(rank)
+        return Group(axis, self.mesh, ranks=ranks, nranks=sizes[axis])
 
     def _degree(self, axis):
         return {"dp": self._dp_degree, "mp": self._mp_degree,
@@ -201,21 +261,22 @@ class HybridCommunicateGroup:
     def get_sharding_parallel_world_size(self):
         return self._sharding_degree
 
-    # ranks (single-controller SPMD: this process sees the whole mesh)
+    # ranks: derived from this process's coordinate (launcher env or jax
+    # process placement); 0 under a single controller that owns the mesh
     def get_data_parallel_rank(self):
-        return 0
+        return self._axis_rank("dp")
 
     def get_model_parallel_rank(self):
-        return 0
+        return self._axis_rank("mp")
 
     def get_stage_id(self):
-        return 0
+        return self._axis_rank("pp")
 
     def get_sep_parallel_rank(self):
-        return 0
+        return self._axis_rank("sep")
 
     def get_sharding_parallel_rank(self):
-        return 0
+        return self._axis_rank("sharding")
 
     # groups
     def get_data_parallel_group(self):
@@ -267,8 +328,12 @@ def batch_partition_spec(mesh: Mesh, shape,
     Single source for ShardedTrainStep._shard_batch,
     DistModel._batch_vals and shard_dataloader — keep them from
     diverging."""
-    axes = tuple(a for a in batch_axes
-                 if a in mesh.axis_names and mesh.shape[a] > 1)
+    # order by MESH axis order (not caller order): the tuple's order is
+    # the tiling-major order, and a spec transposed against the mesh's
+    # device enumeration makes XLA fall back to replicate-then-reshard
+    # ("involuntary full rematerialization") at sharding transitions
+    axes = tuple(a for a in mesh.axis_names
+                 if a in batch_axes and mesh.shape[a] > 1)
     spec = [None] * len(shape)
     n = 1
     for a in axes:

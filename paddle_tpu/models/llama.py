@@ -25,6 +25,7 @@ import math
 from dataclasses import dataclass, field
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -51,15 +52,36 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"
+    # storage dtype of the parameters; None = same as compute dtype.
+    # "float32" params + bfloat16 compute is the TPU-idiomatic mixed
+    # precision scheme (flax param_dtype/dtype split): the fp32 value IS
+    # the master weight — casts fuse into the matmuls, so no separate
+    # master copy lives in the optimizer (reference O2 keeps bf16 params
+    # + fp32 masters; same math, one less resident copy of the model)
+    param_dtype: str | None = None
     use_flash_attention: bool = True
     recompute: bool = False
     # checkpoint only the first N layers (None = all); lets memory-bound
     # configs trade remat flops for activation memory per layer
     recompute_layers: int | None = None
+    # "full": save only layer boundaries, replay the whole block.
+    # "selective": save post-rope q/k/v, the pre-o-proj attention output
+    # and the post-attention residual; the backward replays only the MLP
+    # matmuls + the flash-attn forward (reference recompute_granularity)
+    recompute_granularity: str = "full"
 
     @property
     def head_dim(self):
         return self.hidden_size // self.num_attention_heads
+
+    @property
+    def compute_dtype(self):
+        return jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+
+    @property
+    def storage_dtype(self):
+        pd = self.param_dtype or self.dtype
+        return jnp.bfloat16 if pd == "bfloat16" else jnp.float32
 
 
 def llama_tiny_config(**kw):
@@ -89,15 +111,14 @@ class LlamaRMSNorm(nn.Layer):
         super().__init__(dtype=config.dtype)
         from ..framework.tensor import Parameter
         self.weight = Parameter(jnp.ones([config.hidden_size],
-                                         jnp.bfloat16
-                                         if config.dtype == "bfloat16"
-                                         else jnp.float32))
+                                         config.storage_dtype))
         self.eps = config.rms_norm_eps
 
     def forward(self, x):
         (x,) = to_tensor_args(x)
-        return run(lambda v, w: tpu_ops.rms_norm(v, w, self.eps), x,
-                   self.weight, name="rms_norm")
+        return run(lambda v, w: tpu_ops.rms_norm(v, w.astype(v.dtype),
+                                                 self.eps),
+                   x, self.weight, name="rms_norm")
 
 
 class LlamaAttention(nn.Layer):
@@ -110,14 +131,11 @@ class LlamaAttention(nn.Layer):
         nh = config.num_attention_heads
         nkv = config.num_key_value_heads
         std = 1.0 / math.sqrt(h)
-        self.q_proj = Parameter(_init_weight([h, nh * hd], std,
-                                             config.dtype))
-        self.k_proj = Parameter(_init_weight([h, nkv * hd], std,
-                                             config.dtype))
-        self.v_proj = Parameter(_init_weight([h, nkv * hd], std,
-                                             config.dtype))
-        self.o_proj = Parameter(_init_weight([nh * hd, h], std,
-                                             config.dtype))
+        pd = config.param_dtype or config.dtype
+        self.q_proj = Parameter(_init_weight([h, nh * hd], std, pd))
+        self.k_proj = Parameter(_init_weight([h, nkv * hd], std, pd))
+        self.v_proj = Parameter(_init_weight([h, nkv * hd], std, pd))
+        self.o_proj = Parameter(_init_weight([nh * hd, h], std, pd))
 
     def forward(self, x, cos, sin):
         cfg = self.config
@@ -126,14 +144,26 @@ class LlamaAttention(nn.Layer):
         sin_a = sin.value if isinstance(sin, Tensor) else sin
 
         def _fn(v, wq, wk, wv, wo):
+            from jax.ad_checkpoint import checkpoint_name
+            cd = v.dtype
             b, s, h = v.shape
-            q = (v @ wq).reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
-            k = (v @ wk).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
-            val = (v @ wv).reshape(b, s, cfg.num_key_value_heads,
-                                   cfg.head_dim)
+            q = (v @ wq.astype(cd)).reshape(b, s, cfg.num_attention_heads,
+                                            cfg.head_dim)
+            k = (v @ wk.astype(cd)).reshape(b, s, cfg.num_key_value_heads,
+                                            cfg.head_dim)
+            val = (v @ wv.astype(cd)).reshape(b, s,
+                                              cfg.num_key_value_heads,
+                                              cfg.head_dim)
             q, k = tpu_ops.apply_rope(q, k, cos_a, sin_a)
+            # selective-recompute anchors: saving post-rope q/k/v lets the
+            # flash backward replay only the attention kernel, not the
+            # projections; the attention output feeds o_proj's weight grad
+            q = checkpoint_name(q, "flash_q")
+            k = checkpoint_name(k, "flash_k")
+            val = checkpoint_name(val, "flash_v")
             out = tpu_ops.attention(q, k, val, causal=True)
-            return out.reshape(b, s, -1) @ wo
+            out = checkpoint_name(out, "attn_out")
+            return out.reshape(b, s, -1) @ wo.astype(cd)
         return run(_fn, x, self.q_proj, self.k_proj, self.v_proj,
                    self.o_proj, name="attention")
 
@@ -144,17 +174,19 @@ class LlamaMLP(nn.Layer):
         from ..framework.tensor import Parameter
         h, i = config.hidden_size, config.intermediate_size
         std = 1.0 / math.sqrt(h)
-        self.gate_proj = Parameter(_init_weight([h, i], std, config.dtype))
-        self.up_proj = Parameter(_init_weight([h, i], std, config.dtype))
+        pd = config.param_dtype or config.dtype
+        self.gate_proj = Parameter(_init_weight([h, i], std, pd))
+        self.up_proj = Parameter(_init_weight([h, i], std, pd))
         self.down_proj = Parameter(_init_weight([i, h],
-                                                1.0 / math.sqrt(i),
-                                                config.dtype))
+                                                1.0 / math.sqrt(i), pd))
 
     def forward(self, x):
         (x,) = to_tensor_args(x)
 
         def _fn(v, wg, wu, wd):
-            return tpu_ops.swiglu(v @ wg, v @ wu) @ wd
+            cd = v.dtype
+            return tpu_ops.swiglu(v @ wg.astype(cd),
+                                  v @ wu.astype(cd)) @ wd.astype(cd)
         return run(_fn, x, self.gate_proj, self.up_proj, self.down_proj,
                    name="mlp_swiglu")
 
@@ -162,6 +194,7 @@ class LlamaMLP(nn.Layer):
 class LlamaDecoderLayer(nn.Layer):
     def __init__(self, config: LlamaConfig, layer_idx: int = 0):
         super().__init__(dtype=config.dtype)
+        self.config = config
         self._recompute = config.recompute and (
             config.recompute_layers is None
             or layer_idx < config.recompute_layers)
@@ -173,16 +206,28 @@ class LlamaDecoderLayer(nn.Layer):
     def forward(self, x, cos, sin):
         if self._recompute:
             # per-layer activation checkpointing (reference:
-            # fleet.recompute wrapping each decoder block) — only the
-            # residual-stream boundary survives the forward
+            # fleet.recompute wrapping each decoder block).  "full" keeps
+            # only the residual-stream boundary; "selective" additionally
+            # saves the tagged attention-side values, so the backward
+            # replays only the MLP matmuls + the flash-attn forward
             from ..distributed.fleet.recompute import recompute
-            return recompute(self._block, x, cos, sin)
+            policy = None
+            if self.config.recompute_granularity == "selective":
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "flash_q", "flash_k", "flash_v", "attn_out",
+                    "resid_mid")
+            return recompute(self._block, x, cos, sin, policy=policy)
         return self._block(x, cos, sin)
 
     def _block(self, x, cos, sin):
+        from jax.ad_checkpoint import checkpoint_name
+        from ..parallel.sharded_trainer import constrain_activation
         x = x + self.self_attn(self.input_layernorm(x), cos, sin)
+        x = run(lambda v: checkpoint_name(constrain_activation(v),
+                                          "resid_mid"), x,
+                name="tag_resid")
         x = x + self.mlp(self.post_attention_layernorm(x))
-        return x
+        return run(constrain_activation, x, name="constrain_resid")
 
 
 class LlamaModel(nn.Layer):
@@ -192,7 +237,8 @@ class LlamaModel(nn.Layer):
         self.config = config
         std = 1.0 / math.sqrt(config.hidden_size)
         self.embed_tokens = Parameter(_init_weight(
-            [config.vocab_size, config.hidden_size], std, config.dtype))
+            [config.vocab_size, config.hidden_size], std,
+            config.param_dtype or config.dtype))
         self.layers = nn.LayerList(
             [LlamaDecoderLayer(config, i)
              for i in range(config.num_hidden_layers)])
@@ -204,9 +250,11 @@ class LlamaModel(nn.Layer):
         seq_len = input_ids.shape[1]
         cos, sin = tpu_ops.rope_cos_sin(seq_len, cfg.head_dim,
                                         cfg.rope_theta, jnp.float32)
-        x = run(lambda w: jnp.take(w, input_ids.value.astype(jnp.int32),
-                                   axis=0), self.embed_tokens,
-                name="embedding")
+        from ..parallel.sharded_trainer import constrain_activation
+        x = run(lambda w: constrain_activation(
+                    jnp.take(w, input_ids.value.astype(jnp.int32),
+                             axis=0).astype(cfg.compute_dtype)),
+                self.embed_tokens, name="embedding")
         for layer in self.layers:
             x = layer(x, cos, sin)
         return self.norm(x)
@@ -221,14 +269,17 @@ class LlamaForCausalLM(nn.Layer):
         if not config.tie_word_embeddings:
             self.lm_head = Parameter(_init_weight(
                 [config.hidden_size, config.vocab_size],
-                1.0 / math.sqrt(config.hidden_size), config.dtype))
+                1.0 / math.sqrt(config.hidden_size),
+                config.param_dtype or config.dtype))
 
     def forward(self, input_ids):
         x = self.llama(input_ids)
         if self.config.tie_word_embeddings:
             w = self.llama.embed_tokens
-            return run(lambda v, e: v @ e.T, x, w, name="lm_head")
-        return run(lambda v, w: v @ w, x, self.lm_head, name="lm_head")
+            return run(lambda v, e: v @ e.T.astype(v.dtype), x, w,
+                       name="lm_head")
+        return run(lambda v, w: v @ w.astype(v.dtype), x, self.lm_head,
+                   name="lm_head")
 
     def compute_loss(self, logits, labels):
         """Next-token cross entropy in fp32 (reference:

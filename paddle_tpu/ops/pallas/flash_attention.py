@@ -78,8 +78,12 @@ def _kv_head_map(h, hk):
 # ---------------------------------------------------------------------------
 def _fwd_small_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                       block_q, block_k, seq_k):
+    # dots keep the INPUT dtype (bf16 on the MXU — fp32 operands run at
+    # ~1/8 the matmul rate); accumulation is fp32 via
+    # preferred_element_type, softmax math is fp32, and the scale is
+    # applied to the fp32 logits after the dot
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)          # [BQ, D]
+    q = q_ref[0]                                                   # [BQ, D]
 
     # all index arithmetic in int32: mosaic rejects mixed i32/i64 (python
     # ints are weak int64 under jax_enable_x64)
@@ -93,10 +97,11 @@ def _fwd_small_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
     def body(j, carry):
         acc, m, l = carry
-        k = k_ref[0, pl.ds(j * i32(block_k), block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * i32(block_k), block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * i32(block_k), block_k), :]
+        v = v_ref[0, pl.ds(j * i32(block_k), block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) \
+            * jnp.float32(scale)
         if causal:
             q_pos = qi * i32(block_q) + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -108,7 +113,7 @@ def _fwd_small_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=1)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
@@ -154,8 +159,8 @@ def _fwd_small(q3, k2, v2, scale, causal, block_q, block_k, h, hk):
 def _bwd_dq_small_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, scale, causal, block_q, block_k, seq_k):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0][:, 0]
     delta = delta_ref[0][:, 0]
 
@@ -167,10 +172,11 @@ def _bwd_dq_small_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ((qi + i32(1)) * i32(block_q) - i32(1)) // i32(block_k) + i32(1))
 
     def body(j, dq):
-        k = k_ref[0, pl.ds(j * i32(block_k), block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * i32(block_k), block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * i32(block_k), block_k), :]
+        v = v_ref[0, pl.ds(j * i32(block_k), block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) \
+            * jnp.float32(scale)
         if causal:
             q_pos = qi * i32(block_q) + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -181,7 +187,8 @@ def _bwd_dq_small_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * jnp.float32(scale)
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+        return dq + jax.lax.dot_general(ds.astype(k.dtype), k,
+                                        (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
     d = q_ref.shape[-1]
@@ -200,8 +207,8 @@ def _bwd_dkv_small_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     (1, sk, d) output rows."""
     g = pl.program_id(1)
     kj = pl.program_id(2)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
 
     i32 = lambda v: jnp.asarray(v, jnp.int32)
     num_qb = i32(seq_q // block_q)
@@ -212,14 +219,13 @@ def _bwd_dkv_small_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * i32(block_q), block_q), :].astype(
-            jnp.float32) * jnp.float32(scale)
-        do = do_ref[0, pl.ds(i * i32(block_q), block_q), :].astype(
-            jnp.float32)
+        q = q_ref[0, pl.ds(i * i32(block_q), block_q), :]
+        do = do_ref[0, pl.ds(i * i32(block_q), block_q), :]
         lse = lse_ref[0, pl.ds(i * i32(block_q), block_q), 0]
         delta = delta_ref[0, pl.ds(i * i32(block_q), block_q), 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) \
+            * jnp.float32(scale)
         if causal:
             q_pos = i * i32(block_q) + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -228,15 +234,13 @@ def _bwd_dkv_small_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
         p = jnp.exp(s - lse[:, None])                   # [BQ, BK]
         dv_new = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # [BK, D]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        # q above is pre-multiplied by scale, so ds needs no extra
-        # factor: dk_true = scale · dsᵀq = dsᵀ · (q·scale)
-        ds = p * (dp - delta[:, None])                  # [BQ, BK]
+        ds = p * (dp - delta[:, None]) * jnp.float32(scale)  # [BQ, BK]
         dk_new = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
@@ -346,11 +350,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)   # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)                        # [BK, D]
-        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0]                                            # [BQ, D]
+        k = k_ref[0]                                            # [BK, D]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) \
+            * jnp.float32(scale)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -364,7 +369,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[...] = m_new[:, None]
         l_ref[...] = l_new[:, None]
@@ -444,14 +449,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]
         delta = delta_ref[0][:, 0]
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) \
+            * jnp.float32(scale)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -463,7 +469,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * jnp.float32(scale)
         acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kj == num_kb - 1)
@@ -492,14 +498,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _compute():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)
-        do = do_ref[0].astype(jnp.float32)
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]
         delta = delta_ref[0][:, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) \
+            * jnp.float32(scale)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -508,15 +515,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
         p = jnp.exp(s - lse[:, None])                       # [BQ, BK]
         dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # [BK, D]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        # q above is pre-multiplied by scale, so ds needs no extra factor:
-        # dk_true = scale · dsᵀq = dsᵀ · (q·scale)
-        ds = p * (dp - delta[:, None])                      # [BQ, BK]
+        ds = p * (dp - delta[:, None]) * jnp.float32(scale)  # [BQ, BK]
         dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(t == num_t - 1)

@@ -4,10 +4,18 @@ Reference: the reference fuses the AdamW update in CUDA
 (`paddle/phi/kernels/gpu/adamw_kernel.cu`, `fused_adam_kernel.cu` multi
 tensor) so one kernel reads grad + moments + master once.  TPU-native
 equivalent: one Pallas pass that reads (grad, m, v, master) and writes
-(param_half, m, v, master) with input/output aliasing, so the moments and
-master update IN PLACE — the optimizer step's HBM traffic is exactly one
-read + one write of the state, and XLA never materialises intermediate
-fp32 copies of the parameter.
+(param, m, v[, master]) with input/output aliasing, so the state updates
+IN PLACE — the optimizer step's HBM traffic is exactly one read + one
+write of the state, and XLA never materialises intermediate fp32 copies
+of the parameter.
+
+Two storage schemes:
+  - half params + fp32 master (reference O2): outputs a fresh half param
+    and the aliased fp32 master.
+  - fp32 params (flax param_dtype idiom — the param IS the master):
+    the param aliases in place; no separate half copy is written.
+Moments may be stored in any dtype (bf16 halves state memory); update
+math is fp32 regardless.
 
 Bias corrections (1-βᵗ) are computed outside (scalar XLA) and passed in
 SMEM; weight decay and betas are compile-time constants.
@@ -23,40 +31,62 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["fused_adamw"]
 
-# elements per grid step: in+out blocks (4 f32 + 2 bf16-ish each way)
-# double-buffered must fit the ~16 MiB scoped VMEM → ~3.5 MiB per block set
-_CHUNK = 128 * 1024
+# elements per grid step: in+out blocks (up to 4 f32 + 2 bf16 each way)
+# double-buffered must fit the ~16 MiB scoped VMEM
+_CHUNK = 64 * 1024
 
 
 def _interpret():
     return jax.default_backend() != "tpu"
 
 
-def _kernel(lr_ref, c1_ref, c2_ref, g_ref, m_ref, v_ref, mst_ref,
-            p_out, m_out, v_out, mst_out, *, b1, b2, eps, wd, decoupled):
+def _step_math(g_ref, m_ref, v_ref, mst_ref, lr_ref, c1_ref, c2_ref, *,
+               b1, b2, eps, wd, decoupled):
     g = g_ref[...].astype(jnp.float32)
-    mst = mst_ref[...]
+    mst = mst_ref[...].astype(jnp.float32)
     if wd and not decoupled:
         g = g + jnp.float32(wd) * mst
-    m = jnp.float32(b1) * m_ref[...] + jnp.float32(1 - b1) * g
-    v = jnp.float32(b2) * v_ref[...] + jnp.float32(1 - b2) * g * g
+    m = jnp.float32(b1) * m_ref[...].astype(jnp.float32) \
+        + jnp.float32(1 - b1) * g
+    v = jnp.float32(b2) * v_ref[...].astype(jnp.float32) \
+        + jnp.float32(1 - b2) * g * g
     mhat = m / c1_ref[0]
     vhat = v / c2_ref[0]
     upd = mhat / (jnp.sqrt(vhat) + jnp.float32(eps))
     if wd and decoupled:
         upd = upd + jnp.float32(wd) * mst
-    new_mst = mst - lr_ref[0] * upd
+    return mst - lr_ref[0] * upd, m, v
+
+
+def _kernel_master(lr_ref, c1_ref, c2_ref, g_ref, m_ref, v_ref, mst_ref,
+                   p_out, m_out, v_out, mst_out, *, b1, b2, eps, wd,
+                   decoupled):
+    new_mst, m, v = _step_math(g_ref, m_ref, v_ref, mst_ref, lr_ref,
+                               c1_ref, c2_ref, b1=b1, b2=b2, eps=eps,
+                               wd=wd, decoupled=decoupled)
     p_out[...] = new_mst.astype(p_out.dtype)
-    m_out[...] = m
-    v_out[...] = v
+    m_out[...] = m.astype(m_out.dtype)
+    v_out[...] = v.astype(v_out.dtype)
     mst_out[...] = new_mst
+
+
+def _kernel_fp32(lr_ref, c1_ref, c2_ref, g_ref, m_ref, v_ref, p_ref,
+                 p_out, m_out, v_out, *, b1, b2, eps, wd, decoupled):
+    new_p, m, v = _step_math(g_ref, m_ref, v_ref, p_ref, lr_ref,
+                             c1_ref, c2_ref, b1=b1, b2=b2, eps=eps,
+                             wd=wd, decoupled=decoupled)
+    p_out[...] = new_p
+    m_out[...] = m.astype(m_out.dtype)
+    v_out[...] = v.astype(v_out.dtype)
 
 
 def fused_adamw(grad, m, v, master, lr, step, *, b1=0.9, b2=0.999,
                 eps=1e-8, wd=0.0, decoupled=True, out_dtype=jnp.bfloat16):
-    """One fused AdamW step.  grad: any shape/dtype; m/v/master: fp32 of
-    the same shape.  Returns (param(out_dtype), m, v, master); m, v and
-    master alias their inputs (updated in place under jit donation).
+    """One fused AdamW step.  grad: any shape/dtype; m/v: any float dtype
+    of the same shape; master: fp32.  Returns (param(out_dtype), m, v,
+    master); the state aliases its inputs (updated in place under jit
+    donation).  When out_dtype is fp32 the param IS the master (one
+    aliased output; the returned master is the new param).
 
     lr: scalar f32 (traced); step: scalar int (traced, 1-based).
     """
@@ -67,32 +97,61 @@ def fused_adamw(grad, m, v, master, lr, step, *, b1=0.9, b2=0.999,
     c2 = (1.0 - jnp.float32(b2) ** stepf).reshape(1)
     lr1 = jnp.asarray(lr, jnp.float32).reshape(1)
 
-    g1 = grad.reshape(n)
-    m1 = m.reshape(n)
-    v1 = v.reshape(n)
-    mst1 = master.reshape(n)
-    chunk = min(_CHUNK, n)
-    grid = ((n + chunk - 1) // chunk,)
+    # big tensors: 2-D (rows, 1024) blocks — native (8,128)/(16,128)
+    # tiling, large contiguous DMAs per grid step.  Fallback: flat 1-D
+    # chunks for shapes that don't divide.
+    lanes = 1024
+    if n % lanes == 0:
+        rows = n // lanes
+        br = next((d for d in (256, 128, 64, 32, 16, 8, 4, 2, 1)
+                   if rows % d == 0))
+        work_shape = (rows, lanes)
+        grid = (rows // br,)
+        blk = pl.BlockSpec((br, lanes), lambda i: (i, 0))
+    else:
+        work_shape = (n,)
+        chunk = min(_CHUNK, n)
+        grid = ((n + chunk - 1) // chunk,)
+        blk = pl.BlockSpec((chunk,), lambda i: (i,))
+    g1 = grad.reshape(work_shape)
+    m1 = m.reshape(work_shape)
+    v1 = v.reshape(work_shape)
+    mst1 = master.reshape(work_shape)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
-    blk = pl.BlockSpec((chunk,), lambda i: (i,))
+    fp32_params = jnp.dtype(out_dtype) == jnp.float32
+    kw = dict(b1=b1, b2=b2, eps=eps, wd=wd, decoupled=decoupled)
     with jax.enable_x64(False):
-        p1, m1, v1, mst1 = pl.pallas_call(
-            functools.partial(_kernel, b1=b1, b2=b2, eps=eps, wd=wd,
-                              decoupled=decoupled),
-            grid=grid,
-            in_specs=[smem, smem, smem, blk, blk, blk, blk],
-            out_specs=[blk, blk, blk, blk],
-            out_shape=[
-                jax.ShapeDtypeStruct((n,), out_dtype),
-                jax.ShapeDtypeStruct((n,), jnp.float32),
-                jax.ShapeDtypeStruct((n,), jnp.float32),
-                jax.ShapeDtypeStruct((n,), jnp.float32),
-            ],
-            # m, v, master update in place (operand index counts the 3
-            # scalar-prefetch SMEM refs first: grads are operand 3)
-            input_output_aliases={4: 1, 5: 2, 6: 3},
-            interpret=_interpret(),
-        )(lr1, c1, c2, g1, m1, v1, mst1)
+        if fp32_params:
+            # operand index counts the 3 scalar SMEM refs first
+            p1, m1, v1 = pl.pallas_call(
+                functools.partial(_kernel_fp32, **kw),
+                grid=grid,
+                in_specs=[smem, smem, smem, blk, blk, blk, blk],
+                out_specs=[blk, blk, blk],
+                out_shape=[
+                    jax.ShapeDtypeStruct(work_shape, jnp.float32),
+                    jax.ShapeDtypeStruct(work_shape, m.dtype),
+                    jax.ShapeDtypeStruct(work_shape, v.dtype),
+                ],
+                input_output_aliases={6: 0, 4: 1, 5: 2},
+                interpret=_interpret(),
+            )(lr1, c1, c2, g1, m1, v1, mst1)
+            mst1 = p1
+        else:
+            p1, m1, v1, mst1 = pl.pallas_call(
+                functools.partial(_kernel_master, **kw),
+                grid=grid,
+                in_specs=[smem, smem, smem, blk, blk, blk, blk],
+                out_specs=[blk, blk, blk, blk],
+                out_shape=[
+                    jax.ShapeDtypeStruct(work_shape, out_dtype),
+                    jax.ShapeDtypeStruct(work_shape, m.dtype),
+                    jax.ShapeDtypeStruct(work_shape, v.dtype),
+                    jax.ShapeDtypeStruct(work_shape, jnp.float32),
+                ],
+                input_output_aliases={4: 1, 5: 2, 6: 3},
+                interpret=_interpret(),
+            )(lr1, c1, c2, g1, m1, v1, mst1)
     return (p1.reshape(shape), m1.reshape(shape), v1.reshape(shape),
             mst1.reshape(shape))
 

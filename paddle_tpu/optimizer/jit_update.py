@@ -5,19 +5,27 @@ Reference: multi-precision (master weight) AdamW — `optimizer/adamw.py`
 (`phi/kernels/gpu/adamw_kernel.cu` MultiPrecision variants).  TPU-native:
 the fp32 master lives INSIDE the optimizer state pytree, so it is donated,
 sharded by the trainer's ZeRO policy alongside the moments (ZeRO-1/2
-"master shards"), and checkpointed with the rest of the state.
+"master shards"), and checkpointed with the rest of the state.  With
+fp32 params (flax param_dtype idiom) the param itself is the master and
+no separate copy exists.
 
 `apply_update` is used by both jit.TrainStep and parallel.ShardedTrainStep:
 
   - state contains "master": the pure update rule runs on the fp32
     master and the half-precision param is re-derived by a cast
+  - fp32 param + {moment1, moment2} state: the param is updated in place
   - on TPU with Adam/AdamW hyper-params, dispatches to the Pallas
-    fused_adamw kernel (single pass, in-place moments/master)
+    fused_adamw kernel (single pass, in-place state)
+  - under a multi-device mesh, the fused kernel is shard_map-wrapped
+    over the caller-provided PartitionSpec so every chip updates only
+    its own ZeRO shard (a bare pallas_call has no SPMD rule — GSPMD
+    would replicate the state on every chip)
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ..framework.flags import get_flag, define_flag
 
@@ -26,6 +34,9 @@ __all__ = ["apply_update", "maybe_master_state", "wants_master"]
 define_flag("use_fused_adamw", True,
             "dispatch jitted Adam/AdamW updates to the fused Pallas kernel "
             "on TPU")
+define_flag("fused_adamw_interpret", False,
+            "allow the fused AdamW path off-TPU (Pallas interpret mode) — "
+            "for tests exercising the shard_map-wrapped kernel on CPU")
 
 _HALF = (jnp.bfloat16, jnp.float16)
 
@@ -48,32 +59,64 @@ def _is_adam_hp(hp):
     return {"b1", "b2", "eps", "decoupled"} <= set(hp)
 
 
-def _fusable(hp, state):
-    return (_is_adam_hp(hp) and "master" in state
-            and {"moment1", "moment2", "master"} == set(state)
-            and get_flag("use_fused_adamw")
-            and jax.default_backend() == "tpu")
+def _fusable(hp, state, p_dtype):
+    if not (_is_adam_hp(hp) and get_flag("use_fused_adamw")):
+        return False
+    if jax.default_backend() != "tpu" \
+            and not get_flag("fused_adamw_interpret"):
+        return False
+    keys = set(state)
+    if "master" in keys:
+        return {"moment1", "moment2", "master"} == keys
+    return ({"moment1", "moment2"} == keys
+            and jnp.dtype(p_dtype) == jnp.float32)
 
 
-def apply_update(upd, p, g, s, lr, wd, step_i, hp, fused_ok=True):
+def _pad_spec(spec, ndim):
+    parts = tuple(spec) if spec is not None else ()
+    return P(*(parts + (None,) * (ndim - len(parts))))
+
+
+def apply_update(upd, p, g, s, lr, wd, step_i, hp, fused_ok=True,
+                 mesh=None, spec=None):
     """One parameter's optimizer update inside a jitted step.
 
     upd: the optimizer class's pure `_update(param, grad, state, lr, wd,
     step, **hp)`.  Handles the master-weight indirection and the fused
     TPU kernel; falls back to the pure rule everywhere else.
 
-    fused_ok: callers running under a multi-device mesh MUST pass False
-    when the optimizer state is sharded — a pallas_call has no SPMD
-    partitioning rule, so GSPMD would all-gather (replicate) the fp32
-    master/moments on every chip, defeating ZeRO.
+    fused_ok=False with mesh/spec given: the state is sharded — the
+    fused kernel is wrapped in shard_map over `spec` (the state's
+    PartitionSpec on `mesh`) so each chip updates its local shard.
+    Without mesh/spec, sharded callers fall back to the pure rule
+    (GSPMD partitions it).
     """
-    if fused_ok and _fusable(hp, s):
+    fusable = _fusable(hp, s, jnp.dtype(p.dtype))
+    if fusable and (fused_ok or (mesh is not None and spec is not None)):
         from ..ops.pallas.fused_adamw import fused_adamw
-        new_p, m, v, mst = fused_adamw(
-            g, s["moment1"], s["moment2"], s["master"], lr, step_i,
-            b1=hp["b1"], b2=hp["b2"], eps=hp["eps"], wd=wd,
-            decoupled=hp["decoupled"], out_dtype=p.dtype)
-        return new_p, {"moment1": m, "moment2": v, "master": mst}
+        master = s.get("master", p)
+        kw = dict(b1=hp["b1"], b2=hp["b2"], eps=hp["eps"], wd=wd,
+                  decoupled=hp["decoupled"], out_dtype=p.dtype)
+        if fused_ok:
+            new_p, m, v, mst = fused_adamw(g, s["moment1"], s["moment2"],
+                                           master, lr, step_i, **kw)
+        else:
+            from jax.experimental.shard_map import shard_map
+            sp = _pad_spec(spec, g.ndim)
+
+            def local(g_, m_, v_, mst_, lr_, st_):
+                return fused_adamw(g_, m_, v_, mst_, lr_, st_, **kw)
+
+            new_p, m, v, mst = shard_map(
+                local, mesh=mesh,
+                in_specs=(sp, sp, sp, sp, P(), P()),
+                out_specs=(sp, sp, sp, sp),
+                check_rep=False,
+            )(g, s["moment1"], s["moment2"], master,
+              jnp.asarray(lr, jnp.float32), jnp.asarray(step_i, jnp.int32))
+        if "master" in s:
+            return new_p, {"moment1": m, "moment2": v, "master": mst}
+        return new_p, {"moment1": m, "moment2": v}
     if "master" in s:
         rest = {k: v for k, v in s.items() if k != "master"}
         new_master, ns = upd(s["master"], g.astype(jnp.float32), rest,

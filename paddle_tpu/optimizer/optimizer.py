@@ -249,16 +249,22 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 name=None, **kw):
+                 name=None, moment_dtype=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision=multi_precision, **kw)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        # storage dtype of the moments (default fp32).  bfloat16 halves
+        # the optimizer-state HBM footprint; the update math still runs
+        # in fp32 (moments are cast up, computed, cast back)
+        self._moment_dtype = moment_dtype
 
     def _init_state(self, p):
-        return {"moment1": jnp.zeros_like(p.value, jnp.float32),
-                "moment2": jnp.zeros_like(p.value, jnp.float32)}
+        md = jnp.dtype(self._moment_dtype) if self._moment_dtype \
+            else jnp.float32
+        return {"moment1": jnp.zeros_like(p.value, md),
+                "moment2": jnp.zeros_like(p.value, md)}
 
     def _hyper(self):
         return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon,
@@ -269,17 +275,19 @@ class Adam(Optimizer):
                 eps=1e-8, decoupled=True):
         gf = grad.astype(jnp.float32)
         pf = param.astype(jnp.float32)
+        md = state["moment1"].dtype
         if wd and not decoupled:
             gf = gf + wd * pf
-        m = b1 * state["moment1"] + (1 - b1) * gf
-        v = b2 * state["moment2"] + (1 - b2) * gf * gf
+        m = b1 * state["moment1"].astype(jnp.float32) + (1 - b1) * gf
+        v = b2 * state["moment2"].astype(jnp.float32) + (1 - b2) * gf * gf
         mhat = m / (1 - b1 ** step)
         vhat = v / (1 - b2 ** step)
         upd = mhat / (jnp.sqrt(vhat) + eps)
         if wd and decoupled:
             upd = upd + wd * pf
         new_p = pf - lr * upd
-        return new_p.astype(param.dtype), {"moment1": m, "moment2": v}
+        return new_p.astype(param.dtype), {"moment1": m.astype(md),
+                                           "moment2": v.astype(md)}
 
 
 class AdamW(Adam):

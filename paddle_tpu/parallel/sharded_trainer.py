@@ -24,7 +24,47 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..framework.tensor import Tensor
 from ..framework import random as prandom
 
-__all__ = ["ShardedTrainStep", "make_batch_sharding"]
+__all__ = ["ShardedTrainStep", "make_batch_sharding",
+           "activation_sharding_scope", "constrain_activation"]
+
+
+_ACT_SCOPE: list = []
+
+
+class activation_sharding_scope:
+    """While active (during tracing), `constrain_activation` pins
+    [batch, seq, hidden] activations to the data-parallel layout: batch
+    over the dp/sharding axes, hidden replicated.  Without these anchors
+    GSPMD sometimes propagates a ZeRO-3 param's 'sharding' dim into the
+    activations instead of allgathering the param, forcing
+    replicate-then-reshard ("involuntary full rematerialization") at the
+    remat boundaries."""
+
+    def __init__(self, mesh, batch_axes, seq_axis=None, seq_dim=1):
+        self._entry = (mesh, batch_axes, seq_axis, seq_dim)
+
+    def __enter__(self):
+        _ACT_SCOPE.append(self._entry)
+        return self
+
+    def __exit__(self, *exc):
+        _ACT_SCOPE.pop()
+        return False
+
+
+def constrain_activation(v):
+    """Apply the ambient activation sharding (no-op outside the scope)."""
+    if not _ACT_SCOPE or v.ndim < 2:
+        return v
+    mesh, batch_axes, seq_axis, seq_dim = _ACT_SCOPE[-1]
+    from ..distributed.topology import batch_partition_spec
+    spec = batch_partition_spec(mesh, v.shape, batch_axes)
+    if seq_axis and seq_axis in mesh.axis_names \
+            and mesh.shape[seq_axis] > 1 and v.ndim > seq_dim \
+            and v.shape[seq_dim] % mesh.shape[seq_axis] == 0:
+        spec[seq_dim] = seq_axis
+    return jax.lax.with_sharding_constraint(
+        v, NamedSharding(mesh, P(*spec)))
 
 
 def make_batch_sharding(mesh: Mesh, ndim: int, batch_axes=("dp", "sharding")):
@@ -44,9 +84,29 @@ def _current_spec(arr) -> P:
     return [None] * arr.ndim
 
 
-def _add_axis_to_spec(spec, axis_name, shape, axis_size):
-    """Find a dim not already sharded whose size divides evenly; shard it."""
+def _add_axis_to_spec(spec, axis_name, shape, axis_size, mesh=None):
+    """Choose a dim for an extra sharding axis.
+
+    Preference 1: stack onto an already-sharded dim (e.g. the TP dim) —
+    the weight is then allgathered at use, and no new sharded dim leaks
+    into activation shardings (putting the ZeRO axis on a weight's
+    hidden dim makes GSPMD shard activations' hidden dim, forcing
+    full-remat reshards).  Preference 2: largest free dim that divides.
+    """
     order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    if mesh is not None:
+        for i in order:
+            cur = spec[i]
+            if cur is None:
+                continue
+            axes = cur if isinstance(cur, tuple) else (cur,)
+            local = shape[i]
+            for a in axes:
+                local //= mesh.shape[a]
+            if local % axis_size == 0 and local > 1:
+                spec = list(spec)
+                spec[i] = tuple(axes) + (axis_name,)
+                return spec
     for i in order:
         if spec[i] is None and shape[i] % axis_size == 0 and shape[i] > 1:
             spec = list(spec)
@@ -86,9 +146,14 @@ class ShardedTrainStep:
         for n in self._names:
             p = sd[n]
             spec = _current_spec(p.value)
-            if self.stage >= 3 and shard_n > 1:
+            # only matrix-shaped params join ZeRO-3: sharding 1-D params
+            # (norm scales, biases) along the hidden dim makes GSPMD
+            # propagate hidden-dim shardings into every activation that
+            # touches them, forcing full-remat reshards; replicating
+            # them costs ~nothing
+            if self.stage >= 3 and shard_n > 1 and p.value.ndim >= 2:
                 spec = _add_axis_to_spec(spec, "sharding",
-                                         p.value.shape, shard_n)
+                                         p.value.shape, shard_n, mesh)
             ns = NamedSharding(mesh, P(*spec))
             self._param_shardings[n] = ns
             p._value = jax.device_put(p.value, ns)
@@ -99,7 +164,7 @@ class ShardedTrainStep:
                 spec = _current_spec(p.value)
                 if self.stage < 3:
                     spec = _add_axis_to_spec(spec, "sharding",
-                                             p.value.shape, shard_n)
+                                             p.value.shape, shard_n, mesh)
                 self._opt_shardings[n] = NamedSharding(mesh, P(*spec))
             else:
                 self._opt_shardings[n] = self._param_shardings[n]
@@ -160,7 +225,11 @@ class ShardedTrainStep:
             def fwd(param_vals):
                 with _swapped_state(model, names + buf_names,
                                     list(param_vals) + list(buf_vals)):
-                    with prandom.key_scope(key):
+                    with prandom.key_scope(key), \
+                         activation_sharding_scope(self.mesh,
+                                                   self.batch_axes,
+                                                   self.seq_axis,
+                                                   self.seq_dim):
                         inputs = [Tensor(b) for b in batch[:-1]]
                         out = model(*inputs)
                         if loss_fn is not None:
@@ -183,10 +252,13 @@ class ShardedTrainStep:
             grad_shardings = [self._opt_shardings[n] for n in names]
 
         from ..optimizer.jit_update import apply_update
-        # fused pallas update only when nothing is sharded across devices
-        # (a pallas_call can't be partitioned — GSPMD would replicate the
-        # fp32 state on every chip, defeating ZeRO/TP sharding)
+        # single device: plain fused pallas update.  Sharded mesh: the
+        # fused kernel is shard_map-wrapped over each state's spec inside
+        # apply_update, so every chip updates only its ZeRO shard (a bare
+        # pallas_call has no SPMD rule — GSPMD would replicate the state)
         fused_ok = self.mesh.size == 1
+        mesh = self.mesh if self.mesh.size > 1 else None
+        opt_specs = [self._opt_shardings[n].spec for n in names]
 
         def step(param_vals, opt_states, buf_vals, lr, step_i, key, batch):
             loss, grads = jax.value_and_grad(loss_of)(param_vals, buf_vals,
@@ -195,11 +267,11 @@ class ShardedTrainStep:
                 grads = [jax.lax.with_sharding_constraint(g, gs)
                          for g, gs in zip(grads, grad_shardings)]
             new_params, new_states = [], []
-            for p, g, s, wd, ls in zip(param_vals, grads, opt_states, wds,
-                                       lr_scales):
+            for p, g, s, wd, ls, sp in zip(param_vals, grads, opt_states,
+                                           wds, lr_scales, opt_specs):
                 np_, ns = apply_update(
                     upd, p, g, s, lr if ls == 1.0 else lr * ls, wd,
-                    step_i, hp, fused_ok=fused_ok)
+                    step_i, hp, fused_ok=fused_ok, mesh=mesh, spec=sp)
                 new_params.append(np_)
                 new_states.append(ns)
             return loss, new_params, new_states

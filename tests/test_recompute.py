@@ -87,3 +87,103 @@ class TestRecompute:
         assert w.grad is not None
         np.testing.assert_allclose(np.asarray(w.grad.value),
                                    np.full((4, 4), 2.0), atol=1e-6)
+
+
+class TestSelectiveRecompute:
+    """recompute_granularity="selective" (jax.checkpoint policy over
+    checkpoint_name tags): loss trajectory must match full recompute and
+    no recompute exactly — policies change memory, not math."""
+
+    def _run(self, rc, granularity="full", param_dtype=None):
+        from paddle_tpu.models.llama import LlamaForCausalLM, LlamaConfig
+        from paddle_tpu.jit import TrainStep
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=64, dtype="float32",
+                          param_dtype=param_dtype, recompute=rc,
+                          recompute_granularity=granularity)
+        m = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        step = TrainStep(m, lambda o, y: m.compute_loss(o, y), opt)
+        ids = paddle.to_tensor(np.random.RandomState(1).randint(
+            0, 64, (2, 16)).astype(np.int32))
+        return [float(np.asarray(step(ids, ids).value)) for _ in range(3)]
+
+    def test_selective_matches_plain(self):
+        np.testing.assert_allclose(self._run(False),
+                                   self._run(True, "selective"),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_selective_matches_full(self):
+        np.testing.assert_allclose(self._run(True, "full"),
+                                   self._run(True, "selective"),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_selective_under_sharded_trainer(self):
+        """selective remat inside the hybrid-parallel jitted step."""
+        import jax
+        from paddle_tpu.models.llama import LlamaForCausalLM, LlamaConfig
+        from paddle_tpu.parallel import ShardedTrainStep
+        from paddle_tpu.distributed.topology import build_mesh
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=64, dtype="float32",
+                          recompute=True,
+                          recompute_granularity="selective")
+        m = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        mesh = build_mesh(dp=2, sharding=2,
+                          devices=jax.devices()[:4])
+        st = ShardedTrainStep(m, opt, mesh, sharding_stage=3)
+        ids = paddle.to_tensor(np.random.RandomState(1).randint(
+            0, 64, (4, 16)).astype(np.int32))
+        losses = [float(np.asarray(st(ids, ids).value)) for _ in range(3)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+
+class TestParamDtype:
+    """fp32 params + low-precision compute (flax param_dtype idiom):
+    params stay fp32, activations run in the compute dtype."""
+
+    def test_params_fp32_activations_bf16(self):
+        import jax.numpy as jnp
+        from paddle_tpu.models.llama import LlamaForCausalLM, LlamaConfig
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=1,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=64, dtype="bfloat16",
+                          param_dtype="float32")
+        m = LlamaForCausalLM(cfg)
+        for n, p in m.named_parameters():
+            assert p.value.dtype == jnp.float32, n
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            0, 64, (2, 8)).astype(np.int32))
+        out = m(ids)
+        assert out.value.dtype == jnp.bfloat16
+        loss = m.compute_loss(out, ids)
+        assert loss.value.dtype == jnp.float32
+
+    def test_fp32_params_match_fp32_compute_closely(self):
+        """param_dtype=fp32 + dtype=fp32 is exactly the fp32 model; the
+        bf16-compute variant must track it within bf16 tolerance."""
+        from paddle_tpu.models.llama import LlamaForCausalLM, LlamaConfig
+
+        def loss_of(dtype):
+            paddle.seed(3)
+            cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                              intermediate_size=64, num_hidden_layers=2,
+                              num_attention_heads=4, num_key_value_heads=2,
+                              max_position_embeddings=64, dtype=dtype,
+                              param_dtype="float32")
+            m = LlamaForCausalLM(cfg)
+            ids = paddle.to_tensor(np.random.RandomState(1).randint(
+                0, 64, (2, 16)).astype(np.int32))
+            return float(np.asarray(
+                m.compute_loss(m(ids), ids).value))
+
+        assert abs(loss_of("float32") - loss_of("bfloat16")) < 0.1
