@@ -310,12 +310,17 @@ class PipelineLayer(Layer):
     paddle_tpu.parallel.pipeline.PipelineEngine."""
 
     def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
-                 seg_method="uniform", recompute_interval=0, **kwargs):
+                 seg_method="uniform", recompute_interval=0,
+                 num_virtual_pipeline_stages=1, **kwargs):
         super().__init__()
         self.descs = layers
         self.loss_fn = loss_fn
         self._num_stages = num_stages or 1
         self._seg_method = seg_method
+        # interleaved VPP (reference pp_layers.py
+        # `get_stage_from_index` with _num_virtual_pipeline_stages):
+        # each physical stage holds this many non-contiguous model chunks
+        self._num_virtual_stages = int(num_virtual_pipeline_stages or 1)
         from ....nn import LayerList
         built = []
         shared_masters = {}
@@ -370,7 +375,10 @@ class PipelineParallel(_MetaParallelBase):
         if self._engine is None:
             from ....parallel.pipeline import PipelineEngine
             mesh = self._hcg.mesh if self._hcg is not None else None
-            self._engine = PipelineEngine(self._layers, mesh=mesh)
+            self._engine = PipelineEngine(
+                self._layers, mesh=mesh,
+                num_virtual_stages=getattr(self._layers,
+                                           "_num_virtual_stages", 1))
         return self._engine
 
     def forward_backward_pipeline(self, data, scaler=None):

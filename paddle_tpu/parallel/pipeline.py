@@ -2,29 +2,37 @@
 
 Reference: `python/paddle/distributed/fleet/meta_parallel/
 pipeline_parallel.py` (1F1B `forward_backward_pipeline:575`,
-`train_batch:820`, FThenB variant :2256), stage partitioning
-`parallel_layers/pp_layers.py`, P2P `pp_utils/p2p_communication.py:52`.
+`train_batch:820`, interleaved VPP `PipelineParallelWithInterleave:1174`,
+FThenB variant :2256), zero-bubble static pass
+`distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62`,
+stage partitioning `parallel_layers/pp_layers.py`, P2P
+`pp_utils/p2p_communication.py:52`.
 
 TPU-native redesign (single-controller SPMD — no NCCL send/recv ranks):
 
-* The `pp` axis of the hybrid mesh indexes **stage submeshes**.  Stage s's
-  parameters live on submesh s (remaining axes sep/sharding/dp/mp intact, so
-  PP composes with TP/DP/ZeRO inside each stage).
-* Each stage has two jitted programs: `fwd(params, bufs, x) -> y` and a
-  rematerializing `bwd(params, bufs, x, dy) -> (dparams, dx)` that recomputes
-  the stage forward inside the VJP (activation memory per in-flight
-  micro-batch = the stage INPUT only — the TPU-idiomatic remat analog of the
-  reference's `recompute_interval`).
-* "P2P" is `jax.device_put` of the activation onto the next stage's
+* The `pp` axis of the hybrid mesh indexes **stage submeshes**.  The model
+  is split into pp × vpp chunks (virtual stages); chunk v's parameters
+  live on submesh v % pp (remaining axes sep/sharding/dp/mp intact, so PP
+  composes with TP/DP/ZeRO inside each stage).  vpp > 1 is interleaved
+  VPP: each physical stage holds several non-contiguous model chunks.
+* Each chunk has jitted programs: `fwd(params, bufs, x) -> y`, a
+  rematerializing `bwd(params, bufs, x, dy) -> (dparams, dx)`, and — for
+  the zero-bubble schedules — SPLIT backwards `bwd_dx` (input grad only)
+  and `bwd_dw` (weight grad only), the B/W decomposition of ZB-H1.
+* "P2P" is `jax.device_put` of the activation onto the next chunk's
   submesh — compiled to ICI transfers by PJRT; no shape negotiation needed
   (shapes are static under jit, the SendRecvMeta machinery dissolves).
-* The host drives the schedule order; device queues run async, so stages
-  overlap exactly as the reference's NCCL streams do.
+* The host drives per-physical-stage op lists through a dependency-checked
+  dispatcher; device queues run async, so stages overlap exactly as the
+  reference's NCCL streams do.
 
-Schedules: FThenB and 1F1B (steady-state one-forward-one-backward with
-warmup pp-1-s forwards per stage), selected per train_batch.  Both are
-expressed as per-stage op lists merged by a dependency-driven dispatcher,
-which is also where interleaved/zero-bubble variants slot in later.
+Schedules (`schedule=` of train_batch / strategy schedule_mode):
+  FThenB       all forwards, then all backwards (peak in-flight = m)
+  1F1B         warmup/steady/cooldown (peak in-flight on stage s = pp-s)
+  VPP          Megatron interleaved 1F1B over virtual stages
+               (requires vpp > 1 and m % pp == 0)
+  ZB / ZB-H1   1F1B with backward split into B (dx) and W (dweight);
+               W ops are deferred to fill the cooldown bubble
 """
 from __future__ import annotations
 
@@ -75,10 +83,10 @@ def _tree_vals(x):
         is_leaf=lambda t: isinstance(t, Tensor))
 
 
-class _Stage:
-    """One pipeline stage: a contiguous slice of the PipelineLayer's
-    callables, its parameters placed on the stage submesh, and jitted
-    fwd / remat-bwd / loss programs."""
+class _Chunk:
+    """One virtual stage: a contiguous slice of the PipelineLayer's
+    callables, its parameters placed on the owning physical stage's
+    submesh, and jitted fwd / remat-bwd / split-bwd / loss programs."""
 
     def __init__(self, idx: int, callables: Sequence, submesh: Optional[Mesh],
                  loss_fn=None, is_last=False):
@@ -100,8 +108,12 @@ class _Stage:
         self._place_state()
         self._fwd = jax.jit(self._fwd_impl)
         self._bwd = jax.jit(self._bwd_impl)
+        self._bwd_dx = jax.jit(self._bwd_dx_impl)
+        self._bwd_dw = jax.jit(self._bwd_dw_impl)
         if is_last:
             self._loss_bwd = jax.jit(self._loss_bwd_impl)
+            self._loss_bwd_dx = jax.jit(self._loss_bwd_dx_impl)
+            self._loss_bwd_dw = jax.jit(self._loss_bwd_dw_impl)
 
     # -- placement --------------------------------------------------------
     def _placed(self, arr):
@@ -155,6 +167,23 @@ class _Stage:
         dparams, dx = vjp(dy)
         return dparams, dx
 
+    # zero-bubble B/W decomposition (reference pipeline_zero_bubble.py:62
+    # splits matmul_grad into dx and dw ops); here each half is its own
+    # rematerializing VJP and XLA dead-code-eliminates the unused output
+    def _bwd_dx_impl(self, param_vals, buf_vals, x, dy):
+        def f(xin):
+            return self._run(param_vals, buf_vals, xin)
+        _, vjp = jax.vjp(f, x)
+        (dx,) = vjp(dy)
+        return dx
+
+    def _bwd_dw_impl(self, param_vals, buf_vals, x, dy):
+        def f(pv):
+            return self._run(pv, buf_vals, x)
+        _, vjp = jax.vjp(f, list(param_vals))
+        (dparams,) = vjp(dy)
+        return dparams
+
     def _loss_of(self, param_vals, buf_vals, x, label):
         out = self._run(param_vals, buf_vals, x)
         loss = self.loss_fn(Tensor(out), Tensor(label))
@@ -167,6 +196,20 @@ class _Stage:
         dparams, dx = vjp(jnp.ones_like(loss))
         return loss, dparams, dx
 
+    def _loss_bwd_dx_impl(self, param_vals, buf_vals, x, label):
+        def f(xin):
+            return self._loss_of(param_vals, buf_vals, xin, label)
+        loss, vjp = jax.vjp(f, x)
+        (dx,) = vjp(jnp.ones_like(loss))
+        return loss, dx
+
+    def _loss_bwd_dw_impl(self, param_vals, buf_vals, x, label):
+        def f(pv):
+            return self._loss_of(pv, buf_vals, x, label)
+        loss, vjp = jax.vjp(f, list(param_vals))
+        (dparams,) = vjp(jnp.ones_like(loss))
+        return dparams
+
     # -- per-step state ----------------------------------------------------
     def begin_batch(self):
         self.param_vals = [self.local_overrides.get(i, p._value)
@@ -174,6 +217,7 @@ class _Stage:
         self.buf_vals = [b._value for b in self.buffers]
         self.grad_acc = None
         self.saved_x = {}
+        self.saved_dy = {}
         self.inbox = {}
         self.dy_inbox = {}
         self.losses = {}
@@ -184,49 +228,73 @@ class _Stage:
         else:
             self.grad_acc = [a + d for a, d in zip(self.grad_acc, dparams)]
 
+    def peak_in_flight(self):
+        return getattr(self, "_peak_saved", 0)
+
+    def note_in_flight(self):
+        self._peak_saved = max(getattr(self, "_peak_saved", 0),
+                               len(self.saved_x))
+
 
 class PipelineEngine:
-    """Drives the micro-batch schedule over the stages.
+    """Drives the micro-batch schedule over the virtual stages.
 
     Reference semantics: `train_batch` == forward_backward_pipeline + grad
     accumulation; the caller's optimizer step runs after (see
     PipelineParallel.train_batch which wraps both)."""
 
     def __init__(self, pipeline_layer, mesh: Optional[Mesh] = None,
-                 num_stages: Optional[int] = None, seg_method: str = None):
+                 num_stages: Optional[int] = None, seg_method: str = None,
+                 num_virtual_stages: int = 1):
         self.layer = pipeline_layer
         seg_method = seg_method or getattr(pipeline_layer, "_seg_method",
                                            "uniform")
+        vpp = num_virtual_stages \
+            or getattr(pipeline_layer, "_num_virtual_stages", 1)
         items = pipeline_layer.run_function
         if mesh is not None and "pp" in mesh.axis_names:
             pp = mesh.shape["pp"]
         else:
             pp = num_stages or pipeline_layer.get_num_stages()
-        self.num_stages = pp
+        self.pp = pp
+        self.vpp = max(1, int(vpp))
+        self.num_chunks = pp * self.vpp
+        if len(items) < self.num_chunks:
+            raise ValueError(
+                f"{len(items)} layers cannot fill {pp}x{self.vpp} chunks")
         if seg_method.startswith("param"):
             from ..nn import Layer
             weights = [sum(int(np.prod(p.shape)) for p in c.parameters())
                        if isinstance(c, Layer) else 0 for c in items]
-            bounds = partition_by_params(weights, pp)
+            bounds = partition_by_params(weights, self.num_chunks)
         else:
-            bounds = partition_uniform(len(items), pp)
+            bounds = partition_uniform(len(items), self.num_chunks)
         self.bounds = bounds
         self.mesh = mesh
         submeshes = self._submeshes(mesh, pp)
         loss_fn = pipeline_layer.loss_fn
-        self.stages = [
-            _Stage(s, items[bounds[s]:bounds[s + 1]], submeshes[s],
-                   loss_fn=loss_fn, is_last=(s == pp - 1))
-            for s in range(pp)]
+        self.chunks = [
+            _Chunk(v, items[bounds[v]:bounds[v + 1]], submeshes[v % pp],
+                   loss_fn=loss_fn, is_last=(v == self.num_chunks - 1))
+            for v in range(self.num_chunks)]
         self._shared_groups = self._find_shared()
-        # building later stages re-placed tied params onto their submesh;
-        # restore the master (first-stage) placement, then give non-master
-        # stages local copies
+        # building later chunks re-placed tied params onto their submesh;
+        # restore the master (first-chunk) placement, then give non-master
+        # chunks local copies
         for group in self._shared_groups:
             ms, mi = group[0]
-            st = self.stages[ms]
+            st = self.chunks[ms]
             st.params[mi]._value = st._placed(st.params[mi]._value)
         self._sync_shared_values()
+
+    # old name kept for introspection/tests
+    @property
+    def num_stages(self):
+        return self.pp
+
+    @property
+    def stages(self):
+        return self.chunks
 
     @staticmethod
     def _submeshes(mesh, pp):
@@ -242,23 +310,23 @@ class PipelineEngine:
         return out
 
     def _find_shared(self):
-        """Groups of (stage_idx, param_idx) positions holding the SAME
+        """Groups of (chunk_idx, param_idx) positions holding the SAME
         Parameter object (tied embeddings via SharedLayerDesc)."""
         groups = {}
-        for s, st in enumerate(self.stages):
+        for s, st in enumerate(self.chunks):
             for i, p in enumerate(st.params):
                 groups.setdefault(id(p), []).append((s, i))
         return [g for g in groups.values() if len(g) > 1]
 
     def _sync_shared_values(self):
-        """The master copy (first stage in the group) holds truth; refresh
-        the other stages' local placed copies (reference: broadcast in the
+        """The master copy (first chunk in the group) holds truth; refresh
+        the other chunks' local placed copies (reference: broadcast in the
         shared-weight comm group)."""
         for group in self._shared_groups:
             ms, mi = group[0]
-            master = self.stages[ms].params[mi]
+            master = self.chunks[ms].params[mi]
             for s, i in group[1:]:
-                st = self.stages[s]
+                st = self.chunks[s]
                 st.local_overrides[i] = st._placed(master._value)
 
     def train_batch(self, data, num_micro: int, schedule: str = "1F1B"):
@@ -272,29 +340,31 @@ class PipelineEngine:
         if xv.shape[0] % m:
             raise ValueError(
                 f"batch {xv.shape[0]} not divisible by micro-batches {m}")
+        sched = schedule.upper().replace("-", "").replace("_", "")
+        self._split_bwd = sched in ("ZB", "ZBH1", "ZEROBUBBLE")
         self._sync_shared_values()
         micro_x = jnp.split(xv, m)
         micro_y = jnp.split(yv, m)
-        stages = self.stages
-        pp = self.num_stages
-        for st in stages:
+        chunks = self.chunks
+        pp = self.pp
+        for st in chunks:
             st.begin_batch()
         for i in range(m):
-            stages[0].inbox[i] = stages[0].place_activation(micro_x[i])
-        labels = [stages[-1].place_activation(lb) for lb in micro_y]
+            chunks[0].inbox[i] = chunks[0].place_activation(micro_x[i])
+        labels = [chunks[-1].place_activation(lb) for lb in micro_y]
 
-        order = [self._stage_order(s, m, schedule) for s in range(pp)]
+        order = self._orders(m, schedule)
         done = set()
         idx = [0] * pp
         while any(idx[s] < len(order[s]) for s in range(pp)):
             progress = False
             for s in range(pp):
                 while idx[s] < len(order[s]):
-                    kind, i = order[s][idx[s]]
-                    if not self._ready(kind, s, i, done):
+                    kind, v, i = order[s][idx[s]]
+                    if not self._ready(kind, v, i, done):
                         break
-                    self._exec(kind, s, i, labels)
-                    done.add((kind, s, i))
+                    self._exec(kind, v, i, labels)
+                    done.add((kind, v, i))
                     idx[s] += 1
                     progress = True
             if not progress:
@@ -302,10 +372,10 @@ class PipelineEngine:
                     f"pipeline schedule deadlock at {done}")
 
         # write back grads (avg over micro-batches); a tied param seen in
-        # several stages gets the SUM of its per-stage grads, placed like
+        # several chunks gets the SUM of its per-chunk grads, placed like
         # the master (first-seen) copy
         grad_by_param = {}
-        for st in stages:
+        for st in chunks:
             for p, g in zip(st.params, st.grad_acc or []):
                 g = g / m
                 if id(p) in grad_by_param:
@@ -314,55 +384,142 @@ class PipelineEngine:
                 grad_by_param[id(p)] = (p, g)
         for p, g in grad_by_param.values():
             p.grad = Tensor(g)
-        losses = [stages[-1].losses[i] for i in range(m)]
+        losses = [chunks[-1].losses[i] for i in range(m)]
         return Tensor(sum(losses[1:], losses[0]) / m)
 
     def eval_batch(self, data, compute_loss=True):
-        """Forward-only pass through the stage programs (reference
+        """Forward-only pass through the chunk programs (reference
         pipeline_parallel.py eval_batch), activations hopping submeshes."""
         x, y = data
         xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
         self._sync_shared_values()
-        for st in self.stages:
+        for st in self.chunks:
             st.begin_batch()
-        a = self.stages[0].place_activation(xv)
-        for st in self.stages:
+        a = self.chunks[0].place_activation(xv)
+        for st in self.chunks:
             a = jax.tree_util.tree_map(st.place_activation, a)
             a = st._fwd(st.param_vals, st.buf_vals, a)
         out = jax.tree_util.tree_map(Tensor, a)
         if compute_loss and self.layer.loss_fn is not None:
-            last = self.stages[-1]
+            last = self.chunks[-1]
             yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
             return self.layer.loss_fn(out, Tensor(
                 last.place_activation(yv)))
         return out
 
-    def _stage_order(self, s, m, schedule):
-        if schedule.upper() in ("FTHENB", "F-THEN-B"):
-            return ([("f", i) for i in range(m)]
-                    + [("b", i) for i in range(m)])
-        # 1F1B (reference pipeline_parallel.py:575): warmup forwards, then
-        # steady one-forward-one-backward, then cooldown backwards.  Peak
-        # in-flight micro-batches on stage s = pp - s (vs m for FThenB).
-        warmup = min(self.num_stages - 1 - s, m)
-        order = [("f", i) for i in range(warmup)]
-        for k in range(m - warmup):
-            order.append(("f", warmup + k))
-            order.append(("b", k))
-        for i in range(m - warmup, m):
-            order.append(("b", i))
+    # -- schedules ---------------------------------------------------------
+    def _orders(self, m, schedule):
+        """Per-physical-stage op lists [(kind, chunk, micro), ...]."""
+        sched = schedule.upper().replace("-", "").replace("_", "")
+        if sched in ("VPP", "INTERLEAVE", "INTERLEAVED") \
+                or (sched == "1F1B" and self.vpp > 1):
+            return [self._interleaved_order(s, m) for s in range(self.pp)]
+        if self.vpp > 1 and sched != "FTHENB":
+            raise ValueError(
+                f"schedule {schedule} does not support vpp={self.vpp}")
+        if sched == "FTHENB":
+            return [self._fthenb_order(s, m) for s in range(self.pp)]
+        if sched in ("ZB", "ZBH1", "ZEROBUBBLE"):
+            return [self._zb_h1_order(s, m) for s in range(self.pp)]
+        if sched == "1F1B":
+            return [self._1f1b_order(s, m) for s in range(self.pp)]
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+
+    def _local_chunks(self, s):
+        return [c * self.pp + s for c in range(self.vpp)]
+
+    def _fthenb_order(self, s, m):
+        local = self._local_chunks(s)
+        order = [("f", v, i) for i in range(m) for v in local]
+        order += [("b", v, i) for i in range(m) for v in reversed(local)]
         return order
 
-    def _ready(self, kind, s, i, done):
+    def _1f1b_order(self, s, m):
+        # reference pipeline_parallel.py:575: warmup forwards, then
+        # steady one-forward-one-backward, then cooldown backwards.  Peak
+        # in-flight micro-batches on stage s = pp - s (vs m for FThenB).
+        v = s  # vpp == 1: chunk index == stage index
+        warmup = min(self.pp - 1 - s, m)
+        order = [("f", v, i) for i in range(warmup)]
+        for k in range(m - warmup):
+            order.append(("f", v, warmup + k))
+            order.append(("b", v, k))
+        for i in range(m - warmup, m):
+            order.append(("b", v, i))
+        return order
+
+    def _zb_h1_order(self, s, m):
+        """ZB-H1 (reference pipeline_zero_bubble.py:62): 1F1B with the
+        backward split into B (dx, on the critical path) and W (dweight,
+        fills bubbles).  W for micro k is deferred ~(pp-1-s) slots behind
+        its B, then flushed in the cooldown — the tail bubble that 1F1B
+        leaves on early stages is filled with weight-grad work."""
+        v = s
+        warmup = min(self.pp - 1 - s, m)
+        defer = self.pp - 1 - s
+        order = [("f", v, i) for i in range(warmup)]
+        wq = 0
+        for k in range(m - warmup):
+            order.append(("f", v, warmup + k))
+            order.append(("b", v, k))
+            if k >= defer:
+                order.append(("w", v, wq))
+                wq += 1
+        for i in range(m - warmup, m):
+            order.append(("b", v, i))
+            if wq <= i:
+                order.append(("w", v, wq))
+                wq += 1
+        while wq < m:
+            order.append(("w", v, wq))
+            wq += 1
+        return order
+
+    def _interleaved_order(self, s, m):
+        """Megatron-style interleaved VPP 1F1B (reference
+        PipelineParallelWithInterleave:1174): micro-batches advance in
+        groups of pp; within a group each rank cycles through its local
+        chunks.  Requires m % pp == 0."""
+        pp, vpp = self.pp, self.vpp
+        if m % pp:
+            raise ValueError(
+                f"interleaved VPP needs micro-batches ({m}) divisible by "
+                f"pp ({pp})")
+        total = m * vpp
+        group = pp * vpp
+
+        def f_op(k):
+            chunk = (k % group) // pp
+            micro = (k // group) * pp + (k % pp)
+            return ("f", chunk * pp + s, micro)
+
+        def b_op(j):
+            chunk = vpp - 1 - (j % group) // pp
+            micro = (j // group) * pp + (j % pp)
+            return ("b", chunk * pp + s, micro)
+
+        warmup = min((pp - s - 1) * 2 + (vpp - 1) * pp, total)
+        order = [f_op(k) for k in range(warmup)]
+        for t in range(total - warmup):
+            order.append(f_op(warmup + t))
+            order.append(b_op(t))
+        for j in range(total - warmup, total):
+            order.append(b_op(j))
+        return order
+
+    # -- dependency + execution -------------------------------------------
+    def _ready(self, kind, v, i, done):
         if kind == "f":
-            return s == 0 or ("f", s - 1, i) in done
-        deps_ok = ("f", s, i) in done
-        if s < self.num_stages - 1:
-            deps_ok = deps_ok and ("b", s + 1, i) in done
+            return v == 0 or ("f", v - 1, i) in done
+        if kind == "w":
+            return ("b", v, i) in done
+        deps_ok = ("f", v, i) in done
+        if v < self.num_chunks - 1:
+            deps_ok = deps_ok and ("b", v + 1, i) in done
         return deps_ok
 
-    def _exec(self, kind, s, i, labels):
-        st = self.stages[s]
+    def _exec(self, kind, v, i, labels):
+        st = self.chunks[v]
         if kind == "f":
             x = st.inbox[i]
             if st.is_last:
@@ -370,22 +527,49 @@ class PipelineEngine:
             else:
                 y = st._fwd(st.param_vals, st.buf_vals, x)
                 st.saved_x[i] = x
-                nxt = self.stages[s + 1]
+                nxt = self.chunks[v + 1]
                 nxt.inbox[i] = jax.tree_util.tree_map(
                     nxt.place_activation, y)
-        else:
+            st.note_in_flight()
+        elif kind == "b":
             if st.is_last:
-                loss, dparams, dx = st._loss_bwd(
-                    st.param_vals, st.buf_vals, st.saved_x.pop(i),
-                    labels[i])
+                loss, dparams_or_none, dx = self._last_bwd(st, i, labels)
                 st.losses[i] = loss
+                dparams = dparams_or_none
             else:
                 dy = st.dy_inbox.pop(i)
-                dparams, dx = st._bwd(st.param_vals, st.buf_vals,
-                                      st.saved_x.pop(i), dy)
-            st.accumulate(dparams)
-            if s > 0:
-                prev = self.stages[s - 1]
+                if self._split_bwd:
+                    dx = st._bwd_dx(st.param_vals, st.buf_vals,
+                                    st.saved_x[i], dy)
+                    st.saved_dy[i] = dy
+                    dparams = None
+                else:
+                    dparams, dx = st._bwd(st.param_vals, st.buf_vals,
+                                          st.saved_x.pop(i), dy)
+            if dparams is not None:
+                st.accumulate(dparams)
+            if v > 0:
+                prev = self.chunks[v - 1]
                 prev.dy_inbox[i] = jax.tree_util.tree_map(
                     prev.place_activation, dx)
             st.inbox.pop(i, None)
+        else:  # "w": deferred weight grad (zero-bubble)
+            x = st.saved_x.pop(i)
+            if st.is_last:
+                dparams = st._loss_bwd_dw(st.param_vals, st.buf_vals, x,
+                                          labels[i])
+            else:
+                dy = st.saved_dy.pop(i)
+                dparams = st._bwd_dw(st.param_vals, st.buf_vals, x, dy)
+            st.accumulate(dparams)
+
+    def _last_bwd(self, st, i, labels):
+        if self._split_bwd:
+            loss, dx = st._loss_bwd_dx(st.param_vals, st.buf_vals,
+                                       st.saved_x[i], labels[i])
+            return loss, None, dx
+        loss, dparams, dx = st._loss_bwd(st.param_vals, st.buf_vals,
+                                         st.saved_x.pop(i), labels[i])
+        return loss, dparams, dx
+
+    _split_bwd = False  # set per-train_batch by the schedule

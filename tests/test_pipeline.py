@@ -144,6 +144,21 @@ def test_pp_shared_embedding_tied():
     np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-5, atol=1e-6)
 
 
+def _peak_in_flight(order):
+    """Max simultaneously-held forward activations implied by an order
+    list: +1 per f, released by b (plain) or w (zero-bubble)."""
+    has_w = any(k == "w" for k, _, _ in order)
+    release = "w" if has_w else "b"
+    in_flight = peak = 0
+    for kind, _, _ in order:
+        if kind == "f":
+            in_flight += 1
+        elif kind == release:
+            in_flight -= 1
+        peak = max(peak, in_flight)
+    return peak
+
+
 def test_pp_1f1b_in_flight_bound():
     """1F1B order: stage 0 of a 4-stage pipeline never holds more than
     pp in-flight forwards (vs m for FThenB)."""
@@ -152,10 +167,159 @@ def test_pp_1f1b_in_flight_bound():
     pl = PipelineLayer(_make_descs(8, 3), loss_fn=_mse)
     eng = PipelineEngine(pl, mesh=hcg.mesh)
     m = 8
-    order = eng._stage_order(0, m, "1F1B")
-    in_flight = peak = 0
-    for kind, _ in order:
-        in_flight += 1 if kind == "f" else -1
-        peak = max(peak, in_flight)
-    assert peak == 4
-    assert [k for k, _ in eng._stage_order(0, m, "FThenB")].count("f") == m
+    assert _peak_in_flight(eng._1f1b_order(0, m)) == 4
+    assert [k for k, _, _ in eng._fthenb_order(0, m)].count("f") == m
+
+
+def test_pp_zb_h1_in_flight_bound():
+    """ZB-H1: W release lags B by at most pp-1-s slots, so peak in-flight
+    stays O(pp) — independent of m — while W work fills the tail."""
+    hcg = HybridCommunicateGroup(pp_degree=4)
+    set_hybrid_communicate_group(hcg)
+    pl = PipelineLayer(_make_descs(8, 3), loss_fn=_mse)
+    eng = PipelineEngine(pl, mesh=hcg.mesh)
+    m = 12
+    for s in range(4):
+        order = eng._zb_h1_order(s, m)
+        assert [k for k, _, _ in order].count("w") == m
+        assert _peak_in_flight(order) <= 2 * (4 - s), s
+
+
+def test_pp_interleaved_order_structure():
+    """VPP order: every (chunk, micro) f/b appears exactly once and the
+    in-flight bound stays below FThenB's m·vpp."""
+    hcg = HybridCommunicateGroup(pp_degree=2)
+    set_hybrid_communicate_group(hcg)
+    pl = PipelineLayer(_make_descs(8, 7), loss_fn=_mse)
+    eng = PipelineEngine(pl, mesh=hcg.mesh, num_virtual_stages=2)
+    m = 4
+    for s in range(2):
+        order = eng._interleaved_order(s, m)
+        fs = [(v, i) for k, v, i in order if k == "f"]
+        bs = [(v, i) for k, v, i in order if k == "b"]
+        want = {(c * 2 + s, i) for c in range(2) for i in range(m)}
+        assert set(fs) == want and len(fs) == len(want)
+        assert set(bs) == want and len(bs) == len(want)
+        assert _peak_in_flight(order) < m * 2
+
+
+@pytest.mark.parametrize("pp,vpp,micro,schedule", [
+    (2, 2, 4, "VPP"), (2, 2, 4, "FThenB"), (2, 1, 4, "ZB"),
+    (4, 1, 8, "ZB-H1"), (2, 3, 2, "VPP"),
+])
+def test_pp_schedules_match_single_device(pp, vpp, micro, schedule):
+    """Every schedule in the zoo reproduces the unpipelined loss
+    trajectory exactly (same init/data/optimizer)."""
+    d, depth, steps = 8, 5, 2
+    paddle.seed(42)
+    ref = PipelineLayer(_make_descs(d, depth), loss_fn=_mse)
+    paddle.seed(42)
+    pl = PipelineLayer(_make_descs(d, depth), loss_fn=_mse,
+                       num_virtual_pipeline_stages=vpp)
+
+    data = _data(d)
+    ref_losses = _train_ref(ref, data, steps)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs = {"accumulate_steps": micro,
+                                 "schedule_mode": schedule}
+    hcg = HybridCommunicateGroup(pp_degree=pp)
+    set_hybrid_communicate_group(hcg)
+    model = PipelineParallel(pl, hcg=hcg, strategy=strategy)
+    opt = paddle.optimizer.SGD(0.05, parameters=pl.parameters())
+    pp_losses = [float(np.asarray(
+        model.train_batch(data, opt).value)) for _ in range(steps)]
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-5, atol=1e-6)
+
+
+def test_pp_mp_composition():
+    """pp=2 × mp=2 (+ zb and vpp variants): tensor-parallel layers inside
+    pipeline stages; loss must match the single-device baseline."""
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    d = 8
+
+    class TPBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.col = ColumnParallelLinear(d, 2 * d, gather_output=False,
+                                            has_bias=True)
+            self.row = RowParallelLinear(2 * d, d, input_is_parallel=True)
+
+        def forward(self, x):
+            return self.row(nn.functional.gelu(self.col(x)))
+
+    class PlainBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.col = nn.Linear(d, 2 * d)
+            self.row = nn.Linear(2 * d, d)
+
+        def forward(self, x):
+            return self.row(nn.functional.gelu(self.col(x)))
+
+    def make(cls, vpp=1):
+        return PipelineLayer([LayerDesc(cls) for _ in range(4)],
+                             loss_fn=_mse,
+                             num_virtual_pipeline_stages=vpp)
+
+    data = _data(d)
+    paddle.seed(11)
+    ref = make(PlainBlock)
+    ref_losses = _train_ref(ref, data, 2)
+
+    for schedule, vpp in [("1F1B", 1), ("ZB", 1), ("VPP", 2)]:
+        hcg = HybridCommunicateGroup(pp_degree=2, mp_degree=2)
+        set_hybrid_communicate_group(hcg)
+        paddle.seed(11)
+        pl = make(TPBlock, vpp)
+        strategy = fleet.DistributedStrategy()
+        strategy.pipeline_configs = {"accumulate_steps": 2,
+                                     "schedule_mode": schedule}
+        model = PipelineParallel(pl, hcg=hcg, strategy=strategy)
+        opt = paddle.optimizer.SGD(0.05, parameters=pl.parameters())
+        losses = [float(np.asarray(
+            model.train_batch(data, opt).value)) for _ in range(2)]
+        np.testing.assert_allclose(losses, ref_losses, rtol=2e-5,
+                                   atol=1e-6, err_msg=schedule)
+
+
+def test_pp_eval_batch():
+    """eval_batch: forward-only over the stage programs matches the
+    unpipelined forward, with and without loss."""
+    d = 8
+    paddle.seed(5)
+    ref = PipelineLayer(_make_descs(d, 3), loss_fn=_mse)
+    paddle.seed(5)
+    pl = PipelineLayer(_make_descs(d, 3), loss_fn=_mse)
+    data = _data(d)
+    hcg = HybridCommunicateGroup(pp_degree=2)
+    set_hybrid_communicate_group(hcg)
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    model = PipelineParallel(pl, hcg=hcg, strategy=strategy)
+    x, y = data
+    want_out = ref(x)
+    want_loss = float(np.asarray(_mse(want_out, y).value))
+    got_loss = float(np.asarray(model.eval_batch(data).value))
+    np.testing.assert_allclose(got_loss, want_loss, rtol=2e-5)
+    out = model.eval_batch(data, compute_loss=False)
+    np.testing.assert_allclose(np.asarray(out.value),
+                               np.asarray(want_out.value), rtol=2e-5,
+                               atol=1e-6)
+
+
+def test_pp_deadlock_detection():
+    """A self-inconsistent order list must be reported as a deadlock, not
+    hang (parallel/pipeline.py dependency executor)."""
+    hcg = HybridCommunicateGroup(pp_degree=2)
+    set_hybrid_communicate_group(hcg)
+    pl = PipelineLayer(_make_descs(8, 3), loss_fn=_mse)
+    eng = PipelineEngine(pl, mesh=hcg.mesh)
+    # backward scheduled before its forward on every stage: never ready
+    eng._orders = lambda m, schedule: [
+        [("b", s, 0), ("f", s, 0)] for s in range(eng.pp)]
+    x, y = _data(8, batch=2)
+    with pytest.raises(RuntimeError, match="deadlock"):
+        eng.train_batch([x, y], 1, schedule="1F1B")
