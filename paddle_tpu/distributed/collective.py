@@ -1,14 +1,23 @@
 """Eager collective API.
 
 Reference: `python/paddle/distributed/communication/` (all_reduce.py:29
-etc → ProcessGroupNCCL).
+etc → ProcessGroup impls, `process_group.h:48` — every primitive on any
+group).
 
-TPU-native: collectives are COMPILED into programs.  The eager facades here
-exist for API/test parity: each builds a small jitted shard_map over the
-current mesh axis and applies it to the (replicated or sharded) array.  For
-single-device meshes they are identity — matching the reference's behavior
-for world_size=1.  Inside jitted SPMD code, use paddle_tpu ops directly;
-XLA emits the real psum/all_gather/... over ICI.
+TPU-native: DATA-plane collectives are COMPILED into programs (XLA emits
+psum/all_gather/… over ICI inside jit/shard_map).  The eager facades here
+exist for API parity, control-plane exchange and tests; they are
+group-correct:
+
+  - single process, world_size==1: identity, like the reference.
+  - multi-process under the repo launcher (PADDLE_KV_MASTER set): routed
+    through the KV-store host backend (`host_collectives.py`) scoped to
+    `group.ranks` — an mp-group allreduce reduces over exactly that
+    group, not the world.  src/dst args are GLOBAL ranks (reference
+    semantics) mapped to group indices here.
+  - multi-process with jax.distributed but no KV master: world-scoped
+    ops fall back to jax multihost utils; group-scoped ops require the
+    KV backend and say so.
 """
 from __future__ import annotations
 
@@ -19,6 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..framework.tensor import Tensor
 from .topology import Group, get_hybrid_communicate_group
+from .host_collectives import get_host_collectives, host_world
 
 __all__ = ["ReduceOp", "all_reduce", "all_gather", "reduce",
            "reduce_scatter", "broadcast", "scatter", "alltoall",
@@ -44,115 +54,225 @@ def new_group(ranks=None, backend=None, timeout=None):
     return g
 
 
-def _world_n(group):
-    hcg = get_hybrid_communicate_group()
-    if group is not None and group.nranks > 1:
-        return group.nranks
-    if hcg is not None:
-        return hcg.nranks
-    return 1
+def _multi() -> bool:
+    rank, world = host_world()
+    return world > 1 or jax.process_count() > 1
+
+
+def _backend(group, need_group_scope=True):
+    """Pick the eager backend: None (identity), the KV host backend, or
+    'jaxmh' (jax multihost utils, world-scope only)."""
+    if not _multi():
+        return None
+    hc = get_host_collectives()
+    if hc is not None:
+        return hc
+    if need_group_scope and group is not None \
+            and getattr(group, "ranks", None) \
+            and len(group.ranks) not in (0, jax.process_count()):
+        raise NotImplementedError(
+            "group-scoped eager collectives need the launcher KV store "
+            "(set PADDLE_KV_MASTER / run under "
+            "paddle_tpu.distributed.launch)")
+    return "jaxmh"
+
+
+
+
+def _group_local(group, rank):
+    """Reference semantics: src/dst are GLOBAL ranks, mapped to the
+    group-local index via group.get_group_rank (communication/
+    broadcast.py).  Groups without rank lists use the value as-is."""
+    ranks = list(getattr(group, "ranks", None) or []) if group else []
+    return ranks.index(rank) if rank in ranks else rank
+
+
+
+def _val(tensor):
+    return tensor.value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
 
 
 def _reduce_np(op, x, axis=0):
-    if op in (ReduceOp.SUM, "sum"):
+    op = str(getattr(op, "name", op)).lower().replace("reduceop.", "")
+    if op == "sum":
         return np.sum(x, axis=axis)
-    if op in (ReduceOp.MAX, "max"):
+    if op == "max":
         return np.max(x, axis=axis)
-    if op in (ReduceOp.MIN, "min"):
+    if op == "min":
         return np.min(x, axis=axis)
-    if op in (ReduceOp.PROD, "prod"):
+    if op in ("prod", "product"):
         return np.prod(x, axis=axis)
-    if op in (ReduceOp.AVG, "avg"):
+    if op == "avg":
         return np.mean(x, axis=axis)
     raise ValueError(op)
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    """world_size==1 (single controller): identity, like the reference.
-    Multi-host eager allreduce uses jax multihost collectives."""
-    n = jax.process_count()
-    if n <= 1:
+    be = _backend(group)
+    if be is None:
         return tensor
-    from jax.experimental import multihost_utils
-    v = multihost_utils.process_allgather(tensor.value)
-    tensor._value = jnp.asarray(_reduce_np(op, np.asarray(v), axis=0))
+    if be == "jaxmh":
+        from jax.experimental import multihost_utils
+        v = multihost_utils.process_allgather(_val(tensor))
+        tensor._value = jnp.asarray(_reduce_np(op, np.asarray(v), axis=0))
+        return tensor
+    out = be.all_reduce(np.asarray(_val(tensor)), op=op, group=group)
+    if out is not None:
+        tensor._value = jnp.asarray(out)
     return tensor
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
-    n = jax.process_count()
-    if n <= 1:
-        tensor_list.append(Tensor(tensor.value))
+    be = _backend(group)
+    if be is None:
+        tensor_list.append(Tensor(_val(tensor)))
         return tensor_list
-    from jax.experimental import multihost_utils
-    v = multihost_utils.process_allgather(tensor.value)
-    for i in range(v.shape[0]):
-        tensor_list.append(Tensor(jnp.asarray(v[i])))
+    if be == "jaxmh":
+        from jax.experimental import multihost_utils
+        v = multihost_utils.process_allgather(_val(tensor))
+        for i in range(v.shape[0]):
+            tensor_list.append(Tensor(jnp.asarray(v[i])))
+        return tensor_list
+    parts = be.all_gather(np.asarray(_val(tensor)), group=group)
+    for p in parts or []:
+        tensor_list.append(Tensor(jnp.asarray(p)))
     return tensor_list
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    return all_reduce(tensor, op, group, sync_op)
+    be = _backend(group)
+    if be is None:
+        return tensor
+    if be == "jaxmh":
+        return all_reduce(tensor, op, group, sync_op)
+    out = be.reduce(np.asarray(_val(tensor)),
+                    dst_group_rank=_group_local(group, dst), op=op,
+                    group=group)
+    if out is not None:
+        tensor._value = jnp.asarray(out)
+    return tensor
 
 
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
                    sync_op=True):
-    if jax.process_count() <= 1:
+    """tensor receives the reduced chunk for this rank; tensor_list is
+    this rank's per-destination contribution (reference
+    communication/reduce_scatter.py)."""
+    be = _backend(group)
+    if be is None:
         if tensor_list:
-            tensor._value = tensor_list[0].value
+            tensor._value = _val(tensor_list[0])
         return tensor
-    raise NotImplementedError("eager multi-host reduce_scatter: use the "
-                              "compiled path (shard_map) instead")
+    contrib = np.concatenate(
+        [np.asarray(_val(t)) for t in tensor_list]) if tensor_list \
+        else np.asarray(_val(tensor))
+    if be == "jaxmh":
+        be = get_host_collectives()
+        if be is None:
+            raise NotImplementedError(
+                "eager multi-host reduce_scatter needs the launcher KV "
+                "store (PADDLE_MASTER)")
+    out = be.reduce_scatter(contrib, op=op, group=group)
+    if out is not None:
+        tensor._value = jnp.asarray(out)
+    return tensor
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    n = jax.process_count()
-    if n <= 1:
+    be = _backend(group)
+    if be is None:
         return tensor
-    from jax.experimental import multihost_utils
-    tensor._value = multihost_utils.broadcast_one_to_all(tensor.value)
+    if be == "jaxmh":
+        from jax.experimental import multihost_utils
+        tensor._value = multihost_utils.broadcast_one_to_all(_val(tensor))
+        return tensor
+    out = be.broadcast(np.asarray(_val(tensor)),
+                       src_group_rank=_group_local(group, src),
+                       group=group)
+    if out is not None:
+        tensor._value = jnp.asarray(out)
     return tensor
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    if jax.process_count() <= 1:
+    be = _backend(group)
+    if be is None:
         if tensor_list:
-            tensor._value = tensor_list[0].value
+            tensor._value = _val(tensor_list[0])
         return tensor
-    raise NotImplementedError
+    if be == "jaxmh":
+        be = get_host_collectives()
+        if be is None:
+            raise NotImplementedError(
+                "eager multi-host scatter needs the launcher KV store")
+    arrs = [np.asarray(_val(t)) for t in (tensor_list or [])]
+    out = be.scatter(arrs, src_group_rank=_group_local(group, src),
+                     group=group)
+    if out is not None:
+        tensor._value = jnp.asarray(out)
+    return tensor
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
-    if jax.process_count() <= 1:
-        outs = [Tensor(t.value) for t in in_tensor_list]
+    be = _backend(group)
+    if be is None:
+        outs = [Tensor(_val(t)) for t in in_tensor_list]
         if out_tensor_list is not None:
             out_tensor_list.extend(outs)
             return out_tensor_list
         return outs
-    raise NotImplementedError
+    if be == "jaxmh":
+        be = get_host_collectives()
+        if be is None:
+            raise NotImplementedError(
+                "eager multi-host alltoall needs the launcher KV store")
+    parts = be.alltoall([np.asarray(_val(t)) for t in in_tensor_list],
+                        group=group)
+    outs = [Tensor(jnp.asarray(p)) for p in (parts or [])]
+    if out_tensor_list is not None:
+        out_tensor_list.extend(outs)
+        return out_tensor_list
+    return outs
 
 
 all_to_all = alltoall
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    if jax.process_count() <= 1:
+    be = _backend(group, need_group_scope=False)
+    if be is None:
         return tensor
-    raise NotImplementedError("host-level send/recv lands with the "
-                              "pipeline transfer server")
+    if be == "jaxmh":
+        be = get_host_collectives()
+        if be is None:
+            raise NotImplementedError(
+                "eager host send/recv needs the launcher KV store")
+    be.send(np.asarray(_val(tensor)), dst=dst)
+    return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    if jax.process_count() <= 1:
+    be = _backend(group, need_group_scope=False)
+    if be is None:
         return tensor
-    raise NotImplementedError
+    if be == "jaxmh":
+        be = get_host_collectives()
+        if be is None:
+            raise NotImplementedError(
+                "eager host send/recv needs the launcher KV store")
+    tensor._value = jnp.asarray(be.recv(src=src))
+    return tensor
 
 
 def barrier(group=None):
-    if jax.process_count() <= 1:
+    be = _backend(group)
+    if be is None:
         return
-    from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    if be == "jaxmh":
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+        return
+    be.barrier(group=group)
 
 
 def wait(tensor, group=None, use_calc_stream=True):

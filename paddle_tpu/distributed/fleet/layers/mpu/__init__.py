@@ -1,0 +1,2 @@
+from .mp_ops import (_c_identity, _c_concat, _c_split, _mp_allreduce,
+                     split)  # noqa: F401

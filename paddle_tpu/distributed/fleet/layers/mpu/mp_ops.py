@@ -1,0 +1,167 @@
+"""Eager tensor-parallel communication primitives.
+
+Reference: `python/paddle/distributed/fleet/layers/mpu/mp_ops.py` —
+`_c_identity:91` (fwd identity / bwd mp-allreduce), `_c_concat:134`
+(fwd mp allgather-concat / bwd take own slice), `_c_split:196` (fwd take
+own slice / bwd allgather-concat), `_mp_allreduce:293` (fwd allreduce /
+bwd identity), `paddle.distributed.split:706`.
+
+TPU-native: inside jit/shard_map these dissolve into GSPMD collectives;
+the eager forms exist for dygraph parity and run over the group-correct
+eager collective API (identity in a single-controller world of size 1,
+KV-store host collectives under the multi-process launcher).  Autograd
+rides PyLayer so the forward/backward collective pairing matches the
+reference exactly.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import PyLayer
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.distributed.topology import get_hybrid_communicate_group
+
+__all__ = ["_c_identity", "_c_concat", "_c_split", "_mp_allreduce",
+           "split"]
+
+
+def _mp_group(group):
+    if group is not None:
+        return group
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_model_parallel_group() if hcg is not None else None
+
+
+def _group_size(group):
+    return getattr(group, "nranks", 1) or 1
+
+
+def _group_rank(group):
+    """This process's rank within the group (0 single-controller)."""
+    from paddle_tpu.distributed.env import get_rank
+    ranks = list(getattr(group, "ranks", None) or [])
+    me = get_rank()
+    return ranks.index(me) if me in ranks else 0
+
+
+def _allreduce_value(value, group):
+    from paddle_tpu.distributed import collective
+    t = Tensor(value)
+    collective.all_reduce(t, group=group)
+    return t.value
+
+
+def _allgather_concat_value(value, group, axis=-1):
+    from paddle_tpu.distributed import collective
+    parts: list = []
+    collective.all_gather(parts, Tensor(value), group=group)
+    if not parts:
+        return value
+    return jnp.concatenate([p.value for p in parts], axis=axis)
+
+
+class _CIdentity(PyLayer):
+    @staticmethod
+    def forward(ctx, x, group=None):
+        ctx.group = group
+        return Tensor(x.value)
+
+    @staticmethod
+    def backward(ctx, dy):
+        return Tensor(_allreduce_value(dy.value, ctx.group))
+
+
+class _MpAllreduce(PyLayer):
+    @staticmethod
+    def forward(ctx, x, group=None):
+        return Tensor(_allreduce_value(x.value, group))
+
+    @staticmethod
+    def backward(ctx, dy):
+        return dy
+
+
+class _CSplit(PyLayer):
+    @staticmethod
+    def forward(ctx, x, group=None):
+        ctx.group = group
+        n = _group_size(group)
+        if n <= 1:
+            return Tensor(x.value)
+        r = _group_rank(group)
+        chunk = x.shape[-1] // n
+        return Tensor(x.value[..., r * chunk:(r + 1) * chunk])
+
+    @staticmethod
+    def backward(ctx, dy):
+        if _group_size(ctx.group) <= 1:
+            return dy
+        return Tensor(_allgather_concat_value(dy.value, ctx.group))
+
+
+class _CConcat(PyLayer):
+    @staticmethod
+    def forward(ctx, x, group=None):
+        ctx.group = group
+        if _group_size(group) <= 1:
+            return Tensor(x.value)
+        return Tensor(_allgather_concat_value(x.value, group))
+
+    @staticmethod
+    def backward(ctx, dy):
+        n = _group_size(ctx.group)
+        if n <= 1:
+            return dy
+        r = _group_rank(ctx.group)
+        chunk = dy.shape[-1] // n
+        return Tensor(dy.value[..., r * chunk:(r + 1) * chunk])
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    """Forward identity; backward allreduces the gradient over the mp
+    group (the entry op of a column-parallel layer)."""
+    return _CIdentity.apply(tensor, group=_mp_group(group))
+
+
+def _mp_allreduce(tensor, op=None, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    """Forward allreduce over the mp group; backward identity (the exit
+    op of a row-parallel layer)."""
+    return _MpAllreduce.apply(tensor, group=_mp_group(group))
+
+
+def _c_split(tensor, group=None):
+    """Take this rank's slice of the last dim; backward allgathers."""
+    return _CSplit.apply(tensor, group=_mp_group(group))
+
+
+def _c_concat(tensor, group=None):
+    """Allgather-concat the last dim; backward takes this rank's slice."""
+    return _CConcat.apply(tensor, group=_mp_group(group))
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Reference: mp_ops.py:706 `paddle.distributed.split` — build a
+    row/column-parallel linear or vocab-parallel embedding in one call."""
+    from paddle_tpu.distributed.fleet.meta_parallel import (ColumnParallelLinear,
+                                        RowParallelLinear,
+                                        VocabParallelEmbedding)
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            layer = RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False)
+        else:
+            layer = ColumnParallelLinear(in_f, out_f,
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        vocab, emb = size
+        layer = VocabParallelEmbedding(vocab, emb, weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation!r}")

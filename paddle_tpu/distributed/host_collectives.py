@@ -1,0 +1,260 @@
+"""Host-level eager collectives over the launcher's KV store.
+
+Reference: the reference's eager ProcessGroup family
+(`paddle/phi/core/distributed/collective/process_group.h:48` — 11
+primitives, any group) with its Gloo CPU backend
+(`fluid/distributed/collective/process_group_gloo.cc`) used for
+control-plane exchanges.
+
+TPU-native split: DATA-plane collectives are compiled into programs (XLA
+psum/all_gather over ICI — see SURVEY §5.8); what remains host-side is
+the control plane: metadata exchange, eager API parity, small-tensor
+sync, tests.  Those ride the SAME HTTP KV store the launcher already
+runs for rendezvous (`launch/master.py`), so no extra service exists.
+
+Every process in the group must issue the same sequence of collectives
+per group (the standard SPMD eager contract); a per-(group, op) sequence
+counter keys each round.  Values are base64-encoded numpy buffers.
+"""
+from __future__ import annotations
+
+import base64
+import io
+import os
+import time
+from collections import defaultdict
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["KVCollectives", "get_host_collectives", "host_world"]
+
+
+def _encode(arr: np.ndarray) -> str:
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+def _decode(s: str) -> np.ndarray:
+    return np.load(io.BytesIO(base64.b64decode(s)), allow_pickle=False)
+
+
+class KVCollectives:
+    """Eager collectives for `world` processes rendezvoused on the
+    launcher's KV store (PADDLE_MASTER)."""
+
+    def __init__(self, endpoint: str, rank: int, world: int,
+                 timeout: float = 60.0):
+        from .launch.master import KVClient
+        self.kv = KVClient(endpoint if "://" in endpoint
+                           else f"http://{endpoint}")
+        self.rank = int(rank)
+        self.world = int(world)
+        self.timeout = timeout
+        self._seq = defaultdict(int)
+        # keys this rank wrote, per (op, gid) round — deleted two rounds
+        # later (any rank entering round s proves every rank finished
+        # round s-1, so round s-2's keys can no longer be read)
+        self._mine = defaultdict(dict)
+
+    # -- plumbing ----------------------------------------------------------
+    def _ranks(self, group) -> List[int]:
+        if group is None:
+            return list(range(self.world))
+        ranks = list(getattr(group, "ranks", None) or [])
+        return ranks if ranks else list(range(self.world))
+
+    def _round_key(self, op: str, ranks: Sequence[int]) -> str:
+        gid = "-".join(map(str, ranks))
+        seq = self._seq[(op, gid)]
+        self._seq[(op, gid)] += 1
+        self._gc((op, gid), seq)
+        return f"coll/{op}/{gid}/{seq}"
+
+    def _note_written(self, op: str, ranks: Sequence[int], seq_key: str,
+                      keys) -> None:
+        gid = "-".join(map(str, ranks))
+        seq = int(seq_key.rsplit("/", 1)[-1])
+        self._mine[(op, gid)][seq] = list(keys)
+
+    def _gc(self, opgid, current_seq) -> None:
+        """Delete this rank's payloads from rounds ≤ current-2 (safe: a
+        rank can only reach round s after every rank finished s-1)."""
+        mine = self._mine.get(opgid, {})
+        for s in [s for s in mine if s <= current_seq - 2]:
+            for k in mine.pop(s):
+                try:
+                    self.kv.delete(k)
+                except Exception:
+                    pass
+
+    def _wait(self, prefix: str, n: int) -> dict:
+        got = self.kv.wait_n(prefix, n, timeout=self.timeout)
+        if len(got) < n:
+            raise TimeoutError(
+                f"collective {prefix}: {len(got)}/{n} peers after "
+                f"{self.timeout}s")
+        return got
+
+    def _exchange(self, op: str, arr: np.ndarray, group) -> Optional[dict]:
+        """Publish this rank's array; wait for the whole group.  Returns
+        {group_rank: array} or None if this rank is not in the group."""
+        ranks = self._ranks(group)
+        if self.rank not in ranks:
+            self._seq[(op, "-".join(map(str, ranks)))] += 1
+            return None
+        key = self._round_key(op, ranks)
+        me = ranks.index(self.rank)
+        self.kv.put(f"{key}/{me}", _encode(arr))
+        self._note_written(op, ranks, key, [f"{key}/{me}"])
+        got = self._wait(key, len(ranks))
+        return {int(k.rsplit("/", 1)[-1]): _decode(v)
+                for k, v in got.items()}
+
+    # -- primitives --------------------------------------------------------
+    def all_gather(self, arr, group=None) -> Optional[List[np.ndarray]]:
+        got = self._exchange("ag", np.asarray(arr), group)
+        if got is None:
+            return None
+        return [got[i] for i in range(len(got))]
+
+    def all_reduce(self, arr, op="sum", group=None) -> Optional[np.ndarray]:
+        parts = self.all_gather(arr, group)
+        if parts is None:
+            return None
+        return _reduce(op, np.stack(parts))
+
+    def reduce(self, arr, dst_group_rank=0, op="sum", group=None):
+        out = self.all_reduce(arr, op, group)
+        if out is None:
+            return None
+        ranks = self._ranks(group)
+        return out if ranks.index(self.rank) == dst_group_rank \
+            else np.asarray(arr)
+
+    def reduce_scatter(self, arr, op="sum", group=None):
+        """arr: this rank's full contribution; returns the reduced chunk
+        for this rank (dim 0 split evenly across the group)."""
+        parts = self.all_gather(arr, group)
+        if parts is None:
+            return None
+        ranks = self._ranks(group)
+        red = _reduce(op, np.stack(parts))
+        chunks = np.split(red, len(ranks), axis=0)
+        return chunks[ranks.index(self.rank)]
+
+    def broadcast(self, arr, src_group_rank=0, group=None):
+        ranks = self._ranks(group)
+        if self.rank not in ranks:
+            self._seq[("bc", "-".join(map(str, ranks)))] += 1
+            return None
+        key = self._round_key("bc", ranks)
+        me = ranks.index(self.rank)
+        if me == src_group_rank:
+            self.kv.put(f"{key}/src", _encode(np.asarray(arr)))
+            self._note_written("bc", ranks, key, [f"{key}/src"])
+            return np.asarray(arr)
+        got = self._wait(key, 1)
+        return _decode(next(iter(got.values())))
+
+    def scatter(self, arrs, src_group_rank=0, group=None):
+        """src provides a list (one array per group rank); each rank gets
+        its element."""
+        ranks = self._ranks(group)
+        if self.rank not in ranks:
+            self._seq[("sc", "-".join(map(str, ranks)))] += 1
+            return None
+        key = self._round_key("sc", ranks)
+        me = ranks.index(self.rank)
+        if me == src_group_rank:
+            for i, a in enumerate(arrs):
+                self.kv.put(f"{key}/{i}", _encode(np.asarray(a)))
+            self._note_written("sc", ranks, key,
+                               [f"{key}/{i}" for i in range(len(arrs))])
+            return np.asarray(arrs[me])
+        got = self.kv.wait_n(key, len(ranks), timeout=self.timeout)
+        if f"{key}/{me}" not in got:
+            raise TimeoutError(f"scatter {key}: rank {me} item missing")
+        return _decode(got[f"{key}/{me}"])
+
+    def alltoall(self, arrs, group=None):
+        """arrs[j] goes to group rank j; returns [arr from rank 0, ...]."""
+        ranks = self._ranks(group)
+        if self.rank not in ranks:
+            self._seq[("a2a", "-".join(map(str, ranks)))] += 1
+            return None
+        key = self._round_key("a2a", ranks)
+        me = ranks.index(self.rank)
+        for j, a in enumerate(arrs):
+            self.kv.put(f"{key}/{me}.{j}", _encode(np.asarray(a)))
+        self._note_written("a2a", ranks, key,
+                           [f"{key}/{me}.{j}" for j in range(len(arrs))])
+        need = len(ranks) * len(ranks)
+        got = self._wait(key, need)
+        return [_decode(got[f"{key}/{j}.{me}"]) for j in range(len(ranks))]
+
+    def send(self, arr, dst: int, tag: str = ""):
+        seq = self._seq[("p2p", dst, tag)]
+        self._seq[("p2p", dst, tag)] += 1
+        self.kv.put(f"coll/p2p/{self.rank}.{dst}.{tag}/{seq}",
+                    _encode(np.asarray(arr)))
+
+    def recv(self, src: int, tag: str = ""):
+        seq = self._seq[("p2p-r", src, tag)]
+        self._seq[("p2p-r", src, tag)] += 1
+        key = f"coll/p2p/{src}.{self.rank}.{tag}"
+        deadline = time.time() + self.timeout
+        while time.time() < deadline:
+            v = self.kv.get(f"{key}/{seq}")
+            if v is not None:
+                # single consumer: the message is ours to reclaim
+                try:
+                    self.kv.delete(f"{key}/{seq}")
+                except Exception:
+                    pass
+                return _decode(v)
+            time.sleep(0.02)
+        raise TimeoutError(f"recv from {src} (tag={tag!r}, seq={seq})")
+
+    def barrier(self, group=None):
+        self._exchange("bar", np.zeros(1, np.int8), group)
+
+
+def _reduce(op, stacked):
+    op = getattr(op, "name", op)
+    op = str(op).lower().replace("reduceop.", "")
+    if op in ("sum", "avg"):
+        out = np.sum(stacked, axis=0)
+        return out / stacked.shape[0] if op == "avg" else out
+    if op == "max":
+        return np.max(stacked, axis=0)
+    if op == "min":
+        return np.min(stacked, axis=0)
+    if op in ("prod", "product"):
+        return np.prod(stacked, axis=0)
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def host_world():
+    """(rank, world) of the host-process group from the launcher env."""
+    return (int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+
+
+_instance: Optional[KVCollectives] = None
+
+
+def get_host_collectives() -> Optional[KVCollectives]:
+    """The process-wide KV collective backend, constructed on first use
+    from the launcher env (PADDLE_MASTER + PADDLE_TRAINER_ID/NUM); None
+    when not running under a multi-process launch."""
+    global _instance
+    if _instance is not None:
+        return _instance
+    rank, world = host_world()
+    master = os.environ.get("PADDLE_KV_MASTER")
+    if world <= 1 or not master:
+        return None
+    _instance = KVCollectives(master, rank, world)
+    return _instance

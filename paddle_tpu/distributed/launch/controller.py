@@ -147,6 +147,17 @@ class CollectiveController:
             self.node_rank, self.peers = 0, [f"{_this_host()}:0"]
             self.coordinator = None
             self.world_nodes = 1
+            # single-node jobs still get a local KV store: the eager
+            # host collectives (host_collectives.py) and any control-
+            # plane exchange ride it via PADDLE_KV_MASTER (distinct from
+            # PADDLE_MASTER, which names the jax.distributed gRPC
+            # coordinator on multi-node runs)
+            try:
+                self.master_server = KVServer(0).start()
+                self.kv_endpoint = \
+                    f"127.0.0.1:{self.master_server.port}"
+            except OSError:
+                self.kv_endpoint = None
             return
         if not a.master:
             raise ValueError("--master is required when nnodes > 1")
@@ -287,6 +298,12 @@ class CollectiveController:
         if self.coordinator:
             env["PADDLE_MASTER"] = self.coordinator
             env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(self.peers)
+        # the HTTP KV store backing host-level eager collectives: the
+        # job master on multi-node runs, the local server on single-node
+        kv_ep = getattr(self, "kv_endpoint", None) \
+            or (self.args.master if self.args.master else None)
+        if kv_ep:
+            env["PADDLE_KV_MASTER"] = kv_ep
         if a.devices:
             env["TPU_VISIBLE_DEVICES"] = a.devices
         return env

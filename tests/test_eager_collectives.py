@@ -1,0 +1,199 @@
+"""Group-correct eager collectives across real processes.
+
+Reference test pattern: test/collective/* — spawn N local processes with
+fake-cluster env and compare collective results against numpy (SURVEY
+§4).  Here the processes are launched through the repo's OWN launcher
+(paddle_tpu.distributed.launch), and the collectives ride the launcher's
+KV store (the control-plane backend, host_collectives.py).
+
+The key assertion (VERDICT round-2 #4): an mp-GROUP allreduce must
+reduce over exactly the group — NOT the world — and both must match
+numpy.
+"""
+import json
+import os
+import textwrap
+
+import numpy as np
+
+from paddle_tpu.distributed.launch import parse_args, CollectiveController
+
+WORKER = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import json
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.topology import HybridCommunicateGroup
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+
+# 4 processes as a dp=2 x mp=2 grid: mp groups [0,1] and [2,3]
+hcg = HybridCommunicateGroup(dp_degree=2, mp_degree=2)
+mp_group = hcg.get_model_parallel_group()
+dp_group = hcg.get_data_parallel_group()
+
+x = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+world = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+
+dist.all_reduce(x, group=mp_group)
+dist.all_reduce(world)
+
+gathered = []
+dist.all_gather(gathered, paddle.to_tensor(
+    np.array([float(rank)], np.float32)), group=dp_group)
+
+# reduce_scatter over the world: rank r gets the reduced r-th chunk
+rs = paddle.to_tensor(np.zeros((1,), np.float32))
+dist.reduce_scatter(rs, [paddle.to_tensor(
+    np.array([float(rank * 10 + j)], np.float32)) for j in range(4)])
+
+# alltoall over the mp group
+a2a = dist.alltoall([paddle.to_tensor(
+    np.array([float(rank * 100 + j)], np.float32)) for j in range(2)],
+    group=mp_group)
+
+# broadcast within the mp group from GLOBAL rank (dp*2 + 1): the src arg
+# is a global rank per reference semantics, mapped to the group index
+bsrc = (rank // 2) * 2 + 1
+bc = paddle.to_tensor(np.full((2,), float(rank), np.float32))
+dist.broadcast(bc, src=bsrc, group=mp_group)
+
+# p2p ring: send to (rank+1) % 4, recv from (rank-1) % 4
+dist.send(paddle.to_tensor(np.array([float(rank)], np.float32)),
+          dst=(rank + 1) % 4)
+pr = paddle.to_tensor(np.zeros((1,), np.float32))
+dist.recv(pr, src=(rank - 1) % 4)
+
+out = {
+    "rank": rank,
+    "mp_ranks": mp_group.ranks,
+    "mp_allreduce": np.asarray(x.value).tolist(),
+    "world_allreduce": np.asarray(world.value).tolist(),
+    "dp_gather": [float(np.asarray(t.value)[0]) for t in gathered],
+    "reduce_scatter": np.asarray(rs.value).tolist(),
+    "alltoall": [float(np.asarray(t.value)[0]) for t in a2a],
+    "broadcast": np.asarray(bc.value).tolist(),
+    "p2p_recv": float(np.asarray(pr.value)[0]),
+    "stage_ranks": [hcg.get_data_parallel_rank(),
+                    hcg.get_model_parallel_rank()],
+}
+with open(os.path.join(os.environ["DUMP_DIR"], f"out.{rank}.json"),
+          "w") as f:
+    json.dump(out, f)
+"""
+
+
+def test_group_scoped_collectives_4proc(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(WORKER))
+    os.environ["DUMP_DIR"] = str(tmp_path)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.environ["PYTHONPATH"] = repo + os.pathsep \
+        + os.environ.get("PYTHONPATH", "")
+    try:
+        args = parse_args([
+            "--nproc_per_node=4", f"--log_dir={tmp_path}/log",
+            "--job_id=coll", str(script)])
+        rc = CollectiveController(args).run()
+    finally:
+        del os.environ["DUMP_DIR"]
+    assert rc == 0
+    outs = {}
+    for r in range(4):
+        with open(tmp_path / f"out.{r}.json") as f:
+            outs[r] = json.load(f)
+
+    # mesh order (pp, sep, sharding, dp, mp): rank = dp*2 + mp
+    # mp groups: [0,1] (dp=0) and [2,3] (dp=1)
+    assert outs[0]["mp_ranks"] == [0, 1]
+    assert outs[2]["mp_ranks"] == [2, 3]
+
+    # mp allreduce: group [0,1] -> 1+2 = 3; group [2,3] -> 3+4 = 7
+    for r in (0, 1):
+        assert outs[r]["mp_allreduce"] == [3.0] * 4, outs[r]
+    for r in (2, 3):
+        assert outs[r]["mp_allreduce"] == [7.0] * 4, outs[r]
+    # world allreduce: 1+2+3+4 = 10 — DIFFERENT from the group result
+    for r in range(4):
+        assert outs[r]["world_allreduce"] == [10.0] * 4
+
+    # dp groups: [0,2] (mp=0) and [1,3] (mp=1); gather collects dp peers
+    assert outs[0]["dp_gather"] == [0.0, 2.0]
+    assert outs[1]["dp_gather"] == [1.0, 3.0]
+
+    # world reduce_scatter: chunk j = sum_r (r*10 + j)
+    for r in range(4):
+        want = sum(rr * 10 + r for rr in range(4))
+        assert outs[r]["reduce_scatter"] == [float(want)]
+
+    # mp alltoall: rank r gets [peer*100 + my_group_index for each peer]
+    assert outs[0]["alltoall"] == [0.0, 100.0]
+    assert outs[1]["alltoall"] == [1.0, 101.0]
+    assert outs[2]["alltoall"] == [200.0, 300.0]
+    assert outs[3]["alltoall"] == [201.0, 301.0]
+
+    # broadcast from global rank 1 in group [0,1], global 3 in [2,3]
+    for r in (0, 1):
+        assert outs[r]["broadcast"] == [1.0, 1.0]
+    for r in (2, 3):
+        assert outs[r]["broadcast"] == [3.0, 3.0]
+
+    # p2p ring
+    for r in range(4):
+        assert outs[r]["p2p_recv"] == float((r - 1) % 4)
+
+    # rank getters derive from the process coordinate (VERDICT #5 weak)
+    assert outs[3]["stage_ranks"] == [1, 1]
+    assert outs[1]["stage_ranks"] == [0, 1]
+
+
+class TestMpOpsEager:
+    """TP eager prims (reference mp_ops.py:91-293): world size 1 —
+    forward identities with the reference's fwd/bwd collective pairing."""
+
+    def test_c_identity_bwd_allreduce(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.fleet.layers.mpu import _c_identity
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        x.stop_gradient = False
+        out = _c_identity(x)
+        np.testing.assert_allclose(np.asarray(out.value),
+                                   np.asarray(x.value))
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.value),
+                                   np.ones((2, 4)))
+
+    def test_mp_allreduce_bwd_identity(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.fleet.layers.mpu import _mp_allreduce
+        x = paddle.to_tensor(np.full((3,), 2.0, np.float32))
+        x.stop_gradient = False
+        out = _mp_allreduce(x)
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.value), np.ones(3))
+
+    def test_c_split_concat_roundtrip(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.fleet.layers.mpu import (_c_split,
+                                                             _c_concat)
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(2, 4))
+        x.stop_gradient = False
+        out = _c_concat(_c_split(x))
+        np.testing.assert_allclose(np.asarray(out.value),
+                                   np.asarray(x.value))
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.value),
+                                   np.ones((2, 4)))
+
+    def test_distributed_split_linear(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.fleet.layers.mpu import split
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        out = split(x, (8, 6), "linear", axis=1, gather_out=True)
+        assert tuple(out.shape) == (2, 6)
