@@ -1,0 +1,23 @@
+"""Distributed-strategy auto-tuner.
+
+Reference: `python/paddle/distributed/auto_tuner/` — `tuner.py`
+(AutoTuner driving a search algo), `search.py` (grid over the candidate
+space), `prune.py` (registered prune rules over divisibility/memory/
+history), `memory_cost_model.py` (interface only — raises
+NotImplementedError in the reference!), launching a real trial run per
+surviving candidate.
+
+TPU-native redesign: trial runs are replaced by an ANALYTIC pass —
+a per-chip HBM model (params/grads/optimizer/activations as a function
+of dp/mp/pp/vpp/sharding-stage/micro-bs/recompute) prunes infeasible
+points, and a roofline cost model (MXU flops + HBM traffic + ICI
+collective volumes + pipeline bubble) ranks the rest — plus an optional
+compile check of the top candidates on a virtual CPU mesh through the
+real ShardedTrainStep (the XLA-is-the-executor analog of the reference's
+trial launches).
+"""
+from .tuner import AutoTuner, tune  # noqa: F401
+from .search import GridSearch  # noqa: F401
+from . import prune  # noqa: F401
+from .memory_model import estimate_memory_bytes  # noqa: F401
+from .cost_model import estimate_step_time  # noqa: F401

@@ -1,0 +1,101 @@
+"""Roofline step-time estimate for a (model, strategy) point.
+
+Reference: `auto_tuner/cost_model.py` — a coarse single-card flops/s
+model scaled by degrees.  TPU version: MXU compute time + HBM optimizer
+traffic + ICI collective volume + pipeline bubble + recompute overhead,
+per chip, using published per-generation peaks.
+"""
+from __future__ import annotations
+
+__all__ = ["estimate_step_time", "CHIP_SPECS"]
+
+# bf16 matmul peak (FLOP/s), HBM BW (B/s), per-link ICI BW (B/s, one dir)
+CHIP_SPECS = {
+    "v4": (275e12, 1.2e12, 50e9),
+    "v5e": (197e12, 0.82e12, 50e9),
+    "v5p": (459e12, 2.77e12, 100e9),
+    "v6e": (918e12, 1.64e12, 90e9),
+}
+
+
+def _model_flops_per_token(m) -> float:
+    """Dense-decoder fwd matmul flops/token (2·MAC), incl causal attn."""
+    h, i = m["hidden_size"], m["intermediate_size"]
+    nh = m.get("num_attention_heads", 1)
+    nkv = m.get("num_key_value_heads", nh)
+    hd = h // nh
+    seq = m["seq_len"]
+    L = m["num_hidden_layers"]
+    per_layer = (2 * h * nh * hd + 4 * h * nkv * hd   # q, k+v
+                 + 2 * nh * hd * h                    # o
+                 + 2 * seq * nh * hd                  # causal attn
+                 + 6 * h * i)                         # mlp
+    lm_head = 2 * h * m["vocab_size"]
+    return L * per_layer + lm_head
+
+
+def estimate_step_time(model_cfg: dict, strategy: dict,
+                       global_batch_size: int, chip: str = "v5p",
+                       mfu_assumption: float = 0.6) -> float:
+    """Seconds per optimizer step on `chip`, for dp×mp×pp×sharding chips."""
+    m, s = model_cfg, strategy
+    peak, hbm_bw, ici_bw = CHIP_SPECS.get(chip, CHIP_SPECS["v5p"])
+    dp = s.get("dp", 1)
+    mp = s.get("mp", 1)
+    pp = s.get("pp", 1)
+    vpp = s.get("vpp", 1)
+    shard = s.get("sharding", 1)
+    stage = s.get("sharding_stage", 0)
+    micro = s.get("micro_batch_size", 1)
+    rec = s.get("recompute", "none")
+    seq = m["seq_len"]
+
+    data_ways = dp * shard
+    tokens_per_step = global_batch_size * seq
+    tokens_per_chip = tokens_per_step / data_ways
+
+    fwd = _model_flops_per_token(m)
+    # recompute replay flops (matches llama.py granularities)
+    h, i = m["hidden_size"], m["intermediate_size"]
+    nh = m.get("num_attention_heads", 1)
+    hd = h // nh
+    L = m["num_hidden_layers"]
+    replay = {"none": 0.0,
+              "selective": L * (2 * seq * nh * hd + 4 * h * i),
+              "full": fwd}[rec]
+    total_flops = (3 * fwd + replay) * tokens_per_chip / (mp * pp)
+    compute = total_flops / (peak * mfu_assumption)
+
+    # optimizer + grad HBM traffic (fp32 params-as-master + bf16 moments)
+    from .memory_model import _layer_param_count, _embedding_param_count
+    n_params = (L * _layer_param_count(m)
+                + _embedding_param_count(m)) / (mp * pp)
+    opt_traffic = n_params / max(1, shard if stage >= 1 else 1) * 20.0
+    hbm = opt_traffic / hbm_bw
+
+    # collectives over ICI (per chip, per step):
+    #   dp/sharding grad reduction: 2·(n-1)/n · bytes(grads)
+    #   ZeRO-3 param allgathers: fwd + bwd re-gather
+    grad_bytes = n_params * 4.0
+    overlappable = 0.0   # hideable behind the backward (XLA overlaps
+    exposed = 0.0        # async collectives with compute); mp traffic
+    # sits on the layer critical path and p2p on stage boundaries
+    if data_ways > 1:
+        overlappable += 2 * grad_bytes * (data_ways - 1) / data_ways
+    if stage >= 3 and shard > 1:
+        overlappable += 2 * n_params * 2.0 * (shard - 1) / shard
+    if mp > 1:
+        # per-layer fwd+bwd activation allreduces (2 each) on mp group
+        act_bytes = tokens_per_chip * h * 2.0
+        exposed += 4 * L * act_bytes * (mp - 1) / mp / pp
+    if pp > 1:
+        exposed += 2 * tokens_per_chip * h * 2.0  # stage p2p fwd+bwd
+    comm = exposed / ici_bw \
+        + max(0.0, overlappable / ici_bw - 0.7 * compute)
+
+    # pipeline bubble: (pp-1) / (micro_count · vpp) of the compute
+    micro_count = max(1, tokens_per_chip // max(1, micro * seq))
+    bubble = compute * (pp - 1) / max(1, micro_count * vpp) if pp > 1 \
+        else 0.0
+
+    return compute + hbm + comm + bubble

@@ -1,0 +1,120 @@
+"""Per-chip HBM estimate for a (model, strategy) point.
+
+Reference: `auto_tuner/memory_cost_model.py` declares exactly this
+interface (strategy + model args -> bytes) but leaves the body
+NotImplementedError; the real pruning there happens by OOM-ing trial
+runs.  Here the estimate is computed so infeasible points never run.
+
+Model assumptions (dense decoder, llama-shaped — the reference tuner's
+target family): weights 4h²(1+kv/h)… per layer via explicit terms, AdamW
+two moments, ZeRO sharding over the `sharding` axis, TP over `mp`,
+stages over `pp`, activation footprint per recompute granularity
+matching models/llama.py's selective policy.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["estimate_memory_bytes", "MemoryBreakdown"]
+
+
+@dataclass
+class MemoryBreakdown:
+    params: float
+    grads: float
+    optimizer: float
+    activations: float
+    workspace: float
+
+    @property
+    def total(self):
+        # grads and activations don't fully coexist: grads accumulate as
+        # the backward frees activations — peak is the larger plus a
+        # fraction of the smaller (backward-start vs backward-end)
+        transient = (max(self.grads, self.activations)
+                     + 0.15 * min(self.grads, self.activations))
+        return self.params + self.optimizer + transient + self.workspace
+
+
+def _layer_param_count(m) -> float:
+    h, i = m["hidden_size"], m["intermediate_size"]
+    nh = m["num_attention_heads"]
+    nkv = m.get("num_key_value_heads", nh)
+    hd = h // nh
+    attn = h * nh * hd + 2 * h * nkv * hd + nh * hd * h
+    mlp = 3 * h * i
+    norms = 2 * h
+    return attn + mlp + norms
+
+
+def _embedding_param_count(m) -> float:
+    tied = m.get("tie_word_embeddings", False)
+    n = m["vocab_size"] * m["hidden_size"]
+    return n if tied else 2 * n
+
+
+def estimate_memory_bytes(model_cfg: dict, strategy: dict,
+                          dtype_bytes: float = 4.0,
+                          moment_bytes: float = 2.0,
+                          compute_bytes: float = 2.0) -> MemoryBreakdown:
+    """Bytes per chip.  model_cfg: hidden_size/intermediate_size/
+    num_hidden_layers/num_attention_heads/[num_key_value_heads]/
+    vocab_size/seq_len.  strategy: dp/mp/pp/vpp/sharding/sharding_stage/
+    micro_batch_size/recompute ('none'|'selective'|'full').
+
+    dtype_bytes: parameter storage (4 = fp32 params-as-master, the
+    bench scheme; 2+4 master handled by passing 6).  moment_bytes: per
+    AdamW moment.  compute_bytes: activation dtype.
+    """
+    m = model_cfg
+    s = strategy
+    L = m["num_hidden_layers"]
+    h = m["hidden_size"]
+    i = m["intermediate_size"]
+    nh = m["num_attention_heads"]
+    nkv = m.get("num_key_value_heads", nh)
+    hd = h // nh
+    seq = m["seq_len"]
+    mp = s.get("mp", 1)
+    pp = s.get("pp", 1)
+    shard = s.get("sharding", 1)
+    stage = s.get("sharding_stage", 0)
+    micro = s.get("micro_batch_size", 1)
+    rec = s.get("recompute", "none")
+
+    layers_here = L / pp
+    p_layer = _layer_param_count(m) / mp
+    p_embed = _embedding_param_count(m) / mp / (1 if pp == 1 else pp)
+    n_local = layers_here * p_layer + p_embed
+
+    shard_p = shard if stage >= 3 else 1
+    shard_o = shard if stage >= 1 else 1
+    shard_g = shard if stage >= 2 else 1
+    params = n_local * dtype_bytes / shard_p
+    grads = n_local * dtype_bytes / shard_g
+    optimizer = n_local * 2 * moment_bytes / shard_o
+
+    # activation elements per token per layer (matches llama.py's saved
+    # sets; TP divides the head/intermediate terms)
+    full_save = (4 * h                      # x, normed1, x_mid, normed2
+                 + (nh + 2 * nkv) * hd / mp  # q, k, v post-rope
+                 + h / mp                   # attn out (pre-o-proj)
+                 + 3 * i / mp)              # gate, up, swiglu
+    selective = (2 * h                      # x boundary, x_mid
+                 + (nh + 2 * nkv) * hd / mp
+                 + h / mp)
+    boundary = h
+    per_tok = {"none": full_save, "selective": selective,
+               "full": boundary}[rec]
+    # in-flight micro-batches on a pipeline stage ~ pp (1F1B warmup)
+    in_flight = min(pp, max(1, pp))
+    tokens = micro * seq * in_flight
+    activations = tokens * layers_here * per_tok * compute_bytes
+    # logits + loss softmax in fp32 on the last stage
+    logits = micro * seq * m["vocab_size"] * 4.0 * 1.5
+    activations += logits / max(1, pp)
+
+    workspace = 0.5e9  # XLA scratch/fusion headroom (empirical)
+    return MemoryBreakdown(params=params, grads=grads,
+                           optimizer=optimizer, activations=activations,
+                           workspace=workspace)

@@ -1,0 +1,97 @@
+"""Registered prune rules over strategy candidates.
+
+Reference: `auto_tuner/prune.py` — `register_prune` decorated rules
+(`prune_by_mp:129`, `prune_by_pp:173`, `prune_by_mbs:307`,
+`prune_by_sharding:395`, memory-estimate rules) returning True when a
+candidate must be discarded.  Same registry shape here; the memory rule
+uses the real HBM model instead of OOM-ing trial runs.
+"""
+from __future__ import annotations
+
+_PRUNE_RULES = []
+
+__all__ = ["register_prune", "prune_candidate", "_PRUNE_RULES"]
+
+
+def register_prune(fn):
+    _PRUNE_RULES.append(fn)
+    return fn
+
+
+def prune_candidate(tuner_cfg: dict, cand: dict):
+    """Returns a reason string if any rule rejects `cand`, else None."""
+    for rule in _PRUNE_RULES:
+        reason = rule(tuner_cfg, cand)
+        if reason:
+            return reason
+    return None
+
+
+@register_prune
+def prune_by_device_product(tuner_cfg, c):
+    n = tuner_cfg["n_devices"]
+    used = c["dp"] * c["mp"] * c["pp"] * c["sharding"]
+    if used != n:
+        return f"dp*mp*pp*sharding={used} != n_devices={n}"
+
+
+@register_prune
+def prune_by_mp(tuner_cfg, c):
+    m = tuner_cfg["model_cfg"]
+    mp = c["mp"]
+    if mp > tuner_cfg.get("mp_limit", 8):
+        return "mp above limit (ICI-neighbor collectives)"
+    if m["num_attention_heads"] % mp:
+        return "heads % mp != 0"
+    kv = m.get("num_key_value_heads", m["num_attention_heads"])
+    if kv % mp and mp % kv:
+        return "kv heads not partitionable by mp"
+    if m["hidden_size"] % mp or m["intermediate_size"] % mp:
+        return "hidden/intermediate % mp != 0"
+    if m["vocab_size"] % mp:
+        return "vocab % mp != 0"
+
+
+@register_prune
+def prune_by_pp(tuner_cfg, c):
+    m = tuner_cfg["model_cfg"]
+    if m["num_hidden_layers"] % (c["pp"] * c.get("vpp", 1)):
+        return "layers % (pp*vpp) != 0"
+    if c.get("vpp", 1) > 1 and c["pp"] == 1:
+        return "vpp without pp"
+
+
+@register_prune
+def prune_by_mbs(tuner_cfg, c):
+    gbs = tuner_cfg["global_batch_size"]
+    data_ways = c["dp"] * c["sharding"]
+    if gbs % data_ways:
+        return "global batch % (dp*sharding) != 0"
+    local = gbs // data_ways
+    if local % c["micro_batch_size"]:
+        return "local batch % micro != 0"
+    if c["pp"] > 1:
+        micros = local // c["micro_batch_size"]
+        if c.get("vpp", 1) > 1 and micros % c["pp"]:
+            return "interleaved VPP needs micros % pp == 0"
+
+
+@register_prune
+def prune_by_sharding(tuner_cfg, c):
+    if c["sharding"] == 1 and c["sharding_stage"] > 0:
+        return "sharding stage without sharding degree"
+    if c["sharding"] > 1 and c["sharding_stage"] == 0:
+        return "sharding degree without stage"
+
+
+@register_prune
+def prune_by_memory(tuner_cfg, c):
+    from .memory_model import estimate_memory_bytes
+    hbm = tuner_cfg.get("hbm_bytes", 16e9)
+    est = estimate_memory_bytes(
+        dict(tuner_cfg["model_cfg"]), c,
+        dtype_bytes=tuner_cfg.get("param_bytes", 4.0),
+        moment_bytes=tuner_cfg.get("moment_bytes", 2.0))
+    if est.total > hbm * tuner_cfg.get("memory_fraction", 0.95):
+        return (f"estimated {est.total/1e9:.1f}G > "
+                f"{hbm/1e9:.0f}G HBM")

@@ -1,0 +1,48 @@
+"""Candidate enumeration (reference: auto_tuner/search.py GridSearch +
+utils.default_candidates)."""
+from __future__ import annotations
+
+from itertools import product
+
+__all__ = ["GridSearch", "default_candidates"]
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(tuner_cfg: dict) -> dict:
+    """Axis candidates from the device count + model shape (reference
+    utils.default_candidates)."""
+    n = tuner_cfg["n_devices"]
+    m = tuner_cfg["model_cfg"]
+    L = m["num_hidden_layers"]
+    gbs = tuner_cfg["global_batch_size"]
+    return {
+        "dp": _divisors(n),
+        "mp": [d for d in _divisors(n)
+               if d <= tuner_cfg.get("mp_limit", 8)],
+        "pp": [d for d in _divisors(n) if L % d == 0],
+        "vpp": [v for v in (1, 2, 3, 4) if L % v == 0],
+        "sharding": _divisors(n),
+        "sharding_stage": [0, 1, 2, 3],
+        "micro_batch_size": [b for b in (1, 2, 4, 8, 16) if b <= gbs],
+        "recompute": ["none", "selective", "full"],
+    }
+
+
+class GridSearch:
+    """Exhaustive product of the candidate axes, pruned lazily
+    (reference search.py GridSearch.search_once)."""
+
+    AXES = ("dp", "mp", "pp", "vpp", "sharding", "sharding_stage",
+            "micro_batch_size", "recompute")
+
+    def __init__(self, tuner_cfg: dict):
+        self.tuner_cfg = tuner_cfg
+        cands = tuner_cfg.get("candidates") or default_candidates(tuner_cfg)
+        self._iter = product(*(cands[a] for a in self.AXES))
+
+    def __iter__(self):
+        for values in self._iter:
+            yield dict(zip(self.AXES, values))
