@@ -59,19 +59,22 @@ def _multi() -> bool:
     return world > 1 or jax.process_count() > 1
 
 
-def _backend(group, need_group_scope=True):
+def _backend(group, need_group_scope=True, jaxmh_ok=True):
     """Pick the eager backend: None (identity), the KV host backend, or
-    'jaxmh' (jax multihost utils, world-scope only)."""
+    'jaxmh' (jax multihost utils, world-scope only).  Ops with no jax
+    multihost implementation pass jaxmh_ok=False and fail here with the
+    actionable message instead of at five call sites."""
     if not _multi():
         return None
     hc = get_host_collectives()
     if hc is not None:
         return hc
-    if need_group_scope and group is not None \
-            and getattr(group, "ranks", None) \
-            and len(group.ranks) not in (0, jax.process_count()):
+    group_scoped = (need_group_scope and group is not None
+                    and getattr(group, "ranks", None)
+                    and len(group.ranks) not in (0, jax.process_count()))
+    if group_scoped or not jaxmh_ok:
         raise NotImplementedError(
-            "group-scoped eager collectives need the launcher KV store "
+            "this eager collective needs the launcher KV store "
             "(set PADDLE_KV_MASTER / run under "
             "paddle_tpu.distributed.launch)")
     return "jaxmh"
@@ -158,7 +161,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     """tensor receives the reduced chunk for this rank; tensor_list is
     this rank's per-destination contribution (reference
     communication/reduce_scatter.py)."""
-    be = _backend(group)
+    be = _backend(group, jaxmh_ok=False)
     if be is None:
         if tensor_list:
             tensor._value = _val(tensor_list[0])
@@ -166,12 +169,6 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     contrib = np.concatenate(
         [np.asarray(_val(t)) for t in tensor_list]) if tensor_list \
         else np.asarray(_val(tensor))
-    if be == "jaxmh":
-        be = get_host_collectives()
-        if be is None:
-            raise NotImplementedError(
-                "eager multi-host reduce_scatter needs the launcher KV "
-                "store (PADDLE_MASTER)")
     out = be.reduce_scatter(contrib, op=op, group=group)
     if out is not None:
         tensor._value = jnp.asarray(out)
@@ -195,16 +192,11 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    be = _backend(group)
+    be = _backend(group, jaxmh_ok=False)
     if be is None:
         if tensor_list:
             tensor._value = _val(tensor_list[0])
         return tensor
-    if be == "jaxmh":
-        be = get_host_collectives()
-        if be is None:
-            raise NotImplementedError(
-                "eager multi-host scatter needs the launcher KV store")
     arrs = [np.asarray(_val(t)) for t in (tensor_list or [])]
     out = be.scatter(arrs, src_group_rank=_group_local(group, src),
                      group=group)
@@ -214,18 +206,13 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
-    be = _backend(group)
+    be = _backend(group, jaxmh_ok=False)
     if be is None:
         outs = [Tensor(_val(t)) for t in in_tensor_list]
         if out_tensor_list is not None:
             out_tensor_list.extend(outs)
             return out_tensor_list
         return outs
-    if be == "jaxmh":
-        be = get_host_collectives()
-        if be is None:
-            raise NotImplementedError(
-                "eager multi-host alltoall needs the launcher KV store")
     parts = be.alltoall([np.asarray(_val(t)) for t in in_tensor_list],
                         group=group)
     outs = [Tensor(jnp.asarray(p)) for p in (parts or [])]
@@ -239,27 +226,17 @@ all_to_all = alltoall
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    be = _backend(group, need_group_scope=False)
+    be = _backend(group, need_group_scope=False, jaxmh_ok=False)
     if be is None:
         return tensor
-    if be == "jaxmh":
-        be = get_host_collectives()
-        if be is None:
-            raise NotImplementedError(
-                "eager host send/recv needs the launcher KV store")
     be.send(np.asarray(_val(tensor)), dst=dst)
     return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    be = _backend(group, need_group_scope=False)
+    be = _backend(group, need_group_scope=False, jaxmh_ok=False)
     if be is None:
         return tensor
-    if be == "jaxmh":
-        be = get_host_collectives()
-        if be is None:
-            raise NotImplementedError(
-                "eager host send/recv needs the launcher KV store")
     tensor._value = jnp.asarray(be.recv(src=src))
     return tensor
 

@@ -73,24 +73,38 @@ class KVCollectives:
         return f"coll/{op}/{gid}/{seq}"
 
     def _note_written(self, op: str, ranks: Sequence[int], seq_key: str,
-                      keys) -> None:
+                      keys, ack_need: int = 0) -> None:
         gid = "-".join(map(str, ranks))
         seq = int(seq_key.rsplit("/", 1)[-1])
-        self._mine[(op, gid)][seq] = list(keys)
+        self._mine[(op, gid)][seq] = (list(keys), seq_key, ack_need)
 
     def _gc(self, opgid, current_seq) -> None:
-        """Delete this rank's payloads from rounds ≤ current-2 (safe: a
-        rank can only reach round s after every rank finished s-1)."""
+        """Delete this rank's payloads from rounds ≤ current-2.  Safe
+        for all-to-all-style ops because a rank can only reach round s
+        after every rank finished s-1; broadcast/scatter sources never
+        wait, so their rounds carry receiver acks and are only reclaimed
+        once every receiver acked (retained otherwise)."""
         mine = self._mine.get(opgid, {})
         for s in [s for s in mine if s <= current_seq - 2]:
-            for k in mine.pop(s):
+            keys, seq_key, ack_need = mine[s]
+            if ack_need:
+                try:
+                    acked = len(self.kv.prefix(f"{seq_key}/ack"))
+                except Exception:
+                    acked = 0
+                if acked < ack_need:
+                    continue  # a receiver may still be reading: retain
+            mine.pop(s)
+            for k in keys:
                 try:
                     self.kv.delete(k)
                 except Exception:
                     pass
 
     def _wait(self, prefix: str, n: int) -> dict:
-        got = self.kv.wait_n(prefix, n, timeout=self.timeout)
+        from .watchdog import watched
+        with watched(f"host collective {prefix}"):
+            got = self.kv.wait_n(prefix, n, timeout=self.timeout)
         if len(got) < n:
             raise TimeoutError(
                 f"collective {prefix}: {len(got)}/{n} peers after "
@@ -152,11 +166,14 @@ class KVCollectives:
         key = self._round_key("bc", ranks)
         me = ranks.index(self.rank)
         if me == src_group_rank:
-            self.kv.put(f"{key}/src", _encode(np.asarray(arr)))
-            self._note_written("bc", ranks, key, [f"{key}/src"])
+            self.kv.put(f"{key}/src/0", _encode(np.asarray(arr)))
+            self._note_written("bc", ranks, key, [f"{key}/src/0"],
+                               ack_need=len(ranks) - 1)
             return np.asarray(arr)
-        got = self._wait(key, 1)
-        return _decode(next(iter(got.values())))
+        got = self._wait(f"{key}/src", 1)
+        out = _decode(next(iter(got.values())))
+        self.kv.stamp(f"{key}/ack/{me}")
+        return out
 
     def scatter(self, arrs, src_group_rank=0, group=None):
         """src provides a list (one array per group rank); each rank gets
@@ -169,14 +186,19 @@ class KVCollectives:
         me = ranks.index(self.rank)
         if me == src_group_rank:
             for i, a in enumerate(arrs):
-                self.kv.put(f"{key}/{i}", _encode(np.asarray(a)))
-            self._note_written("sc", ranks, key,
-                               [f"{key}/{i}" for i in range(len(arrs))])
+                self.kv.put(f"{key}/item/{i}", _encode(np.asarray(a)))
+            self._note_written(
+                "sc", ranks, key,
+                [f"{key}/item/{i}" for i in range(len(arrs))],
+                ack_need=len(ranks) - 1)
             return np.asarray(arrs[me])
-        got = self.kv.wait_n(key, len(ranks), timeout=self.timeout)
-        if f"{key}/{me}" not in got:
+        got = self.kv.wait_n(f"{key}/item", len(ranks),
+                             timeout=self.timeout)
+        if f"{key}/item/{me}" not in got:
             raise TimeoutError(f"scatter {key}: rank {me} item missing")
-        return _decode(got[f"{key}/{me}"])
+        out = _decode(got[f"{key}/item/{me}"])
+        self.kv.stamp(f"{key}/ack/{me}")
+        return out
 
     def alltoall(self, arrs, group=None):
         """arrs[j] goes to group rank j; returns [arr from rank 0, ...]."""

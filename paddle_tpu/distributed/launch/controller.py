@@ -33,6 +33,10 @@ __all__ = ["CollectiveController", "ProcEntry"]
 HEARTBEAT_INTERVAL = 2.0
 HEARTBEAT_TTL = 10.0
 ELASTIC_SETTLE = 2.0   # absorb late joiners up to nnodes_max for this long
+# reference fleet/elastic/manager.py:33 — a child exiting with this code
+# asks the launcher to re-form the gang instead of counting a failure
+ELASTIC_EXIT_CODE = 101
+SCALE_CHECK_INTERVAL = 5.0
 
 
 class ProcEntry:
@@ -87,6 +91,9 @@ class CollectiveController:
         self.job_id = args.job_id
         self.restarts = 0
         self.world_nodes = args.nnodes
+        self.order = []
+        self.epoch = 0
+        self._scale_events = 0
         self.procs: list[ProcEntry] = []
         self.master_server = None  # KVServer if this node hosts it
         self.kv = None             # KVClient if multi-node
@@ -214,7 +221,11 @@ class CollectiveController:
         # rejected.
         commit_key = f"{self.job_id}/commit"
         committed = None
-        commit_deadline = time.time() + max(30, ELASTIC_SETTLE * 5)
+        # elastic jobs: a late joiner keeps its registration visible and
+        # waits for the running gang to re-form around it (scale-out)
+        commit_deadline = time.time() + (
+            a.elastic_timeout if self._is_elastic()
+            else max(30, ELASTIC_SETTLE * 5))
         while committed is None:
             raw = self.kv.get(commit_key)
             if raw:
@@ -250,7 +261,8 @@ class CollectiveController:
             if order and order[0] == my_key:
                 payload = {"order": order,
                            "peers": [live[k]["endpoint"] for k in order],
-                           "pods": [live[k]["pod"] for k in order]}
+                           "pods": [live[k]["pod"] for k in order],
+                           "epoch": 0}
                 if self.kv.put_new(commit_key, json.dumps(payload)):
                     committed = payload
                     break
@@ -265,6 +277,8 @@ class CollectiveController:
                 self.kv.put(my_key, my_val)
                 live[my_key] = my_rec
         order = committed["order"]
+        self.order = order
+        self.epoch = int(committed.get("epoch", 0))
         self.peers = committed["peers"]
         self.peer_pods = committed["pods"]
         self.node_rank = order.index(my_key)
@@ -276,6 +290,96 @@ class CollectiveController:
                 "ranks or a mix of explicit and auto-assigned ranks")
         # node 0's registered endpoint doubles as jax coordinator
         self.coordinator = self.peers[0]
+
+    # ---------------- elastic re-form ----------------
+
+    def _is_elastic(self):
+        a = self.args
+        return getattr(a, "nnodes_max", a.nnodes) \
+            > getattr(a, "nnodes_min", a.nnodes)
+
+    def _reform(self, reason: str) -> bool:
+        """Scale event (reference fleet/elastic/manager.py:125): kill the
+        local procs, re-elect membership among the CURRENT live pods
+        (scale-in drops lapsed leases, scale-out admits new
+        registrations up to nnodes_max), bump the commit epoch, rewrite
+        endpoints and relaunch.  Returns False when the job can no
+        longer meet nnodes_min."""
+        a = self.args
+        print(f"[launch] elastic re-form: {reason}", file=sys.stderr)
+        # tell the other launchers (a local 101 exit or locally-observed
+        # lease lapse is invisible to them); their watch loops poll this
+        self.kv.put(f"{self.job_id}/scale_request", str(self.epoch))
+        for p in self.procs:
+            p.terminate()
+        deadline = time.time() + a.elastic_timeout
+        settle = None
+        while True:
+            if time.time() > deadline:
+                print(f"[launch] re-form failed: quorum below "
+                      f"nnodes_min={a.nnodes_min} for "
+                      f"{a.elastic_timeout}s", file=sys.stderr)
+                return False
+            live = self._live_pods()
+            if self.my_key not in live:
+                self.kv.put(self.my_key, json.dumps(
+                    {"endpoint": self.peers[self.node_rank],
+                     "pod": self.pod_id}))
+                time.sleep(0.2)
+                continue
+            if len(live) >= a.nnodes_max:
+                break
+            if len(live) >= a.nnodes_min:
+                settle = settle or time.time() + ELASTIC_SETTLE
+                if time.time() >= settle:
+                    break
+            else:
+                settle = None
+            time.sleep(0.2)
+        order = sorted(live)[: a.nnodes_max]
+        new_epoch = self.epoch + 1
+        # epoch-keyed put-if-absent: two pods with diverging snapshots
+        # race to commit, exactly one wins, both adopt the winner
+        epoch_key = f"{self.job_id}/commit@{new_epoch}"
+        if order[0] == self.my_key:
+            payload = {"order": order,
+                       "peers": [live[k]["endpoint"] for k in order],
+                       "pods": [live[k]["pod"] for k in order],
+                       "epoch": new_epoch}
+            self.kv.put_new(epoch_key, json.dumps(payload))
+        committed = None
+        cdl = time.time() + a.elastic_timeout
+        while committed is None:
+            raw = self.kv.get(epoch_key)
+            if raw:
+                c = json.loads(raw)
+                if self.my_key in c["order"]:
+                    committed = c
+                    break
+                print("[launch] re-form: dropped from the new gang",
+                      file=sys.stderr)
+                return False
+            if time.time() > cdl:
+                print("[launch] re-form: no new commit appeared",
+                      file=sys.stderr)
+                return False
+            time.sleep(0.2)
+        # mirror to the base commit key so NEW pods (still in their
+        # initial rendezvous loop, polling <job>/commit) can adopt it
+        self.kv.put(f"{self.job_id}/commit", json.dumps(committed))
+        self.kv.delete(f"{self.job_id}/scale_request")
+        self.order = committed["order"]
+        self.epoch = int(committed["epoch"])
+        self.peers = committed["peers"]
+        self.peer_pods = committed["pods"]
+        self.node_rank = self.order.index(self.my_key)
+        self.world_nodes = len(self.order)
+        self.coordinator = self.peers[0]
+        print(f"[launch] re-formed epoch {self.epoch}: "
+              f"{self.world_nodes} nodes, rank {self.node_rank}",
+              file=sys.stderr)
+        self.launch()
+        return True
 
     # ---------------- spawn ----------------
 
@@ -294,6 +398,7 @@ class CollectiveController:
             "PADDLE_NODE_RANK": str(self.node_rank),
             "PADDLE_JOB_ID": self.job_id,
             "PADDLE_RESTART_CNT": str(self.restarts),
+            "PADDLE_ELASTIC_EPOCH": str(getattr(self, "epoch", 0)),
         })
         if self.coordinator:
             env["PADDLE_MASTER"] = self.coordinator
@@ -374,6 +479,7 @@ class CollectiveController:
         --max_restart times (reference: controller.py watch +
         elastic ElasticLevel.FAULT_TOLERANCE)."""
         a = self.args
+        last_scale_check = time.time()
         while True:
             time.sleep(0.5)
             codes = [p.poll() for p in self.procs]
@@ -381,6 +487,18 @@ class CollectiveController:
                 return 0
             bad = [c for c in codes if c not in (None, 0)]
             if bad:
+                # a child exiting ELASTIC_EXIT_CODE requests a re-form
+                # (reference manager.py:33); not counted as a failure —
+                # but bounded, so a script that always exits 101 can't
+                # re-form forever
+                if ELASTIC_EXIT_CODE in bad and self.kv is not None \
+                        and self._scale_events < 10 * max(1,
+                                                          a.max_restart):
+                    self._scale_events += 1
+                    if self._reform("child requested scale event "
+                                    f"(exit {ELASTIC_EXIT_CODE})"):
+                        continue
+                    return 1
                 for p in self.procs:
                     p.terminate()
                 if self.restarts < a.max_restart:
@@ -396,11 +514,35 @@ class CollectiveController:
                 return 128 - rc if rc < 0 else rc
             dead = self.dead_peers()
             if dead:
-                print(f"[launch] peer heartbeat lost: {dead}; "
-                      "stopping local procs", file=sys.stderr)
+                print(f"[launch] peer heartbeat lost: {dead}; ",
+                      file=sys.stderr)
+                # scale-in: shrink the gang and continue when the
+                # remaining pods still meet nnodes_min
+                if self._is_elastic():
+                    if self._reform(f"peer(s) lost: {dead}"):
+                        continue
                 for p in self.procs:
                     p.terminate()
                 return 1
+            if self.kv is not None and time.time() - last_scale_check \
+                    > SCALE_CHECK_INTERVAL:
+                last_scale_check = time.time()
+                # a peer announced a scale event (its child exited 101 /
+                # it observed a lease lapse first): join the re-form
+                raw = self.kv.get(f"{self.job_id}/scale_request")
+                if raw is not None and int(raw) >= self.epoch:
+                    if not self._reform("peer requested scale event"):
+                        return 1
+                    continue
+                if self._is_elastic() \
+                        and self.world_nodes < a.nnodes_max:
+                    live = self._live_pods()
+                    extra = [k for k in live if k not in self.order]
+                    if extra:
+                        # scale-out: a new pod registered — admit it
+                        if not self._reform(
+                                f"new pod(s) joined: {sorted(extra)}"):
+                            return 1
 
     def stop(self):
         self._hb_stop.set()
@@ -412,6 +554,11 @@ class CollectiveController:
                 self.kv.delete(self.my_key)
             if getattr(self, "node_rank", None) == 0:
                 self.kv.delete(f"{self.job_id}/commit")
+                try:
+                    for k in self.kv.prefix(f"{self.job_id}/commit@"):
+                        self.kv.delete(k)
+                except Exception:
+                    pass
         if self.master_server is not None:
             self.master_server.stop()
 

@@ -1,0 +1,178 @@
+"""Collective / step hang watchdog.
+
+Reference: `paddle/phi/core/distributed/comm_task_manager.h:37`
+(`CommTaskManager`) + `nccl_comm_task.cc` — a background thread ages
+in-flight NCCL collectives and logs/aborts when one exceeds the
+timeout, honoring `FLAGS_stop_check_timeout`.
+
+TPU-native: compiled collectives can't be individually aged (XLA owns
+the stream), so the watchdog guards HOST-side suspension points — the
+train step dispatch+sync, eager host collectives, barriers, pipeline
+train_batch.  On expiry it dumps every Python thread's stack and the
+live-device-array census (count + bytes — the state a hang post-mortem
+needs), then invokes the abort handler (default: log only; opt-in
+process abort like the reference's comm-abort path).
+"""
+from __future__ import annotations
+
+import faulthandler
+import io
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+from ..framework.flags import define_flag, get_flag
+
+__all__ = ["CommTask", "CommTaskManager", "get_comm_task_manager",
+           "watched"]
+
+define_flag("stop_check_timeout", 0,
+            "seconds before an in-flight host-side collective/step is "
+            "declared hung (0 disables the watchdog; reference "
+            "FLAGS_stop_check_timeout)")
+define_flag("comm_watchdog_abort", False,
+            "abort the process when a watched task times out (reference "
+            "CommTaskManager abort-on-timeout behavior)")
+
+
+class CommTask:
+    def __init__(self, name: str, timeout: float, manager):
+        self.name = name
+        self.started = time.monotonic()
+        self.deadline = self.started + timeout
+        self.reported = False
+        self._manager = manager
+
+    def done(self):
+        self._manager._finish(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.done()
+        return False
+
+
+class CommTaskManager:
+    """Ages in-flight host tasks on a daemon thread (reference
+    comm_task_manager.h:37 CommTaskManager loop)."""
+
+    def __init__(self, poll_interval: float = 0.25):
+        self._tasks: set = set()
+        self._lock = threading.Lock()
+        self._poll = poll_interval
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.timeout_log: list = []   # (name, age, report) tuples
+        self.on_timeout: Optional[Callable] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            name="comm-watchdog",
+                                            daemon=True)
+            self._thread.start()
+
+    def shutdown(self):
+        self._stop.set()
+
+    # -- task API ----------------------------------------------------------
+    def start_task(self, name: str, timeout: Optional[float] = None
+                   ) -> Optional[CommTask]:
+        t = timeout if timeout is not None \
+            else float(get_flag("stop_check_timeout") or 0)
+        if t <= 0:
+            return None
+        task = CommTask(name, t, self)
+        with self._lock:
+            self._tasks.add(task)
+        self._ensure_thread()
+        return task
+
+    def _finish(self, task):
+        with self._lock:
+            self._tasks.discard(task)
+
+    # -- monitor -----------------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self._poll):
+            now = time.monotonic()
+            with self._lock:
+                expired = [t for t in self._tasks
+                           if now > t.deadline and not t.reported]
+            for t in expired:
+                t.reported = True
+                self._report(t, now - t.started)
+
+    def _report(self, task, age):
+        report = self._build_report(task, age)
+        self.timeout_log.append((task.name, age, report))
+        sys.stderr.write(report)
+        sys.stderr.flush()
+        if self.on_timeout is not None:
+            try:
+                self.on_timeout(task, report)
+            except Exception:
+                pass
+        if get_flag("comm_watchdog_abort"):
+            faulthandler.dump_traceback()
+            import os
+            os.abort()
+
+    @staticmethod
+    def _build_report(task, age) -> str:
+        buf = io.StringIO()
+        buf.write(f"\n[comm-watchdog] task '{task.name}' exceeded its "
+                  f"deadline ({age:.1f}s in flight)\n")
+        buf.write("[comm-watchdog] python thread stacks:\n")
+        for tid, frame in sys._current_frames().items():
+            buf.write(f"--- thread {tid} ---\n")
+            buf.write("".join(traceback.format_stack(frame)))
+        try:
+            import jax
+            arrs = jax.live_arrays()
+            total = sum(a.size * a.dtype.itemsize for a in arrs)
+            buf.write(f"[comm-watchdog] live device arrays: {len(arrs)} "
+                      f"({total / 1e9:.2f} GB)\n")
+        except Exception:
+            pass
+        return buf.getvalue()
+
+
+_manager: Optional[CommTaskManager] = None
+
+
+def get_comm_task_manager() -> CommTaskManager:
+    global _manager
+    if _manager is None:
+        _manager = CommTaskManager()
+    return _manager
+
+
+class watched:
+    """Guard a host-side suspension point:
+
+        with watched("pp train_batch"):
+            engine.train_batch(...)
+
+    No-op unless FLAGS_stop_check_timeout > 0 or timeout given."""
+
+    def __init__(self, name: str, timeout: Optional[float] = None):
+        self.name = name
+        self.timeout = timeout
+        self._task = None
+
+    def __enter__(self):
+        self._task = get_comm_task_manager().start_task(self.name,
+                                                        self.timeout)
+        return self
+
+    def __exit__(self, *exc):
+        if self._task is not None:
+            self._task.done()
+        return False

@@ -342,6 +342,7 @@ class PipelineEngine:
                 f"batch {xv.shape[0]} not divisible by micro-batches {m}")
         sched = schedule.upper().replace("-", "").replace("_", "")
         self._split_bwd = sched in ("ZB", "ZBH1", "ZEROBUBBLE")
+        from ..distributed.watchdog import watched
         self._sync_shared_values()
         micro_x = jnp.split(xv, m)
         micro_y = jnp.split(yv, m)
@@ -356,20 +357,21 @@ class PipelineEngine:
         order = self._orders(m, schedule)
         done = set()
         idx = [0] * pp
-        while any(idx[s] < len(order[s]) for s in range(pp)):
-            progress = False
-            for s in range(pp):
-                while idx[s] < len(order[s]):
-                    kind, v, i = order[s][idx[s]]
-                    if not self._ready(kind, v, i, done):
-                        break
-                    self._exec(kind, v, i, labels)
-                    done.add((kind, v, i))
-                    idx[s] += 1
-                    progress = True
-            if not progress:
-                raise RuntimeError(
-                    f"pipeline schedule deadlock at {done}")
+        with watched(f"pipeline train_batch ({schedule}, m={m})"):
+            while any(idx[s] < len(order[s]) for s in range(pp)):
+                progress = False
+                for s in range(pp):
+                    while idx[s] < len(order[s]):
+                        kind, v, i = order[s][idx[s]]
+                        if not self._ready(kind, v, i, done):
+                            break
+                        self._exec(kind, v, i, labels)
+                        done.add((kind, v, i))
+                        idx[s] += 1
+                        progress = True
+                if not progress:
+                    raise RuntimeError(
+                        f"pipeline schedule deadlock at {done}")
 
         # write back grads (avg over micro-batches); a tied param seen in
         # several chunks gets the SUM of its per-chunk grads, placed like

@@ -317,16 +317,18 @@ class ShardedTrainStep:
 
     # -- run ---------------------------------------------------------------
     def __call__(self, *batch):
+        from ..distributed.watchdog import watched
         param_vals, buf_vals, batch_vals = self._prepare(batch)
         sd = self._sd
         self.optimizer._step_count += 1
         lr = self.optimizer.get_lr()
         key = prandom.next_key()
-        loss, new_params, new_states = self._compiled(
-            param_vals, self._opt_states, buf_vals,
-            jnp.asarray(lr, jnp.float32),
-            jnp.asarray(self.optimizer._step_count, jnp.int32), key,
-            batch_vals)
+        with watched("sharded train step"):
+            loss, new_params, new_states = self._compiled(
+                param_vals, self._opt_states, buf_vals,
+                jnp.asarray(lr, jnp.float32),
+                jnp.asarray(self.optimizer._step_count, jnp.int32), key,
+                batch_vals)
         for n, v in zip(self._names, new_params):
             sd[n]._value = v
         self._opt_states = new_states

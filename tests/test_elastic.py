@@ -1,0 +1,146 @@
+"""Elastic scale-in/out + fault injection.
+
+Reference: fleet/elastic/manager.py:125 (ElasticManager) — TTL
+heartbeats (:40), scale events rewrite the endpoint list and relaunch,
+ELASTIC_EXIT_CODE=101 (:33) asks for a re-form.
+
+Pattern per SURVEY §4: fake cluster = launcher processes on localhost,
+fault injection = killing one of them.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.distributed.launch.controller import ELASTIC_EXIT_CODE
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _launcher_cmd(master_port, tmp_path, job, script, nnodes="1:2"):
+    return [sys.executable, "-m", "paddle_tpu.distributed.launch",
+            f"--master=127.0.0.1:{master_port}", f"--nnodes={nnodes}",
+            f"--log_dir={tmp_path}/log", f"--job_id={job}",
+            "--elastic_timeout=60", str(script)]
+
+
+def _env(tmp_path):
+    return dict(os.environ, DUMP_DIR=str(tmp_path),
+                PYTHONPATH=REPO + os.pathsep
+                + os.environ.get("PYTHONPATH", ""))
+
+
+def test_scale_in_on_pod_death(tmp_path):
+    """Kill one of two pods mid-run: the survivor re-forms at world
+    size 1 and finishes (reference: scale-in on lease expiry)."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import json, os, time
+        world = int(os.environ["PADDLE_TRAINERS_NUM"])
+        epoch = int(os.environ["PADDLE_ELASTIC_EPOCH"])
+        path = os.path.join(os.environ["DUMP_DIR"],
+                            "run.%d.%s.json" % (epoch,
+                                                os.environ["PADDLE_TRAINER_ID"]))
+        with open(path, "w") as f:
+            json.dump({"world": world, "epoch": epoch}, f)
+        if world > 1:
+            time.sleep(120)   # wait to be killed by the scale event
+        # world 1 (post scale-in): finish cleanly
+    """))
+    port = _free_port()
+    env = _env(tmp_path)
+    procs = [subprocess.Popen(
+        _launcher_cmd(port, tmp_path, "ei", script), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for _ in range(2)]
+    # let the gang form and children start
+    deadline = time.time() + 60
+    while time.time() < deadline and not (
+            (tmp_path / "run.0.0.json").exists()
+            and (tmp_path / "run.0.1.json").exists()):
+        time.sleep(0.5)
+    assert (tmp_path / "run.0.0.json").exists(), "gang never formed"
+    # fault injection: SIGKILL the second launcher (heartbeat stops)
+    procs[1].kill()
+    procs[1].wait()
+    out, _ = procs[0].communicate(timeout=120)
+    assert procs[0].returncode == 0, out.decode()[-2000:]
+    assert b"elastic re-form" in out
+    # the survivor relaunched at world size 1, epoch 1
+    done = [p for p in tmp_path.glob("run.1.*.json")]
+    assert done, "no epoch-1 run recorded"
+    rec = json.loads(done[0].read_text())
+    assert rec["world"] == 1 and rec["epoch"] == 1
+
+
+def test_scale_out_admits_new_pod(tmp_path):
+    """Start one pod of an elastic 1:2 job, then add a second: the
+    running pod re-forms at world size 2 (reference: scale-out on new
+    registration)."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import json, os, time
+        world = int(os.environ["PADDLE_TRAINERS_NUM"])
+        epoch = int(os.environ["PADDLE_ELASTIC_EPOCH"])
+        path = os.path.join(os.environ["DUMP_DIR"],
+                            "run.%d.%s.json" % (epoch,
+                                                os.environ["PADDLE_TRAINER_ID"]))
+        with open(path, "w") as f:
+            json.dump({"world": world, "epoch": epoch}, f)
+        if world < 2:
+            time.sleep(120)   # hold until the scale-out re-form kills us
+    """))
+    port = _free_port()
+    env = _env(tmp_path)
+    first = subprocess.Popen(
+        _launcher_cmd(port, tmp_path, "eo", script), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    deadline = time.time() + 60
+    while time.time() < deadline and not (
+            tmp_path / "run.0.0.json").exists():
+        time.sleep(0.5)
+    assert (tmp_path / "run.0.0.json").exists(), "solo gang never formed"
+    second = subprocess.Popen(
+        _launcher_cmd(port, tmp_path, "eo", script), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    out1, _ = first.communicate(timeout=120)
+    out2, _ = second.communicate(timeout=120)
+    assert first.returncode == 0, out1.decode()[-2000:]
+    assert second.returncode == 0, out2.decode()[-2000:]
+    # both ranks ran at world 2 in a later epoch
+    sized = []
+    for p in tmp_path.glob("run.*.json"):
+        rec = json.loads(p.read_text())
+        if rec["world"] == 2:
+            sized.append(rec)
+    assert len(sized) >= 2, list(tmp_path.glob("run.*"))
+
+
+def test_elastic_exit_code_triggers_reform(tmp_path):
+    """A child exiting ELASTIC_EXIT_CODE=101 is relaunched via a
+    re-form (epoch bump), not counted as a failure."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        epoch = int(os.environ["PADDLE_ELASTIC_EPOCH"])
+        sys.exit({ELASTIC_EXIT_CODE} if epoch == 0 else 0)
+    """))
+    port = _free_port()
+    env = _env(tmp_path)
+    proc = subprocess.Popen(
+        _launcher_cmd(port, tmp_path, "ec", script, nnodes="1"),
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    out, _ = proc.communicate(timeout=120)
+    assert proc.returncode == 0, out.decode()[-2000:]
+    assert b"scale event" in out

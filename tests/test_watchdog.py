@@ -1,0 +1,83 @@
+"""Collective hang watchdog (reference comm_task_manager.h:37
+CommTaskManager: age in-flight collectives, report on timeout)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.watchdog import (CommTaskManager, watched,
+                                             get_comm_task_manager)
+
+
+class TestWatchdog:
+    def test_disabled_by_default(self):
+        mgr = get_comm_task_manager()
+        assert mgr.start_task("noop") is None  # flag 0 -> no-op
+
+    def test_times_out_and_reports(self):
+        mgr = CommTaskManager(poll_interval=0.05)
+        fired = []
+        mgr.on_timeout = lambda task, report: fired.append(
+            (task.name, report))
+        task = mgr.start_task("hung allreduce", timeout=0.2)
+        try:
+            time.sleep(0.6)
+        finally:
+            task.done()
+            mgr.shutdown()
+        assert fired and fired[0][0] == "hung allreduce"
+        report = fired[0][1]
+        assert "thread" in report          # stack dump present
+        assert "exceeded its deadline" in report
+        # only reported once despite several poll cycles
+        assert len(fired) == 1
+
+    def test_completed_task_never_reports(self):
+        mgr = CommTaskManager(poll_interval=0.05)
+        fired = []
+        mgr.on_timeout = lambda *a: fired.append(a)
+        with mgr.start_task("quick", timeout=5.0):
+            pass
+        time.sleep(0.2)
+        mgr.shutdown()
+        assert not fired
+
+    def test_flag_arms_watched(self):
+        mgr = get_comm_task_manager()
+        fired = []
+        old = mgr.on_timeout
+        mgr.on_timeout = lambda task, report: fired.append(task.name)
+        paddle.set_flags({"FLAGS_stop_check_timeout": 1})
+        try:
+            # simulate a hung barrier: a watched region that outlives
+            # the 1s deadline (poll interval 0.25s)
+            with watched("hung barrier"):
+                time.sleep(1.8)
+        finally:
+            paddle.set_flags({"FLAGS_stop_check_timeout": 0})
+            mgr.on_timeout = old
+        assert fired == ["hung barrier"]
+
+    def test_hung_kv_barrier_reports(self):
+        """A real barrier against a KV store whose peer never shows up
+        is caught by the watchdog before its own timeout."""
+        from paddle_tpu.distributed.launch.master import KVServer
+        from paddle_tpu.distributed.host_collectives import KVCollectives
+        srv = KVServer(0).start()
+        mgr = get_comm_task_manager()
+        fired = []
+        old = mgr.on_timeout
+        mgr.on_timeout = lambda task, report: fired.append(task.name)
+        paddle.set_flags({"FLAGS_stop_check_timeout": 1})
+        try:
+            hc = KVCollectives(f"127.0.0.1:{srv.port}", rank=0, world=2,
+                               timeout=2.5)
+            with pytest.raises(TimeoutError):
+                hc.barrier()  # peer 1 never arrives
+        finally:
+            paddle.set_flags({"FLAGS_stop_check_timeout": 0})
+            mgr.on_timeout = old
+            srv.stop()
+        assert fired and "host collective" in fired[0]
