@@ -46,7 +46,8 @@ def chip_peak_flops():
     return PEAK_BF16["v5e"]
 
 
-def main():
+def bench_llama():
+    """BASELINE.md config 3: llama pretraining tokens/s/chip + MFU."""
     import jax
     on_tpu = jax.default_backend() == "tpu"
     import paddle_tpu as paddle
@@ -113,10 +114,12 @@ def main():
     peak = chip_peak_flops()
     mfu = model_flops / peak
     # hardware utilization: each selectively-recomputed layer replays
-    # the flash-attn forward + the gate/up MLP matmuls in the backward
-    recompute_per_tok = n_sel * (2.0 * seq * cfg.num_attention_heads
-                                 * cfg.head_dim
-                                 + 4.0 * cfg.hidden_size
+    # only the gate/up MLP matmuls in the backward.  The q/k/v, o_proj
+    # and down_proj matmuls sit in the remat regions too, but their
+    # OUTPUTS are saved (region boundaries / resid_mid tag) or unused in
+    # the backward, so jax's remat DCE drops them from the replay jaxpr;
+    # norms/rope replay with no matmul flops
+    recompute_per_tok = n_sel * (4.0 * cfg.hidden_size
                                  * cfg.intermediate_size)
     hw_util = mfu * (6.0 * n_params + recompute_per_tok) / (6.0 * n_params)
 
@@ -128,6 +131,151 @@ def main():
         "vs_baseline": round(mfu / 0.40, 3),
     }
     print(json.dumps(result))
+
+
+def _class_correlated_images(n, num_classes, rng, noise=0.6):
+    """Learnable synthetic CIFAR stand-in (zero-egress environment):
+    per-class template + gaussian noise — convergence on a held-out
+    split is real evidence the training machinery optimizes."""
+    import numpy as np
+    templates = rng.randn(num_classes, 3, 32, 32).astype(np.float32)
+    labels = rng.randint(0, num_classes, n)
+    imgs = templates[labels] + noise * rng.randn(n, 3, 32, 32)
+    return imgs.astype(np.float32), labels.astype(np.int64)
+
+
+def bench_resnet():
+    """BASELINE.md config 1: ResNet-50 on CIFAR-10-shaped data —
+    images/sec + top-1 convergence on a held-out split."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import resnet50, resnet18
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    if on_tpu:
+        model = resnet50(num_classes=10)
+        batch, n_train, n_test, epochs = 256, 4096, 1024, 3
+    else:
+        model = resnet18(num_classes=10)
+        batch, n_train, n_test, epochs = 32, 64, 32, 1
+
+    xs_all, ys_all = _class_correlated_images(n_train + n_test, 10, rng)
+    xs, ys = xs_all[:n_train], ys_all[:n_train]
+    xt, yt = xs_all[n_train:], ys_all[n_train:]
+    opt = paddle.optimizer.Momentum(0.02, momentum=0.9,
+                                    parameters=model.parameters(),
+                                    weight_decay=1e-4)
+    loss_fn = lambda o, y: nn.functional.cross_entropy(o, y)
+    step = TrainStep(model, loss_fn, opt)
+
+    xb0 = paddle.to_tensor(xs[:batch])
+    yb0 = paddle.to_tensor(ys[:batch])
+    _ = float(np.asarray(step(xb0, yb0).value))  # compile
+
+    steps_per_epoch = n_train // batch
+    t0 = time.perf_counter()
+    seen = 0
+    for _ in range(epochs):
+        for i in range(steps_per_epoch):
+            xb = paddle.to_tensor(xs[i * batch:(i + 1) * batch])
+            yb = paddle.to_tensor(ys[i * batch:(i + 1) * batch])
+            loss = step(xb, yb)
+            seen += batch
+    final_loss = float(np.asarray(loss.value))
+    dt = time.perf_counter() - t0
+    images_per_sec = seen / dt
+
+    # held-out top-1 (jitted eval — per-op eager would be host-bound)
+    import jax.numpy as jnp
+    from paddle_tpu.jit import to_static
+    model.eval()
+    eval_fwd = to_static(model)
+    correct = tot = 0
+    for i in range(0, n_test, batch):
+        out = eval_fwd(paddle.to_tensor(xt[i:i + batch]))
+        pred = np.asarray(jnp.argmax(out.value, axis=-1))
+        correct += int((pred == yt[i:i + batch]).sum())
+        tot += len(pred)
+    top1 = correct / max(1, tot)
+
+    result = {
+        "metric": "resnet50_cifar_images_per_sec",
+        "value": round(images_per_sec, 1),
+        "unit": f"images/s (top1={top1:.3f} heldout after {epochs} "
+                f"epochs, loss={final_loss:.3f})",
+        "vs_baseline": round(top1 / 0.90, 3),
+    }
+    print(json.dumps(result))
+
+
+def bench_bert():
+    """BASELINE.md config 2: BERT-base pretraining, DP + sharding
+    stage 1 — tokens/s/chip + MFU."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    import paddle_tpu as paddle
+    from paddle_tpu.models.bert import BertForMaskedLM, BertConfig
+    from paddle_tpu.parallel import ShardedTrainStep
+    from paddle_tpu.distributed.topology import build_mesh
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = BertConfig(dtype="bfloat16")
+        batch, seq, steps = 32, 512, 8
+    else:
+        cfg = BertConfig(vocab_size=128, hidden_size=64,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         intermediate_size=128,
+                         max_position_embeddings=64)
+        batch, seq, steps = 2, 32, 2
+
+    model = BertForMaskedLM(cfg)
+    n_params = sum(int(np.prod(p.value.shape))
+                   for p in model.parameters())
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
+                                 weight_decay=0.01,
+                                 multi_precision=on_tpu)
+    mesh = build_mesh(sharding=1, devices=jax.devices()[:1])
+    step = ShardedTrainStep(model, opt, mesh, sharding_stage=1,
+                            batch_axes=("dp", "sharding"))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    loss = step(x, x)
+    _ = float(np.asarray(loss.value))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, x)
+    final_loss = float(np.asarray(loss.value))
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    # encoder fwd+bwd ~ 6*N flops/token (N excl embeddings ~ attention
+    # is small at seq 512); use full param count like the llama metric
+    mfu = 6.0 * n_params * tokens_per_sec / chip_peak_flops()
+    result = {
+        "metric": "bert_base_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": f"tokens/s/chip (mfu={mfu:.3f}, "
+                f"params={n_params/1e6:.0f}M, loss={final_loss:.3f})",
+        "vs_baseline": round(mfu / 0.40, 3),
+    }
+    print(json.dumps(result))
+
+
+def main():
+    which = os.environ.get("BENCH_CONFIG", "llama").lower()
+    if which in ("resnet", "resnet50", "cifar"):
+        return bench_resnet()
+    if which == "bert":
+        return bench_bert()
+    return bench_llama()
 
 
 if __name__ == "__main__":

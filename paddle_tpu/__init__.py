@@ -23,10 +23,10 @@ __version__ = "0.1.0"
 
 from .framework import (  # noqa: E402
     dtype, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
-    float64, complex64, complex128, bool_,
+    float64, complex64, complex128, bool_, float8_e4m3fn, float8_e5m2,
     Tensor, to_tensor,
     no_grad, enable_grad, is_grad_enabled, set_grad_enabled,
-    CPUPlace, TPUPlace, CUDAPlace, XPUPlace, Place,
+    CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace, Place,
     set_device, get_device, is_compiled_with_cuda, is_compiled_with_rocm,
     is_compiled_with_xpu, is_compiled_with_cinn, is_compiled_with_distribute,
     device_count,
@@ -35,6 +35,115 @@ from .framework import (  # noqa: E402
     iinfo, finfo,
 )
 from .framework.tensor import Parameter  # noqa: E402
+
+# dtype alias (reference exports `bool` shadowing the builtin)
+bool = bool_  # noqa: A001
+
+# CUDA RNG state parity: one functional key stream drives every device
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+class LazyGuard:
+    """Reference: paddle.LazyGuard defers parameter materialization for
+    giant models.  Parameters here are jax arrays created on the default
+    (host) backend and sharded/placed at trainer setup, so the guard is
+    a no-op context kept for API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Reference: paddle.set_printoptions → numpy print options (Tensor
+    repr renders through numpy)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """Reference: paddle.disable_signal_handler — the C++ runtime's
+    signal interceptors don't exist here; no-op for parity."""
+
+
+def check_shape(shape):
+    """Reference: paddle.check_shape — validate a shape list."""
+    for s in (shape or []):
+        if not isinstance(s, int) and s is not None:
+            raise TypeError(f"shape entries must be int, got {type(s)}")
+        if isinstance(s, int) and s < -1:
+            raise ValueError(f"invalid dim {s}")
+    return True
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Reference: paddle.batch (legacy reader decorator)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Reference: paddle.create_parameter (static helper)."""
+    import numpy as _np
+    from .nn.initializer import XavierNormal, Constant
+    init = default_initializer or (
+        Constant(0.0) if is_bias else XavierNormal())
+    val = init(tuple(shape), dtype)
+    p = Parameter(val)
+    p.name = name
+    return p
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Reference: paddle.flops (hapi/dynamic_flops.py) — matmul/conv
+    FLOPs of one forward at `input_size`, via jax's compiled cost
+    analysis (counts exactly what XLA will execute)."""
+    import numpy as _np
+    import jax as _j
+    import jax.numpy as _jnp
+    from .jit import _swapped_state as _ss
+
+    sd = net.state_dict()
+    names = list(sd.keys())
+    vals = [sd[n].value for n in names]
+
+    def fwd(params, x):
+        with _ss(net, names, list(params)):
+            out = net(Tensor(x))
+        return out.value if isinstance(out, Tensor) else out
+
+    x = _jnp.zeros(tuple(input_size), _jnp.float32)
+    compiled = _j.jit(fwd).lower(vals, x).compile()
+    cost = compiled.cost_analysis()
+    cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+    total = float((cost or {}).get("flops", 0.0))
+    if print_detail:
+        print(f"Total Flops: {total:.0f}")
+    return total
 
 from .tensor import *  # noqa: F401,F403,E402
 from .tensor import creation as _creation  # noqa: E402
@@ -67,6 +176,8 @@ from . import fft  # noqa: E402
 from . import distribution  # noqa: E402
 from . import quantization  # noqa: E402
 from . import text  # noqa: E402
+from . import audio  # noqa: E402
+from . import onnx  # noqa: E402
 from . import geometric  # noqa: E402
 from .framework.param_attr import ParamAttr  # noqa: E402
 
@@ -108,3 +219,11 @@ _default_dtype = float32
 # see paddle_tpu/ops/registry.py)
 from .ops.registry import build_ops as _build_ops  # noqa: E402
 _registry_ops = _build_ops(globals(), tensor_cls=Tensor)
+
+# in-place variants for registry-generated ops that live only at the top
+# level (sinc_, logit_, gammaln_, …); the tensor-module pass covered the
+# hand-written namespace
+from .tensor.inplace import make_inplace_variants as _miv_top  # noqa: E402
+globals().update({k: v for k, v in _miv_top(globals()).items()
+                  if k not in globals()})
+del _miv_top

@@ -1,0 +1,11 @@
+"""paddle.audio surface (reference: python/paddle/audio/ — features,
+functional, backends, datasets) implemented on jnp; see the submodule
+docstrings for the TPU-native notes."""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends import info, load, save  # noqa: F401
+
+__all__ = ["functional", "features", "backends", "datasets",
+           "info", "load", "save"]

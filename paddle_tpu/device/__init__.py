@@ -105,9 +105,39 @@ class cuda:
         pass
 
     @staticmethod
-    def max_memory_allocated(device=None):
-        return 0
+    def memory_allocated(device=None):
+        """Live HBM bytes on the accelerator (reference: phi memory
+        stats facade `memory_allocated`); PJRT device stats when the
+        runtime exposes them, else a live-array census."""
+        return _device_mem_stat("bytes_in_use")
 
     @staticmethod
-    def memory_allocated(device=None):
-        return 0
+    def max_memory_allocated(device=None):
+        return _device_mem_stat("peak_bytes_in_use")
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return _device_mem_stat("bytes_reserved")
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return _device_mem_stat("peak_bytes_in_use")
+
+
+def _device_mem_stat(key):
+    import jax
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        if key in stats:
+            return int(stats[key])
+    except Exception:
+        stats = {}
+    if key.startswith("peak"):
+        # runtimes without peak counters: fall back to the live census
+        key = "bytes_in_use"
+    if key in stats:
+        return int(stats[key])
+    total = 0
+    for a in jax.live_arrays():
+        total += a.size * a.dtype.itemsize
+    return total

@@ -26,6 +26,9 @@ from .auto_parallel import (ProcessMesh, Shard, Replicate, Partial,  # noqa: F40
 from . import fleet  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import rpc  # noqa: F401
+from . import auto_tuner  # noqa: F401
+from . import watchdog  # noqa: F401
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

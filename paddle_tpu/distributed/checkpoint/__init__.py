@@ -16,6 +16,8 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import threading
+from concurrent.futures import ThreadPoolExecutor, Future
 
 import numpy as np
 import jax
@@ -23,11 +25,49 @@ import jax.numpy as jnp
 
 from ...framework.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict",
+           "synchronize_async_saves"]
+
+# single-worker writer: async saves queue here (reference
+# save_state_dict.py:46 — a dedicated save process fed from a queue);
+# device->host snapshots happen synchronously (the step may donate the
+# buffers), only the file IO is deferred
+_writer: ThreadPoolExecutor = None
+_pending: list = []
+_pending_lock = threading.Lock()
+
+
+def _get_writer():
+    global _writer
+    if _writer is None:
+        _writer = ThreadPoolExecutor(max_workers=1,
+                                     thread_name_prefix="ckpt-writer")
+    return _writer
+
+
+def synchronize_async_saves():
+    """Step-boundary barrier: block until every queued async save hit
+    disk, re-raising the first writer error (reference: the sync point
+    before the next save / at exit)."""
+    with _pending_lock:
+        futs, _pending[:] = list(_pending), []
+    for f in futs:
+        f.result()
+
+
+def _write_files(path, rank, shards, meta, coordinator_rank):
+    with open(os.path.join(path, f"{rank}.distcp"), "wb") as f:
+        pickle.dump(shards, f, protocol=4)
+    if rank == coordinator_rank:
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f)
 
 
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, async_save=False):
+    """async_save=True: snapshot to host now, write files on the
+    background queue; returns a Future (also joined by
+    synchronize_async_saves)."""
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     meta = {}
@@ -51,11 +91,16 @@ def save_state_dict(state_dict, path, process_group=None,
             meta[k] = {"global_shape": list(arr.shape),
                        "dtype": str(arr.dtype), "rank": rank,
                        "sharded": True}
-    with open(os.path.join(path, f"{rank}.distcp"), "wb") as f:
-        pickle.dump(shards, f, protocol=4)
-    if rank == coordinator_rank:
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(meta, f)
+    if async_save:
+        fut = _get_writer().submit(_write_files, path, rank, shards,
+                                   meta, coordinator_rank)
+        with _pending_lock:
+            _pending.append(fut)
+        return fut
+    _write_files(path, rank, shards, meta, coordinator_rank)
+    done = Future()
+    done.set_result(None)
+    return done
 
 
 def load_state_dict(state_dict, path, process_group=None,

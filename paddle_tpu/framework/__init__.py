@@ -6,10 +6,12 @@ generator.h` (RNG).  See each submodule's docstring for the mapping.
 """
 from .dtypes import (dtype, uint8, int8, int16, int32, int64, float16,
                      bfloat16, float32, float64, complex64, complex128,
-                     bool_, convert_np_dtype_to_dtype_, iinfo, finfo)
+                     bool_, float8_e4m3fn, float8_e5m2,
+                     convert_np_dtype_to_dtype_, iinfo, finfo)
 from .tensor import Tensor, Parameter, to_tensor
 from .tape import no_grad, enable_grad, is_grad_enabled, set_grad_enabled
-from .device import (Place, CPUPlace, TPUPlace, CUDAPlace, XPUPlace,
+from .device import (Place, CPUPlace, TPUPlace, CUDAPlace,
+                     CUDAPinnedPlace, XPUPlace,
                      set_device, get_device, is_compiled_with_cuda,
                      is_compiled_with_rocm, is_compiled_with_xpu,
                      is_compiled_with_cinn, is_compiled_with_distribute,

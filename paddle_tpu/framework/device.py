@@ -64,6 +64,12 @@ class CUDAPlace(TPUPlace):
     pass
 
 
+class CUDAPinnedPlace(CPUPlace):
+    """Host-pinned-memory place shim: jax manages pinned staging
+    buffers internally; data 'on' this place is host memory."""
+    pass
+
+
 class XPUPlace(TPUPlace):
     pass
 

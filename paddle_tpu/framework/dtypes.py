@@ -15,7 +15,7 @@ import ml_dtypes
 __all__ = [
     "dtype", "uint8", "int8", "int16", "int32", "int64",
     "float16", "bfloat16", "float32", "float64",
-    "complex64", "complex128", "bool_",
+    "complex64", "complex128", "bool_", "float8_e4m3fn", "float8_e5m2",
     "convert_np_dtype_to_dtype_", "convert_dtype", "iinfo", "finfo",
 ]
 
@@ -80,9 +80,12 @@ float64 = dtype(np.float64, "float64")
 complex64 = dtype(np.complex64, "complex64")
 complex128 = dtype(np.complex128, "complex128")
 bool_ = dtype(np.bool_, "bool")
+float8_e4m3fn = dtype(ml_dtypes.float8_e4m3fn, "float8_e4m3fn")
+float8_e5m2 = dtype(ml_dtypes.float8_e5m2, "float8_e5m2")
 
 _ALL = [uint8, int8, int16, int32, int64, float16, bfloat16, float32,
-        float64, complex64, complex128, bool_]
+        float64, complex64, complex128, bool_, float8_e4m3fn,
+        float8_e5m2]
 _BY_NAME = {d.name: d for d in _ALL}
 _BY_NAME["bool_"] = bool_
 _BY_NAME["float"] = float32

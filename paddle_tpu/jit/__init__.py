@@ -41,18 +41,32 @@ def enable_to_static(flag: bool):
 @contextlib.contextmanager
 def _swapped_state(layer, names, values):
     """Temporarily replace named parameters/buffers of `layer` (and
-    sublayers) with `values` (jax arrays or tracers)."""
+    sublayers) with `values` (jax arrays or tracers).  While active,
+    in-place buffer mutation under tracing is SAFE (any tracer written
+    into a buffer is either captured by the trainer or restored away),
+    so batch_norm et al. consult `in_swapped_state()` before mutating
+    running stats with traced values."""
+    global _SWAP_DEPTH
     sd = layer.state_dict()
     originals = []
     for n, v in zip(names, values):
         t = sd[n]
         originals.append((t, t._value))
         t._value = v if not isinstance(v, Tensor) else v._value
+    _SWAP_DEPTH += 1
     try:
         yield
     finally:
+        _SWAP_DEPTH -= 1
         for t, v in originals:
             t._value = v
+
+
+_SWAP_DEPTH = 0
+
+
+def in_swapped_state() -> bool:
+    return _SWAP_DEPTH > 0
 
 
 def functional_call(layer, state: Dict[str, Any], *args, **kwargs):
@@ -221,14 +235,18 @@ class TrainStep:
 
         def loss_of(param_vals, buf_vals, key, *batch):
             def fwd(param_vals):
-                state = dict(zip(names, param_vals))
-                state.update(zip(buf_names, buf_vals))
+                sd_ = model.state_dict()
                 with _swapped_state(model, names + buf_names,
                                     list(param_vals) + list(buf_vals)):
                     with prandom.key_scope(key):
                         out = model(*[Tensor(b) for b in batch[:-1]])
                         loss = loss_fn(out, Tensor(batch[-1]))
-                return loss._value if isinstance(loss, Tensor) else loss
+                    # capture buffer mutations (BN running stats etc.)
+                    # BEFORE _swapped_state restores the originals — the
+                    # step threads them out functionally
+                    new_bufs = [sd_[n]._value for n in buf_names]
+                return (loss._value if isinstance(loss, Tensor)
+                        else loss), new_bufs
             if remat:
                 fwd = jax.checkpoint(fwd)
             return fwd(param_vals)
@@ -236,16 +254,16 @@ class TrainStep:
         from ..optimizer.jit_update import apply_update
 
         def step(param_vals, opt_states, buf_vals, lr, step_i, key, *batch):
-            loss, grads = jax.value_and_grad(loss_of)(
-                param_vals, buf_vals, key, *batch)
+            (loss, new_bufs), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(param_vals, buf_vals, key, *batch)
             new_params, new_states = [], []
             for p, g, s, wd in zip(param_vals, grads, opt_states, wds):
                 np_, ns = apply_update(upd, p, g, s, lr, wd, step_i, hp)
                 new_params.append(np_)
                 new_states.append(ns)
-            return loss, new_params, new_states
+            return loss, new_params, new_states, new_bufs
 
-        donate = (0, 1) if self._donate else ()
+        donate = (0, 1, 2) if self._donate else ()
         self._compiled = jax.jit(step, donate_argnums=donate)
 
     def __call__(self, *batch):
@@ -263,12 +281,14 @@ class TrainStep:
         key = prandom.next_key()
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                       for b in batch]
-        loss, new_params, new_states = self._compiled(
+        loss, new_params, new_states, new_bufs = self._compiled(
             param_vals, self._opt_states, buf_vals,
             jnp.asarray(lr, jnp.float32),
             jnp.asarray(self.optimizer._step_count, jnp.int32), key,
             *batch_vals)
         for n, v in zip(self._names, new_params):
+            sd[n]._value = v
+        for n, v in zip(self._buf_names, new_bufs):
             sd[n]._value = v
         self._opt_states = new_states
         return Tensor(loss)

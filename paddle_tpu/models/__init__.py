@@ -3,3 +3,7 @@ reference's Fleet engine trains in its baseline configs — llama, gpt, bert).
 """
 from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,  # noqa: F401
                     llama_tiny_config, llama_7b_config, shard_llama_tp)
+from .bert import (BertConfig, BertModel, BertForMaskedLM,  # noqa: F401
+                   bert_base_config, bert_tiny_config)
+from .gpt import (GPTConfig, GPTModel, GPTForCausalLM,  # noqa: F401
+                  gpt_tiny_config, gpt3_6b7_config, shard_gpt_tp)

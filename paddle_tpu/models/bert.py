@@ -1,0 +1,200 @@
+"""BERT family — baseline config 2 (BERT-base pretraining, DP +
+sharding stage-1; BASELINE.md).
+
+Reference capability: PaddleNLP-style BERT built on the reference's nn
+stack (`python/paddle/nn/` MultiHeadAttention/TransformerEncoder) and
+trained through Fleet DP with sharding stage 1.
+
+TPU-native design: the encoder is plain paddle_tpu.nn layers (Linear /
+LayerNorm / Embedding / Dropout) — everything jits into one XLA program
+via jit.TrainStep / ShardedTrainStep; attention dispatches through
+paddle_tpu.ops.attention (Pallas flash kernel on TPU, non-causal path).
+Post-LN residual blocks and learned position embeddings match the
+original BERT; the MLM decoder ties the word-embedding matrix.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.tensor import Tensor
+from ..framework.dispatch import run, to_tensor_args
+from .. import ops as tpu_ops
+
+__all__ = ["BertConfig", "BertModel", "BertForMaskedLM",
+           "bert_base_config", "bert_tiny_config"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.0
+    layer_norm_eps: float = 1e-12
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def bert_base_config(**kw):
+    cfg = BertConfig()
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def bert_tiny_config(**kw):
+    cfg = BertConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=128,
+                     max_position_embeddings=64, type_vocab_size=2)
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__(dtype=config.dtype)
+        self.word_embeddings = nn.Embedding(config.vocab_size,
+                                            config.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        (input_ids,) = to_tensor_args(input_ids)
+        seq = input_ids.shape[1]
+        pos = Tensor(jnp.arange(seq, dtype=jnp.int32)[None, :])
+        x = self.word_embeddings(input_ids) \
+            + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        h = config.hidden_size
+        self.query = nn.Linear(h, h)
+        self.key = nn.Linear(h, h)
+        self.value = nn.Linear(h, h)
+        self.out = nn.Linear(h, h)
+
+    def forward(self, x, attention_mask=None):
+        cfg = self.config
+        q, k, v = self.query(x), self.key(x), self.value(x)
+        (q, k, v) = to_tensor_args(q, k, v)
+        mask = attention_mask.value if isinstance(attention_mask, Tensor) \
+            else attention_mask
+
+        def _fn(qv, kv, vv):
+            b, s, h = qv.shape
+            nh, hd = cfg.num_attention_heads, cfg.head_dim
+            out = tpu_ops.attention(
+                qv.reshape(b, s, nh, hd), kv.reshape(b, s, nh, hd),
+                vv.reshape(b, s, nh, hd), mask=mask, causal=False)
+            return out.reshape(b, s, h)
+        ctx = run(_fn, q, k, v, name="bert_attention")
+        return self.out(ctx)
+
+
+class BertLayer(nn.Layer):
+    """Post-LN transformer block (original BERT residual order)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__(dtype=config.dtype)
+        self.attention = BertSelfAttention(config)
+        self.attn_norm = nn.LayerNorm(config.hidden_size,
+                                      epsilon=config.layer_norm_eps)
+        self.intermediate = nn.Linear(config.hidden_size,
+                                      config.intermediate_size)
+        self.output = nn.Linear(config.intermediate_size,
+                                config.hidden_size)
+        self.out_norm = nn.LayerNorm(config.hidden_size,
+                                     epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attention_mask=None):
+        x = self.attn_norm(x + self.dropout(
+            self.attention(x, attention_mask)))
+        y = self.output(nn.functional.gelu(self.intermediate(x)))
+        return self.out_norm(x + self.dropout(y))
+
+
+class BertModel(nn.Layer):
+    """Reference surface: paddlenlp BertModel(input_ids, token_type_ids,
+    attention_mask) -> (sequence_output, pooled_output)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.layers = nn.LayerList(
+            [BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        for layer in self.layers:
+            x = layer(x, attention_mask)
+        pooled = nn.functional.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForMaskedLM(nn.Layer):
+    """MLM head: dense + gelu + LN + tied-embedding decoder."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.bert = BertModel(config)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = nn.LayerNorm(config.hidden_size,
+                                           epsilon=config.layer_norm_eps)
+        from ..framework.tensor import Parameter
+        self.decoder_bias = Parameter(
+            jnp.zeros([config.vocab_size], jnp.float32))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq_out, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        x = self.transform_norm(
+            nn.functional.gelu(self.transform(seq_out)))
+        w = self.bert.embeddings.word_embeddings.weight
+        return run(lambda v, e, b: v @ e.T.astype(v.dtype)
+                   + b.astype(v.dtype),
+                   *to_tensor_args(x, w, self.decoder_bias),
+                   name="mlm_decoder")
+
+    def compute_loss(self, logits, labels, ignore_index=-100):
+        """Masked-position cross entropy (fp32)."""
+        (logits, labels) = to_tensor_args(logits, labels)
+        lbl = labels.value
+
+        def _fn(lg):
+            import jax
+            lgf = lg.astype(jnp.float32)
+            tgt = jnp.maximum(lbl.astype(jnp.int32), 0)
+            logp = jax.nn.log_softmax(lgf, axis=-1)
+            picked = jnp.take_along_axis(logp, tgt[..., None],
+                                         axis=-1)[..., 0]
+            mask = (lbl != ignore_index).astype(jnp.float32)
+            return -jnp.sum(picked * mask) / jnp.maximum(
+                jnp.sum(mask), 1.0)
+        return run(_fn, logits, name="mlm_loss")

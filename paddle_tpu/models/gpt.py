@@ -1,0 +1,194 @@
+"""GPT family — baseline config 4 (GPT-3-style hybrid TP+PP+sharding
+pretraining; BASELINE.md).
+
+Reference capability: PaddleNLP-style GPT trained by the Fleet hybrid
+engine (the reference's flagship static hybrid config).
+
+TPU-native design mirrors models/llama.py: parameters carry optional TP
+NamedShardings ('mp' axis — GSPMD inserts the collectives), fp32
+param_dtype + bf16 compute supported, attention through
+paddle_tpu.ops.attention (Pallas flash kernel, causal), pre-LN blocks
+with learned position embeddings and gelu MLP (the GPT-2/3 recipe, vs
+llama's RMSNorm/rope/swiglu)."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.tensor import Tensor, Parameter
+from ..framework.dispatch import run, to_tensor_args
+from .. import ops as tpu_ops
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny_config",
+           "gpt3_6b7_config", "shard_gpt_tp"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 4096
+    intermediate_size: int = 16384
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    max_position_embeddings: int = 2048
+    layer_norm_epsilon: float = 1e-5
+    dtype: str = "bfloat16"
+    param_dtype: str | None = None
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def compute_dtype(self):
+        return jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+
+
+def gpt_tiny_config(**kw):
+    cfg = GPTConfig(vocab_size=256, hidden_size=64,
+                    intermediate_size=128, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=128,
+                    dtype="float32")
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def gpt3_6b7_config(**kw):
+    cfg = GPTConfig()
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _w(shape, std, dtype):
+    from ..nn.initializer import Normal
+    return Normal(0.0, std)(tuple(shape), dtype)
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__(dtype=config.dtype)
+        cfg = self.config = config
+        h, i = cfg.hidden_size, cfg.intermediate_size
+        pd = cfg.param_dtype or cfg.dtype
+        std = 0.02
+        self.ln1 = nn.LayerNorm(h, epsilon=cfg.layer_norm_epsilon)
+        self.qkv = Parameter(_w([h, 3 * h], std, pd))
+        self.qkv_bias = Parameter(jnp.zeros([3 * h], jnp.float32))
+        self.proj = Parameter(_w([h, h], std / math.sqrt(
+            2 * cfg.num_hidden_layers), pd))
+        self.proj_bias = Parameter(jnp.zeros([h], jnp.float32))
+        self.ln2 = nn.LayerNorm(h, epsilon=cfg.layer_norm_epsilon)
+        self.fc_in = Parameter(_w([h, i], std, pd))
+        self.fc_in_bias = Parameter(jnp.zeros([i], jnp.float32))
+        self.fc_out = Parameter(_w([i, h], std / math.sqrt(
+            2 * cfg.num_hidden_layers), pd))
+        self.fc_out_bias = Parameter(jnp.zeros([h], jnp.float32))
+
+    def forward(self, x):
+        cfg = self.config
+        (x,) = to_tensor_args(x)
+
+        def _attn(v, wqkv, bqkv, wo, bo):
+            cd = v.dtype
+            b, s, h = v.shape
+            nh, hd = cfg.num_attention_heads, cfg.head_dim
+            qkv = v @ wqkv.astype(cd) + bqkv.astype(cd)
+            q, k, val = jnp.split(qkv, 3, axis=-1)
+            out = tpu_ops.attention(
+                q.reshape(b, s, nh, hd), k.reshape(b, s, nh, hd),
+                val.reshape(b, s, nh, hd), causal=True)
+            return out.reshape(b, s, h) @ wo.astype(cd) + bo.astype(cd)
+
+        def _mlp(v, wi, bi, wo, bo):
+            cd = v.dtype
+            y = jax.nn.gelu(v @ wi.astype(cd) + bi.astype(cd),
+                            approximate=True)
+            return y @ wo.astype(cd) + bo.astype(cd)
+
+        a = run(_attn, self.ln1(x), self.qkv, self.qkv_bias, self.proj,
+                self.proj_bias, name="gpt_attention")
+        x = x + a
+        m = run(_mlp, self.ln2(x), self.fc_in, self.fc_in_bias,
+                self.fc_out, self.fc_out_bias, name="gpt_mlp")
+        return x + m
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__(dtype=config.dtype)
+        cfg = self.config = config
+        pd = cfg.param_dtype or cfg.dtype
+        self.wte = Parameter(_w([cfg.vocab_size, cfg.hidden_size], 0.02,
+                                pd))
+        self.wpe = Parameter(_w([cfg.max_position_embeddings,
+                                 cfg.hidden_size], 0.01, pd))
+        self.layers = nn.LayerList(
+            [GPTBlock(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        cfg = self.config
+        (input_ids,) = to_tensor_args(input_ids)
+        seq = input_ids.shape[1]
+        x = run(lambda w, p: (jnp.take(w, input_ids.value.astype(
+                    jnp.int32), axis=0) + p[:seq]).astype(
+                        cfg.compute_dtype),
+                self.wte, self.wpe, name="gpt_embedding")
+        for layer in self.layers:
+            x = layer(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """Tied-embedding LM head (GPT-2/3 recipe)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.gpt = GPTModel(config)
+
+    def forward(self, input_ids):
+        x = self.gpt(input_ids)
+        w = self.gpt.wte
+        return run(lambda v, e: v @ e.T.astype(v.dtype), x, w,
+                   name="gpt_lm_head")
+
+    def compute_loss(self, logits, labels):
+        (logits, labels) = to_tensor_args(logits, labels)
+        lbl = labels.value
+
+        def _fn(lg):
+            lgf = lg[:, :-1].astype(jnp.float32)
+            tgt = lbl[:, 1:].astype(jnp.int32)
+            logp = jax.nn.log_softmax(lgf, axis=-1)
+            picked = jnp.take_along_axis(logp, tgt[..., None],
+                                         axis=-1)[..., 0]
+            return -jnp.mean(picked)
+        return run(_fn, logits, name="gpt_lm_loss")
+
+
+def shard_gpt_tp(model: GPTForCausalLM, mesh):
+    """Megatron TP layout over the 'mp' axis: qkv/fc_in column-sharded,
+    proj/fc_out row-sharded, embeddings vocab-sharded."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(p, spec):
+        p._value = jax.device_put(p.value, NamedSharding(mesh, spec))
+
+    put(model.gpt.wte, P("mp", None))
+    for layer in model.gpt.layers:
+        put(layer.qkv, P(None, "mp"))
+        put(layer.qkv_bias, P("mp"))
+        put(layer.proj, P("mp", None))
+        put(layer.fc_in, P(None, "mp"))
+        put(layer.fc_in_bias, P("mp"))
+        put(layer.fc_out, P("mp", None))
+    return model

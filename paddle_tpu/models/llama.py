@@ -167,6 +167,43 @@ class LlamaAttention(nn.Layer):
         return run(_fn, x, self.q_proj, self.k_proj, self.v_proj,
                    self.o_proj, name="attention")
 
+    # split entry points for the selective-recompute block structure
+    # (forward above stays the single fused path)
+    def qkv_rope(self, x, cos, sin):
+        cfg = self.config
+        (x,) = to_tensor_args(x)
+        cos_a = cos.value if isinstance(cos, Tensor) else cos
+        sin_a = sin.value if isinstance(sin, Tensor) else sin
+
+        def _fn(v, wq, wk, wv):
+            cd = v.dtype
+            b, s, h = v.shape
+            q = (v @ wq.astype(cd)).reshape(b, s, cfg.num_attention_heads,
+                                            cfg.head_dim)
+            k = (v @ wk.astype(cd)).reshape(b, s, cfg.num_key_value_heads,
+                                            cfg.head_dim)
+            val = (v @ wv.astype(cd)).reshape(b, s,
+                                              cfg.num_key_value_heads,
+                                              cfg.head_dim)
+            q, k = tpu_ops.apply_rope(q, k, cos_a, sin_a)
+            return q, k, val
+        return run(_fn, x, self.q_proj, self.k_proj, self.v_proj,
+                   name="qkv_rope")
+
+    def core_attention(self, q, k, v):
+        q, k, v = to_tensor_args(q, k, v)
+        return run(lambda a, b_, c: tpu_ops.attention(a, b_, c,
+                                                      causal=True),
+                   q, k, v, name="core_attention")
+
+    def output_proj(self, attn):
+        (attn,) = to_tensor_args(attn)
+
+        def _fn(a, wo):
+            b, s = a.shape[0], a.shape[1]
+            return a.reshape(b, s, -1) @ wo.astype(a.dtype)
+        return run(_fn, attn, self.o_proj, name="attn_out_proj")
+
 
 class LlamaMLP(nn.Layer):
     def __init__(self, config: LlamaConfig):
@@ -207,17 +244,42 @@ class LlamaDecoderLayer(nn.Layer):
         if self._recompute:
             # per-layer activation checkpointing (reference:
             # fleet.recompute wrapping each decoder block).  "full" keeps
-            # only the residual-stream boundary; "selective" additionally
-            # saves the tagged attention-side values, so the backward
-            # replays only the MLP matmuls + the flash-attn forward
-            from ..distributed.fleet.recompute import recompute
-            policy = None
+            # only the residual-stream boundary; "selective" splits the
+            # block so the flash-attention call sits OUTSIDE the remat
+            # regions — its custom_vjp residuals (q/k/v/out/lse) are
+            # saved normally and the backward replays only the qkv
+            # projections' norms and the MLP matmuls
             if self.config.recompute_granularity == "selective":
-                policy = jax.checkpoint_policies.save_only_these_names(
-                    "flash_q", "flash_k", "flash_v", "attn_out",
-                    "resid_mid")
-            return recompute(self._block, x, cos, sin, policy=policy)
+                return self._forward_selective(x, cos, sin)
+            from ..distributed.fleet.recompute import recompute
+            return recompute(self._block, x, cos, sin)
         return self._block(x, cos, sin)
+
+    def _forward_selective(self, x, cos, sin):
+        from ..distributed.fleet.recompute import recompute
+        # region A: norm1 + qkv + rope.  The region outputs (post-rope
+        # q/k/v) are remat boundaries — saved; internals replayed.
+        q, k, v = recompute(self._qkv_part, x, cos, sin)
+        # flash attention runs unrematerialized (saves out + lse)
+        attn = self.self_attn.core_attention(q, k, v)
+        # region B: o_proj + residuals + norm2 + MLP; only the tagged
+        # mid-residual is saved, the MLP matmuls replay in the backward
+        policy = jax.checkpoint_policies.save_only_these_names(
+            "resid_mid")
+        return recompute(self._post_attention, x, attn, policy=policy)
+
+    def _qkv_part(self, x, cos, sin):
+        return self.self_attn.qkv_rope(self.input_layernorm(x), cos, sin)
+
+    def _post_attention(self, x, attn):
+        from jax.ad_checkpoint import checkpoint_name
+        from ..parallel.sharded_trainer import constrain_activation
+        x = x + self.self_attn.output_proj(attn)
+        x = run(lambda v: checkpoint_name(constrain_activation(v),
+                                          "resid_mid"), x,
+                name="tag_resid")
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return run(constrain_activation, x, name="constrain_resid")
 
     def _block(self, x, cos, sin):
         from jax.ad_checkpoint import checkpoint_name

@@ -76,10 +76,15 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         vf = x.value.astype(jnp.float32)
         bm = jnp.mean(vf, axis=red_axes)
         bv = jnp.var(vf, axis=red_axes)
-        # update running stats in place (host-side, eager only — compiled
-        # trainers thread state functionally; see nn/layer/norm.py)
-        if running_mean is not None and not isinstance(
-                x.value, jax.core.Tracer):
+        # update running stats in place: eager mutation always; under
+        # jit tracing ONLY inside _swapped_state (the jitted trainers
+        # capture the traced buffer values and thread them out of the
+        # step; anywhere else a traced write would leak a tracer into
+        # the live buffer)
+        from ...jit import in_swapped_state
+        if running_mean is not None and (
+                not isinstance(x.value, jax.core.Tracer)
+                or in_swapped_state()):
             rm = running_mean.value.astype(jnp.float32)
             rv = running_var.value.astype(jnp.float32)
             running_mean._value = (momentum * rm + (1 - momentum) * bm

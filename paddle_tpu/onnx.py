@@ -1,0 +1,41 @@
+"""Model export interop (reference: `python/paddle/onnx/export.py` —
+`paddle.onnx.export(layer, path, input_spec)` producing a portable
+inference artifact via paddle2onnx).
+
+TPU-native: the portable interchange format for XLA-compiled models is
+**serialized StableHLO** (jax.export), not ONNX protobufs — it is
+versioned, backward-compatible, and loadable by any StableHLO consumer
+(JAX, TF SavedModel via XlaCallModule, IREE, OpenXLA runtimes).
+`export()` here wraps jit.save: one `.pdmodel.stablehlo` artifact holds
+the lowered module + weights; `load()` restores an executable
+(paddle_tpu.jit.load / inference.Predictor consume the same artifact).
+ONNX-protobuf emission is intentionally NOT provided: a faithful
+op-by-op ONNX graph would bypass XLA and reintroduce the kernel-library
+surface this framework deliberately delegates to the compiler
+(SURVEY §7 design stance).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["export", "load"]
+
+
+def export(layer, path, input_spec=None, opset_version=None, **configs):
+    """Export `layer` as a serialized-StableHLO artifact at
+    `path + '.pdmodel'` (reference signature: onnx/export.py export;
+    opset_version accepted for API parity and ignored — StableHLO
+    carries its own versioning).
+
+    Returns the artifact path."""
+    from .jit import save as jit_save
+    base = path[:-8] if path.endswith(".pdmodel") else path
+    jit_save(layer, base, input_spec=input_spec, **configs)
+    return base + ".pdmodel"
+
+
+def load(path):
+    """Load an exported artifact back as an executable layer."""
+    from .jit import load as jit_load
+    base = path[:-8] if path.endswith(".pdmodel") else path
+    return jit_load(base)

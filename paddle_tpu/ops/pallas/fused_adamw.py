@@ -101,10 +101,28 @@ def fused_adamw(grad, m, v, master, lr, step, *, b1=0.9, b2=0.999,
     # tiling, large contiguous DMAs per grid step.  Fallback: flat 1-D
     # chunks for shapes that don't divide.
     lanes = 1024
-    if n % lanes == 0:
+    fp32_params_mode = jnp.dtype(out_dtype) == jnp.float32
+    if n % lanes == 0 and (n // lanes) % 8 == 0:
+        # Mosaic needs the sublane dim divisible by 8 (or the full
+        # array); block rows sized so the double-buffered operand +
+        # result set stays well under the ~16 MiB scoped VMEM
         rows = n // lanes
-        br = next((d for d in (256, 128, 64, 32, 16, 8, 4, 2, 1)
-                   if rows % d == 0))
+        esz = (jnp.dtype(grad.dtype).itemsize + 4  # g + master
+               + 2 * jnp.dtype(m.dtype).itemsize)  # moments in
+        esz += esz if fp32_params_mode else esz + 2  # outputs
+        budget = 8 * 1024 * 1024
+        br = next((d for d in (256, 128, 64, 32, 16, 8)
+                   if rows % d == 0 and 2 * d * lanes * esz <= budget),
+                  None)
+        if br is None:
+            br = next(d for d in (256, 128, 64, 32, 16, 8)
+                      if rows % d == 0)
+            br = min(br, 8)
+            if rows % br:
+                br = None
+    else:
+        br = None
+    if br is not None:
         work_shape = (rows, lanes)
         grid = (rows // br,)
         blk = pl.BlockSpec((br, lanes), lambda i: (i, 0))

@@ -223,6 +223,7 @@ class ShardedTrainStep:
 
         def loss_of(param_vals, buf_vals, key, batch):
             def fwd(param_vals):
+                sd_ = model.state_dict()
                 with _swapped_state(model, names + buf_names,
                                     list(param_vals) + list(buf_vals)):
                     with prandom.key_scope(key), \
@@ -236,7 +237,11 @@ class ShardedTrainStep:
                             loss = loss_fn(out, Tensor(batch[-1]))
                         else:
                             loss = model.compute_loss(out, Tensor(batch[-1]))
-                return loss._value if isinstance(loss, Tensor) else loss
+                    # capture buffer mutations (BN running stats etc.)
+                    # before _swapped_state restores the originals
+                    new_bufs = [sd_[n]._value for n in buf_names]
+                return (loss._value if isinstance(loss, Tensor)
+                        else loss), new_bufs
             if remat:
                 fwd = jax.checkpoint(fwd)
             return fwd(param_vals)
@@ -261,8 +266,8 @@ class ShardedTrainStep:
         opt_specs = [self._opt_shardings[n].spec for n in names]
 
         def step(param_vals, opt_states, buf_vals, lr, step_i, key, batch):
-            loss, grads = jax.value_and_grad(loss_of)(param_vals, buf_vals,
-                                                      key, batch)
+            (loss, new_bufs), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(param_vals, buf_vals, key, batch)
             if grad_shardings is not None:
                 grads = [jax.lax.with_sharding_constraint(g, gs)
                          for g, gs in zip(grads, grad_shardings)]
@@ -274,17 +279,18 @@ class ShardedTrainStep:
                     step_i, hp, fused_ok=fused_ok, mesh=mesh, spec=sp)
                 new_params.append(np_)
                 new_states.append(ns)
-            return loss, new_params, new_states
+            return loss, new_params, new_states, new_bufs
 
         param_sh = [self._param_shardings[n] for n in names]
         opt_sh = []
         for n, st in zip(names, self._opt_states):
             opt_sh.append({k: self._opt_shardings[n] for k in st})
-        donate = (0, 1) if self._donate else ()
+        buf_sh = [None] * len(buf_names)
+        donate = (0, 1, 2) if self._donate else ()
         with self.mesh:
             self._compiled = jax.jit(
                 step, donate_argnums=donate,
-                out_shardings=(None, param_sh, opt_sh))
+                out_shardings=(None, param_sh, opt_sh, buf_sh))
 
     def compiled_hlo(self, *batch, optimized: bool = True) -> str:
         """Compile the step for `batch` (without executing) and return the
@@ -324,12 +330,14 @@ class ShardedTrainStep:
         lr = self.optimizer.get_lr()
         key = prandom.next_key()
         with watched("sharded train step"):
-            loss, new_params, new_states = self._compiled(
+            loss, new_params, new_states, new_bufs = self._compiled(
                 param_vals, self._opt_states, buf_vals,
                 jnp.asarray(lr, jnp.float32),
                 jnp.asarray(self.optimizer._step_count, jnp.int32), key,
                 batch_vals)
         for n, v in zip(self._names, new_params):
+            sd[n]._value = v
+        for n, v in zip(self._buf_names, new_bufs):
             sd[n]._value = v
         self._opt_states = new_states
         return Tensor(loss)

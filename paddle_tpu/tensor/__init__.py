@@ -32,6 +32,58 @@ from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
 from .attribute import rank, is_floating_point, is_integer, is_complex  # noqa: F401
+from . import extras
+from .extras import *  # noqa: F401,F403
+
+# mechanical in-place (`op_`) variants over the flat namespace
+# (reference: the `_`-suffixed half of paddle.__all__)
+from .inplace import make_inplace_variants as _miv
+globals().update(_miv(globals()))
+
+
+def _random_inplace(fill):
+    def op_(x, *args, **kwargs):
+        out = fill(x, *args, **kwargs)
+        x._value = out if not isinstance(out, Tensor) else out._value
+        return x
+    return op_
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """In-place Cauchy(loc, scale) fill (reference paddle.cauchy_)."""
+    import jax
+    import jax.numpy as jnp
+    from ..framework.random import next_key
+    x._value = (loc + scale * jax.random.cauchy(
+        next_key(), x.value.shape)).astype(x.value.dtype)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    """In-place Geometric(probs) fill (reference paddle.geometric_)."""
+    import jax
+    import jax.numpy as jnp
+    from ..framework.random import next_key
+    u = jax.random.uniform(next_key(), x.value.shape, minval=1e-7,
+                           maxval=1.0)
+    x._value = jnp.ceil(
+        jnp.log1p(-u) / jnp.log1p(-jnp.float32(probs))
+    ).astype(x.value.dtype)
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """In-place exp(Normal(mean, std)) fill (reference
+    paddle.log_normal_)."""
+    import jax
+    import jax.numpy as jnp
+    from ..framework.random import next_key
+    x._value = jnp.exp(
+        jnp.float32(mean)
+        + jnp.float32(std) * jax.random.normal(next_key(),
+                                               x.value.shape)
+    ).astype(x.value.dtype)
+    return x
 
 # names that must not shadow Tensor's own properties/attrs
 _SKIP_METHODS = {

@@ -1,0 +1,195 @@
+"""Long-tail tensor ops completing the reference top-level `__all__`.
+
+Reference: python/paddle/tensor/manipulation.py (hstack/vstack/dstack
+:~stack family, unbind, as_strided, unfold, diagonal_scatter),
+math.py (add_n, isreal, sinc, multigammaln, reduce_as, log_normal,
+hypot-family lives in math already), linalg.py (histogram_bin_edges),
+random.py (standard_gamma).  All lowered to jnp.
+"""
+from __future__ import annotations
+
+import math as _math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.dispatch import run, to_tensor_args
+
+__all__ = ["hstack", "vstack", "dstack", "unbind", "reverse", "add_n",
+           "isreal", "histogram_bin_edges", "multigammaln",
+           "standard_gamma", "log_normal", "reduce_as", "as_strided",
+           "unfold", "diagonal_scatter", "shape"]
+
+
+def _stack_impl(x, fn, name):
+    ts = to_tensor_args(*x)
+    return run(lambda *vs: fn(vs), *ts, name=name)
+
+
+def hstack(x, name=None):
+    return _stack_impl(x, jnp.hstack, "hstack")
+
+
+def vstack(x, name=None):
+    return _stack_impl(x, jnp.vstack, "vstack")
+
+
+def dstack(x, name=None):
+    return _stack_impl(x, jnp.dstack, "dstack")
+
+
+def unbind(input, axis=0):
+    (input,) = to_tensor_args(input)
+    n = input.shape[axis]
+    return [run(lambda v, i=i: jnp.take(v, i, axis=axis), input,
+                name="unbind")
+            for i in range(n)]
+
+
+def reverse(x, axis, name=None):
+    (x,) = to_tensor_args(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return run(lambda v: jnp.flip(v, axis=tuple(axes)), x, name="reverse")
+
+
+def add_n(inputs, name=None):
+    ts = to_tensor_args(*(inputs if isinstance(inputs, (list, tuple))
+                          else [inputs]))
+    return run(lambda *vs: sum(vs[1:], vs[0]), *ts, name="add_n")
+
+
+def isreal(x, name=None):
+    (x,) = to_tensor_args(x)
+    return run(lambda v: (jnp.imag(v) == 0
+                          if jnp.iscomplexobj(v)
+                          else jnp.ones(v.shape, bool)),
+               x, name="isreal")
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    (input,) = to_tensor_args(input)
+
+    def _fn(v):
+        lo, hi = jnp.float32(min), jnp.float32(max)
+        same = lo == hi
+        vmin = jnp.where(same, jnp.min(v).astype(jnp.float32), lo)
+        vmax = jnp.where(same, jnp.max(v).astype(jnp.float32), hi)
+        vmax = jnp.where(vmax == vmin, vmin + 1.0, vmax)
+        return jnp.linspace(vmin, vmax, bins + 1)
+    return run(_fn, input, name="histogram_bin_edges")
+
+
+def multigammaln(x, p, name=None):
+    (x,) = to_tensor_args(x)
+
+    def _fn(v):
+        vf = v.astype(jnp.float32)
+        out = jnp.full_like(vf, 0.25 * p * (p - 1) * _math.log(_math.pi))
+        for i in range(p):
+            out = out + jax.scipy.special.gammaln(vf - 0.5 * i)
+        return out
+    return run(_fn, x, name="multigammaln")
+
+
+def standard_gamma(x, name=None):
+    """Sample Gamma(alpha=x, 1) elementwise (reference
+    paddle.standard_gamma)."""
+    (x,) = to_tensor_args(x)
+    from ..framework.random import next_key
+
+    def _fn(v):
+        return jax.random.gamma(next_key(), v.astype(jnp.float32),
+                                shape=v.shape).astype(v.dtype)
+    return run(_fn, x, name="standard_gamma")
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    """exp(Normal(mean, std)) samples (reference paddle.log_normal)."""
+    from ..framework.random import next_key
+    dt = jnp.dtype(dtype) if dtype else jnp.float32
+    sh = tuple(shape) if shape is not None else ()
+    out = jnp.exp(jnp.float32(mean)
+                  + jnp.float32(std) * jax.random.normal(next_key(), sh))
+    return Tensor(out.astype(dt))
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x to the shape of target (reference paddle.reduce_as)."""
+    (x, target) = to_tensor_args(x, target)
+    tgt_shape = tuple(target.shape)
+
+    def _fn(v):
+        out = v
+        while out.ndim > len(tgt_shape):
+            out = jnp.sum(out, axis=0)
+        axes = tuple(i for i, (a, b) in enumerate(zip(out.shape,
+                                                      tgt_shape))
+                     if a != b and b == 1)
+        if axes:
+            out = jnp.sum(out, axis=axes, keepdims=True)
+        return out
+    return run(_fn, x, name="reduce_as")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view (reference paddle.as_strided; here a gather copy —
+    XLA has no aliased strided views)."""
+    (x,) = to_tensor_args(x)
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+
+    def _fn(v):
+        flat = v.reshape(-1)
+        idx = np.zeros(shape, np.int64) + offset
+        for d, (n, st) in enumerate(zip(shape, stride)):
+            ix = np.arange(n) * st
+            idx += ix.reshape((1,) * d + (n,) + (1,) * (len(shape) - d - 1))
+        return flat[jnp.asarray(idx.reshape(-1))].reshape(shape)
+    return run(_fn, x, name="as_strided")
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along axis (reference paddle.unfold / torch
+    Tensor.unfold semantics: appends a window dim)."""
+    (x,) = to_tensor_args(x)
+
+    def _fn(v):
+        n = v.shape[axis]
+        starts = np.arange(0, n - size + 1, step)
+        wins = [jax.lax.slice_in_dim(v, int(s), int(s) + size, axis=axis)
+                for s in starts]
+        stacked = jnp.stack(wins, axis=axis)
+        return jnp.moveaxis(stacked, axis + 1, -1)
+    return run(_fn, x, name="unfold")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write y onto the selected diagonal of x (reference
+    paddle.diagonal_scatter)."""
+    (x, y) = to_tensor_args(x, y)
+
+    def _fn(v, w):
+        n1, n2 = v.shape[axis1], v.shape[axis2]
+        if offset >= 0:
+            i = jnp.arange(min(n1, n2 - offset))
+            j = i + offset
+        else:
+            j = jnp.arange(min(n2, n1 + offset))
+            i = j - offset
+        # move target axes to front for a clean scatter
+        perm = ([axis1, axis2]
+                + [a for a in range(v.ndim) if a not in (axis1, axis2)])
+        inv = np.argsort(perm)
+        vt = jnp.transpose(v, perm)
+        wt = jnp.moveaxis(w, -1, 0) if w.ndim == v.ndim - 1 else w
+        vt = vt.at[i, j].set(wt.astype(vt.dtype))
+        return jnp.transpose(vt, inv)
+    return run(_fn, x, y, name="diagonal_scatter")
+
+
+def shape(input):
+    """Runtime shape as a 1-D int32 tensor (reference paddle.shape)."""
+    (input,) = to_tensor_args(input)
+    return Tensor(jnp.asarray(input.shape, jnp.int32))

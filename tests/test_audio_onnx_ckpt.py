@@ -1,0 +1,146 @@
+"""paddle.audio + onnx-equivalent export + async distributed checkpoint.
+
+Reference: python/paddle/audio/ (features/functional/backends),
+python/paddle/onnx/export.py, distributed/checkpoint/save_state_dict.py
+(:46 async save queue).
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestAudioFunctional:
+    def test_mel_scale_roundtrip(self):
+        from paddle_tpu.audio.functional import hz_to_mel, mel_to_hz
+        f = np.array([55.0, 440.0, 4000.0], np.float32)
+        back = np.asarray(mel_to_hz(hz_to_mel(f)))
+        np.testing.assert_allclose(back, f, rtol=1e-4)
+        back_htk = np.asarray(mel_to_hz(hz_to_mel(f, htk=True), htk=True))
+        np.testing.assert_allclose(back_htk, f, rtol=1e-4)
+
+    def test_fbank_shape_and_coverage(self):
+        from paddle_tpu.audio.functional import compute_fbank_matrix
+        fb = np.asarray(compute_fbank_matrix(16000, 512, n_mels=40))
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all() and fb.sum() > 0
+        # every mel filter covers some bins
+        assert (fb.sum(axis=1) > 0).all()
+
+    def test_power_to_db(self):
+        from paddle_tpu.audio.functional import power_to_db
+        db = np.asarray(power_to_db(np.array([1.0, 10.0, 100.0])))
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-4)
+
+    def test_dct_orthonormal(self):
+        from paddle_tpu.audio.functional import create_dct
+        d = np.asarray(create_dct(13, 40))
+        gram = d.T @ d
+        np.testing.assert_allclose(gram, np.eye(13), atol=1e-4)
+
+
+class TestAudioFeatures:
+    def test_spectrogram_tone_peak(self):
+        """A pure tone's spectrogram peaks at the right FFT bin."""
+        from paddle_tpu.audio.features import Spectrogram
+        sr, n_fft = 16000, 512
+        t = np.arange(sr // 4) / sr
+        tone = np.sin(2 * np.pi * 1000.0 * t).astype(np.float32)
+        spec = Spectrogram(n_fft=n_fft)(paddle.to_tensor(tone[None]))
+        s = np.asarray(spec.value)[0]          # [bins, frames]
+        peak_bin = int(s.mean(axis=1).argmax())
+        expect = round(1000.0 * n_fft / sr)
+        assert abs(peak_bin - expect) <= 1
+
+    def test_mfcc_pipeline_shapes(self):
+        from paddle_tpu.audio.features import (MelSpectrogram,
+                                               LogMelSpectrogram, MFCC)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8000).astype(np.float32))
+        mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert mel.shape[0] == 2 and mel.shape[1] == 40
+        lm = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert lm.shape == mel.shape
+        mf = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+        assert mf.shape[0] == 2 and mf.shape[1] == 13
+
+
+class TestAudioBackends:
+    def test_wav_roundtrip(self, tmp_path):
+        from paddle_tpu import audio
+        sr = 16000
+        wav = np.sin(np.linspace(0, 100, 4000)).astype(np.float32)[None]
+        p = str(tmp_path / "t.wav")
+        audio.save(p, wav, sr)
+        meta = audio.info(p)
+        assert meta.sample_rate == sr and meta.num_channels == 1
+        back, sr2 = audio.load(p)
+        assert sr2 == sr
+        np.testing.assert_allclose(back, wav, atol=1e-3)
+
+    def test_datasets_learnable_labels(self):
+        from paddle_tpu.audio.datasets import TESS
+        ds = TESS(mode="train", n_synthetic=16)
+        x, y = ds[0]
+        assert x.ndim == 1 and 0 <= y < 7
+        mf, _ = TESS(mode="train", n_synthetic=4, feat_type="mfcc",
+                     n_mfcc=13)[0]
+        assert mf.shape[0] == 13
+
+
+class TestOnnxExport:
+    def test_export_load_roundtrip(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.static import InputSpec
+        paddle.seed(0)
+        layer = nn.Sequential(nn.Linear(4, 8), nn.ReLU(),
+                              nn.Linear(8, 2))
+        p = str(tmp_path / "model")
+        out_path = paddle.onnx.export(
+            layer, p, input_spec=[InputSpec([-1, 4], "float32")])
+        assert os.path.exists(out_path)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 4).astype(np.float32))
+        want = np.asarray(layer(x).value)
+        loaded = paddle.onnx.load(out_path)
+        got = np.asarray(loaded(x).value)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestAsyncCheckpoint:
+    def test_async_save_matches_sync(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import (
+            save_state_dict, load_state_dict, synchronize_async_saves)
+        import paddle_tpu.nn as nn
+        paddle.seed(1)
+        m = nn.Linear(4, 4)
+        sd = m.state_dict()
+        fut = save_state_dict(sd, str(tmp_path / "async"),
+                              async_save=True)
+        synchronize_async_saves()
+        assert fut.done()
+        paddle.seed(2)
+        m2 = nn.Linear(4, 4)
+        load_state_dict(m2.state_dict(), str(tmp_path / "async"))
+        np.testing.assert_allclose(np.asarray(m2.weight.value),
+                                   np.asarray(m.weight.value))
+
+    def test_async_save_snapshot_isolated_from_updates(self, tmp_path):
+        """The checkpoint must hold the values AT CALL TIME even if the
+        params are mutated right after (the donation hazard the sync
+        snapshot protects against)."""
+        from paddle_tpu.distributed.checkpoint import (
+            save_state_dict, load_state_dict, synchronize_async_saves)
+        import jax.numpy as jnp
+        from paddle_tpu.framework.tensor import Tensor
+        t = Tensor(jnp.ones((8,), jnp.float32))
+        save_state_dict({"w": t}, str(tmp_path / "snap"),
+                        async_save=True)
+        t._value = jnp.zeros((8,), jnp.float32)  # mutate immediately
+        synchronize_async_saves()
+        probe = Tensor(jnp.full((8,), 7.0))
+        load_state_dict({"w": probe}, str(tmp_path / "snap"))
+        np.testing.assert_allclose(np.asarray(probe.value), np.ones(8))

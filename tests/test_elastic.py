@@ -41,6 +41,14 @@ def _env(tmp_path):
                 + os.environ.get("PYTHONPATH", ""))
 
 
+def _start_master(port):
+    """Host the KV master in the TEST process: either launcher may die
+    in these scenarios, and the store must survive it (in production a
+    dedicated master/etcd plays this role)."""
+    from paddle_tpu.distributed.launch.master import KVServer
+    return KVServer(port).start()
+
+
 def test_scale_in_on_pod_death(tmp_path):
     """Kill one of two pods mid-run: the survivor re-forms at world
     size 1 and finishes (reference: scale-in on lease expiry)."""
@@ -59,13 +67,14 @@ def test_scale_in_on_pod_death(tmp_path):
         # world 1 (post scale-in): finish cleanly
     """))
     port = _free_port()
+    srv = _start_master(port)
     env = _env(tmp_path)
     procs = [subprocess.Popen(
         _launcher_cmd(port, tmp_path, "ei", script), env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for _ in range(2)]
     # let the gang form and children start
-    deadline = time.time() + 60
+    deadline = time.time() + 120
     while time.time() < deadline and not (
             (tmp_path / "run.0.0.json").exists()
             and (tmp_path / "run.0.1.json").exists()):
@@ -74,7 +83,10 @@ def test_scale_in_on_pod_death(tmp_path):
     # fault injection: SIGKILL the second launcher (heartbeat stops)
     procs[1].kill()
     procs[1].wait()
-    out, _ = procs[0].communicate(timeout=120)
+    try:
+        out, _ = procs[0].communicate(timeout=300)
+    finally:
+        srv.stop()
     assert procs[0].returncode == 0, out.decode()[-2000:]
     assert b"elastic re-form" in out
     # the survivor relaunched at world size 1, epoch 1
@@ -102,11 +114,12 @@ def test_scale_out_admits_new_pod(tmp_path):
             time.sleep(120)   # hold until the scale-out re-form kills us
     """))
     port = _free_port()
+    srv = _start_master(port)
     env = _env(tmp_path)
     first = subprocess.Popen(
         _launcher_cmd(port, tmp_path, "eo", script), env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-    deadline = time.time() + 60
+    deadline = time.time() + 120
     while time.time() < deadline and not (
             tmp_path / "run.0.0.json").exists():
         time.sleep(0.5)
@@ -114,8 +127,11 @@ def test_scale_out_admits_new_pod(tmp_path):
     second = subprocess.Popen(
         _launcher_cmd(port, tmp_path, "eo", script), env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-    out1, _ = first.communicate(timeout=120)
-    out2, _ = second.communicate(timeout=120)
+    try:
+        out1, _ = first.communicate(timeout=300)
+        out2, _ = second.communicate(timeout=300)
+    finally:
+        srv.stop()
     assert first.returncode == 0, out1.decode()[-2000:]
     assert second.returncode == 0, out2.decode()[-2000:]
     # both ranks ran at world 2 in a later epoch

@@ -1,0 +1,90 @@
+"""RPC (reference: python/paddle/distributed/rpc/rpc.py — init_rpc,
+rpc_sync/rpc_async over the worker gang).  Two real workers over the
+launcher KV store; in-process master."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.launch import parse_args, CollectiveController
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import os, json
+import paddle_tpu.distributed.rpc as rpc
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+rpc.init_rpc(f"worker{rank}")
+# snapshot the gang BEFORE issuing calls: a fast peer may shutdown (and
+# deregister) while we are still collecting results
+workers = [w.name for w in rpc.get_all_worker_infos()]
+
+def add(a, b):
+    return a + b
+
+def whoami():
+    return rpc.get_current_worker_info().name
+
+peer = f"worker{1 - rank}"
+out = {
+    "sum": rpc.rpc_sync(peer, add, args=(rank * 10, 5)),
+    "peer_name": rpc.rpc_sync(peer, whoami),
+    "async": rpc.rpc_async(peer, add, args=(1, 2)).result(),
+    "workers": workers,
+}
+with open(os.path.join(os.environ["DUMP_DIR"],
+                       f"rpc.{rank}.json"), "w") as f:
+    json.dump(out, f)
+rpc.shutdown()
+"""
+
+
+def test_rpc_two_workers(tmp_path):
+    import json
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(WORKER))
+    os.environ["DUMP_DIR"] = str(tmp_path)
+    os.environ["PYTHONPATH"] = REPO + os.pathsep \
+        + os.environ.get("PYTHONPATH", "")
+    try:
+        args = parse_args([
+            "--nproc_per_node=2", f"--log_dir={tmp_path}/log",
+            "--job_id=rpc", str(script)])
+        rc = CollectiveController(args).run()
+    finally:
+        del os.environ["DUMP_DIR"]
+    assert rc == 0
+    outs = {}
+    for r in (0, 1):
+        with open(tmp_path / f"rpc.{r}.json") as f:
+            outs[r] = json.load(f)
+    # rank 0 asked worker1 to add(0, 5); rank 1 asked worker0 add(10, 5)
+    assert outs[0]["sum"] == 5
+    assert outs[1]["sum"] == 15
+    assert outs[0]["peer_name"] == "worker1"
+    assert outs[1]["peer_name"] == "worker0"
+    assert outs[0]["async"] == 3
+    assert sorted(outs[0]["workers"]) == ["worker0", "worker1"]
+
+
+def test_rpc_exception_propagates(tmp_path):
+    """A remote exception is re-raised at the caller (reference: brpc
+    error propagation)."""
+    from paddle_tpu.distributed.launch.master import KVServer
+    import paddle_tpu.distributed.rpc as rpc
+    srv = KVServer(0).start()
+    try:
+        rpc.init_rpc("solo", rank=0, world_size=1,
+                     master_endpoint=f"127.0.0.1:{srv.port}")
+
+        def boom():
+            raise ValueError("remote kaboom")
+
+        with pytest.raises(ValueError, match="remote kaboom"):
+            rpc.rpc_sync("solo", boom, timeout=10)
+        assert rpc.rpc_sync("solo", lambda: 42, timeout=10) == 42
+    finally:
+        rpc.shutdown()
+        srv.stop()
