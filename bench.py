@@ -269,12 +269,66 @@ def bench_bert():
     print(json.dumps(result))
 
 
+def bench_unet():
+    """BASELINE.md config 5: SD-style conditional UNet —
+    epsilon-prediction training samples/sec."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    import paddle_tpu as paddle
+    from paddle_tpu.models.unet import (UNet2DConditionModel,
+                                        unet_sd_config, unet_tiny_config)
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = unet_sd_config()
+        batch, hw, ctx_len, steps = 8, 64, 77, 6
+    else:
+        cfg = unet_tiny_config()
+        batch, hw, ctx_len, steps = 2, 16, 8, 2
+
+    model = UNet2DConditionModel(cfg)
+    n_params = sum(int(np.prod(p.value.shape))
+                   for p in model.parameters())
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    step = TrainStep(model, lambda o, y: model.compute_loss(o, y), opt)
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, cfg.in_channels, hw,
+                                   hw).astype(np.float32))
+    t = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int32))
+    ctx = paddle.to_tensor(rng.randn(batch, ctx_len,
+                                     cfg.cross_attention_dim)
+                           .astype(np.float32))
+    eps = paddle.to_tensor(rng.randn(batch, cfg.out_channels, hw,
+                                     hw).astype(np.float32))
+
+    loss = step(x, t, ctx, eps)
+    _ = float(np.asarray(loss.value))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, t, ctx, eps)
+    final_loss = float(np.asarray(loss.value))
+    dt = time.perf_counter() - t0
+    samples_per_sec = batch * steps / dt
+    result = {
+        "metric": "sd_unet_train_samples_per_sec",
+        "value": round(samples_per_sec, 2),
+        "unit": f"samples/s (params={n_params/1e6:.0f}M, latents "
+                f"{hw}x{hw}, loss={final_loss:.3f})",
+        "vs_baseline": 1.0,
+    }
+    print(json.dumps(result))
+
+
 def main():
     which = os.environ.get("BENCH_CONFIG", "llama").lower()
     if which in ("resnet", "resnet50", "cifar"):
         return bench_resnet()
     if which == "bert":
         return bench_bert()
+    if which in ("unet", "sd", "diffusion"):
+        return bench_unet()
     return bench_llama()
 
 

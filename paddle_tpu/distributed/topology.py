@@ -66,15 +66,36 @@ class Group:
 
 
 def build_mesh(dp=1, mp=1, pp=1, sep=1, sharding=1, devices=None) -> Mesh:
-    """Build the hybrid mesh with ICI-optimal axis placement."""
+    """Build the hybrid mesh with ICI-optimal axis placement.
+
+    On real TPU slices the device→mesh-coordinate assignment comes from
+    `jax.experimental.mesh_utils.create_device_mesh`, which reads the
+    physical torus coords (PJRT topology) and lays the innermost axes
+    (mp, then dp/sharding) along ICI neighbors — the reference reads the
+    NCCL ring topology for the same purpose (topology.py:301).  Virtual
+    or partial device sets fall back to enumeration order."""
     devices = devices if devices is not None else jax.devices()
     sizes = {"pp": pp, "sep": sep, "sharding": sharding, "dp": dp, "mp": mp}
     need = int(np.prod(list(sizes.values())))
     if need > len(devices):
         raise ValueError(
             f"mesh requires {need} devices, have {len(devices)}")
-    arr = np.asarray(devices[:need]).reshape(
-        [sizes[a] for a in AXIS_ORDER])
+    shape = [sizes[a] for a in AXIS_ORDER]
+    devs = list(devices[:need])
+    if need > 1 and all(getattr(d, "platform", "") == "tpu"
+                        and hasattr(d, "coords") for d in devs):
+        try:
+            from jax.experimental import mesh_utils
+            arr = mesh_utils.create_device_mesh(shape, devices=devs)
+            return Mesh(arr, AXIS_ORDER)
+        except Exception as e:
+            import warnings
+            warnings.warn(
+                f"ICI-optimal device placement unavailable for mesh "
+                f"shape {shape} ({e}); falling back to enumeration "
+                "order — cross-axis collectives may span non-neighbor "
+                "links", RuntimeWarning)
+    arr = np.asarray(devs).reshape(shape)
     return Mesh(arr, AXIS_ORDER)
 
 
@@ -172,10 +193,10 @@ class HybridCommunicateGroup:
         shared by this process's jax devices (multi-process SPMD, e.g.
         PP over hosts); else 0 (single controller owns every rank)."""
         import os
-        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-        if world > 1 and world == self.nranks:
-            return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         if jax.process_count() > 1:
+            # the mesh may be PHYSICALLY permuted (build_mesh ICI
+            # placement), so the device coordinate — not the launcher
+            # rank — is authoritative for axis-group membership
             coord = self._local_coord()
             if coord is not None:
                 sizes = [self._degree(a) for a in AXIS_ORDER]
@@ -183,6 +204,9 @@ class HybridCommunicateGroup:
                 for c, n in zip(coord, sizes):
                     rank = rank * n + c
                 return rank
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        if world > 1 and world == self.nranks:
+            return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         return 0
 
     def _local_coord(self):

@@ -7,3 +7,5 @@ from .bert import (BertConfig, BertModel, BertForMaskedLM,  # noqa: F401
                    bert_base_config, bert_tiny_config)
 from .gpt import (GPTConfig, GPTModel, GPTForCausalLM,  # noqa: F401
                   gpt_tiny_config, gpt3_6b7_config, shard_gpt_tp)
+from .unet import (UNetConfig, UNet2DConditionModel,  # noqa: F401
+                   unet_tiny_config, unet_sd_config)
