@@ -88,8 +88,23 @@ class Config:
     def set_cpu_math_library_num_threads(self, n):
         pass
 
+    def enable_low_precision_io(self, flag=True):
+        """Cast floating inputs to bfloat16 at the predictor boundary
+        (reference: enable_low_precision_io / mixed-precision inference).
+        Compute precision itself is baked at export time by the saved
+        program's dtypes."""
+        self._low_precision_io = flag
+
+    @property
+    def low_precision_io(self):
+        return getattr(self, "_low_precision_io", False)
+
     def summary(self):
-        return f"Config(path={self._path})"
+        import jax
+        return ("Config(path={}, device={}, memory_optim={}, "
+                "low_precision_io={})".format(
+                    self._path, jax.default_backend(),
+                    self._enable_memory_optim, self.low_precision_io))
 
 
 class Tensor:
@@ -124,11 +139,13 @@ class Predictor:
     """Reference: AnalysisPredictor — loads the artifact, owns
     input/output handles, `run()` executes the compiled function."""
 
-    def __init__(self, config: Config):
+    def __init__(self, config: Config, _shared_layer=None):
         from ..jit import load as jit_load
         if config._path is None:
             raise ValueError("Config needs the model path")
-        self._layer = jit_load(config._path)
+        self._config = config
+        self._layer = _shared_layer if _shared_layer is not None \
+            else jit_load(config._path)
         if self._layer._exported is None:
             raise ValueError(
                 f"'{config._path}.pdmodel' holds no compiled function; "
@@ -156,7 +173,11 @@ class Predictor:
         for n, h in self._inputs.items():
             if h._value is None:
                 raise RuntimeError(f"input '{n}' not set")
-            vals.append(h._value)
+            v = h._value
+            if getattr(self._config, "low_precision_io", False) \
+                    and jnp.issubdtype(v.dtype, jnp.floating):
+                v = v.astype(jnp.bfloat16)
+            vals.append(v)
         out = self._layer.forward(*vals)
         outs = out if isinstance(out, (tuple, list)) else [out]
         self._outputs = {}
@@ -182,6 +203,24 @@ class Predictor:
 
     def try_shrink_memory(self):
         pass
+
+    def clone(self):
+        """Reference: AnalysisPredictor::Clone — a new predictor with
+        its own IO handles SHARING the loaded weights/executable (no
+        re-load, no extra HBM)."""
+        return Predictor(self._config, _shared_layer=self._layer)
+
+
+class PredictorPool:
+    """Reference: paddle_infer.PredictorPool — one loaded model, `size`
+    cloned predictors (per-thread handles over shared weights)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        first = Predictor(config)
+        self._preds = [first] + [first.clone() for _ in range(size - 1)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]
 
 
 def create_predictor(config: Config) -> Predictor:

@@ -106,3 +106,55 @@ class TestInferencePredictor:
         from paddle_tpu.inference import Config, create_predictor
         with pytest.raises(ValueError):
             create_predictor(Config(str(p)))
+
+
+def test_predictor_clone_and_pool(tmp_path):
+    """Predictor.clone / PredictorPool share the loaded executable
+    (reference AnalysisPredictor::Clone, paddle_infer.PredictorPool)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu import inference
+
+    paddle.seed(0)
+    layer = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    path = str(tmp_path / "m")
+    paddle.jit.save(layer, path,
+                    input_spec=[InputSpec([-1, 4], "float32")])
+    cfg = inference.Config(path)
+    pred = inference.create_predictor(cfg)
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    want = pred.run([x])[0]
+
+    clone = pred.clone()
+    assert clone._layer is pred._layer  # shared weights/executable
+    np.testing.assert_allclose(clone.run([x])[0], want)
+
+    pool = inference.PredictorPool(cfg, size=3)
+    for i in range(3):
+        np.testing.assert_allclose(pool.retrieve(i).run([x])[0], want)
+
+
+def test_predictor_low_precision_io(tmp_path):
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu import inference
+
+    paddle.seed(1)
+    layer = nn.Linear(4, 2)
+    layer.to(dtype="bfloat16")
+    path = str(tmp_path / "m16")
+    paddle.jit.save(layer, path,
+                    input_spec=[InputSpec([-1, 4], "bfloat16")])
+    cfg = inference.Config(path)
+    cfg.enable_low_precision_io()
+    assert "low_precision_io=True" in cfg.summary()
+    pred = inference.create_predictor(cfg)
+    # fp32 input is cast to bf16 at the boundary instead of erroring
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    out = pred.run([x])[0]
+    assert out.shape == (2, 2)
