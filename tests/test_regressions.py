@@ -213,3 +213,37 @@ def test_trainstep_updates_batchnorm_running_stats():
         st(x, y)
     after2 = np.asarray(model2.state_dict()[bn_mean_name].value)
     assert not np.allclose(before2, after2)
+
+
+def test_trainstep_run_steps_matches_loop():
+    """K scanned steps (TrainStep.run_steps) must produce the same
+    params/losses as K individual step() calls (host-loop elision)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import TrainStep
+
+    def make():
+        paddle.seed(5)
+        m = nn.Sequential(nn.Linear(6, 6), nn.Tanh(), nn.Linear(6, 2))
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        return m, TrainStep(m, lambda o, y:
+                            nn.functional.cross_entropy(o, y), opt)
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(4, 8, 6).astype(np.float32)      # K=4 steps of b=8
+    ys = rng.randint(0, 2, (4, 8)).astype(np.int64)
+
+    m1, s1 = make()
+    loop_losses = [float(np.asarray(
+        s1(paddle.to_tensor(xs[i]), paddle.to_tensor(ys[i])).value))
+        for i in range(4)]
+
+    m2, s2 = make()
+    scanned = np.asarray(s2.run_steps(paddle.to_tensor(xs),
+                                      paddle.to_tensor(ys)).value)
+    np.testing.assert_allclose(scanned, loop_losses, rtol=1e-5,
+                               atol=1e-6)
+    w1 = np.asarray(m1.state_dict()["0.weight"].value)
+    w2 = np.asarray(m2.state_dict()["0.weight"].value)
+    np.testing.assert_allclose(w2, w1, rtol=1e-5, atol=1e-6)
