@@ -31,7 +31,10 @@ from .master import KVClient, KVServer
 __all__ = ["CollectiveController", "ProcEntry"]
 
 HEARTBEAT_INTERVAL = 2.0
-HEARTBEAT_TTL = 10.0
+# lease TTL >> interval: a saturated host (parallel compiles, CI load)
+# can starve the heartbeat thread for several seconds, and a false
+# dead-peer verdict tears the gang down
+HEARTBEAT_TTL = 20.0
 ELASTIC_SETTLE = 2.0   # absorb late joiners up to nnodes_max for this long
 # reference fleet/elastic/manager.py:33 — a child exiting with this code
 # asks the launcher to re-form the gang instead of counting a failure

@@ -287,6 +287,8 @@ class ShardedTrainStep:
             opt_sh.append({k: self._opt_shardings[n] for k in st})
         buf_sh = [None] * len(buf_names)
         donate = (0, 1, 2) if self._donate else ()
+        self._step_fn = step
+        self._out_shardings = (None, param_sh, opt_sh, buf_sh)
         with self.mesh:
             self._compiled = jax.jit(
                 step, donate_argnums=donate,
@@ -320,6 +322,73 @@ class ShardedTrainStep:
             self._shard_batch(b.value if isinstance(b, Tensor)
                               else jnp.asarray(b)) for b in batch)
         return param_vals, buf_vals, batch_vals
+
+    def _build_multi(self):
+        """K sharded steps fused into one device program via lax.scan
+        (host-loop elision — see jit.TrainStep._build_multi)."""
+        step = self._step_fn
+
+        def multi(param_vals, opt_states, buf_vals, lr, step0, key,
+                  stacked):
+            def body(carry, xs):
+                params, states, bufs, i = carry
+                k = jax.random.fold_in(key, i)
+                loss, params, states, bufs = step(
+                    params, states, bufs, lr, step0 + i, k, xs)
+                return (params, states, bufs, i + 1), loss
+            init = (list(param_vals), opt_states, list(buf_vals),
+                    jnp.asarray(0, jnp.int32))
+            (params, states, bufs, _), losses = jax.lax.scan(
+                body, init, stacked)
+            return losses, params, states, bufs
+
+        donate = (0, 1, 2) if self._donate else ()
+        with self.mesh:
+            self._compiled_multi = jax.jit(
+                multi, donate_argnums=donate,
+                out_shardings=self._out_shardings)
+
+    def run_steps(self, *stacked_batch):
+        """Run K sharded train steps in one compiled call; each batch
+        array carries a leading K dim.  Returns the [K] loss Tensor."""
+        param_vals, buf_vals, _ = self._prepare(
+            tuple(Tensor(b.value[0] if isinstance(b, Tensor)
+                         else jnp.asarray(b)[0])
+                  for b in stacked_batch))
+        if getattr(self, "_compiled_multi", None) is None:
+            self._build_multi()
+        stacked = tuple(
+            self._stack_shard(b.value if isinstance(b, Tensor)
+                              else jnp.asarray(b))
+            for b in stacked_batch)
+        k = int(stacked[0].shape[0])
+        lr = self.optimizer.get_lr()
+        step0 = jnp.asarray(self.optimizer._step_count + 1, jnp.int32)
+        key = prandom.next_key()
+        from ..distributed.watchdog import watched
+        with watched(f"sharded train run_steps(k={k})"):
+            losses, new_params, new_states, new_bufs = \
+                self._compiled_multi(param_vals, self._opt_states,
+                                     buf_vals,
+                                     jnp.asarray(lr, jnp.float32),
+                                     step0, key, stacked)
+        self.optimizer._step_count += k
+        sd = self._sd
+        for n, v in zip(self._names, new_params):
+            sd[n]._value = v
+        for n, v in zip(self._buf_names, new_bufs):
+            sd[n]._value = v
+        self._opt_states = new_states
+        return Tensor(losses)
+
+    def _stack_shard(self, arr):
+        """Shard a [K, batch, ...] stack on dim 1 (the batch dim of each
+        step)."""
+        from ..distributed.topology import batch_partition_spec
+        spec = batch_partition_spec(self.mesh, arr.shape[1:],
+                                    self.batch_axes)
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, P(None, *spec)))
 
     # -- run ---------------------------------------------------------------
     def __call__(self, *batch):

@@ -247,3 +247,40 @@ def test_trainstep_run_steps_matches_loop():
     w1 = np.asarray(m1.state_dict()["0.weight"].value)
     w2 = np.asarray(m2.state_dict()["0.weight"].value)
     np.testing.assert_allclose(w2, w1, rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_trainer_run_steps_matches_loop():
+    """ShardedTrainStep.run_steps == K sequential calls on a dp x
+    sharding mesh (scan fusion under GSPMD)."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.parallel import ShardedTrainStep
+    from paddle_tpu.distributed.topology import build_mesh
+
+    def make():
+        paddle.seed(9)
+        m = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 2))
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        mesh = build_mesh(dp=2, sharding=2, devices=jax.devices()[:4])
+        st = ShardedTrainStep(m, opt, mesh, sharding_stage=2,
+                              loss_fn=lambda o, y:
+                              nn.functional.cross_entropy(o, y))
+        return m, st
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(3, 8, 8).astype(np.float32)
+    ys = rng.randint(0, 2, (3, 8)).astype(np.int64)
+
+    m1, s1 = make()
+    loop = [float(np.asarray(
+        s1(paddle.to_tensor(xs[i]), paddle.to_tensor(ys[i])).value))
+        for i in range(3)]
+    m2, s2 = make()
+    scanned = np.asarray(s2.run_steps(paddle.to_tensor(xs),
+                                      paddle.to_tensor(ys)).value)
+    np.testing.assert_allclose(scanned, loop, rtol=1e-5, atol=1e-6)
+    w1 = np.asarray(m1.state_dict()["0.weight"].value)
+    w2 = np.asarray(m2.state_dict()["0.weight"].value)
+    np.testing.assert_allclose(w2, w1, rtol=1e-5, atol=1e-6)
