@@ -49,7 +49,7 @@ class KVCollectives:
         from .launch.master import KVClient
         self.kv = KVClient(endpoint if "://" in endpoint
                            else f"http://{endpoint}")
-        self.rank = int(rank)
+        self._rank = int(rank)
         self.world = int(world)
         self.timeout = timeout
         self._seq = defaultdict(int)
@@ -57,6 +57,22 @@ class KVCollectives:
         # later (any rank entering round s proves every rank finished
         # round s-1, so round s-2's keys can no longer be read)
         self._mine = defaultdict(dict)
+
+    @property
+    def rank(self) -> int:
+        """This process's rank in the SAME rank space the topology's
+        Group.ranks use.  HybridCommunicateGroup derives global ranks
+        from mesh COORDINATES (which `build_mesh` may permute for ICI
+        placement), so when an HCG exists with one process per mesh rank
+        the coordinate-derived rank — not PADDLE_TRAINER_ID — is what
+        `ranks.index(self.rank)` must be compared against; otherwise
+        group-local indices scramble all_gather order / scatter item
+        selection or wrongly exclude a member until timeout."""
+        from .topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None and getattr(hcg, "nranks", None) == self.world:
+            return int(hcg.global_rank)
+        return self._rank
 
     # -- plumbing ----------------------------------------------------------
     def _ranks(self, group) -> List[int]:

@@ -370,7 +370,13 @@ class CollectiveController:
         # mirror to the base commit key so NEW pods (still in their
         # initial rendezvous loop, polling <job>/commit) can adopt it
         self.kv.put(f"{self.job_id}/commit", json.dumps(committed))
-        self.kv.delete(f"{self.job_id}/scale_request")
+        # scale_request is NOT deleted: peers poll it only every
+        # SCALE_CHECK_INTERVAL, and one that polls after a delete would
+        # miss the event and keep its old gang state.  The request stays
+        # keyed by the epoch it was raised in; members that already
+        # re-formed see request-epoch < self.epoch and ignore it, while
+        # a late peer sees request-epoch >= its stale epoch and joins
+        # (adopting the existing commit@new_epoch).  Reaped at stop().
         self.order = committed["order"]
         self.epoch = int(committed["epoch"])
         self.peers = committed["peers"]
@@ -557,6 +563,7 @@ class CollectiveController:
                 self.kv.delete(self.my_key)
             if getattr(self, "node_rank", None) == 0:
                 self.kv.delete(f"{self.job_id}/commit")
+                self.kv.delete(f"{self.job_id}/scale_request")
                 try:
                     for k in self.kv.prefix(f"{self.job_id}/commit@"):
                         self.kv.delete(k)

@@ -179,6 +179,42 @@ def ignore_module(modules):
 # TrainStep — whole-step compilation (the perf path used by Model.fit,
 # bench.py and the distributed trainer).
 # ---------------------------------------------------------------------------
+def per_step_lrs(optimizer, k: int, advance: bool = True):
+    """Per-step LR array [k] for a fused run_steps window, plus a
+    commit callback.
+
+    With ``advance`` (the default), an attached LRScheduler is treated
+    as PER-STEP and advanced k times — the host loop it would normally
+    be stepped in is fused into the device scan, so the trainer owns
+    the advance; callers must NOT also call scheduler.step() for those
+    k steps.  Epoch-granular schedulers (e.g. hapi's
+    LRScheduler(by_epoch=True) callback) must pass
+    ``advance_lr_scheduler=False`` to run_steps: the LR is then held at
+    its current value for the window and the caller keeps stepping the
+    scheduler at epoch boundaries as before.
+
+    The scheduler is NOT mutated here: the k values are computed on a
+    rolled-back state and the advance is applied by the returned
+    ``commit()`` — call it only after the device step succeeds, so a
+    trace/compile/OOM failure leaves the schedule aligned with
+    optimizer._step_count."""
+    sched = getattr(optimizer, "_learning_rate_scheduler", None)
+    if sched is None or not advance:
+        return (jnp.full((k,), float(optimizer.get_lr()), jnp.float32),
+                lambda: None)
+    snap = dict(sched.state_dict())
+    lrs = []
+    for _ in range(k):
+        lrs.append(float(sched()))
+        sched.step()
+    advanced = dict(sched.state_dict())
+    sched.set_state_dict(snap)
+
+    def commit():
+        sched.set_state_dict(advanced)
+    return jnp.asarray(lrs, jnp.float32), commit
+
+
 class TrainStep:
     """Fused forward+backward+update as ONE jitted function with donated
     param/opt-state buffers.
@@ -271,17 +307,18 @@ class TrainStep:
         """K optimizer steps fused into ONE device program via lax.scan —
         host-loop elision: per-step dispatch latency (large on remote /
         tunneled accelerators) is paid once per K steps.  The learning
-        rate is sampled once per call; step_i advances inside the scan so
-        Adam bias correction stays exact."""
+        rate is a scanned [K] array (per-step schedulers advance inside
+        the fused window); step_i advances inside the scan so Adam bias
+        correction stays exact."""
         step = self._step_fn
 
-        def multi(param_vals, opt_states, buf_vals, lr, step0, key,
+        def multi(param_vals, opt_states, buf_vals, lrs, step0, key,
                   *stacked):
             def body(carry, xs):
                 params, states, bufs, i = carry
                 k = jax.random.fold_in(key, i)
                 loss, params, states, bufs = step(
-                    params, states, bufs, lr, step0 + i, k, *xs)
+                    params, states, bufs, lrs[i], step0 + i, k, *xs)
                 return (params, states, bufs, i + 1), loss
             init = (list(param_vals), opt_states, list(buf_vals),
                     jnp.asarray(0, jnp.int32))
@@ -292,10 +329,12 @@ class TrainStep:
         donate = (0, 1, 2) if self._donate else ()
         self._compiled_multi = jax.jit(multi, donate_argnums=donate)
 
-    def run_steps(self, *stacked_batch):
+    def run_steps(self, *stacked_batch, advance_lr_scheduler=True):
         """Run K train steps in one compiled call.  stacked_batch:
         (*inputs, labels) arrays each with a leading K (steps) dim;
-        returns the per-step loss Tensor of shape [K]."""
+        returns the per-step loss Tensor of shape [K].  A per-step
+        LRScheduler is advanced inside the window (see per_step_lrs);
+        epoch-granular schedulers pass advance_lr_scheduler=False."""
         model = self.model
         sd = model.state_dict()
         param_vals = [sd[n]._value for n in self._names]
@@ -309,12 +348,14 @@ class TrainStep:
         if getattr(self, "_compiled_multi", None) is None:
             self._build_multi()
         k = int(batch_vals[0].shape[0])
-        lr = self.optimizer.get_lr()
+        lrs, commit_lr = per_step_lrs(self.optimizer, k,
+                                      advance=advance_lr_scheduler)
         step0 = jnp.asarray(self.optimizer._step_count + 1, jnp.int32)
         key = prandom.next_key()
         losses, new_params, new_states, new_bufs = self._compiled_multi(
-            param_vals, self._opt_states, buf_vals,
-            jnp.asarray(lr, jnp.float32), step0, key, *batch_vals)
+            param_vals, self._opt_states, buf_vals, lrs, step0, key,
+            *batch_vals)
+        commit_lr()
         self.optimizer._step_count += k
         for n, v in zip(self._names, new_params):
             sd[n]._value = v

@@ -245,12 +245,15 @@ class PipelineEngine:
 
     def __init__(self, pipeline_layer, mesh: Optional[Mesh] = None,
                  num_stages: Optional[int] = None, seg_method: str = None,
-                 num_virtual_stages: int = 1):
+                 num_virtual_stages: Optional[int] = None):
         self.layer = pipeline_layer
         seg_method = seg_method or getattr(pipeline_layer, "_seg_method",
                                            "uniform")
-        vpp = num_virtual_stages \
-            or getattr(pipeline_layer, "_num_virtual_stages", 1)
+        # None (not 1) is the sentinel: a PipelineLayer built with
+        # num_virtual_pipeline_stages>1 must get VPP even when the caller
+        # doesn't re-pass the count
+        vpp = num_virtual_stages if num_virtual_stages is not None \
+            else getattr(pipeline_layer, "_num_virtual_stages", 1)
         items = pipeline_layer.run_function
         if mesh is not None and "pp" in mesh.axis_names:
             pp = mesh.shape["pp"]

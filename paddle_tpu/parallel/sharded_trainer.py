@@ -328,13 +328,13 @@ class ShardedTrainStep:
         (host-loop elision — see jit.TrainStep._build_multi)."""
         step = self._step_fn
 
-        def multi(param_vals, opt_states, buf_vals, lr, step0, key,
+        def multi(param_vals, opt_states, buf_vals, lrs, step0, key,
                   stacked):
             def body(carry, xs):
                 params, states, bufs, i = carry
                 k = jax.random.fold_in(key, i)
                 loss, params, states, bufs = step(
-                    params, states, bufs, lr, step0 + i, k, xs)
+                    params, states, bufs, lrs[i], step0 + i, k, xs)
                 return (params, states, bufs, i + 1), loss
             init = (list(param_vals), opt_states, list(buf_vals),
                     jnp.asarray(0, jnp.int32))
@@ -348,9 +348,12 @@ class ShardedTrainStep:
                 multi, donate_argnums=donate,
                 out_shardings=self._out_shardings)
 
-    def run_steps(self, *stacked_batch):
+    def run_steps(self, *stacked_batch, advance_lr_scheduler=True):
         """Run K sharded train steps in one compiled call; each batch
-        array carries a leading K dim.  Returns the [K] loss Tensor."""
+        array carries a leading K dim.  Returns the [K] loss Tensor.
+        A per-step LRScheduler is advanced inside the window (see
+        jit.per_step_lrs); epoch-granular schedulers pass
+        advance_lr_scheduler=False."""
         param_vals, buf_vals, _ = self._prepare(
             tuple(Tensor(b.value[0] if isinstance(b, Tensor)
                          else jnp.asarray(b)[0])
@@ -362,16 +365,17 @@ class ShardedTrainStep:
                               else jnp.asarray(b))
             for b in stacked_batch)
         k = int(stacked[0].shape[0])
-        lr = self.optimizer.get_lr()
+        from ..jit import per_step_lrs
+        lrs, commit_lr = per_step_lrs(self.optimizer, k,
+                                      advance=advance_lr_scheduler)
         step0 = jnp.asarray(self.optimizer._step_count + 1, jnp.int32)
         key = prandom.next_key()
         from ..distributed.watchdog import watched
         with watched(f"sharded train run_steps(k={k})"):
             losses, new_params, new_states, new_bufs = \
                 self._compiled_multi(param_vals, self._opt_states,
-                                     buf_vals,
-                                     jnp.asarray(lr, jnp.float32),
-                                     step0, key, stacked)
+                                     buf_vals, lrs, step0, key, stacked)
+        commit_lr()
         self.optimizer._step_count += k
         sd = self._sd
         for n, v in zip(self._names, new_params):

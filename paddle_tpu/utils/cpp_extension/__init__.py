@@ -163,6 +163,14 @@ def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
         def op(*tensors, out_shapes=None, out_dtypes=None, **attrs):
             ts = to_tensor_args(*tensors)
             key = _memo_key(attrs, out_shapes, out_dtypes)
+            if key is not None and (out_shapes is None
+                                    or out_dtypes is None):
+                # default output metadata is derived from the first
+                # input's shape/dtype inside _build — a cached closure
+                # from a different input signature would declare stale
+                # FFI output types, so the signature joins the key
+                v = ts[0].value
+                key = (key, tuple(v.shape), str(v.dtype))
             if key is None:
                 custom = _build(ts[0], out_shapes, out_dtypes, attrs)
             elif key in customs:

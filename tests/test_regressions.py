@@ -284,3 +284,44 @@ def test_sharded_trainer_run_steps_matches_loop():
     w1 = np.asarray(m1.state_dict()["0.weight"].value)
     w2 = np.asarray(m2.state_dict()["0.weight"].value)
     np.testing.assert_allclose(w2, w1, rtol=1e-5, atol=1e-6)
+
+
+def test_run_steps_advances_lr_scheduler():
+    """A per-step LRScheduler inside a fused run_steps window must see
+    its per-step values (not the window-entry LR held constant): K
+    scanned steps == K individual step()+scheduler.step() calls."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import TrainStep
+
+    def make():
+        paddle.seed(11)
+        m = nn.Sequential(nn.Linear(6, 6), nn.Tanh(), nn.Linear(6, 2))
+        sched = paddle.optimizer.lr.StepDecay(
+            learning_rate=5e-2, step_size=1, gamma=0.5)
+        opt = paddle.optimizer.SGD(sched, parameters=m.parameters())
+        return m, sched, TrainStep(m, lambda o, y:
+                                   nn.functional.cross_entropy(o, y), opt)
+
+    rng = np.random.RandomState(3)
+    xs = rng.randn(4, 8, 6).astype(np.float32)
+    ys = rng.randint(0, 2, (4, 8)).astype(np.int64)
+
+    m1, sched1, s1 = make()
+    loop = []
+    for i in range(4):
+        loop.append(float(np.asarray(
+            s1(paddle.to_tensor(xs[i]), paddle.to_tensor(ys[i])).value)))
+        sched1.step()
+
+    # run_steps advances the scheduler itself (the host loop is fused);
+    # the caller must not also step it for those K steps
+    m2, sched2, s2 = make()
+    scanned = np.asarray(s2.run_steps(paddle.to_tensor(xs),
+                                      paddle.to_tensor(ys)).value)
+    np.testing.assert_allclose(scanned, loop, rtol=1e-5, atol=1e-6)
+    w1 = np.asarray(m1.state_dict()["0.weight"].value)
+    w2 = np.asarray(m2.state_dict()["0.weight"].value)
+    np.testing.assert_allclose(w2, w1, rtol=1e-5, atol=1e-6)
+    assert sched2.last_epoch == sched1.last_epoch
