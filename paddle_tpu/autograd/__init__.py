@@ -21,13 +21,15 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None, name=None):
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order eager grad) is not supported "
-            "yet; use paddle_tpu.jit.grad on a functional form instead.")
+    """Reference: paddle.grad (python/paddle/autograd/autograd.py).
+    retain_graph defaults to create_graph, matching the reference:
+    higher-order use re-walks the same graph."""
+    if retain_graph is None:
+        retain_graph = create_graph
     return calc_gradients(outputs, inputs, grad_outputs,
                           retain_graph=bool(retain_graph),
-                          allow_unused=allow_unused)
+                          allow_unused=allow_unused,
+                          create_graph=create_graph)
 
 
 class PyLayerContext:
@@ -101,11 +103,28 @@ class PyLayer:
                                    (g.value if isinstance(g, Tensor) else g))
                 return tuple(out)
 
+            def ho_call(ct_tensors):
+                """create_graph backward: re-run the user backward with
+                recording ON, so its internal ops join the outer tape
+                (second-order flows through ctx-saved input tensors)."""
+                from ..framework.tape import enable_grad
+                with enable_grad():
+                    grads = cls.backward(ctx, *ct_tensors)
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                out, gi = [], iter(grads)
+                for a in args:
+                    if isinstance(a, Tensor):
+                        g = next(gi, None)
+                        out.append(g if (g is None or isinstance(g, Tensor))
+                                   else Tensor(g))
+                return out
+
             in_refs = [t._ref if (not t.stop_gradient or
                                   t._ref.node is not None) else None
                        for t in tensor_inputs]
             node = Node(vjp_fn, in_refs, out_refs, out_avals,
-                        name=cls.__name__)
+                        name=cls.__name__, ho_call=ho_call)
             for i, r in enumerate(out_refs):
                 r.node = node
                 r.index = i

@@ -67,7 +67,13 @@ class KVCollectives:
         the coordinate-derived rank — not PADDLE_TRAINER_ID — is what
         `ranks.index(self.rank)` must be compared against; otherwise
         group-local indices scramble all_gather order / scatter item
-        selection or wrongly exclude a member until timeout."""
+        selection or wrongly exclude a member until timeout.
+
+        Resolved per access: all processes run the same SPMD program,
+        so at any given collective either every process has built its
+        HCG or none has — mixed-phase participation (one peer entering
+        a round before constructing the HCG other peers already hold)
+        is a program-order bug this cannot repair."""
         from .topology import get_hybrid_communicate_group
         hcg = get_hybrid_communicate_group()
         if hcg is not None and getattr(hcg, "nranks", None) == self.world:

@@ -122,7 +122,8 @@ def run(raw_fn, *tensors: Tensor, name: str = "", n_outs: Optional[int] = None):
                 in_refs.append(t._ref)
             else:
                 in_refs.append(None)
-        node = Node(vjp_fn, in_refs, out_refs, out_avals, name=name)
+        node = Node(vjp_fn, in_refs, out_refs, out_avals, name=name,
+                    raw_fn=raw_fn, in_vals=vals)
         for r in out_refs:
             r.node = node
         for i, r in enumerate(out_refs):

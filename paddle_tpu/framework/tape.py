@@ -40,18 +40,32 @@ class VarRef:
 
 
 class Node:
-    """One recorded differentiable op (reference: GradNodeBase subclasses)."""
+    """One recorded differentiable op (reference: GradNodeBase subclasses).
 
-    __slots__ = ("vjp_fn", "in_refs", "out_refs", "out_avals", "name", "hooks")
+    For higher-order grad (`create_graph=True`) the stored pullback is
+    not enough — it closes over residuals, and gradient flow THROUGH
+    the residuals (d/dx of the pullback's output) would be lost.  So a
+    node also keeps `raw_fn` + the input values it ran at; the
+    create-graph walk re-derives the vjp as a recorded op of (inputs,
+    cotangents), mirroring the reference's generated double-grad nodes
+    (eager_gen.py:1399 higher-order GradNode generation)."""
+
+    __slots__ = ("vjp_fn", "in_refs", "out_refs", "out_avals", "name",
+                 "hooks", "raw_fn", "in_vals", "ho_call")
 
     def __init__(self, vjp_fn: Callable, in_refs: Sequence[Optional[VarRef]],
-                 out_refs: Sequence[VarRef], out_avals, name: str = ""):
+                 out_refs: Sequence[VarRef], out_avals, name: str = "",
+                 raw_fn: Optional[Callable] = None, in_vals=None,
+                 ho_call: Optional[Callable] = None):
         self.vjp_fn = vjp_fn
         self.in_refs = list(in_refs)      # None for non-differentiable inputs
         self.out_refs = list(out_refs)
         self.out_avals = list(out_avals)  # (shape, dtype) per output
         self.name = name
         self.hooks = []                   # grad hooks on outputs
+        self.raw_fn = raw_fn              # rebuildable forward (create_graph)
+        self.in_vals = in_vals            # input arrays raw_fn ran at
+        self.ho_call = ho_call            # PyLayer-style re-entrant backward
 
 
 _grad_enabled = True
@@ -164,6 +178,11 @@ def _run_graph(seed_refs, seed_grads, retain_graph=False):
             continue
         for hook in node.hooks:
             outs_ct = hook(outs_ct)
+        # the pullback demands cotangents in the forward's exact output
+        # dtypes; accumulation across mixed-precision subgraphs (amp
+        # bf16 forward + f32 grad nodes) can promote them
+        outs_ct = [ct if ct.dtype == aval[1] else ct.astype(aval[1])
+                   for ct, aval in zip(outs_ct, node.out_avals)]
         ct_arg = tuple(outs_ct) if len(outs_ct) > 1 else outs_ct[0]
         in_cts = node.vjp_fn(ct_arg)
         if not isinstance(in_cts, (tuple, list)):
@@ -183,7 +202,152 @@ def _run_graph(seed_refs, seed_grads, retain_graph=False):
             keep[id(ref)] = ref
         if not retain_graph:
             node.vjp_fn = None  # free residuals eagerly
+            node.raw_fn = None
+            node.in_vals = None
     return cotangents, keep
+
+
+def _run_graph_ho(seed_refs, seed_grads, retain_graph=False):
+    """Create-graph backward executor: every per-node grad computation
+    is itself dispatched through `dispatch.run`, so the produced
+    cotangents are tape-connected Tensors and can be differentiated
+    again (grad-of-grad, Hessian-vector products, gradient penalties).
+
+    Returns {id(ref): cotangent Tensor} plus the keep-alive ref map."""
+    from .tensor import Tensor
+    from . import dispatch
+    import jax.numpy as jnp
+
+    def as_t(v, stop_gradient=True):
+        return v if isinstance(v, Tensor) else Tensor(v, stop_gradient)
+
+    def acc(store, ref, val):
+        if val is None:
+            return
+        v = val.value if isinstance(val, Tensor) else val
+        if hasattr(v, "dtype") and v.dtype == jax.dtypes.float0:
+            return
+        val = as_t(val)
+        prev = store.get(id(ref))
+        store[id(ref)] = val if prev is None else prev + val
+
+    cotangents: dict = {}
+    keep = {}
+    seed_nodes = []
+    for ref, g in zip(seed_refs, seed_grads):
+        acc(cotangents, ref, g)
+        keep[id(ref)] = ref
+        if ref.node is not None:
+            seed_nodes.append(ref.node)
+
+    for node in _toposort(seed_nodes):
+        outs_ct, any_ct = [], False
+        for ref, aval in zip(node.out_refs, node.out_avals):
+            ct = cotangents.get(id(ref))
+            if ct is None:
+                ct = Tensor(_zeros_like_aval(aval), stop_gradient=True)
+            else:
+                any_ct = True
+            outs_ct.append(as_t(ct))
+        if not any_ct:
+            continue
+        for hook in node.hooks:
+            outs_ct = [as_t(c) for c in hook(outs_ct)]
+        outs_ct = [c if c.value.dtype == aval[1] else c.astype(aval[1])
+                   for c, aval in zip(outs_ct, node.out_avals)]
+        in_cts = _node_grad_ho(node, outs_ct)
+        if in_cts is None:
+            continue
+        for ref, ct in zip(node.in_refs, in_cts):
+            if ref is None or ct is None:
+                continue
+            t = ref.tensor
+            if t is not None and t._grad_hooks:
+                for h in t._grad_hooks:
+                    res = h(as_t(ct))
+                    if res is not None:
+                        ct = res
+            acc(cotangents, ref, ct)
+            keep[id(ref)] = ref
+        if not retain_graph:
+            node.vjp_fn = None
+            node.raw_fn = None
+            node.in_vals = None
+    return cotangents, keep
+
+
+def _node_grad_ho(node, outs_ct):
+    """One node's backward as a RECORDED op: rebuild the vjp from
+    (raw_fn, inputs) and dispatch it, so d(grad)/d(input) and
+    d(grad)/d(cotangent) both stay differentiable.  Returns Tensor/None
+    cotangents aligned with node.in_refs."""
+    from .tensor import Tensor
+    from . import dispatch
+
+    if node.ho_call is not None:          # PyLayer: user backward re-runs
+        return node.ho_call(outs_ct)      # under grad-enabled dispatch
+    raw_fn, in_vals = node.raw_fn, node.in_vals
+    if raw_fn is None or in_vals is None:
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"create_graph backward through '{node.name}': graph "
+                "already freed (pass retain_graph=True to the earlier "
+                "backward)")
+        raise RuntimeError(
+            f"create_graph backward through '{node.name}' is not "
+            "re-buildable (no raw forward recorded)")
+
+    def _is_float(d):
+        import ml_dtypes
+        import jax.numpy as jnp
+        return d == ml_dtypes.bfloat16 or jnp.issubdtype(d, jnp.floating) \
+            or jnp.issubdtype(d, jnp.complexfloating)
+
+    keep_idx = [i for i, (r, v) in enumerate(zip(node.in_refs, in_vals))
+                if r is not None and _is_float(v.dtype)]
+    if not keep_idx:
+        return None
+    n_in = len(in_vals)
+
+    # input tensors wired to the ORIGINAL refs so second-order
+    # cotangents accumulate in the right graph slots; dead wrappers are
+    # resurrected around the recorded values
+    in_ts = []
+    for r, v in zip(node.in_refs, in_vals):
+        t = r.tensor if r is not None else None
+        # dead wrapper, or the live one was since mutated in place (its
+        # _value moved past this version): resurrect a wrapper holding
+        # the value the forward actually ran at.  It shares the ref for
+        # cotangent routing but must NOT rebind r.tensor_wref — stealing
+        # the weakref would make a later backward() miss the live
+        # tensor's .grad accumulation.
+        if t is None or t._value is not v:
+            t = Tensor(v, stop_gradient=(r is None))
+            if r is not None:
+                t._ref = r
+        in_ts.append(t)
+
+    out_dtypes = [d for (_s, d) in node.out_avals]
+
+    def grad_fn(*vals):
+        ins, cts = vals[:n_in], vals[n_in:]
+        _, pull = jax.vjp(raw_fn, *ins)
+        # the pullback demands cotangents in the forward's exact output
+        # dtypes (amp-cast outputs are bf16; walk arithmetic promotes
+        # cotangents to f32) — the cast is itself differentiable
+        cts = tuple(c.astype(d) if c.dtype != d else c
+                    for c, d in zip(cts, out_dtypes))
+        g = pull(cts if len(cts) > 1 else cts[0])
+        return tuple(g[i] for i in keep_idx) if len(keep_idx) > 1 \
+            else g[keep_idx[0]]
+
+    out = dispatch.run(grad_fn, *in_ts, *outs_ct,
+                       name=f"grad:{node.name or 'op'}")
+    outs = (out,) if isinstance(out, Tensor) else tuple(out)
+    aligned = [None] * n_in
+    for j, i in enumerate(keep_idx):
+        aligned[i] = outs[j]
+    return aligned
 
 
 def run_backward(tensors, grad_tensors=None, retain_graph=False):
@@ -237,8 +401,12 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
 
 
 def calc_gradients(outputs, inputs, grad_outputs=None, retain_graph=False,
-                   allow_unused=False):
-    """`paddle.grad` — returns grads w.r.t. inputs without touching .grad."""
+                   allow_unused=False, create_graph=False):
+    """`paddle.grad` — returns grads w.r.t. inputs without touching .grad.
+
+    With create_graph=True the walk itself records (see _run_graph_ho)
+    and the returned gradients are tape-connected Tensors, usable as
+    outputs of a further grad()/backward() call."""
     from .tensor import Tensor
     import jax.numpy as jnp
 
@@ -255,12 +423,17 @@ def calc_gradients(outputs, inputs, grad_outputs=None, retain_graph=False,
     for t, g in zip(outputs, grad_outputs):
         if g is None:
             g = jnp.ones(t.value.shape, t.value.dtype)
-        else:
+        elif not create_graph:
             g = g.value if isinstance(g, Tensor) else jnp.asarray(g)
         seed_refs.append(t._ref)
         seed_grads.append(g)
 
-    cotangents, _ = _run_graph(seed_refs, seed_grads, retain_graph)
+    if create_graph:
+        with enable_grad():  # the walk must record even under no_grad
+            cotangents, _ = _run_graph_ho(seed_refs, seed_grads,
+                                          retain_graph)
+    else:
+        cotangents, _ = _run_graph(seed_refs, seed_grads, retain_graph)
 
     results = []
     for t in inputs:
@@ -271,6 +444,8 @@ def calc_gradients(outputs, inputs, grad_outputs=None, retain_graph=False,
                     "One of the differentiated tensors appears unused in the "
                     "graph; set allow_unused=True to return None for it.")
             results.append(None)
+        elif isinstance(ct, Tensor):
+            results.append(ct)
         else:
             results.append(Tensor(ct, stop_gradient=True))
     return results

@@ -148,3 +148,142 @@ def test_clear_grad():
     assert x.grad is not None
     x.clear_grad()
     assert x.grad is None
+
+
+# ---------------------------------------------------------------------------
+# higher-order autograd (create_graph=True)
+# Reference: test/autograd/ + eager_gen.py:1399 double-grad node generation
+# ---------------------------------------------------------------------------
+def test_create_graph_scalar_third_order():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x * x                      # 4x^3 -> 12x^2 -> 24x
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    assert not g1.stop_gradient
+    (g2,) = paddle.grad(g1, [x], create_graph=True)
+    (g3,) = paddle.grad(g2, [x])
+    assert np.isclose(float(g1), 32.0)
+    assert np.isclose(float(g2), 48.0)
+    assert np.isclose(float(g3), 48.0)
+
+
+def test_create_graph_mlp_matches_jax():
+    """grad-of-grad of an MLP w.r.t. the input == jax.grad(jax.grad)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.nn as nn
+    paddle.seed(7)
+    m = nn.Sequential(nn.Linear(3, 4), nn.Tanh(), nn.Linear(4, 1))
+    xv = np.random.RandomState(0).randn(5, 3).astype(np.float32)
+    xt = paddle.to_tensor(xv, stop_gradient=False)
+    (gx,) = paddle.grad(m(xt).sum(), [xt], create_graph=True)
+    (ggx,) = paddle.grad(gx.sum(), [xt])
+
+    p = {n: np.asarray(t.value) for n, t in m.state_dict().items()}
+
+    def f(xa):
+        h = jnp.tanh(xa @ p['0.weight'] + p['0.bias'])
+        return (h @ p['2.weight'] + p['2.bias']).sum()
+    np.testing.assert_allclose(np.asarray(gx.value), jax.grad(f)(xv),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(ggx.value),
+        jax.grad(lambda xa: jax.grad(f)(xa).sum())(xv),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_gradient_penalty_training_step():
+    """WGAN-GP-style loss: ||d critic/d x|| penalty differentiated
+    w.r.t. the critic parameters via backward() through a
+    create_graph grad."""
+    import paddle_tpu.nn as nn
+    paddle.seed(3)
+    critic = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    opt = paddle.optimizer.SGD(0.05, parameters=critic.parameters())
+    rng = np.random.RandomState(1)
+    xv = rng.randn(16, 4).astype(np.float32)
+    penalties = []
+    for _ in range(25):
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        out = critic(x).sum()
+        (gx,) = paddle.grad(out, [x], create_graph=True)
+        gp = ((gx * gx).sum(axis=1) ** 0.5 - 1.0)
+        loss = (gp * gp).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        penalties.append(float(loss))
+    assert penalties[-1] < penalties[0] * 0.5, penalties[::6]
+
+
+def test_create_graph_param_hvp():
+    """Hessian-vector product w.r.t. parameters through two taped walks."""
+    import paddle_tpu.nn as nn
+    paddle.seed(5)
+    lin = nn.Linear(3, 1)
+    w = lin.weight
+    xv = np.random.RandomState(2).randn(6, 3).astype(np.float32)
+    x = paddle.to_tensor(xv)
+    y = (lin(x) ** 2).sum()              # quadratic in w
+    (gw,) = paddle.grad(y, [w], create_graph=True)
+    v = paddle.to_tensor(np.ones(gw.shape, np.float32))
+    (hvp,) = paddle.grad((gw * v).sum(), [w])
+    # analytic: y = sum_i (x_i . w + b)^2 ; H = 2 X^T X ; Hv = 2 X^T X v
+    expect = 2.0 * xv.T @ xv @ np.ones((3, 1), np.float32)
+    np.testing.assert_allclose(np.asarray(hvp.value), expect,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_create_graph_pylayer():
+    """PyLayer with a differentiable backward participates in
+    second-order grad (re-entrant user backward)."""
+    from paddle_tpu.autograd import PyLayer
+
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, gy):
+            (x,) = ctx.saved_tensor
+            return gy * 3.0 * x * x
+
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = Cube.apply(x)
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    (g2,) = paddle.grad(g1, [x])
+    assert np.isclose(float(g1), 12.0)
+    assert np.isclose(float(g2), 12.0)
+
+
+def test_create_graph_inplace_mutated_leaf_keeps_grad_path():
+    """A leaf whose _value was swapped in place (optimizer idiom) must
+    still accumulate .grad after a create_graph walk resurrected a
+    wrapper for its recorded version (weakref must not be stolen)."""
+    p = paddle.to_tensor(np.float32([2.0]), stop_gradient=False)
+    y = (p * p).sum()
+    p._value = p._value + 0
+    paddle.grad(y, [p], create_graph=True)
+    z = (p * p * p).sum()
+    z.backward()
+    assert p.grad is not None
+    assert np.isclose(float(np.asarray(p.grad.value)[0]), 12.0)
+
+
+def test_create_graph_under_amp():
+    """Gradient penalty through an AMP O1 (bf16) forward: cotangents
+    must be cast to each node's recorded output dtype in both walks."""
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(16, 4).astype(np.float32),
+        stop_gradient=False)
+    with paddle.amp.auto_cast(level='O1'):
+        out = m(x).sum()
+    (gx,) = paddle.grad(out, [x], create_graph=True)
+    loss = (gx * gx).sum()
+    loss.backward()
+    assert m[0].weight.grad is not None
+    assert np.isfinite(float(np.asarray(loss.value)))
