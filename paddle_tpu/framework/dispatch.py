@@ -122,8 +122,11 @@ def run(raw_fn, *tensors: Tensor, name: str = "", n_outs: Optional[int] = None):
                 in_refs.append(t._ref)
             else:
                 in_refs.append(None)
+        from .tape import capture_higher_order
+        cap = capture_higher_order()
         node = Node(vjp_fn, in_refs, out_refs, out_avals, name=name,
-                    raw_fn=raw_fn, in_vals=vals)
+                    raw_fn=raw_fn if cap else None,
+                    in_vals=vals if cap else None)
         for r in out_refs:
             r.node = node
         for i, r in enumerate(out_refs):

@@ -204,7 +204,25 @@ def _run_graph(seed_refs, seed_grads, retain_graph=False):
             node.vjp_fn = None  # free residuals eagerly
             node.raw_fn = None
             node.in_vals = None
+            node.ho_call = None  # PyLayer closure pins ctx residuals
     return cotangents, keep
+
+
+_capture_ho = True
+
+
+def set_capture_higher_order(flag: bool):
+    """When False, dispatch stops stashing (raw_fn, in_vals) on nodes:
+    ops whose pullbacks hold no residuals (add/reshape/concat/...)
+    release their inputs as soon as the caller drops them, at the cost
+    of create_graph=True raising on such graphs.  Default True —
+    reference parity: double-grad works out of the box."""
+    global _capture_ho
+    _capture_ho = bool(flag)
+
+
+def capture_higher_order() -> bool:
+    return _capture_ho
 
 
 def _run_graph_ho(seed_refs, seed_grads, retain_graph=False):
@@ -273,6 +291,7 @@ def _run_graph_ho(seed_refs, seed_grads, retain_graph=False):
             node.vjp_fn = None
             node.raw_fn = None
             node.in_vals = None
+            node.ho_call = None
     return cotangents, keep
 
 

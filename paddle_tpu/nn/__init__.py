@@ -14,3 +14,20 @@ from .clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from .utils import weight_norm, remove_weight_norm, spectral_norm  # noqa: F401
+
+
+def set_compute_dtype(layer, dtype):
+    """Flax-style TPU mixed precision: parameters stay fp32 (the param
+    IS the master weight) while supporting layers (Linear / LayerNorm /
+    Embedding) compute in `dtype` — casts fuse into the matmuls, so the
+    MXU runs at full bf16 rate with no separate master copy.  Returns
+    the number of layers switched.  Contrast amp.decorate O2, which
+    casts the PARAMS and keeps fp32 masters in the optimizer."""
+    from ..framework import dtypes as _dt
+    jd = _dt.to_jax(dtype)
+    n = 0
+    for sub in layer.sublayers(include_self=True):
+        if hasattr(type(sub), "_compute_dtype"):
+            sub._compute_dtype = jd
+            n += 1
+    return n

@@ -14,15 +14,23 @@ from ...framework.random import next_key
 from ...framework import dtypes
 
 
-def linear(x, weight, bias=None, name=None):
+def linear(x, weight, bias=None, name=None, compute_dtype=None):
     """y = x @ W + b.  Weight layout [in, out] as in the reference
     (`python/paddle/nn/functional/common.py` linear → matmul kernel).
-    Kept as one dot for MXU mapping; XLA fuses the bias add."""
+    Kept as one dot for MXU mapping; XLA fuses the bias add.
+    compute_dtype: cast operands for the dot (fp32 master params, bf16
+    MXU compute — see nn.Linear)."""
+    from ...framework import dtypes as _dt
+    cd = _dt.to_jax(compute_dtype) if compute_dtype is not None else None
+
+    def _c(v):
+        return v.astype(cd) if cd is not None and v.dtype != cd else v
     if bias is None:
         x, weight = to_tensor_args(x, weight)
-        return run(lambda v, w: v @ w, x, weight, name="linear")
+        return run(lambda v, w: _c(v) @ _c(w), x, weight, name="linear")
     x, weight, bias = to_tensor_args(x, weight, bias)
-    return run(lambda v, w, b: v @ w + b, x, weight, bias, name="linear")
+    return run(lambda v, w, b: _c(v) @ _c(w) + _c(b), x, weight, bias,
+               name="linear")
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",

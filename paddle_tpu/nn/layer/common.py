@@ -29,7 +29,15 @@ class Identity(Layer):
 
 
 class Linear(Layer):
-    """y = xW + b with W:[in, out] (reference layout, common.py:113)."""
+    """y = xW + b with W:[in, out] (reference layout, common.py:113).
+
+    `_compute_dtype` (settable post-construction, e.g. by
+    paddle_tpu.nn.set_compute_dtype) selects the flax-style mixed
+    precision idiom for TPU: the fp32 parameter IS the master weight and
+    the cast to the compute dtype fuses into the matmul — no separate
+    master copy, full MXU rate."""
+
+    _compute_dtype = None
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  bias_attr=None, name=None):
@@ -44,7 +52,8 @@ class Linear(Layer):
             is_bias=True)
 
     def forward(self, input):
-        return F.linear(input, self.weight, self.bias)
+        return F.linear(input, self.weight, self.bias,
+                        compute_dtype=self._compute_dtype)
 
     def extra_repr(self):
         return (f"in_features={self._in_features}, "
@@ -118,8 +127,13 @@ class Embedding(Layer):
                 else num_embeddings + padding_idx
             self.weight._value = self.weight._value.at[pid].set(0.0)
 
+    _compute_dtype = None
+
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        out = F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        if self._compute_dtype is not None:
+            out = out.astype(self._compute_dtype)
+        return out
 
     def extra_repr(self):
         return f"{self._num_embeddings}, {self._embedding_dim}"

@@ -44,9 +44,16 @@ class LayerNorm(Layer):
             self.bias = self.create_parameter(
                 shape=self._normalized_shape, attr=bias_attr, is_bias=True)
 
+    _compute_dtype = None
+
     def forward(self, input):
-        return F.layer_norm(input, self._normalized_shape, self.weight,
-                            self.bias, self._epsilon)
+        out = F.layer_norm(input, self._normalized_shape, self.weight,
+                           self.bias, self._epsilon)
+        if self._compute_dtype is not None:
+            # normalization math stays fp32 (fp32 params); only the
+            # RESULT re-enters the low-precision residual stream
+            out = out.astype(self._compute_dtype)
+        return out
 
     def extra_repr(self):
         return f"normalized_shape={self._normalized_shape}"

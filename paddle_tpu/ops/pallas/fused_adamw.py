@@ -122,19 +122,35 @@ def fused_adamw(grad, m, v, master, lr, step, *, b1=0.9, b2=0.999,
                 br = None
     else:
         br = None
+    pad = 0
     if br is not None:
         work_shape = (rows, lanes)
         grid = (rows // br,)
         blk = pl.BlockSpec((br, lanes), lambda i: (i, 0))
     else:
-        work_shape = (n,)
-        chunk = min(_CHUNK, n)
-        grid = ((n + chunk - 1) // chunk,)
+        # flat path: pad to the packed-tile granule (bf16 packs (16,128)
+        # sublane tiles = 2048 elems; also covers fp32 (8,128)=1024) so
+        # every block offset AND the final partial block stay
+        # sublane-aligned for Mosaic
+        align = 2048
+        n_pad = -n % align
+        pad = n_pad
+        work_shape = (n + n_pad,)
+        chunk = min(_CHUNK, n + n_pad)
+        grid = ((n + n_pad + chunk - 1) // chunk,)
         blk = pl.BlockSpec((chunk,), lambda i: (i,))
-    g1 = grad.reshape(work_shape)
-    m1 = m.reshape(work_shape)
-    v1 = v.reshape(work_shape)
-    mst1 = master.reshape(work_shape)
+
+    def _flat(a):
+        a = a.reshape((n,))
+        return jnp.pad(a, (0, pad)) if pad else a
+    if pad:
+        g1, m1, v1, mst1 = (_flat(grad), _flat(m), _flat(v),
+                            _flat(master))
+    else:
+        g1 = grad.reshape(work_shape)
+        m1 = m.reshape(work_shape)
+        v1 = v.reshape(work_shape)
+        mst1 = master.reshape(work_shape)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     fp32_params = jnp.dtype(out_dtype) == jnp.float32
     kw = dict(b1=b1, b2=b2, eps=eps, wd=wd, decoupled=decoupled)
@@ -170,6 +186,8 @@ def fused_adamw(grad, m, v, master, lr, step, *, b1=0.9, b2=0.999,
                 input_output_aliases={4: 1, 5: 2, 6: 3},
                 interpret=_interpret(),
             )(lr1, c1, c2, g1, m1, v1, mst1)
+    if pad:
+        p1, m1, v1, mst1 = (a[:n] for a in (p1, m1, v1, mst1))
     return (p1.reshape(shape), m1.reshape(shape), v1.reshape(shape),
             mst1.reshape(shape))
 

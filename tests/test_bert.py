@@ -1,0 +1,68 @@
+"""BERT family + nn.set_compute_dtype (flax-idiom mixed precision).
+
+Reference: PaddleNLP BertModel surface; the mixed-precision contract is
+the TPU design's own (fp32 params are the masters, compute in bf16).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.models.bert import (BertForMaskedLM, BertModel,
+                                    bert_tiny_config)
+
+
+def test_bert_forward_shapes():
+    cfg = bert_tiny_config()
+    m = BertModel(cfg)
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 16)).astype(np.int32))
+    seq, pooled = m(ids)
+    assert tuple(seq.shape) == (2, 16, cfg.hidden_size)
+    assert tuple(pooled.shape) == (2, cfg.hidden_size)
+
+
+def test_bert_mlm_trains():
+    paddle.seed(0)
+    cfg = bert_tiny_config()
+    m = BertForMaskedLM(cfg)
+    opt = paddle.optimizer.AdamW(5e-3, parameters=m.parameters())
+    from paddle_tpu.jit import TrainStep
+    step = TrainStep(m, lambda o, y: m.compute_loss(o, y), opt)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    losses = [float(np.asarray(step(x, x).value)) for _ in range(12)]
+    assert losses[-1] < losses[0] * 0.8, losses[::4]
+
+
+def test_bert_compute_dtype_bf16():
+    """cfg.dtype='bfloat16' → fp32 params (masters), bf16 activations."""
+    cfg = bert_tiny_config(dtype="bfloat16")
+    m = BertForMaskedLM(cfg)
+    for n, p in m.state_dict().items():
+        assert str(p.value.dtype) == "float32", (n, p.value.dtype)
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 16)).astype(np.int32))
+    logits = m(ids)
+    assert str(logits.value.dtype) == "bfloat16"
+    # loss is fp32 and close to the fp32 model's
+    loss = m.compute_loss(logits, ids)
+    assert str(loss.value.dtype) == "float32"
+    assert np.isfinite(float(np.asarray(loss.value)))
+
+
+def test_set_compute_dtype_counts_and_grad():
+    """set_compute_dtype flips Linear/LayerNorm/Embedding; grads stay
+    fp32 (cast is inside the recorded op, so the vjp casts back)."""
+    m = nn.Sequential(nn.Linear(8, 8), nn.LayerNorm(8), nn.Linear(8, 2))
+    n = nn.set_compute_dtype(m, "bfloat16")
+    assert n == 3
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 8).astype(np.float32))
+    out = m(x)
+    assert str(out.value.dtype) == "bfloat16"
+    loss = (out.astype("float32") ** 2).sum()
+    loss.backward()
+    g = m[0].weight.grad
+    assert g is not None and str(g.value.dtype) == "float32"
