@@ -1,0 +1,320 @@
+"""Full-auto parallel engine: unannotated Layer → planned strategy →
+configured trainer.
+
+Reference: the reference's largest distributed subsystem —
+`python/paddle/distributed/auto_parallel/static/engine.py:100` (Engine),
+`completion.py` (sharding propagation from seed annotations),
+`partitioner.py` (program partition), `planner_v2.py` + `cost_model.py`
+(cost-driven strategy planning).  There the pipeline rewrites a static
+program op by op; ~51K LoC.
+
+TPU-native redesign, three stages:
+
+1. **analyze** — structural inspection of the Layer tree (parameter
+   shapes + repeated-block detection from parameter name indices)
+   producing the model summary the analytic models consume.  This
+   replaces the reference's program-graph analysis: on TPU the op-level
+   dataflow is XLA's concern, so the engine only needs the model's
+   macro shape.
+2. **plan** — the existing auto_tuner (`distributed/auto_tuner`) ranks
+   (dp, mp, sharding, stage, recompute) candidates by the roofline cost
+   model, pruned by the per-chip HBM model (reference planner_v2 +
+   cost_model, with the memory estimate replacing OOM trial runs).
+3. **complete + emit** — parameter shardings are completed from seed
+   rules (user annotations win; the engine fills the rest with the
+   megatron layout inferred from shape + name) and a ShardedTrainStep
+   (or PipelineEngine for an explicit PipelineLayer) is configured.
+   Op-level propagation — the bulk of the reference's completion.py —
+   is DELEGATED to GSPMD: annotating the parameters is the seed, XLA
+   propagates through every op in the jitted program.
+"""
+from __future__ import annotations
+
+import re
+from collections import Counter, defaultdict
+from typing import Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["analyze_model", "complete_shardings", "AutoParallelEngine",
+           "auto_engine"]
+
+
+# ---------------------------------------------------------------------------
+# 1. analyze
+# ---------------------------------------------------------------------------
+_IDX = re.compile(r"\.(\d+)\.")
+
+
+def analyze_model(model, seq_len: int = 512) -> dict:
+    """Structural summary of an unannotated Layer for the planner.
+
+    Returns the model_cfg dict the auto_tuner's cost/memory models
+    consume: hidden_size / intermediate_size / num_hidden_layers /
+    num_attention_heads / vocab_size / seq_len / n_params, plus
+    block_prefix (the repeated-layer path, for pp segmentation).
+
+    Reference analog: static/completion.py walks the program; here the
+    parameter NAME INDICES reveal the repeated block and the 2-D
+    parameter SHAPES reveal the transformer dims."""
+    shapes = [(n, tuple(int(d) for d in p.value.shape))
+              for n, p in model.named_parameters()]
+    n_params = sum(int(np.prod(s)) for _, s in shapes)
+
+    # repeated block: the name prefix with the most distinct indices
+    groups = defaultdict(set)
+    for n, _ in shapes:
+        m = _IDX.search(n)
+        if m:
+            groups[n[: m.start()]].add(int(m.group(1)))
+    block_prefix, L = None, 1
+    if groups:
+        block_prefix = max(groups, key=lambda k: len(groups[k]))
+        L = max(1, len(groups[block_prefix]))
+
+    two_d = [s for _, s in shapes if len(s) == 2]
+    dims = Counter(d for s in two_d for d in s)
+    if dims:
+        hidden = dims.most_common(1)[0][0]
+        vocab = max((max(s) for s in two_d), default=hidden)
+        if vocab < 2 * hidden:
+            vocab = hidden  # no embedding-like table
+        inter = max((d for s in two_d for d in s
+                     if hidden in s and d != hidden and d != vocab),
+                    default=4 * hidden)
+    else:
+        hidden = inter = vocab = max(
+            (int(np.prod(s)) for _, s in shapes), default=1)
+
+    # heads are invisible in parameter shapes; hd=64/128 are the only
+    # TPU-sane choices and only divisibility matters to the planner
+    heads = max(1, hidden // (128 if hidden % 128 == 0 else 64))
+    return {
+        "hidden_size": hidden,
+        "intermediate_size": inter,
+        "num_hidden_layers": L,
+        "num_attention_heads": heads,
+        "vocab_size": vocab,
+        "seq_len": seq_len,
+        "n_params": n_params,
+        "block_prefix": block_prefix,
+    }
+
+
+# ---------------------------------------------------------------------------
+# 2. complete — parameter shardings from seed rules
+# ---------------------------------------------------------------------------
+_ROW_HINTS = ("o_proj", "down", "out", "wo", "dense_4h_to_h", "fc2")
+
+
+def _is_seeded(p) -> bool:
+    """A user-annotated param (shard_tensor / device_put with a real
+    PartitionSpec) is a completion SEED — never overwritten."""
+    try:
+        spec = p.value.sharding.spec
+    except AttributeError:
+        return False
+    return any(s is not None for s in spec)
+
+
+def complete_shardings(model, mesh: Mesh, hidden_size: Optional[int] = None,
+                       vocab_size: Optional[int] = None) -> int:
+    """Annotate every unannotated parameter with its TP sharding
+    (megatron layout inferred from shape+name); returns the number
+    annotated.  1-D params stay replicated — sharded 1-D params leak
+    their spec into activations under GSPMD (see
+    parallel/sharded_trainer.py notes).  Op-level propagation from
+    these seeds is GSPMD's job inside the jitted step.
+
+    Reference: completion.py complete_forward_annotation — there a
+    fixpoint pass over program ops; here param rules + XLA propagation.
+    """
+    if "mp" not in mesh.axis_names or mesh.shape["mp"] <= 1:
+        return 0
+    mp = mesh.shape["mp"]
+    info = analyze_model(model) if (hidden_size is None
+                                    or vocab_size is None) else None
+    hidden = hidden_size or info["hidden_size"]
+    vocab = vocab_size or info["vocab_size"]
+
+    n = 0
+    for name, p in model.named_parameters():
+        shape = tuple(int(d) for d in p.value.shape)
+        if len(shape) != 2 or _is_seeded(p):
+            continue
+        a, b = shape
+        # leaf name: either the param itself (llama's raw Parameters:
+        # "...self_attn.o_proj") or its module ("...out.weight")
+        parts = name.lower().split(".")
+        base = parts[-2] if parts[-1] in ("weight", "bias") \
+            and len(parts) > 1 else parts[-1]
+        if a == vocab and a > 2 * hidden:
+            spec = P("mp", None) if a % mp == 0 else None   # embedding
+        elif b == vocab and b > 2 * hidden:
+            spec = P(None, "mp") if b % mp == 0 else None   # lm head
+        elif any(h in base for h in _ROW_HINTS):
+            spec = P("mp", None) if a % mp == 0 else None   # row-parallel
+        elif b % mp == 0:
+            spec = P(None, "mp")                            # column
+        elif a % mp == 0:
+            spec = P("mp", None)
+        else:
+            spec = None
+        if spec is not None:
+            p._value = jax.device_put(p.value, NamedSharding(mesh, spec))
+            n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# 3. plan + emit
+# ---------------------------------------------------------------------------
+class AutoParallelEngine:
+    """One-call full-auto engine (reference Engine, api.py Engine.fit):
+
+        eng = AutoParallelEngine(model, opt, loss_fn,
+                                 global_batch_size=32, seq_len=512,
+                                 hbm_bytes=16e9)
+        loss = eng.step(x, y)          # plans, builds, then trains
+
+    The chosen strategy is in `eng.strategy`; `eng.plan()` /
+    `eng.build()` run the stages explicitly."""
+
+    def __init__(self, model, optimizer, loss_fn=None, devices=None,
+                 global_batch_size: int = 8, seq_len: int = 512,
+                 chip: Optional[str] = None,
+                 hbm_bytes: Optional[float] = None,
+                 allow_pp: Optional[bool] = None,
+                 model_cfg: Optional[dict] = None, **tune_kw):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.devices = list(devices) if devices is not None \
+            else jax.devices()
+        self.global_batch_size = int(global_batch_size)
+        self.seq_len = int(seq_len)
+        self.chip = chip or self._chip_kind()
+        self.hbm_bytes = hbm_bytes
+        self.strategy: Optional[dict] = None
+        self.mesh: Optional[Mesh] = None
+        self.trainer = None
+        # what-if planning: plan for a DIFFERENT model shape than the
+        # one in hand (reference planner runs from the cost model alone)
+        self._model_cfg_override = model_cfg
+        self._tune_kw = tune_kw
+        from ..fleet.meta_parallel import PipelineLayer
+        self._is_pipeline_layer = isinstance(model, PipelineLayer)
+        self.allow_pp = (self._is_pipeline_layer if allow_pp is None
+                         else allow_pp)
+
+    def _chip_kind(self) -> str:
+        kind = getattr(self.devices[0], "device_kind", "").lower()
+        for k in ("v6", "v5p", "v4"):
+            if k in kind:
+                return {"v6": "v6e"}.get(k, k)
+        if "v5 lite" in kind or "v5e" in kind:
+            return "v5e"
+        return "v5e"
+
+    # -- stage 2: plan ------------------------------------------------------
+    def plan(self) -> dict:
+        """Rank strategies with the auto_tuner and keep the best
+        feasible one.  pp candidates are offered only for an explicit
+        PipelineLayer (automatic model bisection is not attempted —
+        emitting a wrong pipeline split silently would be worse than
+        saying so)."""
+        from ..auto_tuner import tune
+        from ..auto_tuner.search import default_candidates
+
+        info = dict(self._model_cfg_override) \
+            if self._model_cfg_override is not None \
+            else analyze_model(self.model, seq_len=self.seq_len)
+        info.setdefault("seq_len", self.seq_len)
+        self.model_info = info
+        n = len(self.devices)
+        tuner_cfg = {"model_cfg": info, "n_devices": n,
+                     "global_batch_size": self.global_batch_size}
+        cands = default_candidates(tuner_cfg)
+        if not self.allow_pp:
+            cands["pp"] = [1]
+            cands["vpp"] = [1]
+        ranked = tune(info, n,
+                      global_batch_size=self.global_batch_size,
+                      chip=self.chip, hbm_bytes=self.hbm_bytes,
+                      candidates=cands, **self._tune_kw)
+        if not ranked:
+            raise RuntimeError(
+                "auto-parallel planner found no feasible strategy "
+                f"(devices={n}, hbm={self.hbm_bytes}) — every candidate "
+                "was pruned; raise hbm_bytes or shrink the model")
+        self.strategy = ranked[0]
+        self.ranked = ranked
+        return self.strategy
+
+    # -- stage 3: complete + emit -------------------------------------------
+    def build(self):
+        if self.strategy is None:
+            self.plan()
+        s = self.strategy
+        from ...distributed.topology import build_mesh
+        from ...parallel import ShardedTrainStep
+
+        if s.get("pp", 1) > 1 and not self._is_pipeline_layer:
+            raise RuntimeError(
+                "planned strategy uses pp>1 but the model is not a "
+                "PipelineLayer — automatic model bisection is not "
+                "attempted (a silent wrong split would be worse); wrap "
+                "the model in fleet.meta_parallel.PipelineLayer or "
+                "plan with allow_pp=False")
+        if s.get("pp", 1) > 1 and self._is_pipeline_layer:
+            from ...parallel.pipeline import PipelineEngine
+            self.mesh = build_mesh(dp=s["dp"], mp=s["mp"], pp=s["pp"],
+                                   sharding=s["sharding"],
+                                   devices=self.devices)
+            complete_shardings(self.model, self.mesh)
+            self.trainer = PipelineEngine(
+                self.model, self.mesh,
+                num_virtual_stages=s.get("vpp", 1))
+            return self.trainer
+
+        self.mesh = build_mesh(dp=s["dp"], mp=s["mp"],
+                               sharding=s["sharding"],
+                               devices=self.devices)
+        complete_shardings(self.model, self.mesh)
+        # a generic analyzed model has no internal selective-remat tags,
+        # so ANY planned recompute must hold at runtime as whole-step
+        # remat — otherwise the planner's memory verdict is violated and
+        # the step OOMs (models with internal tags pay some double
+        # remat; correct, just conservative)
+        self.trainer = ShardedTrainStep(
+            self.model, self.optimizer, self.mesh,
+            sharding_stage=s["sharding_stage"],
+            rematerialize=(s.get("recompute", "none") != "none"),
+            loss_fn=self.loss_fn)
+        return self.trainer
+
+    def step(self, *batch):
+        """One optimizer step under the planned strategy.  For a
+        PipelineEngine plan the caller's optimizer still runs the
+        update (reference PipelineParallel.train_batch wraps both)."""
+        if self.trainer is None:
+            self.build()
+        s = self.strategy
+        if s.get("pp", 1) > 1 and self._is_pipeline_layer:
+            micros = max(1, self.global_batch_size
+                         // max(1, s.get("micro_batch_size", 1)))
+            loss = self.trainer.train_batch(list(batch), micros)
+            self.optimizer.step()
+            self.optimizer.clear_grad()
+            return loss
+        return self.trainer(*batch)
+
+    __call__ = step
+
+
+def auto_engine(model, optimizer, loss_fn=None, **kw) -> AutoParallelEngine:
+    """Convenience constructor mirroring reference
+    `auto_parallel.api.to_static(..., strategy=auto)`."""
+    return AutoParallelEngine(model, optimizer, loss_fn, **kw)

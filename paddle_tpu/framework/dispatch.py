@@ -19,7 +19,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-from .tape import Node, VarRef, is_grad_enabled
+from .tape import Node, VarRef, is_grad_enabled, capture_higher_order
 from .tensor import Tensor
 from . import dtypes
 
@@ -122,7 +122,6 @@ def run(raw_fn, *tensors: Tensor, name: str = "", n_outs: Optional[int] = None):
                 in_refs.append(t._ref)
             else:
                 in_refs.append(None)
-        from .tape import capture_higher_order
         cap = capture_higher_order()
         node = Node(vjp_fn, in_refs, out_refs, out_avals, name=name,
                     raw_fn=raw_fn if cap else None,

@@ -1,0 +1,127 @@
+"""Full-auto parallel engine (analyze → plan → complete → emit).
+
+Reference: auto_parallel/static/engine.py + planner_v2.py +
+completion.py — here validated end-to-end on the virtual 8-device CPU
+mesh: the planner picks a feasible strategy for an unannotated model,
+the completion produces the megatron layout from shape+name seeds, and
+the emitted trainer's loss matches an unsharded baseline.
+"""
+import numpy as np
+import jax
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.auto_parallel import (
+    AutoParallelEngine, analyze_model, complete_shardings)
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+
+
+def _tiny_llama():
+    paddle.seed(0)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            intermediate_size=128, num_attention_heads=4,
+                            num_key_value_heads=4, vocab_size=256,
+                            max_position_embeddings=64)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def test_analyze_model_extracts_structure():
+    model, cfg = _tiny_llama()
+    info = analyze_model(model, seq_len=32)
+    assert info["hidden_size"] == 64
+    assert info["intermediate_size"] == 128
+    assert info["num_hidden_layers"] == 2
+    assert info["vocab_size"] == 256
+    assert info["block_prefix"] and "layers" in info["block_prefix"]
+
+
+def test_completion_megatron_layout_and_seed_respected():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.distributed.topology import build_mesh
+    model, cfg = _tiny_llama()
+    mesh = build_mesh(mp=2, dp=4)
+    # seed one param by hand: completion must not overwrite it
+    q = model.llama.layers[0].self_attn.q_proj
+    q._value = jax.device_put(q.value, NamedSharding(mesh, P("mp", None)))
+    n = complete_shardings(model, mesh)
+    assert n > 0
+    spec = lambda p: tuple(p.value.sharding.spec)
+    # seed kept (engine would have chosen column = (None, 'mp'))
+    assert spec(q) == ("mp", None)
+    l1 = model.llama.layers[1].self_attn
+    assert spec(l1.q_proj) == (None, "mp")            # column
+    assert spec(l1.o_proj) == ("mp", None)            # row (name hint)
+    assert spec(model.llama.layers[1].mlp.down_proj) == ("mp", None)
+    assert spec(model.llama.embed_tokens) == ("mp", None)  # vocab
+    # 1-D norms stay replicated (GSPMD leak avoidance)
+    norm = model.llama.layers[1].input_layernorm.weight
+    assert not any(s is not None
+                   for s in getattr(norm.value.sharding, "spec", ()))
+
+
+def _engine(hbm, model=None, opt=None, **kw):
+    if model is None:
+        model, _ = _tiny_llama()
+        opt = paddle.optimizer.AdamW(1e-3,
+                                     parameters=model.parameters())
+    return AutoParallelEngine(model, opt, global_batch_size=8,
+                              seq_len=32, hbm_bytes=hbm, chip="v5e",
+                              **kw)
+
+
+def test_planner_finds_feasible_strategy_and_runs():
+    eng = _engine(hbm=16e9)
+    s = eng.plan()
+    assert s["dp"] * s["mp"] * s["pp"] * s["sharding"] == 8
+    eng.build()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 256, (8, 32)).astype(np.int32)
+    loss = eng.step(paddle.to_tensor(ids), paddle.to_tensor(ids))
+    auto_loss = float(np.asarray(loss.value))
+    assert np.isfinite(auto_loss)
+
+    # strategy invariance: same loss as an unsharded single-device step
+    paddle.seed(0)
+    model2, _ = _tiny_llama()
+    opt2 = paddle.optimizer.AdamW(1e-3, parameters=model2.parameters())
+    from paddle_tpu.parallel import ShardedTrainStep
+    from paddle_tpu.distributed.topology import build_mesh
+    st = ShardedTrainStep(model2, opt2,
+                          build_mesh(devices=jax.devices()[:1]),
+                          sharding_stage=0)
+    base = float(np.asarray(st(paddle.to_tensor(ids),
+                               paddle.to_tensor(ids)).value))
+    np.testing.assert_allclose(auto_loss, base, rtol=2e-4, atol=2e-5)
+
+
+def test_planner_adapts_to_memory_budget():
+    """Shrinking the budget must change the plan toward state sharding
+    / recompute (reference planner_v2 cost-vs-memory tradeoff).  Uses
+    what-if planning on a 7B-class config — the cost/memory models, not
+    the in-hand tiny model, drive the choice."""
+    llama7b = dict(hidden_size=4096, intermediate_size=11008,
+                   num_hidden_layers=32, num_attention_heads=32,
+                   vocab_size=32000, seq_len=2048)
+    model, _ = _tiny_llama()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+
+    def plan_at(hbm):
+        return AutoParallelEngine(model, opt, global_batch_size=8,
+                                  seq_len=2048, hbm_bytes=hbm,
+                                  chip="v5p", model_cfg=llama7b).plan()
+
+    big = plan_at(95e9 * 8)      # practically unconstrained
+    small = plan_at(24e9)        # tight: must shard state / recompute
+    assert (small["sharding_stage"], small["sharding"],
+            small["recompute"]) != (big["sharding_stage"],
+                                    big["sharding"], big["recompute"]), \
+        (big, small)
+    assert small["sharding_stage"] >= 1 or small["recompute"] != "none"
+    assert small["est_memory_gb"] <= 24.0
+
+
+def test_planner_raises_when_infeasible():
+    eng = _engine(hbm=0.001e9)
+    with pytest.raises(RuntimeError, match="no feasible strategy"):
+        eng.plan()
