@@ -411,10 +411,11 @@ def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
 
 
 from .static_engine import Strategy, DistModel, to_static, Engine  # noqa: E402,F401
-from .auto_engine import (AutoParallelEngine, auto_engine,  # noqa: E402,F401
-                          analyze_model, complete_shardings)
+from .auto_engine import (AutoParallelEngine,  # noqa: E402,F401
+                          make_auto_engine, analyze_model,
+                          complete_shardings)
 
 __all__ += ["ShardingStage1", "ShardingStage2", "ShardingStage3",
             "shard_optimizer", "shard_dataloader", "Strategy",
             "DistModel", "to_static", "Engine", "AutoParallelEngine",
-            "auto_engine", "analyze_model", "complete_shardings"]
+            "make_auto_engine", "analyze_model", "complete_shardings"]

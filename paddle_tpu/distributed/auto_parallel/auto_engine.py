@@ -39,7 +39,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["analyze_model", "complete_shardings", "AutoParallelEngine",
-           "auto_engine"]
+           "make_auto_engine"]
 
 
 # ---------------------------------------------------------------------------
@@ -88,9 +88,22 @@ def analyze_model(model, seq_len: int = 512) -> dict:
         hidden = inter = vocab = max(
             (int(np.prod(s)) for _, s in shapes), default=1)
 
-    # heads are invisible in parameter shapes; hd=64/128 are the only
-    # TPU-sane choices and only divisibility matters to the planner
-    heads = max(1, hidden // (128 if hidden % 128 == 0 else 64))
+    # heads are invisible in parameter shapes: probe the model's own
+    # config first (llama/bert/gpt style) — a wrong inferred count
+    # corrupts exactly the divisibility check prune_by_mp runs, pruning
+    # every TP candidate; the hd=64/128 guess is only the last resort
+    heads = None
+    cfg = getattr(model, "config", None)
+    for holder in (cfg, model):
+        for attr in ("num_attention_heads", "num_heads", "n_head"):
+            v = getattr(holder, attr, None) if holder is not None else None
+            if isinstance(v, int) and v > 0:
+                heads = v
+                break
+        if heads:
+            break
+    if heads is None:
+        heads = max(1, hidden // (128 if hidden % 128 == 0 else 64))
     return {
         "hidden_size": hidden,
         "intermediate_size": inter,
@@ -273,7 +286,7 @@ class AutoParallelEngine:
             self.mesh = build_mesh(dp=s["dp"], mp=s["mp"], pp=s["pp"],
                                    sharding=s["sharding"],
                                    devices=self.devices)
-            complete_shardings(self.model, self.mesh)
+            self._complete(self.mesh)
             self.trainer = PipelineEngine(
                 self.model, self.mesh,
                 num_virtual_stages=s.get("vpp", 1))
@@ -282,7 +295,7 @@ class AutoParallelEngine:
         self.mesh = build_mesh(dp=s["dp"], mp=s["mp"],
                                sharding=s["sharding"],
                                devices=self.devices)
-        complete_shardings(self.model, self.mesh)
+        self._complete(self.mesh)
         # a generic analyzed model has no internal selective-remat tags,
         # so ANY planned recompute must hold at runtime as whole-step
         # remat — otherwise the planner's memory verdict is violated and
@@ -295,6 +308,18 @@ class AutoParallelEngine:
             loss_fn=self.loss_fn)
         return self.trainer
 
+    def _complete(self, mesh):
+        """Completion with plan()'s analysis reused — unless the plan
+        ran on a what-if model_cfg override, in which case the REAL
+        model's dims must be re-derived."""
+        info = getattr(self, "model_info", None)
+        if info is None or self._model_cfg_override is not None:
+            complete_shardings(self.model, mesh)
+        else:
+            complete_shardings(self.model, mesh,
+                               hidden_size=info["hidden_size"],
+                               vocab_size=info["vocab_size"])
+
     def step(self, *batch):
         """One optimizer step under the planned strategy.  For a
         PipelineEngine plan the caller's optimizer still runs the
@@ -303,8 +328,10 @@ class AutoParallelEngine:
             self.build()
         s = self.strategy
         if s.get("pp", 1) > 1 and self._is_pipeline_layer:
-            micros = max(1, self.global_batch_size
-                         // max(1, s.get("micro_batch_size", 1)))
+            # per-REPLICA micro count — the count prune_by_mbs validated
+            data_ways = s.get("dp", 1) * s.get("sharding", 1)
+            local = max(1, self.global_batch_size // data_ways)
+            micros = max(1, local // max(1, s.get("micro_batch_size", 1)))
             loss = self.trainer.train_batch(list(batch), micros)
             self.optimizer.step()
             self.optimizer.clear_grad()
@@ -314,7 +341,9 @@ class AutoParallelEngine:
     __call__ = step
 
 
-def auto_engine(model, optimizer, loss_fn=None, **kw) -> AutoParallelEngine:
+def make_auto_engine(model, optimizer, loss_fn=None,
+                     **kw) -> AutoParallelEngine:
     """Convenience constructor mirroring reference
-    `auto_parallel.api.to_static(..., strategy=auto)`."""
+    `auto_parallel.api.to_static(..., strategy=auto)`.  (Named so the
+    `auto_engine` SUBMODULE attribute isn't shadowed on the package.)"""
     return AutoParallelEngine(model, optimizer, loss_fn, **kw)

@@ -603,6 +603,12 @@ REGISTRY: Sequence[OpSpec] = [
            ref="python/paddle/tensor/linalg.py outer"),
 ]
 
+# ops.yaml long-tail extension (round-4 audit close) — kept in its own
+# module; build_extra takes the helpers as args to avoid a circular
+# import at module load
+from .registry_ext import build_extra as _build_extra  # noqa: E402
+REGISTRY = list(REGISTRY) + _build_extra(OpSpec, _n, _u, _rs, _seed_of)
+
 
 def _np_index_fill(x, index, axis, value):
     out = np.array(x)

@@ -1,0 +1,945 @@
+"""Op-registry extension: the ops.yaml long tail (round-4 audit close).
+
+Reference: `paddle/phi/ops/yaml/ops.yaml` — each entry below names its
+declaration.  Same single-source contract as registry.py: one OpSpec →
+the paddle_tpu.* function, its `_C_ops` binding, and its generated
+output+grad OpTests.  Selection driven by `tools/op_audit.py`'s `todo`
+category (the genuinely missing, implementable ops).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def build_extra(OpSpec, _n, _u, _rs, _seed_of):
+    """Returns the extension OpSpec list.  Called from registry.py after
+    OpSpec/helpers exist (avoids a circular import)."""
+
+    def _ints(lo, hi, *shape, seed_key="i"):
+        return _rs(_seed_of(seed_key, lo, hi, shape)).randint(
+            lo, hi, shape).astype(np.int64)
+
+    # -- vision ----------------------------------------------------------
+    def affine_channel(x, scale, bias, data_format="NCHW"):
+        if data_format == "NCHW":
+            return x * scale[None, :, None, None] + bias[None, :, None, None]
+        return x * scale + bias
+
+    def affine_grid_j(theta, out_h, out_w, align_corners=True):
+        n = theta.shape[0]
+        xs = jnp.linspace(-1.0, 1.0, out_w)
+        ys = jnp.linspace(-1.0, 1.0, out_h)
+        if not align_corners:
+            xs = xs * (out_w - 1) / out_w
+            ys = ys * (out_h - 1) / out_h
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)        # [H, W, 3]
+        grid = jnp.einsum("hwk,nck->nhwc", base, theta)  # [N, H, W, 2]
+        return grid
+
+    def affine_grid_np(theta, out_h, out_w, align_corners=True):
+        xs = np.linspace(-1.0, 1.0, out_w)
+        ys = np.linspace(-1.0, 1.0, out_h)
+        if not align_corners:
+            xs = xs * (out_w - 1) / out_w
+            ys = ys * (out_h - 1) / out_h
+        gx, gy = np.meshgrid(xs, ys)
+        base = np.stack([gx, gy, np.ones_like(gx)], axis=-1)
+        return np.einsum("hwk,nck->nhwc", base, theta).astype(np.float32)
+
+    def _unnorm(coord, size, align_corners):
+        if align_corners:
+            return (coord + 1) * 0.5 * (size - 1)
+        return ((coord + 1) * size - 1) * 0.5
+
+    def grid_sample_j(x, grid, mode="bilinear", padding_mode="zeros",
+                      align_corners=True):
+        n, c, h, w = x.shape
+        gx = _unnorm(grid[..., 0], w, align_corners)     # [N, Ho, Wo]
+        gy = _unnorm(grid[..., 1], h, align_corners)
+
+        def gather(ix, iy):
+            inb = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            out = x[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [N,Ho,Wo,C]
+            return jnp.where(inb[..., None], out, 0.0)
+
+        if mode == "nearest":
+            out = gather(jnp.round(gx).astype(jnp.int32),
+                         jnp.round(gy).astype(jnp.int32))
+            return out.transpose(0, 3, 1, 2)
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx = (gx - x0)[..., None]
+        wy = (gy - y0)[..., None]
+        x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+        out = (gather(x0i, y0i) * (1 - wx) * (1 - wy)
+               + gather(x0i + 1, y0i) * wx * (1 - wy)
+               + gather(x0i, y0i + 1) * (1 - wx) * wy
+               + gather(x0i + 1, y0i + 1) * wx * wy)
+        return out.transpose(0, 3, 1, 2)
+
+    def grid_sample_np(x, grid, mode="bilinear", padding_mode="zeros",
+                       align_corners=True):
+        n, c, h, w = x.shape
+        gx = _unnorm(grid[..., 0], w, align_corners)
+        gy = _unnorm(grid[..., 1], h, align_corners)
+
+        def gather(ix, iy):
+            inb = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+            ixc = np.clip(ix, 0, w - 1).astype(np.int64)
+            iyc = np.clip(iy, 0, h - 1).astype(np.int64)
+            out = x[np.arange(n)[:, None, None], :, iyc, ixc]
+            return np.where(inb[..., None], out, 0.0)
+
+        if mode == "nearest":
+            return gather(np.round(gx).astype(np.int64),
+                          np.round(gy).astype(np.int64)
+                          ).transpose(0, 3, 1, 2).astype(np.float32)
+        x0 = np.floor(gx)
+        y0 = np.floor(gy)
+        wx = (gx - x0)[..., None]
+        wy = (gy - y0)[..., None]
+        x0i, y0i = x0.astype(np.int64), y0.astype(np.int64)
+        out = (gather(x0i, y0i) * (1 - wx) * (1 - wy)
+               + gather(x0i + 1, y0i) * wx * (1 - wy)
+               + gather(x0i, y0i + 1) * (1 - wx) * wy
+               + gather(x0i + 1, y0i + 1) * wx * wy)
+        return out.transpose(0, 3, 1, 2).astype(np.float32)
+
+    def shuffle_channel(x, group=1):
+        n, c, h, w = x.shape
+        return x.reshape(n, group, c // group, h, w) \
+                .swapaxes(1, 2).reshape(n, c, h, w)
+
+    def temporal_shift_j(x, seg_num, shift_ratio=0.25,
+                         data_format="NCHW"):
+        nt, c, h, w = x.shape
+        n = nt // seg_num
+        v = x.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        back = jnp.concatenate(
+            [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold:2 * fold]),
+             v[:, :-1, fold:2 * fold]], axis=1)
+        keep = v[:, :, 2 * fold:]
+        return jnp.concatenate([back, fwd, keep], axis=2) \
+                  .reshape(nt, c, h, w)
+
+    def temporal_shift_np(x, seg_num, shift_ratio=0.25,
+                          data_format="NCHW"):
+        nt, c, h, w = x.shape
+        n = nt // seg_num
+        v = x.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        out = np.zeros_like(v)
+        out[:, :-1, :fold] = v[:, 1:, :fold]
+        out[:, 1:, fold:2 * fold] = v[:, :-1, fold:2 * fold]
+        out[:, :, 2 * fold:] = v[:, :, 2 * fold:]
+        return out.reshape(nt, c, h, w)
+
+    # -- pooling ---------------------------------------------------------
+    def _pool_patches(x, ksize, stride, pad):
+        """[N, C, kh*kw, Ho, Wo] patch tensor (NCHW)."""
+        n, c, h, w = x.shape
+        kh, kw = ksize
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), stride, [(pad, pad), (pad, pad)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        ho, wo = patches.shape[2], patches.shape[3]
+        return patches.reshape(n, c, kh * kw, ho, wo), (h, w, ho, wo)
+
+    def max_pool2d_with_index_j(x, kernel_size, stride=None, padding=0):
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        st = ks if stride is None else (
+            (stride, stride) if isinstance(stride, int) else tuple(stride))
+        p, (h, w, ho, wo) = _pool_patches(x, ks, st, padding)
+        out = p.max(axis=2)
+        within = p.argmax(axis=2)
+        dh, dw = within // ks[1], within % ks[1]
+        oy = jnp.arange(ho)[:, None] * st[0] - padding
+        ox = jnp.arange(wo)[None, :] * st[1] - padding
+        idx = (oy[None, None] + dh) * w + (ox[None, None] + dw)
+        return out, idx.astype(jnp.int32)
+
+    def max_pool2d_with_index_np(x, kernel_size, stride=None, padding=0):
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        st = ks if stride is None else (
+            (stride, stride) if isinstance(stride, int) else tuple(stride))
+        n, c, h, w = x.shape
+        hp = h + 2 * padding
+        wp = w + 2 * padding
+        xp = np.full((n, c, hp, wp), -np.inf, np.float32)
+        xp[:, :, padding:padding + h, padding:padding + w] = x
+        ho = (hp - ks[0]) // st[0] + 1
+        wo = (wp - ks[1]) // st[1] + 1
+        out = np.zeros((n, c, ho, wo), np.float32)
+        idx = np.zeros((n, c, ho, wo), np.int32)
+        for i in range(ho):
+            for j in range(wo):
+                win = xp[:, :, i * st[0]:i * st[0] + ks[0],
+                         j * st[1]:j * st[1] + ks[1]].reshape(n, c, -1)
+                a = win.argmax(-1)
+                out[:, :, i, j] = win.max(-1)
+                dh, dw = a // ks[1], a % ks[1]
+                idx[:, :, i, j] = ((i * st[0] - padding + dh) * w
+                                   + (j * st[1] - padding + dw))
+        return out, idx
+
+    def unpool_j(x, indices, output_size):
+        indices = indices.astype(jnp.int32)
+        n, c, ho, wo = x.shape
+        h, w = output_size
+        flat = jnp.zeros((n, c, h * w), x.dtype)
+        ni = jnp.arange(n)[:, None, None]
+        ci = jnp.arange(c)[None, :, None]
+        flat = flat.at[ni, ci, indices.reshape(n, c, -1)].set(
+            x.reshape(n, c, -1))
+        return flat.reshape(n, c, h, w)
+
+    def unpool_np(x, indices, output_size):
+        indices = indices.astype(np.int64)
+        n, c, ho, wo = x.shape
+        h, w = output_size
+        flat = np.zeros((n, c, h * w), np.float32)
+        for b in range(n):
+            for ch in range(c):
+                flat[b, ch, indices[b, ch].reshape(-1)] = \
+                    x[b, ch].reshape(-1)
+        return flat.reshape(n, c, h, w)
+
+    def lp_pool2d_j(x, norm_type, kernel_size, stride=None):
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        st = ks if stride is None else (
+            (stride, stride) if isinstance(stride, int) else tuple(stride))
+        p, _ = _pool_patches(jnp.abs(x) ** norm_type, ks, st, 0)
+        return p.sum(axis=2) ** (1.0 / norm_type)
+
+    def lp_pool2d_np(x, norm_type, kernel_size, stride=None):
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        st = ks if stride is None else (
+            (stride, stride) if isinstance(stride, int) else tuple(stride))
+        n, c, h, w = x.shape
+        ho = (h - ks[0]) // st[0] + 1
+        wo = (w - ks[1]) // st[1] + 1
+        out = np.zeros((n, c, ho, wo), np.float32)
+        for i in range(ho):
+            for j in range(wo):
+                win = np.abs(x[:, :, i * st[0]:i * st[0] + ks[0],
+                             j * st[1]:j * st[1] + ks[1]]) ** norm_type
+                out[:, :, i, j] = win.sum((-1, -2)) ** (1.0 / norm_type)
+        return out
+
+    def _frac_bounds(n_in, n_out, u):
+        """Fractional pooling region bounds (Graham 2014): row i covers
+        [ceil(a*(i+u))-ceil(a*u), ceil(a*(i+1+u))-ceil(a*u))."""
+        a = n_in / n_out
+        base = math.ceil(a * u)
+        return [(min(n_in - 1, math.ceil(a * (i + u)) - base),
+                 max(1, math.ceil(a * (i + 1 + u)) - base))
+                for i in range(n_out)]
+
+    def fractional_max_pool2d_j(x, output_size, random_u=0.5):
+        oh, ow = output_size
+        hbs = _frac_bounds(x.shape[2], oh, random_u)
+        wbs = _frac_bounds(x.shape[3], ow, random_u)
+        rows = []
+        for (h0, h1) in hbs:
+            cols = [x[:, :, h0:max(h1, h0 + 1), w0:max(w1, w0 + 1)]
+                    .max(axis=(2, 3)) for (w0, w1) in wbs]
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+
+    def fractional_max_pool2d_np(x, output_size, random_u=0.5):
+        oh, ow = output_size
+        hbs = _frac_bounds(x.shape[2], oh, random_u)
+        wbs = _frac_bounds(x.shape[3], ow, random_u)
+        out = np.zeros(x.shape[:2] + (oh, ow), np.float32)
+        for i, (h0, h1) in enumerate(hbs):
+            for j, (w0, w1) in enumerate(wbs):
+                out[:, :, i, j] = x[:, :, h0:max(h1, h0 + 1),
+                                    w0:max(w1, w0 + 1)].max((2, 3))
+        return out
+
+    # -- signal ----------------------------------------------------------
+    def frame_j(x, frame_length, hop_length, axis=-1):
+        n = x.shape[-1]
+        nf = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[:, None]
+               + hop_length * jnp.arange(nf)[None, :])
+        return x[..., idx]                       # [..., frame_len, n_frames]
+
+    def frame_np(x, frame_length, hop_length, axis=-1):
+        n = x.shape[-1]
+        nf = 1 + (n - frame_length) // hop_length
+        idx = (np.arange(frame_length)[:, None]
+               + hop_length * np.arange(nf)[None, :])
+        return x[..., idx].astype(np.float32)
+
+    def overlap_add_j(x, hop_length, axis=-1):
+        fl, nf = x.shape[-2], x.shape[-1]
+        n = fl + hop_length * (nf - 1)
+        out = jnp.zeros(x.shape[:-2] + (n,), x.dtype)
+        for f in range(nf):                       # nf is static
+            out = out.at[..., f * hop_length:f * hop_length + fl].add(
+                x[..., f])
+        return out
+
+    def overlap_add_np(x, hop_length, axis=-1):
+        fl, nf = x.shape[-2], x.shape[-1]
+        n = fl + hop_length * (nf - 1)
+        out = np.zeros(x.shape[:-2] + (n,), np.float32)
+        for f in range(nf):
+            out[..., f * hop_length:f * hop_length + fl] += x[..., f]
+        return out
+
+    def stft_j(x, n_fft, hop_length=None, center=True,
+               pad_mode="reflect", onesided=True):
+        hop = hop_length or n_fft // 4
+        if center:
+            pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            x = jnp.pad(x, pad, mode=pad_mode)
+        frames = frame_j(x, n_fft, hop)          # [..., n_fft, nf]
+        win = jnp.hanning(n_fft + 1)[:-1].astype(x.dtype)
+        spec = jnp.fft.rfft(frames * win[:, None], axis=-2) if onesided \
+            else jnp.fft.fft(frames * win[:, None], axis=-2)
+        return spec
+
+    def stft_np(x, n_fft, hop_length=None, center=True,
+                pad_mode="reflect", onesided=True):
+        hop = hop_length or n_fft // 4
+        if center:
+            pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            x = np.pad(x, pad, mode=pad_mode)
+        frames = frame_np(x, n_fft, hop)
+        win = np.hanning(n_fft + 1)[:-1].astype(np.float32)
+        fn = np.fft.rfft if onesided else np.fft.fft
+        return fn(frames * win[:, None], axis=-2)
+
+    # -- losses / metrics ------------------------------------------------
+    def hinge_loss(logits, labels):
+        return jnp.maximum(0.0, 1.0 - logits * labels)
+
+    def huber_loss_j(x, label, delta=1.0):
+        r = jnp.abs(x - label)
+        return jnp.where(r <= delta, 0.5 * r * r,
+                         delta * (r - 0.5 * delta))
+
+    def huber_loss_np(x, label, delta=1.0):
+        r = np.abs(x - label)
+        return np.where(r <= delta, 0.5 * r * r,
+                        delta * (r - 0.5 * delta)).astype(np.float32)
+
+    def margin_cross_entropy_j(logits, label, margin1=1.0, margin2=0.5,
+                               margin3=0.0, scale=64.0):
+        label = label.astype(jnp.int32)
+        theta = jnp.arccos(jnp.clip(logits, -1 + 1e-6, 1 - 1e-6))
+        adj = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(label, logits.shape[-1],
+                                dtype=logits.dtype)
+        z = scale * jnp.where(onehot > 0, adj, logits)
+        logp = jax.nn.log_softmax(z, axis=-1)
+        return -jnp.sum(logp * onehot, axis=-1)
+
+    def margin_cross_entropy_np(logits, label, margin1=1.0, margin2=0.5,
+                                margin3=0.0, scale=64.0):
+        label = label.astype(np.int64)
+        theta = np.arccos(np.clip(logits, -1 + 1e-6, 1 - 1e-6))
+        adj = np.cos(margin1 * theta + margin2) - margin3
+        onehot = np.eye(logits.shape[-1], dtype=np.float32)[label]
+        z = scale * np.where(onehot > 0, adj, logits)
+        z = z - z.max(-1, keepdims=True)
+        logp = z - np.log(np.exp(z).sum(-1, keepdims=True))
+        return (-(logp * onehot).sum(-1)).astype(np.float32)
+
+    def accuracy_j(pred, label, k=1):
+        label = label.astype(jnp.int32)
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        hit = (topk == label[:, None]).any(axis=-1)
+        return hit.astype(jnp.float32).mean()
+
+    def accuracy_np(pred, label, k=1):
+        label = label.astype(np.int64)
+        topk = np.argsort(-pred, axis=-1)[..., :k]
+        return (topk == label[:, None]).any(-1).astype(np.float32).mean()
+
+    def auc_j(pred, label):
+        """ROC AUC via the rank formulation (functional form of the
+        reference's streaming auc op)."""
+        score = pred[:, 1] if pred.ndim == 2 else pred
+        order = jnp.argsort(score)
+        ranks = jnp.zeros_like(score).at[order].set(
+            jnp.arange(1, score.shape[0] + 1, dtype=score.dtype))
+        pos = (label > 0).astype(score.dtype)
+        npos = pos.sum()
+        nneg = pos.shape[0] - npos
+        return (ranks * pos).sum() / jnp.maximum(npos * nneg, 1.0) \
+            - (npos + 1) / (2.0 * jnp.maximum(nneg, 1.0))
+
+    def auc_np(pred, label):
+        from scipy.stats import rankdata
+        score = pred[:, 1] if pred.ndim == 2 else pred
+        ranks = rankdata(score, method="ordinal")
+        pos = (label > 0).astype(np.float64)
+        npos, nneg = pos.sum(), len(pos) - pos.sum()
+        return np.float32((ranks * pos).sum() / max(npos * nneg, 1.0)
+                          - (npos + 1) / (2.0 * max(nneg, 1.0)))
+
+    # -- norm / numeric --------------------------------------------------
+    def clip_by_norm_j(x, max_norm):
+        nrm = jnp.sqrt(jnp.sum(x * x))
+        return x * (max_norm / jnp.maximum(nrm, max_norm))
+
+    def clip_by_norm_np(x, max_norm):
+        nrm = np.sqrt((x * x).sum())
+        return (x * (max_norm / max(nrm, max_norm))).astype(np.float32)
+
+    def l1_norm(x):
+        return jnp.abs(x).sum()
+
+    def fill_diagonal_j(x, value=0.0, offset=0, wrap=False):
+        n, m = x.shape
+        i = jnp.arange(n)[:, None]
+        j = jnp.arange(m)[None, :]
+        return jnp.where(j - i == offset, jnp.asarray(value, x.dtype), x)
+
+    def fill_diagonal_np(x, value=0.0, offset=0, wrap=False):
+        out = x.copy()
+        i = np.arange(out.shape[0])[:, None]
+        j = np.arange(out.shape[1])[None, :]
+        out[(j - i) == offset] = value
+        return out
+
+    def fill_diagonal_tensor_j(x, y, offset=0, dim1=0, dim2=1):
+        n, m = x.shape
+        k = min(n, m - offset) if offset >= 0 else min(n + offset, m)
+        rows = jnp.arange(k) + (0 if offset >= 0 else -offset)
+        cols = jnp.arange(k) + max(offset, 0)
+        return x.at[rows, cols].set(y[:k])
+
+    def fill_diagonal_tensor_np(x, y, offset=0, dim1=0, dim2=1):
+        out = x.copy()
+        n, m = x.shape
+        k = min(n, m - offset) if offset >= 0 else min(n + offset, m)
+        rows = np.arange(k) + (0 if offset >= 0 else -offset)
+        cols = np.arange(k) + max(offset, 0)
+        out[rows, cols] = y[:k]
+        return out
+
+    def spectral_norm_j(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+        w = weight if dim == 0 else jnp.moveaxis(weight, dim, 0)
+        mat = w.reshape(w.shape[0], -1)
+        for _ in range(power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ mat @ v
+        out = mat / sigma
+        return out.reshape(w.shape) if dim == 0 else \
+            jnp.moveaxis(out.reshape(w.shape), 0, dim)
+
+    def spectral_norm_np(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+        w = weight if dim == 0 else np.moveaxis(weight, dim, 0)
+        mat = w.reshape(w.shape[0], -1)
+        for _ in range(power_iters):
+            v = mat.T @ u
+            v = v / (np.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (np.linalg.norm(u) + eps)
+        sigma = u @ mat @ v
+        out = (mat / sigma).reshape(w.shape)
+        return (out if dim == 0 else
+                np.moveaxis(out, 0, dim)).astype(np.float32)
+
+    # -- positions / encodings ------------------------------------------
+    def add_position_encoding_j(x, alpha=1.0, beta=1.0):
+        n, s, e = x.shape
+        half = e // 2
+        pos = jnp.arange(s, dtype=jnp.float32)[:, None]
+        div = jnp.power(10000.0,
+                        jnp.arange(half, dtype=jnp.float32) / half)
+        pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)],
+                             axis=-1)
+        return alpha * x + beta * pe[None]
+
+    def add_position_encoding_np(x, alpha=1.0, beta=1.0):
+        n, s, e = x.shape
+        half = e // 2
+        pos = np.arange(s, dtype=np.float32)[:, None]
+        div = np.power(10000.0,
+                       np.arange(half, dtype=np.float32) / half)
+        pe = np.concatenate([np.sin(pos / div), np.cos(pos / div)], -1)
+        return (alpha * x + beta * pe[None]).astype(np.float32)
+
+    # -- structured ------------------------------------------------------
+    def gather_tree_j(ids, parents):
+        ids = ids.astype(jnp.int32)
+        parents = parents.astype(jnp.int32)
+        t = ids.shape[0]
+
+        def body(carry, inp):
+            beams, = carry
+            step_ids, step_parents = inp
+            sel = jnp.take_along_axis(step_ids, beams, axis=-1)
+            par = jnp.take_along_axis(step_parents, beams, axis=-1)
+            return (par,), sel
+
+        init = jnp.tile(jnp.arange(ids.shape[2],
+                                   dtype=ids.dtype)[None, :],
+                        (ids.shape[1], 1))
+        (_,), out = jax.lax.scan(body, (init,),
+                                 (ids[::-1], parents[::-1]))
+        return out[::-1]
+
+    def gather_tree_np(ids, parents):
+        ids = ids.astype(np.int64)
+        parents = parents.astype(np.int64)
+        t, b, w = ids.shape
+        out = np.zeros_like(ids)
+        beams = np.tile(np.arange(w)[None, :], (b, 1))
+        for step in range(t - 1, -1, -1):
+            out[step] = np.take_along_axis(ids[step], beams, axis=-1)
+            beams = np.take_along_axis(parents[step], beams, axis=-1)
+        return out
+
+    def segment_pool_j(x, segment_ids, pool_type="MEAN",
+                       num_segments=None):
+        segment_ids = segment_ids.astype(jnp.int32)
+        # num_segments must be static under jit; eager callers can omit
+        num = int(num_segments) if num_segments is not None \
+            else int(segment_ids.max()) + 1
+        if pool_type == "MEAN":
+            s = jax.ops.segment_sum(x, segment_ids, num)
+            c = jax.ops.segment_sum(jnp.ones_like(x[:, :1]),
+                                    segment_ids, num)
+            return s / jnp.maximum(c, 1.0)
+        op = {"SUM": jax.ops.segment_sum,
+              "MAX": jax.ops.segment_max,
+              "MIN": jax.ops.segment_min}[pool_type]
+        return op(x, segment_ids, num)
+
+    def segment_pool_np(x, segment_ids, pool_type="MEAN",
+                        num_segments=None):
+        segment_ids = segment_ids.astype(np.int64)
+        num = int(num_segments) if num_segments is not None \
+            else int(segment_ids.max()) + 1
+        out = np.zeros((num,) + x.shape[1:], np.float32)
+        for seg in range(num):
+            rows = x[segment_ids == seg]
+            if len(rows) == 0:
+                continue
+            out[seg] = {"SUM": rows.sum(0), "MEAN": rows.mean(0),
+                        "MAX": rows.max(0), "MIN": rows.min(0)}[pool_type]
+        return out
+
+    def pad3d_j(x, paddings, mode="constant", value=0.0,
+                data_format="NCDHW"):
+        l, r, t, b, f, bk = paddings
+        pads = [(0, 0), (0, 0), (f, bk), (t, b), (l, r)]
+        if mode == "constant":
+            return jnp.pad(x, pads, constant_values=value)
+        return jnp.pad(x, pads,
+                       mode={"reflect": "reflect",
+                             "replicate": "edge",
+                             "circular": "wrap"}[mode])
+
+    def pad3d_np(x, paddings, mode="constant", value=0.0,
+                 data_format="NCDHW"):
+        l, r, t, b, f, bk = paddings
+        pads = [(0, 0), (0, 0), (f, bk), (t, b), (l, r)]
+        if mode == "constant":
+            return np.pad(x, pads, constant_values=value).astype(
+                np.float32)
+        return np.pad(x, pads,
+                      mode={"reflect": "reflect", "replicate": "edge",
+                            "circular": "wrap"}[mode]).astype(np.float32)
+
+    def top_p_sampling_j(probs, ps=0.9):
+        """Nucleus filter + sample.  Deterministic contract for the
+        generated test: with ps below the top prob it reduces to
+        argmax (the sampling path uses jax.random in decode)."""
+        sort_idx = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        keep = cum - sorted_p <= ps
+        filt = jnp.where(keep, sorted_p, 0.0)
+        filt = filt / filt.sum(-1, keepdims=True)
+        pick = jnp.argmax(filt, axis=-1)
+        ids = jnp.take_along_axis(sort_idx, pick[:, None], axis=-1)
+        val = jnp.take_along_axis(probs, ids, axis=-1)
+        return val, ids.astype(jnp.int64)
+
+    def top_p_sampling_np(probs, ps=0.9):
+        sort_idx = np.argsort(-probs, axis=-1)
+        sorted_p = np.take_along_axis(probs, sort_idx, axis=-1)
+        cum = np.cumsum(sorted_p, axis=-1)
+        keep = (cum - sorted_p) <= ps
+        filt = np.where(keep, sorted_p, 0.0)
+        filt = filt / filt.sum(-1, keepdims=True)
+        pick = np.argmax(filt, axis=-1)
+        ids = np.take_along_axis(sort_idx, pick[:, None], axis=-1)
+        val = np.take_along_axis(probs, ids, axis=-1)
+        return val.astype(np.float32), ids.astype(np.int64)
+
+    def assign_pos_j(x, cum_count):
+        """MoE dispatch helper (reference assign_pos op): token i with
+        expert x[i] gets slot --cum_count[x[i]]; builds the
+        expert-grouped position array."""
+        x = x.astype(jnp.int32)
+        n = x.shape[0]
+
+        def body(carry, i):
+            cc, pos = carry
+            e = x[i]
+            cc = cc.at[e].add(-1)
+            pos = pos.at[cc[e]].set(i)
+            return (cc, pos), ()
+
+        init = (cum_count.astype(jnp.int32),
+                jnp.zeros((n,), jnp.int32))
+        (cc, pos), _ = jax.lax.scan(body, init,
+                                    jnp.arange(n - 1, -1, -1))
+        return pos
+
+    def assign_pos_np(x, cum_count):
+        x = x.astype(np.int64)
+        cc = cum_count.astype(np.int64).copy()
+        pos = np.zeros((x.shape[0],), np.int64)
+        for i in range(x.shape[0] - 1, -1, -1):
+            e = x[i]
+            cc[e] -= 1
+            pos[cc[e]] = i
+        return pos
+
+    # -- quantization ----------------------------------------------------
+    def _qmax(bits):
+        return float(2 ** (bits - 1) - 1)
+
+    def fake_quantize_abs_max_j(x, bit_length=8):
+        scale = jnp.max(jnp.abs(x))
+        q = jnp.round(x / jnp.maximum(scale, 1e-12) * _qmax(bit_length))
+        return q, scale.reshape(1)
+
+    def fake_quantize_abs_max_np(x, bit_length=8):
+        scale = np.abs(x).max()
+        q = np.round(x / max(scale, 1e-12) * _qmax(bit_length))
+        return q.astype(np.float32), np.float32([scale])
+
+    def fake_quantize_dequantize_abs_max_j(x, bit_length=8):
+        scale = jnp.max(jnp.abs(x))
+        qmax = _qmax(bit_length)
+        q = jnp.round(x / jnp.maximum(scale, 1e-12) * qmax)
+        return q * scale / qmax, scale.reshape(1)
+
+    def fake_quantize_dequantize_abs_max_np(x, bit_length=8):
+        scale = np.abs(x).max()
+        qmax = _qmax(bit_length)
+        q = np.round(x / max(scale, 1e-12) * qmax)
+        return (q * scale / qmax).astype(np.float32), np.float32([scale])
+
+    def fake_channel_wise_quantize_abs_max_j(x, bit_length=8,
+                                             quant_axis=0):
+        red = tuple(i for i in range(x.ndim) if i != quant_axis)
+        scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+        q = jnp.round(x / jnp.maximum(scale, 1e-12) * _qmax(bit_length))
+        return q, scale.reshape(-1)
+
+    def fake_channel_wise_quantize_abs_max_np(x, bit_length=8,
+                                              quant_axis=0):
+        red = tuple(i for i in range(x.ndim) if i != quant_axis)
+        scale = np.abs(x).max(axis=red, keepdims=True)
+        q = np.round(x / np.maximum(scale, 1e-12) * _qmax(bit_length))
+        return q.astype(np.float32), scale.reshape(-1).astype(np.float32)
+
+    def fake_channel_wise_quantize_dequantize_abs_max_j(
+            x, bit_length=8, quant_axis=0):
+        red = tuple(i for i in range(x.ndim) if i != quant_axis)
+        scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+        qmax = _qmax(bit_length)
+        q = jnp.round(x / jnp.maximum(scale, 1e-12) * qmax)
+        return q * scale / qmax, scale.reshape(-1)
+
+    def fake_channel_wise_quantize_dequantize_abs_max_np(
+            x, bit_length=8, quant_axis=0):
+        red = tuple(i for i in range(x.ndim) if i != quant_axis)
+        scale = np.abs(x).max(axis=red, keepdims=True)
+        qmax = _qmax(bit_length)
+        q = np.round(x / np.maximum(scale, 1e-12) * qmax)
+        return ((q * scale / qmax).astype(np.float32),
+                scale.reshape(-1).astype(np.float32))
+
+    def fake_dequantize_max_abs_j(x, scale, max_range=127.0):
+        return x * scale / max_range
+
+    def fake_quantize_moving_average_abs_max_j(x, in_scale, bit_length=8,
+                                               moving_rate=0.9):
+        cur = jnp.max(jnp.abs(x))
+        scale = moving_rate * in_scale.reshape(()) + (1 - moving_rate) * cur
+        q = jnp.round(x / jnp.maximum(scale, 1e-12) * _qmax(bit_length))
+        return q, scale.reshape(1)
+
+    def fake_quantize_moving_average_abs_max_np(x, in_scale,
+                                                bit_length=8,
+                                                moving_rate=0.9):
+        cur = np.abs(x).max()
+        scale = moving_rate * float(in_scale.reshape(())) \
+            + (1 - moving_rate) * cur
+        q = np.round(x / max(scale, 1e-12) * _qmax(bit_length))
+        return q.astype(np.float32), np.float32([scale])
+
+    def weight_quantize_j(w, algo="weight_only_int8"):
+        scale = jnp.max(jnp.abs(w), axis=0) / 127.0
+        q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-12)),
+                     -127, 127)
+        return q.astype(jnp.int8), scale
+
+    def weight_quantize_np(w, algo="weight_only_int8"):
+        scale = np.abs(w).max(axis=0) / 127.0
+        q = np.clip(np.round(w / np.maximum(scale, 1e-12)), -127, 127)
+        return q.astype(np.int8), scale.astype(np.float32)
+
+    def weight_dequantize_j(qw, scale, algo="weight_only_int8"):
+        return qw.astype(scale.dtype) * scale
+
+    def weight_only_linear_j(x, qw, scale, algo="weight_only_int8"):
+        return x @ (qw.astype(x.dtype) * scale.astype(x.dtype))
+
+    def weight_only_linear_np(x, qw, scale, algo="weight_only_int8"):
+        return (x @ (qw.astype(np.float32) * scale)).astype(np.float32)
+
+    def llm_int8_linear_j(x, qw, scale, threshold=6.0):
+        """bitsandbytes-style outlier decomposition: columns of x with
+        any |value| > threshold run at full precision, the rest through
+        the int8 weight."""
+        outlier = (jnp.abs(x) > threshold).any(axis=tuple(
+            range(x.ndim - 1)))
+        w = qw.astype(x.dtype) * scale.astype(x.dtype)
+        x_reg = jnp.where(outlier[None, :], 0.0, x)
+        x_out = jnp.where(outlier[None, :], x, 0.0)
+        return x_reg @ w + x_out @ w
+
+    def llm_int8_linear_np(x, qw, scale, threshold=6.0):
+        w = qw.astype(np.float32) * scale
+        return (x @ w).astype(np.float32)
+
+    def send_uv_j(x, y, src_index, dst_index, message_op="ADD"):
+        """Graph per-edge message (reference geometric send_uv):
+        out[e] = x[src[e]] (op) y[dst[e]]."""
+        src_index = src_index.astype(jnp.int32)
+        dst_index = dst_index.astype(jnp.int32)
+        a = x[src_index]
+        b = y[dst_index]
+        return {"ADD": a + b, "SUB": a - b,
+                "MUL": a * b, "DIV": a / b}[message_op.upper()]
+
+    def send_uv_np(x, y, src_index, dst_index, message_op="ADD"):
+        a = x[src_index.astype(np.int64)]
+        b = y[dst_index.astype(np.int64)]
+        return {"ADD": a + b, "SUB": a - b, "MUL": a * b,
+                "DIV": a / b}[message_op.upper()].astype(np.float32)
+
+    def lu_unpack_j(lu, pivots, unpack_ludata=True, unpack_pivots=True):
+        pivots = pivots.astype(jnp.int32)
+        n = lu.shape[0]
+        low = jnp.tril(lu, -1) + jnp.eye(n, dtype=lu.dtype)
+        up = jnp.triu(lu)
+        perm = jnp.arange(n)
+        for i in range(pivots.shape[0]):          # static small loop
+            j = pivots[i] - 1
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        p = jnp.eye(n, dtype=lu.dtype)[perm].T
+        return p, low, up
+
+    def lu_unpack_np(lu, pivots, unpack_ludata=True, unpack_pivots=True):
+        pivots = pivots.astype(np.int64)
+        n = lu.shape[0]
+        low = np.tril(lu, -1) + np.eye(n, dtype=np.float32)
+        up = np.triu(lu)
+        perm = np.arange(n)
+        for i in range(len(pivots)):
+            j = pivots[i] - 1
+            perm[i], perm[j] = perm[j], perm[i]
+        p = np.eye(n, dtype=np.float32)[perm].T
+        return p.astype(np.float32), low.astype(np.float32), \
+            up.astype(np.float32)
+
+    R = "paddle/phi/ops/yaml/ops.yaml"
+
+    def S(name, fn, ref, samples, **kw):
+        return OpSpec(name, fn, ref, samples, ref=f"{R}: op {name}", **kw)
+
+    return [
+        # vision
+        S("affine_channel", affine_channel, affine_channel,
+          lambda: ([_n(2, 3, 4, 4), _u(0.5, 1.5, 3), _n(3)], {}),
+          n_tensors=3, grad_atol=5e-2),
+        S("affine_grid", affine_grid_j, affine_grid_np,
+          lambda: ([_n(2, 2, 3)], {"out_h": 4, "out_w": 5})),
+        S("grid_sample", grid_sample_j, grid_sample_np,
+          lambda: ([_n(2, 3, 5, 5), _u(-0.9, 0.9, 2, 4, 4, 2)], {}),
+          n_tensors=2, grad_atol=2e-2),
+        S("shuffle_channel", shuffle_channel, shuffle_channel,
+          lambda: ([_n(2, 6, 3, 3)], {"group": 3})),
+        S("temporal_shift", temporal_shift_j, temporal_shift_np,
+          lambda: ([_n(6, 8, 3, 3)], {"seg_num": 3}), grad_atol=5e-2),
+        # pooling
+        S("max_pool2d_with_index", max_pool2d_with_index_j,
+          max_pool2d_with_index_np,
+          lambda: ([_n(2, 3, 6, 6)], {"kernel_size": 2})),
+        S("unpool", unpool_j, unpool_np,
+          lambda: (
+              [_n(1, 2, 2, 2),
+               np.array([[[[0, 3], [9, 14]], [[1, 5], [10, 15]]]],
+                        np.int32)],
+              {"output_size": (4, 4)}), n_tensors=2),
+        S("lp_pool2d", lp_pool2d_j, lp_pool2d_np,
+          lambda: ([_u(0.2, 2.0, 2, 3, 6, 6)],
+                   {"norm_type": 2.0, "kernel_size": 2}),
+          grad_atol=2e-2),
+        S("fractional_max_pool2d", fractional_max_pool2d_j,
+          fractional_max_pool2d_np,
+          lambda: ([_n(2, 3, 7, 7)],
+                   {"output_size": (3, 3), "random_u": 0.4})),
+        # signal
+        S("frame", frame_j, frame_np,
+          lambda: ([_n(2, 32)], {"frame_length": 8, "hop_length": 4}),
+          method=True),
+        S("overlap_add", overlap_add_j, overlap_add_np,
+          lambda: ([_n(2, 8, 7)], {"hop_length": 4})),
+        S("stft", stft_j, stft_np,
+          lambda: ([_n(2, 64)], {"n_fft": 16, "hop_length": 8}),
+          grad=False),
+        # losses / metrics
+        S("hinge_loss", hinge_loss, hinge_loss,
+          lambda: ([_n(8, 1), np.sign(_n(8, 1)).astype(np.float32)],
+                   {}), n_tensors=2, grad=False),
+        S("huber_loss", huber_loss_j, huber_loss_np,
+          lambda: ([_n(8, 3), _n(8, 3)], {"delta": 1.0}),
+          n_tensors=2, grad_atol=2e-2),
+        S("margin_cross_entropy", margin_cross_entropy_j,
+          margin_cross_entropy_np,
+          lambda: ([_u(-0.9, 0.9, 4, 10),
+                    _ints(0, 10, 4, seed_key="mce")], {}),
+          n_tensors=2, grad=False),
+        S("accuracy", accuracy_j, accuracy_np,
+          lambda: ([_n(16, 5), _ints(0, 5, 16, seed_key="acc")],
+                   {"k": 2}), n_tensors=2, grad=False),
+        S("auc", auc_j, auc_np,
+          lambda: ([_u(0.01, 0.99, 32),
+                    _ints(0, 2, 32, seed_key="auc")], {}),
+          n_tensors=2, grad=False),
+        # norms / numeric
+        S("clip_by_norm", clip_by_norm_j, clip_by_norm_np,
+          lambda: ([_n(4, 5)], {"max_norm": 1.0})),
+        S("l1_norm", l1_norm, lambda x: np.abs(x).sum(),
+          lambda: ([_n(4, 5)], {}), grad=False),
+        S("fill_diagonal", fill_diagonal_j, fill_diagonal_np,
+          lambda: ([_n(4, 5)], {"value": 7.0}), method=True),
+        S("fill_diagonal_tensor", fill_diagonal_tensor_j,
+          fill_diagonal_tensor_np,
+          lambda: ([_n(4, 5), _n(4)], {}), n_tensors=2, method=True),
+        S("spectral_norm", spectral_norm_j, spectral_norm_np,
+          lambda: ([_n(4, 6), _n(4), _n(6)], {"power_iters": 2}),
+          n_tensors=3, grad=False),
+        # encodings / structured
+        S("add_position_encoding", add_position_encoding_j,
+          add_position_encoding_np,
+          lambda: ([_n(2, 6, 8)], {"alpha": 1.0, "beta": 0.5})),
+        S("gather_tree", gather_tree_j, gather_tree_np,
+          lambda: ([_ints(0, 9, 4, 2, 3, seed_key="gt_ids"),
+                    _ints(0, 3, 4, 2, 3, seed_key="gt_par")], {}),
+          n_tensors=2, grad=False),
+        S("segment_pool", segment_pool_j, segment_pool_np,
+          lambda: ([_n(8, 4),
+                    np.sort(_ints(0, 3, 8, seed_key="seg"))],
+                   {"pool_type": "MEAN"}), n_tensors=2, grad=False),
+        S("pad3d", pad3d_j, pad3d_np,
+          lambda: ([_n(2, 2, 3, 4, 5)],
+                   {"paddings": (1, 1, 0, 1, 1, 0)}), grad_atol=5e-2),
+        S("top_p_sampling", top_p_sampling_j, top_p_sampling_np,
+          lambda: ([(lambda p: p / p.sum(-1, keepdims=True))(
+              _u(0.01, 1.0, 4, 16))], {"ps": 0.2}), grad=False),
+        S("assign_pos", assign_pos_j, assign_pos_np,
+          lambda: ([_ints(0, 4, 10, seed_key="ap"),
+                    np.cumsum(np.bincount(
+                        _ints(0, 4, 10, seed_key="ap"),
+                        minlength=4)).astype(np.int64)], {}),
+          n_tensors=2, grad=False),
+        S("send_uv", send_uv_j, send_uv_np,
+          lambda: ([_n(5, 4), _n(5, 4),
+                    _ints(0, 5, 7, seed_key="suv_s"),
+                    _ints(0, 5, 7, seed_key="suv_d")],
+                   {"message_op": "MUL"}), n_tensors=4, grad=False),
+        S("lu_unpack", lu_unpack_j, lu_unpack_np,
+          lambda: ([_n(4, 4),
+                    np.array([2, 3, 3, 4], np.int32)], {}),
+          n_tensors=2, grad=False),
+        # quantization family
+        S("fake_quantize_abs_max", fake_quantize_abs_max_j,
+          fake_quantize_abs_max_np, lambda: ([_n(4, 6)], {}),
+          grad=False),
+        S("fake_quantize_dequantize_abs_max",
+          fake_quantize_dequantize_abs_max_j,
+          fake_quantize_dequantize_abs_max_np,
+          lambda: ([_n(4, 6)], {}), grad=False),
+        S("fake_channel_wise_quantize_abs_max",
+          fake_channel_wise_quantize_abs_max_j,
+          fake_channel_wise_quantize_abs_max_np,
+          lambda: ([_n(4, 6)], {}), grad=False),
+        S("fake_channel_wise_quantize_dequantize_abs_max",
+          fake_channel_wise_quantize_dequantize_abs_max_j,
+          fake_channel_wise_quantize_dequantize_abs_max_np,
+          lambda: ([_n(4, 6)], {}), grad=False),
+        S("fake_dequantize_max_abs", fake_dequantize_max_abs_j,
+          fake_dequantize_max_abs_j,
+          lambda: ([_n(4, 6), np.float32([0.5])], {}),
+          n_tensors=2, grad=False),
+        S("fake_channel_wise_dequantize_max_abs",
+          lambda x, scale, quant_axis=0:
+              x * scale.reshape([-1 if i == quant_axis else 1
+                                 for i in range(x.ndim)]) / 127.0,
+          lambda x, scale, quant_axis=0:
+              (x * scale.reshape([-1 if i == quant_axis else 1
+                                  for i in range(x.ndim)])
+               / 127.0).astype(np.float32),
+          lambda: ([_n(4, 6), _u(0.1, 1.0, 4)], {}),
+          n_tensors=2, grad=False),
+        S("fake_quantize_moving_average_abs_max",
+          fake_quantize_moving_average_abs_max_j,
+          fake_quantize_moving_average_abs_max_np,
+          lambda: ([_n(4, 6), np.float32([0.8])], {}),
+          n_tensors=2, grad=False),
+        S("weight_quantize", weight_quantize_j, weight_quantize_np,
+          lambda: ([_n(8, 4)], {}), grad=False),
+        S("weight_dequantize", weight_dequantize_j, weight_dequantize_j,
+          lambda: ([_ints(-127, 127, 8, 4,
+                          seed_key="wq").astype(np.float32),
+                    _u(0.001, 0.02, 4)], {}),
+          n_tensors=2, grad=False),
+        S("weight_only_linear", weight_only_linear_j,
+          weight_only_linear_np,
+          lambda: ([_n(3, 8),
+                    _ints(-127, 127, 8, 4,
+                          seed_key="wol").astype(np.float32),
+                    _u(0.001, 0.02, 4)], {}),
+          n_tensors=3, grad=False),
+        S("llm_int8_linear", llm_int8_linear_j, llm_int8_linear_np,
+          lambda: ([_n(3, 8),
+                    _ints(-127, 127, 8, 4,
+                          seed_key="l8").astype(np.float32),
+                    _u(0.001, 0.02, 4)], {"threshold": 100.0}),
+          n_tensors=3, grad=False),
+    ]

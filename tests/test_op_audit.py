@@ -1,0 +1,24 @@
+"""Op-coverage audit regression (VERDICT r3 item 4): the checked-in
+audit must keep coverage over the bar and leave no uncategorized miss."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+
+
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/paddle/phi/ops/yaml/ops.yaml"),
+    reason="reference checkout not present")
+def test_ops_yaml_coverage():
+    from op_audit import audit
+    rows = audit()
+    by = {}
+    for op, cat in rows:
+        by.setdefault(cat, []).append(op)
+    total = len(rows)
+    covered = len(by.get("covered", []))
+    assert covered / total >= 0.70, f"{covered}/{total}"
+    assert not by.get("todo"), by.get("todo")
