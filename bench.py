@@ -336,6 +336,61 @@ def bench_unet():
     print(json.dumps(result))
 
 
+def bench_llama_decode():
+    """Serving decode: KV-cached generate() on the 1B llama — whole
+    generation is one jitted lax.scan program (inference/generation.py).
+    Reports decode tokens/s/chip."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM, LlamaConfig
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=8192, hidden_size=2560,
+                          intermediate_size=6912, num_hidden_layers=14,
+                          num_attention_heads=20, num_key_value_heads=4,
+                          max_position_embeddings=2048, dtype="bfloat16",
+                          param_dtype="float32")
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        prompt_len, new_tokens = 128, 512
+    else:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=128,
+                          intermediate_size=384, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=4,
+                          max_position_embeddings=256, dtype="float32")
+        batch, prompt_len, new_tokens = 2, 8, 16
+
+    model = LlamaForCausalLM(cfg)
+    n_params = sum(int(np.prod(p.value.shape))
+                   for p in model.parameters())
+    rng = np.random.RandomState(0)
+    prompt = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size,
+                    (batch, prompt_len)).astype(np.int32))
+
+    out = model.generate(prompt, max_new_tokens=new_tokens)  # compile
+    _ = np.asarray(out.value)
+    t0 = time.perf_counter()
+    out = model.generate(prompt, max_new_tokens=new_tokens)
+    _ = np.asarray(out.value)
+    dt = time.perf_counter() - t0
+    tok_s = batch * new_tokens / dt
+    # decode roofline: every token reads all params once (bf16 compute
+    # stream) → tokens/s ≈ batch · HBM_BW / (2·N) when batched decode
+    # is bandwidth-bound
+    roofline = batch * 0.82e12 / (2.0 * n_params)
+    result = {
+        "metric": "llama_decode_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": f"tokens/s/chip (b={batch}, new={new_tokens}, "
+                f"params={n_params/1e6:.0f}M, "
+                f"hbm_roofline={roofline:.0f} tok/s)",
+        "vs_baseline": round(tok_s / max(roofline, 1e-9), 3),
+    }
+    print(json.dumps(result))
+
+
 def main():
     which = os.environ.get("BENCH_CONFIG", "llama").lower()
     if which in ("resnet", "resnet50", "cifar"):
@@ -344,6 +399,8 @@ def main():
         return bench_bert()
     if which in ("unet", "sd", "diffusion"):
         return bench_unet()
+    if which in ("decode", "llama_decode", "generate"):
+        return bench_llama_decode()
     return bench_llama()
 
 

@@ -139,11 +139,37 @@ class Predictor:
     """Reference: AnalysisPredictor — loads the artifact, owns
     input/output handles, `run()` executes the compiled function."""
 
+    @classmethod
+    def from_model(cls, model) -> "Predictor":
+        """Serve a live Layer (no artifact round-trip).  Decode-capable
+        models (forward_cached/init_cache — e.g. LlamaForCausalLM) gain
+        `generate()` with the KV-cached path."""
+        self = cls.__new__(cls)
+        self._config = None
+        self._layer = None
+        self._model = model
+        self._inputs = {}
+        self._outputs = {}
+        return self
+
+    def generate(self, input_ids, max_new_tokens=32, **kw):
+        """KV-cached autoregressive decode (inference.generation).
+        Requires a Predictor built with from_model() on a model with a
+        cached decode path."""
+        model = getattr(self, "_model", None)
+        if model is None or not hasattr(model, "forward_cached"):
+            raise NotImplementedError(
+                "generate() needs Predictor.from_model(model) with a "
+                "decode-capable model (forward_cached/init_cache)")
+        from .generation import generate as _gen
+        return _gen(model, input_ids, max_new_tokens, **kw)
+
     def __init__(self, config: Config, _shared_layer=None):
         from ..jit import load as jit_load
         if config._path is None:
             raise ValueError("Config needs the model path")
         self._config = config
+        self._model = None
         self._layer = _shared_layer if _shared_layer is not None \
             else jit_load(config._path)
         if self._layer._exported is None:
@@ -157,6 +183,13 @@ class Predictor:
         self._inputs: Dict[str, Tensor] = {n: Tensor(n) for n in names}
         self._outputs: Dict[str, Tensor] = {}
 
+    def _require_artifact(self, what):
+        if self._layer is None:
+            raise NotImplementedError(
+                f"{what} needs an artifact-backed Predictor "
+                "(create_predictor(Config(path))); this one wraps a "
+                "live model via from_model() — use generate()")
+
     def get_input_names(self) -> List[str]:
         return list(self._inputs)
 
@@ -166,6 +199,7 @@ class Predictor:
     def run(self, inputs: Optional[list] = None):
         """Execute.  Either feed handles first (reference protocol) or
         pass arrays directly (paddle_infer.Predictor.run(list) style)."""
+        self._require_artifact("run()")
         if inputs is not None:
             for h, a in zip(self._inputs.values(), inputs):
                 h.copy_from_cpu(np.asarray(a))
@@ -208,6 +242,8 @@ class Predictor:
         """Reference: AnalysisPredictor::Clone — a new predictor with
         its own IO handles SHARING the loaded weights/executable (no
         re-load, no extra HBM)."""
+        if self._layer is None:
+            return Predictor.from_model(self._model)
         return Predictor(self._config, _shared_layer=self._layer)
 
 

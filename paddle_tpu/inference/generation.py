@@ -1,0 +1,133 @@
+"""KV-cached autoregressive generation — the serving decode path.
+
+Reference: `python/paddle/incubate/nn/functional/
+block_multihead_attention.py` (paged-KV decode attention) and
+paddlenlp's GenerationMixin.generate.
+
+TPU-native design: the ENTIRE generation — prefill over the prompt plus
+a `lax.scan` over max_new_tokens decode steps — is ONE jitted program.
+On a tunneled/remote accelerator a per-token host loop would pay
+~10 ms dispatch per token (the measured relay latency that motivated
+TrainStep.run_steps); the scanned program pays it once.  The KV cache
+is a static-shape fixed-size buffer per layer sized to
+prompt+max_new_tokens (XLA requires static shapes; "paged" blocks buy
+nothing on TPU where the compiler owns layout), and
+decode attention is one batched masked GEMV (ops.cached_attention — a
+Pallas q_len==1 kernel would be grid-overhead-bound, see
+ops/pallas/flash_attention.py packed-path notes).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework import random as prandom
+
+__all__ = ["generate"]
+
+
+def _sample(logits, key, temperature, top_p, top_k):
+    """Next-token sampling on [b, V] fp32 logits."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:                       # greedy
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p is not None:
+        sort_idx = jnp.argsort(-logits, axis=-1)
+        sorted_l = jnp.take_along_axis(logits, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs <= top_p               # always keeps top-1
+        sorted_l = jnp.where(keep, sorted_l, -1e30)
+        inv = jnp.argsort(sort_idx, axis=-1)
+        logits = jnp.take_along_axis(sorted_l, inv, axis=-1)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def _compiled_gen(model, b, s_prompt, max_new, temperature, top_p,
+                  top_k, eos_token_id, max_len):
+    """Compiled-generation cache lives ON the model object, so its
+    lifetime (and the closed-over weights) ends with the model —
+    a global registry would pin every served model's HBM forever."""
+    cache_key = (b, s_prompt, max_new, temperature, top_p, top_k,
+                 eos_token_id, max_len)
+    store = model.__dict__.setdefault("_gen_compiled", {})
+    if cache_key in store:
+        return store[cache_key]
+    from ..jit import _swapped_state
+    sd = model.state_dict()
+    names = list(sd.keys())
+
+    def gen(param_vals, ids, key):
+        with _swapped_state(model, names, list(param_vals)):
+            cache = model.init_cache(b, max_len)
+            logits, cache = model.forward_cached(
+                ids, cache, jnp.asarray(0, jnp.int32))
+            key, sub = jax.random.split(key)
+            first = _sample(logits[:, -1], sub, temperature, top_p,
+                            top_k)
+            done0 = jnp.zeros((b,), bool) if eos_token_id is None \
+                else (first == eos_token_id)
+
+            def body(carry, _):
+                cache, tok, pos, key, done = carry
+                lg, cache = model.forward_cached(tok[:, None], cache,
+                                                 pos)
+                key, sub = jax.random.split(key)
+                nxt = _sample(lg[:, 0], sub, temperature, top_p, top_k)
+                if eos_token_id is not None:
+                    nxt = jnp.where(done, eos_token_id, nxt)
+                    done = done | (nxt == eos_token_id)
+                return (cache, nxt, pos + 1, key, done), nxt
+
+            init = (cache, first, jnp.asarray(s_prompt, jnp.int32),
+                    key, done0)
+            _, rest = jax.lax.scan(body, init, None,
+                                   length=max_new - 1)
+        return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+    fn = jax.jit(gen)
+    if len(store) >= 16:
+        store.pop(next(iter(store)))
+    store[cache_key] = fn
+    return fn
+
+
+def generate(model, input_ids, max_new_tokens: int = 32,
+             temperature: float = 0.0, top_p: Optional[float] = None,
+             top_k: Optional[int] = None,
+             eos_token_id: Optional[int] = None,
+             max_length: Optional[int] = None, seed: Optional[int] = None
+             ) -> Tensor:
+    """Generate [b, max_new_tokens] token ids.  temperature=0 → greedy.
+
+    The compiled program is cached per (model, shape, sampling config);
+    repeat calls with the same prompt shape reuse it."""
+    ids = input_ids.value if isinstance(input_ids, Tensor) \
+        else jnp.asarray(np.asarray(input_ids))
+    ids = ids.astype(jnp.int32)
+    b, s = int(ids.shape[0]), int(ids.shape[1])
+    max_len = int(max_length or (s + max_new_tokens))
+    if s + int(max_new_tokens) > max_len:
+        raise ValueError(
+            f"max_length={max_len} cannot hold prompt ({s}) + "
+            f"{max_new_tokens} new tokens — the cache is a fixed-size "
+            "buffer (no wraparound); raise max_length")
+    fn = _compiled_gen(model, b, s, int(max_new_tokens),
+                       float(temperature),
+                       None if top_p is None else float(top_p),
+                       None if top_k is None else int(top_k),
+                       eos_token_id, max_len)
+    sd = model.state_dict()
+    param_vals = [sd[n]._value for n in sd.keys()]
+    key = jax.random.PRNGKey(seed) if seed is not None \
+        else prandom.next_key()
+    out = fn(param_vals, ids, key)
+    return Tensor(out, stop_gradient=True)

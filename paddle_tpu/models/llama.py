@@ -167,6 +167,32 @@ class LlamaAttention(nn.Layer):
         return run(_fn, x, self.q_proj, self.k_proj, self.v_proj,
                    self.o_proj, name="attention")
 
+    def forward_cached(self, x, cos, sin, k_cache, v_cache, pos):
+        """Decode-path attention: project the s_new tokens in x, write
+        their K/V into the ring buffer at `pos`, attend against the
+        whole cache (see ops.cached_attention).  Returns (out, k_cache,
+        v_cache).  Raw jax values in and out — the generation loop is
+        one jitted program, not a taped eager path."""
+        cfg = self.config
+        cd = x.dtype
+        b, s, _ = x.shape
+        wq = self.q_proj.value.astype(cd)
+        wk = self.k_proj.value.astype(cd)
+        wv = self.v_proj.value.astype(cd)
+        wo = self.o_proj.value.astype(cd)
+        q = (x @ wq).reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
+        k = (x @ wk).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+        v = (x @ wv).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+        q, k = tpu_ops.apply_rope(q, k, cos, sin)
+        pos = jnp.asarray(pos, jnp.int32)
+        z = jnp.zeros((), jnp.int32)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (z, pos, z, z))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (z, pos, z, z))
+        out = tpu_ops.cached_attention(q, k_cache, v_cache, pos)
+        return out.reshape(b, s, -1) @ wo, k_cache, v_cache
+
     # split entry points for the selective-recompute block structure
     # (forward above stays the single fused path)
     def qkv_rope(self, x, cos, sin):
@@ -291,6 +317,22 @@ class LlamaDecoderLayer(nn.Layer):
         x = x + self.mlp(self.post_attention_layernorm(x))
         return run(constrain_activation, x, name="constrain_resid")
 
+    def forward_cached(self, x, cos, sin, k_cache, v_cache, pos):
+        """Raw-jax decode block (see LlamaAttention.forward_cached)."""
+        cfg = self.config
+        ln1 = self.input_layernorm.weight.value
+        ln2 = self.post_attention_layernorm.weight.value
+        h = tpu_ops.rms_norm(x, ln1.astype(x.dtype), cfg.rms_norm_eps)
+        attn, k_cache, v_cache = self.self_attn.forward_cached(
+            h, cos, sin, k_cache, v_cache, pos)
+        x = x + attn
+        h = tpu_ops.rms_norm(x, ln2.astype(x.dtype), cfg.rms_norm_eps)
+        wg = self.mlp.gate_proj.value.astype(x.dtype)
+        wu = self.mlp.up_proj.value.astype(x.dtype)
+        wd = self.mlp.down_proj.value.astype(x.dtype)
+        x = x + tpu_ops.swiglu(h @ wg, h @ wu) @ wd
+        return x, k_cache, v_cache
+
 
 class LlamaModel(nn.Layer):
     def __init__(self, config: LlamaConfig):
@@ -321,6 +363,35 @@ class LlamaModel(nn.Layer):
             x = layer(x, cos, sin)
         return self.norm(x)
 
+    def init_cache(self, batch: int, max_len: int):
+        """Per-layer KV ring buffers [b, max_len, n_kv, hd] in the
+        compute dtype (static shapes — XLA requirement)."""
+        cfg = self.config
+        shape = (batch, max_len, cfg.num_key_value_heads, cfg.head_dim)
+        dt = cfg.compute_dtype
+        return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                for _ in self.layers]
+
+    def forward_cached(self, input_ids, cache, pos):
+        """input_ids: [b, s_new] jax array; cache: init_cache pytree;
+        pos: int32 scalar.  Returns (hidden [b, s_new, h], new_cache)."""
+        cfg = self.config
+        s = input_ids.shape[1]
+        positions = pos + jnp.arange(s, dtype=jnp.int32)
+        cos, sin = tpu_ops.rope_cos_sin(s, cfg.head_dim, cfg.rope_theta,
+                                        jnp.float32,
+                                        position_ids=positions)
+        x = jnp.take(self.embed_tokens.value,
+                     input_ids.astype(jnp.int32),
+                     axis=0).astype(cfg.compute_dtype)
+        new_cache = []
+        for layer, (kc, vc) in zip(self.layers, cache):
+            x, kc, vc = layer.forward_cached(x, cos, sin, kc, vc, pos)
+            new_cache.append((kc, vc))
+        w = self.norm.weight.value
+        return tpu_ops.rms_norm(x, w.astype(x.dtype),
+                                cfg.rms_norm_eps), new_cache
+
 
 class LlamaForCausalLM(nn.Layer):
     def __init__(self, config: LlamaConfig):
@@ -342,6 +413,23 @@ class LlamaForCausalLM(nn.Layer):
                        name="lm_head")
         return run(lambda v, w: v @ w.astype(v.dtype), x, self.lm_head,
                    name="lm_head")
+
+    def init_cache(self, batch: int, max_len: int):
+        return self.llama.init_cache(batch, max_len)
+
+    def forward_cached(self, input_ids, cache, pos):
+        """Raw-jax cached step for the generation loop: returns
+        (logits [b, s_new, V], new_cache)."""
+        x, cache = self.llama.forward_cached(input_ids, cache, pos)
+        if self.config.tie_word_embeddings:
+            w = self.llama.embed_tokens.value
+            return x @ w.T.astype(x.dtype), cache
+        return x @ self.lm_head.value.astype(x.dtype), cache
+
+    def generate(self, input_ids, max_new_tokens=32, **kw):
+        """KV-cached generation (see inference.generation.generate)."""
+        from ..inference.generation import generate
+        return generate(self, input_ids, max_new_tokens, **kw)
 
     def compute_loss(self, logits, labels):
         """Next-token cross entropy in fp32 (reference:

@@ -17,7 +17,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["attention", "rms_norm", "layer_norm", "rope", "apply_rope",
+__all__ = ["attention", "cached_attention", "rms_norm", "layer_norm",
+           "rope", "apply_rope",
            "swiglu", "get_attention_backend", "set_attention_backend",
            "gqa_scores", "gqa_weighted_v"]
 
@@ -94,6 +95,33 @@ def xla_attention(q, k, v, mask=None, causal=False, scale=None,
         keep = jax.random.bernoulli(next_key(), 1.0 - dropout_p, w.shape)
         w = w * keep / (1.0 - dropout_p)
     out = gqa_weighted_v(w.astype(v.dtype), v)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def cached_attention(q, k_cache, v_cache, q_pos0, scale=None):
+    """Incremental-decode attention against a fixed-size KV ring buffer.
+
+    q: [b, s_new, h, d] (queries for the tokens being appended);
+    k_cache/v_cache: [b, S_max, h_kv, d] with positions < q_pos0 + s_new
+    valid; q_pos0: int32 scalar — global position of q's first token.
+    Query i attends cache slots j <= q_pos0 + i.
+
+    Reference: `python/paddle/incubate/nn/functional/
+    block_multihead_attention.py` (paged-KV decode).  TPU-native
+    design: a ring buffer with STATIC S_max (XLA needs static shapes)
+    and one batched masked matmul — at q_len==1 a Pallas kernel would
+    be per-instance-overhead-bound (the measured failure mode of small
+    grids on v5e; see flash_attention._fwd_1b notes), while XLA lowers
+    this to a single large batched GEMV at full HBM rate."""
+    b, sq, h, d = q.shape
+    sk = k_cache.shape[1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = gqa_scores(q, k_cache) * s
+    pos_q = q_pos0 + jnp.arange(sq, dtype=jnp.int32)[:, None]
+    valid = jnp.arange(sk, dtype=jnp.int32)[None, :] <= pos_q
+    logits = jnp.where(valid[None, None], logits, -1e30)
+    w = jax.nn.softmax(logits, axis=-1)
+    out = gqa_weighted_v(w.astype(v_cache.dtype), v_cache)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
