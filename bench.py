@@ -55,6 +55,8 @@ def bench_llama():
     from paddle_tpu.parallel import ShardedTrainStep
     from paddle_tpu.distributed.topology import build_mesh
 
+    offload = on_tpu and os.environ.get("BENCH_OFFLOAD", "") \
+        not in ("", "0")
     if on_tpu:
         # 1.0B-param GQA llama sized for v5e 16G HBM.  Mixed precision
         # the TPU-idiomatic way: fp32 params (the param IS the master —
@@ -66,14 +68,33 @@ def bench_llama():
         # and the flash-attn forward).  Sharding stage 3 (no-op on 1
         # chip, but the exact north-star code path: BASELINE.md cfg 3).
         n_sel = int(os.environ.get("BENCH_RECOMPUTE_LAYERS", "8"))
-        cfg = LlamaConfig(vocab_size=8192, hidden_size=2560,
-                          intermediate_size=6912, num_hidden_layers=14,
-                          num_attention_heads=20, num_key_value_heads=4,
-                          max_position_embeddings=2048, dtype="bfloat16",
-                          param_dtype="float32",
-                          recompute=n_sel > 0, recompute_layers=n_sel,
-                          recompute_granularity="selective")
-        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        if offload:
+            # 2.0B params — ~2x the fp32-params-resident ceiling.  bf16
+            # params on device; fp32 master + moments parked in pinned
+            # host memory and streamed through HBM inside the step
+            # (ShardedTrainStep offload=True).
+            cfg = LlamaConfig(vocab_size=8192, hidden_size=3584,
+                              intermediate_size=9600,
+                              num_hidden_layers=14,
+                              num_attention_heads=28,
+                              num_key_value_heads=4,
+                              max_position_embeddings=2048,
+                              dtype="bfloat16",
+                              recompute=True, recompute_layers=None,
+                              recompute_granularity="full")
+            batch = int(os.environ.get("BENCH_BATCH", "2"))
+        else:
+            cfg = LlamaConfig(vocab_size=8192, hidden_size=2560,
+                              intermediate_size=6912,
+                              num_hidden_layers=14,
+                              num_attention_heads=20,
+                              num_key_value_heads=4,
+                              max_position_embeddings=2048,
+                              dtype="bfloat16", param_dtype="float32",
+                              recompute=n_sel > 0,
+                              recompute_layers=n_sel,
+                              recompute_granularity="selective")
+            batch = int(os.environ.get("BENCH_BATCH", "4"))
         seq, steps = 2048, 8
     else:  # CPU smoke path so the script always runs
         cfg = LlamaConfig(vocab_size=256, hidden_size=128,
@@ -88,10 +109,12 @@ def bench_llama():
     n_params = sum(int(np.prod(p.value.shape)) for p in model.parameters())
     opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters(),
                                  weight_decay=0.1,
-                                 moment_dtype="bfloat16" if on_tpu else None)
+                                 multi_precision=offload,
+                                 moment_dtype="bfloat16" if on_tpu
+                                 else None)
     mesh = build_mesh(devices=jax.devices()[:1])
     step = ShardedTrainStep(model, opt, mesh, sharding_stage=3,
-                            rematerialize=False)
+                            rematerialize=False, offload=offload)
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
@@ -119,8 +142,13 @@ def bench_llama():
     # OUTPUTS are saved (region boundaries / resid_mid tag) or unused in
     # the backward, so jax's remat DCE drops them from the replay jaxpr;
     # norms/rope replay with no matmul flops
-    recompute_per_tok = n_sel * (4.0 * cfg.hidden_size
-                                 * cfg.intermediate_size)
+    if on_tpu and offload:
+        # offload config full-remats EVERY layer: backward replays the
+        # whole forward (~2N flops/token), not the selective gate/up set
+        recompute_per_tok = 2.0 * n_params
+    else:
+        recompute_per_tok = n_sel * (4.0 * cfg.hidden_size
+                                     * cfg.intermediate_size)
     hw_util = mfu * (6.0 * n_params + recompute_per_tok) / (6.0 * n_params)
 
     result = {
@@ -347,11 +375,14 @@ def bench_llama_decode():
 
     paddle.seed(0)
     if on_tpu:
+        # serving-appropriate bf16 weights (param_dtype unset): the
+        # decode roofline below assumes 2 bytes/param, which must match
+        # what the step actually reads
         cfg = LlamaConfig(vocab_size=8192, hidden_size=2560,
                           intermediate_size=6912, num_hidden_layers=14,
                           num_attention_heads=20, num_key_value_heads=4,
-                          max_position_embeddings=2048, dtype="bfloat16",
-                          param_dtype="float32")
+                          max_position_embeddings=2048,
+                          dtype="bfloat16")
         batch = int(os.environ.get("BENCH_BATCH", "8"))
         prompt_len, new_tokens = 128, 512
     else:

@@ -191,9 +191,11 @@ class Predictor:
                 "live model via from_model() — use generate()")
 
     def get_input_names(self) -> List[str]:
+        self._require_artifact("get_input_names()")
         return list(self._inputs)
 
     def get_input_handle(self, name: str) -> Tensor:
+        self._require_artifact("get_input_handle()")
         return self._inputs[name]
 
     def run(self, inputs: Optional[list] = None):

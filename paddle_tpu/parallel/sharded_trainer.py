@@ -119,13 +119,29 @@ class ShardedTrainStep:
     def __init__(self, model, optimizer, mesh: Mesh, loss_fn=None,
                  sharding_stage: int = 0, rematerialize: bool = False,
                  batch_axes=("dp", "sharding"), donate: bool = True,
-                 seq_axis: Optional[str] = None, seq_dim: int = 1):
+                 seq_axis: Optional[str] = None, seq_dim: int = 1,
+                 offload: bool = False):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.stage = sharding_stage
         self.remat = rematerialize
+        # optimizer-state host offload (reference:
+        # group_sharded_stage3.py `offload` — fp32 master + moments
+        # parked on CPU).  TPU-native: the state pytree lives in
+        # pinned_host memory; each step streams it through HBM for the
+        # update (device_put inside the jitted step) and the out_
+        # shardings land the new state back on the host.  HBM then
+        # holds only params + grads + activations — the lever that
+        # lifts the trainable-size ceiling ~2x on a 16G chip.
+        # In-step streaming needs the runtime's memory-space annotate op
+        # (TPU); the CPU backend lacks it, so there the host parking
+        # happens at step boundaries outside jit (identical placement
+        # semantics — what the CPU-mesh tests validate).
+        self.offload = offload
+        self._stream_offload = offload and \
+            jax.default_backend() == "tpu" 
         self.batch_axes = batch_axes
         self.seq_axis = seq_axis
         self.seq_dim = seq_dim
@@ -158,6 +174,7 @@ class ShardedTrainStep:
             self._param_shardings[n] = ns
             p._value = jax.device_put(p.value, ns)
         self._opt_shardings = {}
+        self._opt_store_shardings = {}
         for n in self._names:
             if self.stage >= 1 and shard_n > 1:
                 p = sd[n]
@@ -165,9 +182,32 @@ class ShardedTrainStep:
                 if self.stage < 3:
                     spec = _add_axis_to_spec(spec, "sharding",
                                              p.value.shape, shard_n, mesh)
-                self._opt_shardings[n] = NamedSharding(mesh, P(*spec))
+                ns = NamedSharding(mesh, P(*spec))
             else:
-                self._opt_shardings[n] = self._param_shardings[n]
+                ns = self._param_shardings[n]
+            self._opt_shardings[n] = ns
+            # storage placement: host when offloading, else == compute
+            self._opt_store_shardings[n] = NamedSharding(
+                mesh, ns.spec, memory_kind="pinned_host") \
+                if self.offload else ns
+
+    def _states_for_call(self):
+        """Opt states as the compiled step expects them: host-resident
+        (streaming mode) or transferred to device at the boundary (CPU
+        fallback)."""
+        if self.offload and not self._stream_offload:
+            return [{k: jax.device_put(v, self._opt_shardings[n])
+                     for k, v in st.items()}
+                    for n, st in zip(self._names, self._opt_states)]
+        return self._opt_states
+
+    def _park_states(self, new_states):
+        """Return states in their between-step storage placement."""
+        if self.offload and not self._stream_offload:
+            return [{k: jax.device_put(v, self._opt_store_shardings[n])
+                     for k, v in st.items()}
+                    for n, st in zip(self._names, new_states)]
+        return new_states
 
     def _shard_batch(self, arr):
         from ..distributed.topology import batch_partition_spec
@@ -190,7 +230,7 @@ class ShardedTrainStep:
             # multi_precision: the fp32 master joins the state pytree and
             # is sharded by the same ZeRO policy as the moments
             st = maybe_master_state(opt, sd[n], st)
-            st = {k: jax.device_put(v, self._opt_shardings[n])
+            st = {k: jax.device_put(v, self._opt_store_shardings[n])
                   for k, v in st.items()}
             states.append(st)
         return states
@@ -265,6 +305,15 @@ class ShardedTrainStep:
         mesh = self.mesh if self.mesh.size > 1 else None
         opt_specs = [self._opt_shardings[n].spec for n in names]
 
+        offload = self._stream_offload
+        # explicit memory_kind="device": the in-step transfer must carry
+        # BOTH the placement and the sharding on one custom call, or the
+        # SPMD partitioner rejects the side-effecting annotate op
+        dev_opt_sh = [NamedSharding(self._opt_shardings[n].mesh,
+                                    self._opt_shardings[n].spec,
+                                    memory_kind="device")
+                      for n in names]
+
         def step(param_vals, opt_states, buf_vals, lr, step_i, key, batch):
             (loss, new_bufs), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(param_vals, buf_vals, key, batch)
@@ -272,8 +321,14 @@ class ShardedTrainStep:
                 grads = [jax.lax.with_sharding_constraint(g, gs)
                          for g, gs in zip(grads, grad_shardings)]
             new_params, new_states = [], []
-            for p, g, s, wd, ls, sp in zip(param_vals, grads, opt_states,
-                                           wds, lr_scales, opt_specs):
+            for i, (p, g, s, wd, ls, sp) in enumerate(
+                    zip(param_vals, grads, opt_states, wds, lr_scales,
+                        opt_specs)):
+                if offload:
+                    # stream this param's state host->HBM; XLA overlaps
+                    # the per-param transfers with the update chain
+                    s = {k: jax.device_put(v, dev_opt_sh[i])
+                         for k, v in s.items()}
                 np_, ns = apply_update(
                     upd, p, g, s, lr if ls == 1.0 else lr * ls, wd,
                     step_i, hp, fused_ok=fused_ok, mesh=mesh, spec=sp)
@@ -282,9 +337,13 @@ class ShardedTrainStep:
             return loss, new_params, new_states, new_bufs
 
         param_sh = [self._param_shardings[n] for n in names]
+        # outputs land back on the host only in streaming mode; the CPU
+        # fallback parks them host-side at the call boundary instead
+        out_opt = self._opt_store_shardings if self._stream_offload \
+            else self._opt_shardings
         opt_sh = []
         for n, st in zip(names, self._opt_states):
-            opt_sh.append({k: self._opt_shardings[n] for k in st})
+            opt_sh.append({k: out_opt[n] for k in st})
         buf_sh = [None] * len(buf_names)
         donate = (0, 1, 2) if self._donate else ()
         self._step_fn = step
@@ -302,7 +361,7 @@ class ShardedTrainStep:
         shardings) are still visible as @Sharding custom calls."""
         param_vals, buf_vals, batch_vals = self._prepare(batch)
         lowered = self._compiled.lower(
-            param_vals, self._opt_states, buf_vals,
+            param_vals, self._states_for_call(), buf_vals,
             jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.int32),
             jax.random.key(0), batch_vals)
         return lowered.compile().as_text() if optimized \
@@ -327,9 +386,24 @@ class ShardedTrainStep:
         """K sharded steps fused into one device program via lax.scan
         (host-loop elision — see jit.TrainStep._build_multi)."""
         step = self._step_fn
+        stream = self._stream_offload
+        dev_opt_sh = [NamedSharding(self._opt_shardings[n].mesh,
+                                    self._opt_shardings[n].spec,
+                                    memory_kind="device")
+                      for n in self._names]
 
         def multi(param_vals, opt_states, buf_vals, lrs, step0, key,
                   stacked):
+            if stream:
+                # bring the host-parked states to HBM ONCE for the whole
+                # fused window (a host-resident scan carry would ping-
+                # pong memory spaces every inner step); the final
+                # out_shardings park them back on the host
+                opt_states = [
+                    {k: jax.device_put(v, dev_opt_sh[i])
+                     for k, v in st.items()}
+                    for i, st in enumerate(opt_states)]
+
             def body(carry, xs):
                 params, states, bufs, i = carry
                 k = jax.random.fold_in(key, i)
@@ -373,7 +447,8 @@ class ShardedTrainStep:
         from ..distributed.watchdog import watched
         with watched(f"sharded train run_steps(k={k})"):
             losses, new_params, new_states, new_bufs = \
-                self._compiled_multi(param_vals, self._opt_states,
+                self._compiled_multi(param_vals,
+                                     self._states_for_call(),
                                      buf_vals, lrs, step0, key, stacked)
         commit_lr()
         self.optimizer._step_count += k
@@ -382,7 +457,7 @@ class ShardedTrainStep:
             sd[n]._value = v
         for n, v in zip(self._buf_names, new_bufs):
             sd[n]._value = v
-        self._opt_states = new_states
+        self._opt_states = self._park_states(new_states)
         return Tensor(losses)
 
     def _stack_shard(self, arr):
@@ -404,7 +479,7 @@ class ShardedTrainStep:
         key = prandom.next_key()
         with watched("sharded train step"):
             loss, new_params, new_states, new_bufs = self._compiled(
-                param_vals, self._opt_states, buf_vals,
+                param_vals, self._states_for_call(), buf_vals,
                 jnp.asarray(lr, jnp.float32),
                 jnp.asarray(self.optimizer._step_count, jnp.int32), key,
                 batch_vals)
@@ -412,5 +487,5 @@ class ShardedTrainStep:
             sd[n]._value = v
         for n, v in zip(self._buf_names, new_bufs):
             sd[n]._value = v
-        self._opt_states = new_states
+        self._opt_states = self._park_states(new_states)
         return Tensor(loss)

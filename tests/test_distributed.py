@@ -338,3 +338,53 @@ class TestDistributedSampler:
             for batch in s:
                 seen += batch
         assert sorted(set(seen)) == list(range(20))
+
+
+def test_zero3_host_offload_roundtrip():
+    """ZeRO-3 + offload: optimizer state lives in pinned_host memory
+    between steps, streams through HBM inside the step, and training
+    matches the non-offloaded run exactly (reference:
+    group_sharded_stage3.py `offload`)."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.parallel import ShardedTrainStep
+    from paddle_tpu.distributed.topology import build_mesh
+
+    def make(offload):
+        paddle.seed(21)
+        m = nn.Sequential(nn.Linear(16, 16), nn.Tanh(),
+                          nn.Linear(16, 4))
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        mesh = build_mesh(sharding=8)
+        st = ShardedTrainStep(m, opt, mesh, sharding_stage=3,
+                              offload=offload,
+                              loss_fn=lambda o, y:
+                              nn.functional.cross_entropy(o, y))
+        return m, st
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 16).astype(np.float32)
+    ys = rng.randint(0, 4, (8,)).astype(np.int64)
+
+    m1, s1 = make(False)
+    base = [float(np.asarray(s1(paddle.to_tensor(xs),
+                                paddle.to_tensor(ys)).value))
+            for _ in range(3)]
+    m2, s2 = make(True)
+    off = [float(np.asarray(s2(paddle.to_tensor(xs),
+                               paddle.to_tensor(ys)).value))
+           for _ in range(3)]
+    np.testing.assert_allclose(off, base, rtol=1e-5, atol=1e-6)
+
+    # placement round-trips: state is pinned_host AFTER the step
+    for st_dict in s2._opt_states:
+        for k, v in st_dict.items():
+            assert v.sharding.memory_kind == "pinned_host", (k, v.sharding)
+    # params stayed in device memory
+    for n, p in m2.named_parameters():
+        assert p.value.sharding.memory_kind == "device"
+    w1 = np.asarray(m1.state_dict()["0.weight"].value)
+    w2 = np.asarray(m2.state_dict()["0.weight"].value)
+    np.testing.assert_allclose(w2, w1, rtol=1e-5, atol=1e-6)
