@@ -99,43 +99,68 @@ class StaticFunction:
         self._input_spec = input_spec
         self._compiled = None
         self._names = None
+        self._fallback = False   # SOT-style graph break: run eager
 
     def _build(self):
         layer = self._layer
+        # dy2static AST pass: tensor-dependent if/while lower to
+        # lax.cond/while_loop (reference: jit/dy2static transformers);
+        # anything it can't convert keeps Python semantics and, if a
+        # tracer then hits a Python branch, the call GRAPH-BREAKS to
+        # eager below (reference: SOT fallback, jit/sot/translate.py)
+        from .dy2static import ast_transform
+        fn = ast_transform(self._fn)
 
         if layer is not None:
             names = list(layer.state_dict().keys())
             self._names = names
 
-            fn = self._fn
-
             def raw(state_vals, *in_vals):
-                state = dict(zip(names, state_vals))
                 with _swapped_state(layer, names, state_vals):
-                    out = fn(*in_vals)
+                    out = fn(*[Tensor(v) if isinstance(v, jax.Array)
+                               else v for v in in_vals])
                 return _leaves_to_values(out)
             self._compiled = jax.jit(raw)
         else:
-            fn = self._fn
-
             def raw(*in_vals):
                 return _leaves_to_values(fn(*in_vals))
             self._compiled = jax.jit(raw)
 
     def __call__(self, *args, **kwargs):
-        if not _to_static_enabled:
+        if not _to_static_enabled or self._fallback:
             return self._fn(*args, **kwargs)
         if kwargs:
             # keyword args force eager fallback (graph-break analog)
             return self._fn(*args, **kwargs)
-        if self._compiled is None:
+        first_call = self._compiled is None
+        if first_call:
             self._build()
-        if self._layer is not None:
-            sd = self._layer.state_dict()
-            state_vals = [sd[n]._value for n in self._names]
-            out = self._compiled(state_vals, *args)
-        else:
-            out = self._compiled(*args)
+        try:
+            if self._layer is not None:
+                sd = self._layer.state_dict()
+                state_vals = [sd[n]._value for n in self._names]
+                out = self._compiled(state_vals, *args)
+            else:
+                out = self._compiled(*args)
+        except Exception as e:  # noqa: BLE001 — SOT-style graph break
+            # Tracer concretization errors are always a graph break.
+            # On the FIRST call (trace+compile), ANY failure falls back
+            # to eager (the transform's restrictions — branch pytree
+            # mismatch, lax.cond TypeError, a synthesized NameError —
+            # surface here; eager either succeeds or raises the true
+            # user error).  After a successful compile, non-tracer
+            # errors are real runtime failures and propagate.
+            tracer_err = isinstance(e, jax.errors.ConcretizationTypeError)
+            if not tracer_err and not first_call:
+                raise
+            import warnings
+            warnings.warn(
+                f"to_static: graph break in "
+                f"{getattr(self._fn, '__qualname__', self._fn)} "
+                f"({type(e).__name__}: {e}); falling back to eager "
+                "execution", RuntimeWarning)
+            self._fallback = True
+            return self._fn(*args, **kwargs)
         return jax.tree_util.tree_map(
             lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out)
 

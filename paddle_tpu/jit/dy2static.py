@@ -1,0 +1,376 @@
+"""Dynamic-to-static control-flow conversion.
+
+Reference: `python/paddle/jit/dy2static/` — the AST pass rewrites
+`if`/`while` statements into `convert_ifelse` / `convert_while_loop`
+calls (convert_operators.py), which dispatch at RUNTIME: a Tensor
+predicate builds graph control flow, a Python predicate stays Python.
+The reference's SOT bytecode JIT adds graph-break fallback for
+unconvertible code (`jit/sot/translate.py`).
+
+TPU-native mapping: graph control flow == `lax.cond` / `lax.while_loop`
+(compiled once, no data-dependent Python control flow inside jit — the
+XLA contract), and the graph-break analog is StaticFunction's eager
+fallback on TracerBoolConversionError.
+
+Conversion contract (same restrictions the reference documents):
+  * both `if` branches must leave the assigned variables with the same
+    pytree structure/dtypes (lax.cond requirement);
+  * `while` loop variables must keep fixed shapes/dtypes across
+    iterations (lax.while_loop carry);
+  * variables first bound inside a branch/loop must not be read after
+    it unless every path binds them.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["convert_ifelse", "convert_while_loop", "ast_transform"]
+
+
+def _is_traced_pred(p) -> bool:
+    v = p._value if isinstance(p, Tensor) else p
+    return isinstance(v, jax.core.Tracer)
+
+
+def _pred_value(p):
+    v = p._value if isinstance(p, Tensor) else p
+    return jnp.asarray(v).astype(bool).reshape(())
+
+
+def _unwrap(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x._value if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _rewrap(tree, like):
+    flat_l, _ = jax.tree_util.tree_flatten(
+        like, is_leaf=lambda x: isinstance(x, Tensor))
+    flat_v, treedef = jax.tree_util.tree_flatten(tree)
+    out = [Tensor(v) if isinstance(l, Tensor) else v
+           for v, l in zip(flat_v, flat_l)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def convert_ifelse(pred, true_fn, false_fn):
+    """Reference: convert_operators.py convert_ifelse.  Tensor/tracer
+    predicate → lax.cond over the branch outputs; Python predicate →
+    plain call."""
+    if not _is_traced_pred(pred):
+        if isinstance(pred, Tensor):
+            pred = bool(jax.device_get(pred._value))
+        return true_fn() if pred else false_fn()
+    t_out = true_fn()
+    f_out = false_fn()
+    t_val, f_val = _unwrap(t_out), _unwrap(f_out)
+    out = jax.lax.cond(_pred_value(pred), lambda: t_val, lambda: f_val)
+    return _rewrap(out, t_out)
+
+
+def convert_while_loop(cond_fn, body_fn, loop_vars: tuple):
+    """Reference: convert_operators.py convert_while_loop.  A traced
+    condition lowers to lax.while_loop with the loop variables as the
+    carry; a Python condition runs the loop in Python."""
+    first = cond_fn(*loop_vars)
+    if not _is_traced_pred(first):
+        while True:
+            c = cond_fn(*loop_vars)
+            if isinstance(c, Tensor):
+                c = bool(jax.device_get(c._value))
+            if not c:
+                break
+            loop_vars = body_fn(*loop_vars)
+        return loop_vars
+
+    like = loop_vars
+
+    def cond(vals):
+        return _pred_value(cond_fn(*_rewrap(vals, like)))
+
+    def body(vals):
+        return _unwrap(body_fn(*_rewrap(vals, like)))
+
+    out = jax.lax.while_loop(cond, body, _unwrap(loop_vars))
+    return _rewrap(out, like)
+
+
+# ---------------------------------------------------------------------------
+# AST pass (reference: dy2static/transformers — IfElseTransformer,
+# LoopTransformer)
+# ---------------------------------------------------------------------------
+class _AssignedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = []
+
+    def _add(self, node):
+        if isinstance(node, ast.Name):
+            if node.id not in self.names:
+                self.names.append(node.id)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                self._add(e)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._add(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs own their scope
+
+
+class _LoadedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.names.add(node.id)
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+def _loaded(nodes):
+    v = _LoadedNames()
+    for n in nodes:
+        v.visit(n)
+    return v.names
+
+
+_COUNTER = [0]
+
+
+def _uniq(base):
+    _COUNTER[0] += 1
+    return f"__jst_{base}_{_COUNTER[0]}"
+
+
+class _CtrlFlowTransformer(ast.NodeTransformer):
+    """Rewrites If / While whose body may touch tensors into the
+    runtime converters.  `return`/`break`/`continue` INSIDE a converted
+    block are not supported (same as the reference's converted subset)
+    — blocks containing them are left as plain Python (they still work
+    for non-tensor predicates; tensor predicates then graph-break).
+
+    Conversion is CONSERVATIVE about name binding: an `if` converts
+    only when every branch-assigned name is either assigned in BOTH
+    branches or definitely bound before the statement, and a `while`
+    only when every body-assigned name is definitely bound before it
+    (the lax carry needs an init value).  Anything else keeps Python
+    semantics — a tensor predicate there graph-breaks to eager instead
+    of producing UnboundLocalError from a synthesized branch."""
+
+    def __init__(self):
+        super().__init__()
+        self._bound: set = set()
+
+    def visit_FunctionDef(self, node):
+        prev = self._bound
+        self._bound = {a.arg for a in node.args.args} \
+            | {a.arg for a in node.args.posonlyargs} \
+            | {a.arg for a in node.args.kwonlyargs}
+        if node.args.vararg:
+            self._bound.add(node.args.vararg.arg)
+        if node.args.kwarg:
+            self._bound.add(node.args.kwarg.arg)
+        node.body = self._visit_block(node.body)
+        self._bound = prev
+        return node
+
+    def _visit_block(self, stmts):
+        """Visit statements in order, tracking definitely-bound names."""
+        out = []
+        for st in stmts:
+            res = self.visit(st)
+            out.extend(res if isinstance(res, list) else [res])
+            # after the statement, its assignments are bound on every
+            # path only for plain statements and converted blocks (the
+            # synthesized tuple-assign binds unconditionally)
+            if isinstance(st, (ast.If, ast.While, ast.For, ast.Try,
+                               ast.With)):
+                if isinstance(res, list):   # converted → binds all
+                    self._bound |= set(_assigned([st]))
+                elif isinstance(st, ast.If) and st.orelse:
+                    both = set(_assigned(st.body)) \
+                        & set(_assigned(st.orelse))
+                    self._bound |= both
+                # else: conditional binding — not definitely bound
+            else:
+                self._bound |= set(_assigned([st]))
+        return out
+
+    def _has_escape(self, stmts):
+        for s in stmts:
+            for node in ast.walk(s):
+                if isinstance(node, (ast.Return, ast.Break,
+                                     ast.Continue, ast.Yield,
+                                     ast.YieldFrom)):
+                    return True
+        return False
+
+    def visit_If(self, node):
+        node.body = self._visit_block(node.body)
+        node.orelse = self._visit_block(node.orelse)
+        if self._has_escape(node.body) or self._has_escape(node.orelse):
+            return node
+        t_set, f_set = set(_assigned(node.body)), \
+            set(_assigned(node.orelse))
+        one_sided = (t_set ^ f_set) - self._bound
+        if one_sided:
+            return node  # a synthesized branch would read an unbound name
+        assigned = sorted(t_set | f_set)
+        t_name, f_name = _uniq("true"), _uniq("false")
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in assigned],
+            ctx=ast.Load()))
+        t_def = ast.FunctionDef(
+            name=t_name, args=_no_args(),
+            body=(list(node.body) or [ast.Pass()]) + [ret],
+            decorator_list=[])
+        f_def = ast.FunctionDef(
+            name=f_name, args=_no_args(),
+            body=(list(node.orelse) or [ast.Pass()]) + [ret],
+            decorator_list=[])
+        call = ast.Call(
+            func=ast.Name(id="__jst_ifelse", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=t_name, ctx=ast.Load()),
+                  ast.Name(id=f_name, ctx=ast.Load())],
+            keywords=[])
+        if assigned:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=n, ctx=ast.Store())
+                          for n in assigned],
+                    ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [t_def, f_def, assign]
+
+    def visit_While(self, node):
+        node.body = self._visit_block(node.body)
+        if node.orelse or self._has_escape(node.body):
+            return node
+        assigned = set(_assigned(node.body))
+        if not assigned or (assigned - self._bound):
+            # a body-assigned name with no pre-loop binding has no lax
+            # carry init — keep Python semantics (graph-break if traced)
+            return node
+        # carry EVERY body-assigned name (write-only results included —
+        # their post-loop value must come out of the loop)
+        carried = sorted(assigned)
+        c_name, b_name = _uniq("cond"), _uniq("body")
+        args = _names_args(carried)
+        c_def = ast.FunctionDef(
+            name=c_name, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in carried],
+            ctx=ast.Load()))
+        b_def = ast.FunctionDef(
+            name=b_name, args=_names_args(carried),
+            body=list(node.body) + [ret], decorator_list=[])
+        call = ast.Call(
+            func=ast.Name(id="__jst_while", ctx=ast.Load()),
+            args=[ast.Name(id=c_name, ctx=ast.Load()),
+                  ast.Name(id=b_name, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in carried], ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in carried],
+                ctx=ast.Store())],
+            value=call)
+        return [c_def, b_def, assign]
+
+
+def _no_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def _names_args(names):
+    return ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+        defaults=[])
+
+
+@functools.lru_cache(maxsize=256)
+def _transform_code(fn_qualname, source, filename):
+    tree = ast.parse(source)
+    fdef = tree.body[0]
+    fdef.decorator_list = []          # to_static itself, etc.
+    new = _CtrlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new)
+    return compile(new, filename=f"<dy2static {filename}>", mode="exec")
+
+
+def ast_transform(fn):
+    """Rewrite fn's tensor-convertible if/while into runtime dispatch.
+    Returns the converted function, or fn unchanged when the source is
+    unavailable / unparsable (the caller's graph-break fallback then
+    owns correctness)."""
+    import types
+    bound_self = getattr(fn, "__self__", None)
+    raw = fn.__func__ if inspect.ismethod(fn) else fn
+    try:
+        source = textwrap.dedent(inspect.getsource(raw))
+        code = _transform_code(raw.__qualname__, source,
+                               inspect.getsourcefile(raw) or "<src>")
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    glb = dict(raw.__globals__)
+    glb["__jst_ifelse"] = convert_ifelse
+    glb["__jst_while"] = convert_while_loop
+    # free variables: re-bind the closure cells' current values
+    if raw.__closure__:
+        # free variables SHADOW same-named module globals (python
+        # scoping); values are snapshotted at transform time — a
+        # documented restriction shared with the reference's dy2static
+        for name, cell in zip(raw.__code__.co_freevars, raw.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    loc: dict = {}
+    try:
+        exec(code, glb, loc)
+    except Exception:
+        return fn
+    new_fn = loc.get(raw.__name__)
+    if new_fn is None:
+        return fn
+    new_fn = functools.wraps(raw)(new_fn)
+    if bound_self is not None:
+        return types.MethodType(new_fn, bound_self)
+    return new_fn

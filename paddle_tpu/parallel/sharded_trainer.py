@@ -175,6 +175,7 @@ class ShardedTrainStep:
             p._value = jax.device_put(p.value, ns)
         self._opt_shardings = {}
         self._opt_store_shardings = {}
+        self._dev_opt_shardings = {}
         for n in self._names:
             if self.stage >= 1 and shard_n > 1:
                 p = sd[n]
@@ -186,10 +187,16 @@ class ShardedTrainStep:
             else:
                 ns = self._param_shardings[n]
             self._opt_shardings[n] = ns
-            # storage placement: host when offloading, else == compute
+            # storage placement: host when offloading, else == compute.
+            # The explicit memory_kind="device" twin is what in-step
+            # streaming transfers target — the transfer custom call must
+            # carry BOTH placement and sharding or the SPMD partitioner
+            # rejects it.
             self._opt_store_shardings[n] = NamedSharding(
                 mesh, ns.spec, memory_kind="pinned_host") \
                 if self.offload else ns
+            self._dev_opt_shardings[n] = NamedSharding(
+                mesh, ns.spec, memory_kind="device")
 
     def _states_for_call(self):
         """Opt states as the compiled step expects them: host-resident
@@ -306,13 +313,7 @@ class ShardedTrainStep:
         opt_specs = [self._opt_shardings[n].spec for n in names]
 
         offload = self._stream_offload
-        # explicit memory_kind="device": the in-step transfer must carry
-        # BOTH the placement and the sharding on one custom call, or the
-        # SPMD partitioner rejects the side-effecting annotate op
-        dev_opt_sh = [NamedSharding(self._opt_shardings[n].mesh,
-                                    self._opt_shardings[n].spec,
-                                    memory_kind="device")
-                      for n in names]
+        dev_opt_sh = [self._dev_opt_shardings[n] for n in names]
 
         def step(param_vals, opt_states, buf_vals, lr, step_i, key, batch):
             (loss, new_bufs), grads = jax.value_and_grad(
@@ -387,10 +388,7 @@ class ShardedTrainStep:
         (host-loop elision — see jit.TrainStep._build_multi)."""
         step = self._step_fn
         stream = self._stream_offload
-        dev_opt_sh = [NamedSharding(self._opt_shardings[n].mesh,
-                                    self._opt_shardings[n].spec,
-                                    memory_kind="device")
-                      for n in self._names]
+        dev_opt_sh = [self._dev_opt_shardings[n] for n in self._names]
 
         def multi(param_vals, opt_states, buf_vals, lrs, step0, key,
                   stacked):
