@@ -8,6 +8,8 @@ datasets); the Viterbi decoder is a lax.scan over the transition lattice.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -101,15 +103,57 @@ class ViterbiDecoder:
 # datasets (synthetic fallbacks; reference shapes/dtypes)
 # ---------------------------------------------------------------------------
 class Imdb(Dataset):
-    """Reference: text/datasets/imdb.py — (word-id sequence, 0/1 label)."""
+    """Reference: text/datasets/imdb.py — (word-id sequence, 0/1 label).
+    Parses the real aclImdb tarball when `data_file` exists (same
+    format: <root>/<mode>/{pos,neg}/*.txt, vocab built from train docs
+    above `cutoff` frequency rank); else deterministic synthetic."""
 
     def __init__(self, data_file=None, mode="train", cutoff=150,
                  n_synthetic=512, seq_len=64, vocab=5000):
+        mode = mode.lower()
+        if data_file and os.path.exists(data_file):
+            if self._load_archive(data_file, mode, cutoff):
+                return
         rng = np.random.RandomState(0 if mode == "train" else 1)
         self.docs = rng.randint(1, vocab,
                                 (n_synthetic, seq_len)).astype(np.int64)
         self.labels = rng.randint(0, 2, (n_synthetic,)).astype(np.int64)
         self.word_idx = {f"w{i}": i for i in range(vocab)}
+
+    def _load_archive(self, path, mode, cutoff) -> bool:
+        import re
+        import tarfile
+        from collections import Counter
+        tok = re.compile(r"[a-z]+")
+
+        def words(raw):
+            return tok.findall(raw.decode("utf-8", "ignore").lower())
+
+        with tarfile.open(path) as tf:
+            train_docs, split_docs = [], []
+            for m in tf.getmembers():
+                parts = m.name.split("/")
+                if len(parts) < 4 or not m.name.endswith(".txt") \
+                        or parts[-2] not in ("pos", "neg"):
+                    continue
+                split, label = parts[-3], int(parts[-2] == "pos")
+                ws = words(tf.extractfile(m).read())
+                if split == "train":
+                    train_docs.append(ws)
+                if split == mode:
+                    split_docs.append((ws, label))
+        if not split_docs or not train_docs:
+            return False
+        freq = Counter(w for ws in train_docs for w in ws)
+        # reference builds the dict from words above the cutoff RANK
+        ordered = [w for w, _ in freq.most_common()]
+        self.word_idx = {w: i for i, w in enumerate(ordered[:cutoff])}
+        unk = len(self.word_idx)
+        self.docs = [np.asarray(
+            [self.word_idx.get(w, unk) for w in ws], np.int64)
+            for ws, _ in split_docs]
+        self.labels = np.asarray([l for _, l in split_docs], np.int64)
+        return True
 
     def __len__(self):
         return len(self.docs)

@@ -1,0 +1,103 @@
+"""Real dataset archive parsing (VERDICT r3 item 8): CIFAR pickle
+batches, MNIST idx-gzip, aclImdb tarball — tiny fixture archives are
+generated in the reference formats and must round-trip through the
+same loaders the reference's file formats use; absent archives keep
+the deterministic synthetic fallback.
+"""
+import gzip
+import io
+import os
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+
+
+def _make_cifar(tmp_path, n=20):
+    rng = np.random.RandomState(0)
+    path = tmp_path / "cifar-10-python.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        for name, count in [("data_batch_1", n), ("test_batch", n // 2)]:
+            d = {b"data": rng.randint(0, 256, (count, 3072),
+                                      dtype=np.uint8).tobytes() and
+                 rng.randint(0, 256, (count, 3072)).astype(np.uint8),
+                 b"labels": rng.randint(0, 10, count).tolist()}
+            raw = pickle.dumps(d)
+            info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+            info.size = len(raw)
+            tf.addfile(info, io.BytesIO(raw))
+    return str(path)
+
+
+def _make_mnist(tmp_path, n=12):
+    rng = np.random.RandomState(1)
+    imgs = rng.randint(0, 256, (n, 28, 28)).astype(np.uint8)
+    lbls = rng.randint(0, 10, n).astype(np.uint8)
+    ip = tmp_path / "train-images-idx3-ubyte.gz"
+    lp = tmp_path / "train-labels-idx1-ubyte.gz"
+    with gzip.open(ip, "wb") as f:
+        f.write(b"\x00" * 16 + imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(b"\x00" * 8 + lbls.tobytes())
+    return str(ip), str(lp), imgs, lbls
+
+
+def _make_imdb(tmp_path):
+    path = tmp_path / "aclImdb_v1.tar.gz"
+    docs = {
+        "aclImdb/train/pos/0_9.txt": b"a great great movie",
+        "aclImdb/train/neg/0_2.txt": b"a terrible movie plot",
+        "aclImdb/test/pos/0_8.txt": b"great plot",
+        "aclImdb/test/neg/0_3.txt": b"terrible terrible",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, raw in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(raw)
+            tf.addfile(info, io.BytesIO(raw))
+    return str(path)
+
+
+def test_cifar10_parses_reference_format(tmp_path):
+    from paddle_tpu.vision.datasets import Cifar10
+    path = _make_cifar(tmp_path)
+    train = Cifar10(data_file=path, mode="train")
+    test = Cifar10(data_file=path, mode="test")
+    assert len(train) == 20 and len(test) == 10
+    img, lbl = train[0]
+    assert img.shape == (3, 32, 32) and 0 <= lbl < 10
+
+
+def test_mnist_parses_idx_format(tmp_path):
+    from paddle_tpu.vision.datasets import MNIST
+    ip, lp, imgs, lbls = _make_mnist(tmp_path)
+    ds = MNIST(image_path=ip, label_path=lp, mode="train")
+    assert len(ds) == 12
+    img, lbl = ds[3]
+    np.testing.assert_array_equal(np.asarray(img, np.uint8)[0], imgs[3])
+    assert lbl == int(lbls[3])
+
+
+def test_imdb_parses_aclimdb_tarball(tmp_path):
+    from paddle_tpu.text import Imdb
+    path = _make_imdb(tmp_path)
+    train = Imdb(data_file=path, mode="train", cutoff=10)
+    test = Imdb(data_file=path, mode="test", cutoff=10)
+    assert len(train) == 2 and len(test) == 2
+    assert set(np.asarray(train.labels)) == {0, 1}
+    # vocab built from train docs; 'great' must be a known id shared
+    # across splits, and encodings must use it consistently
+    gid = train.word_idx["great"]
+    doc, lbl = test[0] if test.labels[0] == 1 else test[1]
+    assert gid in list(np.asarray(doc))
+
+
+def test_synthetic_fallback_still_works():
+    from paddle_tpu.vision.datasets import Cifar10
+    from paddle_tpu.text import Imdb
+    ds = Cifar10(data_file=None, mode="train", n_synthetic=32)
+    assert len(ds) == 32
+    im = Imdb(data_file="/nonexistent/path.tar.gz", mode="train",
+              n_synthetic=8)
+    assert len(im.docs) == 8
