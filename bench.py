@@ -67,7 +67,11 @@ def bench_llama():
         # attention output + mid-residual; replay only the MLP matmuls
         # and the flash-attn forward).  Sharding stage 3 (no-op on 1
         # chip, but the exact north-star code path: BASELINE.md cfg 3).
-        n_sel = int(os.environ.get("BENCH_RECOMPUTE_LAYERS", "8"))
+        # r4 sweep: 3 selective-remat layers is the throughput/gap
+        # sweet spot (mfu 0.538, hw_util-mfu 0.019); fewer layers OOM-
+        # pressures XLA into slower schedules (0.522 at 0/2), more
+        # layers replay needless matmuls (0.532 at 8)
+        n_sel = int(os.environ.get("BENCH_RECOMPUTE_LAYERS", "3"))
         if offload:
             # 2.0B params — ~2x the fp32-params-resident ceiling.  bf16
             # params on device; fp32 master + moments parked in pinned

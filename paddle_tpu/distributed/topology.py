@@ -51,6 +51,16 @@ class Group:
 
     @property
     def rank(self):
+        """This process's group-local index (reference Group.rank).
+        Derived from the topology's coordinate-based global rank —
+        a hardcoded 0 would silently misanswer every non-lead process
+        in multi-process code consulting group rank; 0 only under a
+        single controller that owns every rank."""
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None and self.ranks:
+            g = hcg.global_rank
+            if g in self.ranks:
+                return self.ranks.index(g)
         return 0
 
     def get_group_rank(self, rank):

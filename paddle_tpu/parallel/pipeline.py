@@ -344,7 +344,8 @@ class PipelineEngine:
             raise ValueError(
                 f"batch {xv.shape[0]} not divisible by micro-batches {m}")
         sched = schedule.upper().replace("-", "").replace("_", "")
-        self._split_bwd = sched in ("ZB", "ZBH1", "ZEROBUBBLE")
+        self._split_bwd = sched in ("ZB", "ZBH1", "ZEROBUBBLE",
+                                    "ZBVPP", "ZBV", "ZEROBUBBLEVPP")
         from ..distributed.watchdog import watched
         self._sync_shared_values()
         micro_x = jnp.split(xv, m)
@@ -419,6 +420,8 @@ class PipelineEngine:
         if sched in ("VPP", "INTERLEAVE", "INTERLEAVED") \
                 or (sched == "1F1B" and self.vpp > 1):
             return [self._interleaved_order(s, m) for s in range(self.pp)]
+        if sched in ("ZBVPP", "ZBV", "ZEROBUBBLEVPP"):
+            return [self._zb_vpp_order(s, m) for s in range(self.pp)]
         if self.vpp > 1 and sched != "FTHENB":
             raise ValueError(
                 f"schedule {schedule} does not support vpp={self.vpp}")
@@ -510,6 +513,26 @@ class PipelineEngine:
             order.append(b_op(t))
         for j in range(total - warmup, total):
             order.append(b_op(j))
+        return order
+
+    def _zb_vpp_order(self, s, m):
+        """ZB-VPP (reference pipeline_zero_bubble.py:151 — zero-bubble
+        WITH virtual stages): the interleaved VPP order with each
+        backward split into B (dx, critical path) and W (dweight); W
+        ops trail their B by the stage's warmup depth so they fill the
+        interleave bubbles, and the cooldown tail drains the W queue."""
+        from collections import deque
+        base = self._interleaved_order(s, m)
+        defer = self.pp - 1 - s
+        order, pending_w, seen_b = [], deque(), 0
+        for op in base:
+            order.append(op)
+            if op[0] == "b":
+                pending_w.append(("w", op[1], op[2]))
+                seen_b += 1
+                if seen_b > defer:
+                    order.append(pending_w.popleft())
+        order.extend(pending_w)
         return order
 
     # -- dependency + execution -------------------------------------------

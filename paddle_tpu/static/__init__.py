@@ -175,6 +175,15 @@ class Executor:
     "program" was already executed eagerly at build time, so fetches simply
     re-evaluate with the new feeds via functional substitution — correct for
     feed-forward graphs built with paddle_tpu.static.data.
+
+    HARD LIMIT (by design, documented): there is no op-level Program IR —
+    workflows that construct programs with raw `append_op` semantics,
+    program transforms/passes, or feed/fetch-driven PARTIAL-graph
+    execution have no path here.  The static surface exists for
+    Model.fit-style usage and API parity; graph-level programming is
+    XLA's job (trace with jit/to_static instead).  See SURVEY §7's
+    design stance — rebuilding the fluid Program machinery would bypass
+    the compiler this framework is built on.
     """
 
     def __init__(self, place=None):

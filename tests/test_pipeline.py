@@ -205,7 +205,8 @@ def test_pp_interleaved_order_structure():
 
 @pytest.mark.parametrize("pp,vpp,micro,schedule", [
     (2, 2, 4, "VPP"), (2, 2, 4, "FThenB"), (2, 1, 4, "ZB"),
-    (4, 1, 8, "ZB-H1"), (2, 3, 2, "VPP"),
+    (4, 1, 8, "ZB-H1"), (2, 3, 2, "VPP"), (2, 2, 4, "ZB-VPP"),
+    (2, 2, 8, "ZB-VPP"),
 ])
 def test_pp_schedules_match_single_device(pp, vpp, micro, schedule):
     """Every schedule in the zoo reproduces the unpipelined loss
