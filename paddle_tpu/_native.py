@@ -65,8 +65,72 @@ class _FlagsLib:
         return int(self._lib.pd_flags_count())
 
 
+class _IoLib:
+    """ctypes facade over csrc/io_native.cc — multithreaded checkpoint
+    file IO + crc32 (native analog of the reference's compiled
+    save/load IO path)."""
+
+    def __init__(self, cdll):
+        self._lib = cdll
+        LL = ctypes.c_longlong
+        cdll.pd_crc32.argtypes = [ctypes.c_void_p, LL]
+        cdll.pd_crc32.restype = ctypes.c_uint
+        cdll.pd_file_write.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                       LL, LL, ctypes.c_int]
+        cdll.pd_file_write.restype = ctypes.c_int
+        cdll.pd_file_read.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                      LL, LL, ctypes.c_int]
+        cdll.pd_file_read.restype = ctypes.c_int
+
+    @staticmethod
+    def _as_bytes(buf) -> bytes:
+        """bytes view for the C call — zero-copy when the caller already
+        holds bytes (the checkpoint payload path); an extra copy of a
+        multi-GB payload would double peak host memory."""
+        if isinstance(buf, bytes):
+            return buf
+        return bytes(memoryview(buf))
+
+    def crc32(self, buf) -> int:
+        b = self._as_bytes(buf)
+        return int(self._lib.pd_crc32(b, len(b)))
+
+    def write(self, path: str, buf, offset: int = 0,
+              n_threads: int = 8) -> None:
+        b = self._as_bytes(buf)
+        rc = self._lib.pd_file_write(path.encode(), b, len(b),
+                                     offset, n_threads)
+        if rc != 0:
+            raise OSError(f"pd_file_write({path}) failed rc={rc}")
+
+    def read(self, path: str, nbytes: int, offset: int = 0,
+             n_threads: int = 8) -> bytes:
+        out = ctypes.create_string_buffer(nbytes)
+        rc = self._lib.pd_file_read(path.encode(), out, nbytes, offset,
+                                    n_threads)
+        if rc != 0:
+            raise OSError(f"pd_file_read({path}) failed rc={rc}")
+        return out.raw
+
+
 lib = None
 try:
     lib = _FlagsLib(ctypes.CDLL(_build("pd_flags", ["flags_native.cc"])))
 except Exception:  # toolchain/cache unavailable: pure-python fallback
     lib = None
+
+_io_lib = None
+_io_tried = False
+
+
+def io_lib():
+    """The native IO engine, or None (pure-python fallback)."""
+    global _io_lib, _io_tried
+    if not _io_tried:
+        _io_tried = True
+        try:
+            _io_lib = _IoLib(
+                ctypes.CDLL(_build("pd_io", ["io_native.cc"])))
+        except Exception:
+            _io_lib = None
+    return _io_lib

@@ -59,9 +59,14 @@ class Group:
         hcg = get_hybrid_communicate_group()
         if hcg is not None and self.ranks:
             g = hcg.global_rank
-            if g in self.ranks:
-                return self.ranks.index(g)
+            # reference semantics: -1 for a NON-member (is_member() keys
+            # off rank < 0) — returning 0 would make every outsider act
+            # as the group lead
+            return self.ranks.index(g) if g in self.ranks else -1
         return 0
+
+    def is_member(self):
+        return self.rank >= 0
 
     def get_group_rank(self, rank):
         return self.ranks.index(rank) if rank in self.ranks else 0

@@ -22,7 +22,6 @@ raises with its name rather than emitting a wrong graph.
 from __future__ import annotations
 
 import os
-import struct
 
 import numpy as np
 import jax
@@ -84,9 +83,15 @@ _DT = {"float32": 1, "uint8": 2, "int8": 3, "int32": 6, "int64": 7,
 def _tensor_proto(name, arr):
     arr = np.asarray(arr)
     dt = _DT.get(str(arr.dtype))
-    if dt is None:  # bf16 etc → fp32 for interop
-        arr = arr.astype(np.float32)
-        dt = 1
+    if dt is None:
+        if str(arr.dtype) == "bfloat16":
+            # bf16 VALUES survive a widening cast exactly
+            arr = arr.astype(np.float32)
+            dt = 1
+        else:
+            raise NotImplementedError(
+                f"onnx export: dtype {arr.dtype} has no mapping — "
+                "refusing to emit a numerically different graph")
     t = _Proto()
     for d in arr.shape:
         t.varint(1, int(d))            # dims
@@ -262,9 +267,12 @@ def _convert_jaxpr(jaxpr, consts, in_names, prefix=""):
             inits.append(_tensor_proto(cn, shp))
             nodes.append(_node("Expand", [src, cn], outs))
         elif prim == "convert_element_type":
-            nodes.append(_node(
-                "Cast", ins, outs,
-                to=_DT.get(str(np.dtype(p["new_dtype"])), 1)))
+            dt_name = str(np.dtype(p["new_dtype"]))
+            to = _DT.get(dt_name, 1 if dt_name == "bfloat16" else None)
+            if to is None:
+                raise NotImplementedError(
+                    f"onnx export: Cast to unmapped dtype {dt_name}")
+            nodes.append(_node("Cast", ins, outs, to=to))
         elif prim == "reduce_sum":
             # ReduceSum takes axes as an INPUT from opset 13
             axes = np.asarray(p["axes"], np.int64)
