@@ -96,17 +96,23 @@ def _write_files(path, rank, shards, meta, coordinator_rank):
 
     hdr = json.dumps(header).encode()
     prefix = _MAGIC + len(hdr).to_bytes(8, "little") + hdr
-    payload = b"".join(blobs)
     fname = os.path.join(path, f"{rank}.distcp")
     from ... import _native
     io = _native.io_lib()
-    if io is not None and payload:
+    if io is not None and blobs:
+        # per-blob writes at their header offsets: no b"".join — a
+        # concatenated copy would double peak host memory on multi-GB
+        # payloads
         io.write(fname, prefix, 0, 1)
-        io.write(fname, payload, len(prefix), 8)
+        pos = len(prefix)
+        for raw in blobs:
+            io.write(fname, raw, pos, 8)
+            pos += len(raw)
     else:
         with open(fname, "wb") as f:
             f.write(prefix)
-            f.write(payload)
+            for raw in blobs:
+                f.write(raw)
     if rank == coordinator_rank:
         with open(os.path.join(path, "metadata.json"), "w") as f:
             json.dump(meta, f)

@@ -82,16 +82,11 @@ _DT = {"float32": 1, "uint8": 2, "int8": 3, "int32": 6, "int64": 7,
 
 def _tensor_proto(name, arr):
     arr = np.asarray(arr)
-    dt = _DT.get(str(arr.dtype))
+    dt = _DT.get(str(arr.dtype))  # bfloat16 → 16 (true ONNX BFLOAT16)
     if dt is None:
-        if str(arr.dtype) == "bfloat16":
-            # bf16 VALUES survive a widening cast exactly
-            arr = arr.astype(np.float32)
-            dt = 1
-        else:
-            raise NotImplementedError(
-                f"onnx export: dtype {arr.dtype} has no mapping — "
-                "refusing to emit a numerically different graph")
+        raise NotImplementedError(
+            f"onnx export: dtype {arr.dtype} has no mapping — "
+            "refusing to emit a numerically different graph")
     t = _Proto()
     for d in arr.shape:
         t.varint(1, int(d))            # dims
@@ -268,7 +263,7 @@ def _convert_jaxpr(jaxpr, consts, in_names, prefix=""):
             nodes.append(_node("Expand", [src, cn], outs))
         elif prim == "convert_element_type":
             dt_name = str(np.dtype(p["new_dtype"]))
-            to = _DT.get(dt_name, 1 if dt_name == "bfloat16" else None)
+            to = _DT.get(dt_name)   # bfloat16 hits the real enum (16)
             if to is None:
                 raise NotImplementedError(
                     f"onnx export: Cast to unmapped dtype {dt_name}")
