@@ -100,14 +100,32 @@ def _write_files(path, rank, shards, meta, coordinator_rank):
     from ... import _native
     io = _native.io_lib()
     if io is not None and blobs:
-        # per-blob writes at their header offsets: no b"".join — a
-        # concatenated copy would double peak host memory on multi-GB
-        # payloads
+        # coalesce small blobs into a bounded (64 MiB) buffer so the
+        # small-parameter tail costs O(1) native write calls, while
+        # multi-GB tensors still stream without a full-payload join
         io.write(fname, prefix, 0, 1)
         pos = len(prefix)
+        buf, buf_pos = [], pos
+        FLUSH = 64 * 1024 * 1024
+
+        def flush():
+            nonlocal buf, buf_pos
+            if buf:
+                io.write(fname, b"".join(buf), buf_pos, 8)
+                buf = []
+
         for raw in blobs:
-            io.write(fname, raw, pos, 8)
+            if len(raw) >= FLUSH:
+                flush()
+                io.write(fname, raw, pos, 8)
+            else:
+                if not buf:
+                    buf_pos = pos
+                buf.append(raw)
+                if sum(len(b) for b in buf) >= FLUSH:
+                    flush()
             pos += len(raw)
+        flush()
     else:
         with open(fname, "wb") as f:
             f.write(prefix)

@@ -1,0 +1,138 @@
+"""ERNIE family — the reference ecosystem's flagship NLP encoder
+(PaddleNLP ErnieModel; reference nn stack as for BERT).
+
+Architecturally a BERT-style post-LN encoder plus ERNIE's TASK-TYPE
+embedding (continual multi-task pretraining) — the encoder blocks are
+shared with models/bert.py (same TPU-native path: bf16 compute dtype
+via nn.set_compute_dtype, packed flash attention, fused CE).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.tensor import Tensor
+from ..framework.dispatch import run, to_tensor_args
+from .bert import (BertLayer, BertConfig, BertModel, BertForMaskedLM)
+
+__all__ = ["ErnieConfig", "ErnieModel",
+           "ErnieForSequenceClassification", "ErnieForMaskedLM",
+           "ernie_tiny_config"]
+
+
+@dataclass
+class ErnieConfig(BertConfig):
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+
+
+def ernie_tiny_config(**kw):
+    cfg = ErnieConfig(vocab_size=128, hidden_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      intermediate_size=128,
+                      max_position_embeddings=64)
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+class ErnieEmbeddings(nn.Layer):
+    """word + position + token-type + TASK-TYPE embeddings (the
+    task-type table is what distinguishes ERNIE's input layer)."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.word_embeddings = nn.Embedding(config.vocab_size, h)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, h)
+        self.token_type_embeddings = nn.Embedding(
+            config.type_vocab_size, h)
+        self.task_type_embeddings = nn.Embedding(
+            config.task_type_vocab_size, h) if config.use_task_id \
+            else None
+        self.layer_norm = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None):
+        (input_ids,) = to_tensor_args(input_ids)
+        seq = input_ids.shape[1]
+        pos = Tensor(jnp.arange(seq, dtype=jnp.int32)[None, :])
+        x = self.word_embeddings(input_ids) \
+            + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        if self.task_type_embeddings is not None:
+            if task_type_ids is None:
+                task_type_ids = Tensor(jnp.zeros(
+                    (1, seq), jnp.int32))
+            x = x + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class ErnieModel(BertModel):
+    """Reference surface: ErnieModel(input_ids, token_type_ids,
+    position_ids, attention_mask, task_type_ids) →
+    (sequence_output, pooled_output).  Subclasses BertModel — the
+    encoder/pooler are SHARED code; only the embeddings (task-type
+    table) and their threading differ."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__(config)
+        self.embeddings = ErnieEmbeddings(config)
+        if config.dtype != "float32":
+            nn.set_compute_dtype(self.embeddings, config.dtype)
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None, task_type_ids=None):
+        x = self.embeddings(input_ids, token_type_ids, task_type_ids)
+        for layer in self.layers:
+            x = layer(x, attention_mask)
+        pooled = nn.functional.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+        if config.dtype != "float32":
+            nn.set_compute_dtype(self, config.dtype)
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None, task_type_ids=None):
+        _, pooled = self.ernie(input_ids, token_type_ids,
+                               attention_mask, task_type_ids)
+        return self.classifier(self.dropout(pooled))
+
+    def compute_loss(self, logits, labels):
+        return nn.functional.cross_entropy(logits, labels)
+
+
+class ErnieForMaskedLM(BertForMaskedLM):
+    """MLM head SHARED with BertForMaskedLM (transform + tied decoder +
+    fused picked-logit CE) — only the backbone and the task-id
+    threading differ."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__(config)
+        self.bert = ErnieModel(config)      # replace the BERT backbone
+        self.ernie = self.bert              # reference attribute name
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None, task_type_ids=None):
+        seq_out, _ = self.bert(input_ids, token_type_ids,
+                               attention_mask, task_type_ids)
+        x = self.transform_norm(nn.functional.gelu(
+            self.transform(seq_out),
+            approximate=self.config.hidden_act == "gelu_tanh"))
+        w = self.bert.embeddings.word_embeddings.weight
+        return run(lambda v, e, b: v @ e.T.astype(v.dtype)
+                   + b.astype(v.dtype),
+                   *to_tensor_args(x, w, self.decoder_bias),
+                   name="ernie_mlm_decoder")

@@ -18,3 +18,5 @@ from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_25,  # noqa: F401
                            shufflenet_v2_x2_0, shufflenet_v2_swish)
 from .googlenet import GoogLeNet, googlenet  # noqa: F401
 from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401
+from .vit import (VisionTransformer, vit_b_16,  # noqa: F401
+                  vit_s_16, vit_tiny_patch4)

@@ -1,0 +1,130 @@
+"""Vision Transformer.
+
+Reference capability: the reference ecosystem ships ViT through
+PaddleClas/paddle.vision extensions built on `nn.TransformerEncoder`
+(python/paddle/nn/layer/transformer.py).  TPU-native build: patchify is
+ONE conv (= unfold+matmul fused on the MXU), the encoder is pre-LN
+blocks whose attention dispatches through paddle_tpu.ops.attention
+(Pallas flash path on TPU — ViT's s=197-ish MHA hits the packed
+single-block kernel, see ops/pallas/flash_attention._fwd_1b), and the
+whole forward jits into a single XLA program.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ... import nn
+from ...framework.tensor import Tensor, Parameter
+from ...framework.dispatch import run, to_tensor_args
+from ... import ops as tpu_ops
+
+__all__ = ["VisionTransformer", "vit_b_16", "vit_s_16", "vit_tiny_patch4"]
+
+
+class _MHA(nn.Layer):
+    """Encoder self-attention over [B, N, D] token streams."""
+
+    def __init__(self, dim, num_heads):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.qkv = nn.Linear(dim, dim * 3)
+        self.proj = nn.Linear(dim, dim)
+
+    def forward(self, x):
+        nh, hd = self.num_heads, self.head_dim
+        qkv = self.qkv(x)
+        (qkv,) = to_tensor_args(qkv)
+
+        def _fn(v):
+            b, n, _ = v.shape
+            q, k, va = jnp.split(v.reshape(b, n, 3, nh, hd)
+                                 .transpose(2, 0, 1, 3, 4), 3, axis=0)
+            out = tpu_ops.attention(q[0], k[0], va[0], causal=False)
+            return out.reshape(b, n, nh * hd)
+        return self.proj(run(_fn, qkv, name="vit_attention"))
+
+
+class _Block(nn.Layer):
+    """Pre-LN transformer block (ViT standard)."""
+
+    def __init__(self, dim, num_heads, mlp_ratio=4.0):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn = _MHA(dim, num_heads)
+        self.norm2 = nn.LayerNorm(dim)
+        hidden = int(dim * mlp_ratio)
+        self.fc1 = nn.Linear(dim, hidden)
+        self.fc2 = nn.Linear(hidden, dim)
+
+    def forward(self, x):
+        x = x + self.attn(self.norm1(x))
+        h = nn.functional.gelu(self.fc1(self.norm2(x)),
+                               approximate=True)
+        return x + self.fc2(h)
+
+
+class VisionTransformer(nn.Layer):
+    def __init__(self, image_size=224, patch_size=16, embed_dim=768,
+                 depth=12, num_heads=12, mlp_ratio=4.0,
+                 num_classes=1000, in_channels=3):
+        super().__init__()
+        assert image_size % patch_size == 0
+        n_patches = (image_size // patch_size) ** 2
+        self.patch_embed = nn.Conv2D(in_channels, embed_dim,
+                                     kernel_size=patch_size,
+                                     stride=patch_size)
+        from ...nn.initializer import Normal
+        self.cls_token = Parameter(
+            jnp.zeros([1, 1, embed_dim], jnp.float32))
+        # framework RNG (paddle.seed-controlled), same init law as ViT
+        self.pos_embed = Parameter(Normal(0.0, 0.02)(
+            (1, n_patches + 1, embed_dim), "float32"))
+        self.blocks = nn.LayerList(
+            [_Block(embed_dim, num_heads, mlp_ratio)
+             for _ in range(depth)])
+        self.norm = nn.LayerNorm(embed_dim)
+        self.head = nn.Linear(embed_dim, num_classes)
+
+    def forward(self, x):
+        x = self.patch_embed(x)                      # [B, D, H', W']
+        (x,) = to_tensor_args(x)
+        cls_t, pos = self.cls_token, self.pos_embed
+
+        def _fn(v, cls_v, pos_v):
+            b, d = v.shape[0], v.shape[1]
+            tok = v.reshape(b, d, -1).transpose(0, 2, 1)   # [B, N, D]
+            cls = jnp.broadcast_to(cls_v.astype(tok.dtype),
+                                   (b, 1, d))
+            return jnp.concatenate([cls, tok], axis=1) \
+                + pos_v.astype(tok.dtype)
+        x = run(_fn, x, cls_t, pos, name="vit_embed")
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        return self.head(x[:, 0])
+
+
+def vit_b_16(**kw):
+    cfg = dict(image_size=224, patch_size=16, embed_dim=768, depth=12,
+               num_heads=12)
+    cfg.update(kw)
+    return VisionTransformer(**cfg)
+
+
+def vit_s_16(**kw):
+    cfg = dict(image_size=224, patch_size=16, embed_dim=384, depth=12,
+               num_heads=6)
+    cfg.update(kw)
+    return VisionTransformer(**cfg)
+
+
+def vit_tiny_patch4(**kw):
+    """Test-scale ViT (32x32 input, 4x4 patches)."""
+    cfg = dict(image_size=32, patch_size=4, embed_dim=64, depth=2,
+               num_heads=4, num_classes=10)
+    cfg.update(kw)
+    return VisionTransformer(**cfg)

@@ -51,20 +51,21 @@ _SLOW_MODULES = {
 _FAST_PICKS = {
     "test_elastic": "test_elastic_exit_code_triggers_reform",
     "test_launch": "test_two_procs_env_wiring",
-    "test_rpc": None,                       # covered by collectives pick
+    "test_rpc": "test_rpc_two_workers",
     "test_vision_models": "test_forward_shape[squeezenet1_1]",
-    "test_unet": None,
-    "test_gpt": None,                       # llama covered in fast mods
+    "test_unet": "test_unet_forward_shape",
+    "test_gpt": "test_gpt_trains",
     "test_moe": "test_naive_gate_dense_path_equals_dense",
     "test_pipeline": "test_pp_loss_matches_single_device[2-4-1F1B]",
-    "test_recompute": None,
-    "test_long_context": None,
+    "test_recompute": "test_matches_plain_backward",
+    "test_long_context":
+        "test_sequence_parallel_linear_pair_matches_dense",
     "test_generation": "test_prefill_matches_full_forward",
     "test_distributed": "test_dp_matches_single",
     "test_op_registry": "test_registry_op_output[affine_channel]",
-    "test_distribution": None,
-    "test_pallas_kernels": None,
-    "test_eager_collectives": None,
+    "test_distribution": "test_sample_moments[normal]",
+    "test_pallas_kernels": "test_forward[False]",
+    "test_eager_collectives": "test_group_scoped_collectives_4proc",
 }
 
 

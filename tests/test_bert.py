@@ -66,3 +66,52 @@ def test_set_compute_dtype_counts_and_grad():
     loss.backward()
     g = m[0].weight.grad
     assert g is not None and str(g.value.dtype) == "float32"
+
+
+# -- ERNIE family (round 4) -------------------------------------------------
+def test_ernie_forward_and_task_embeddings():
+    from paddle_tpu.models.ernie import ErnieModel, ernie_tiny_config
+    paddle.seed(0)
+    cfg = ernie_tiny_config()
+    m = ErnieModel(cfg)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                       (2, 12)).astype(np.int32))
+    seq, pooled = m(ids)
+    assert tuple(seq.shape) == (2, 12, cfg.hidden_size)
+    # task-type ids change the representation (the ERNIE-specific table)
+    task = paddle.to_tensor(np.ones((2, 12), np.int32))
+    seq2, _ = m(ids, task_type_ids=task)
+    assert not np.allclose(np.asarray(seq.value),
+                           np.asarray(seq2.value))
+
+
+def test_ernie_classifier_trains():
+    from paddle_tpu.models.ernie import (ErnieForSequenceClassification,
+                                         ernie_tiny_config)
+    from paddle_tpu.jit import TrainStep
+    paddle.seed(0)
+    m = ErnieForSequenceClassification(ernie_tiny_config(),
+                                       num_classes=2)
+    opt = paddle.optimizer.AdamW(5e-3, parameters=m.parameters())
+    step = TrainStep(m, lambda o, y: m.compute_loss(o, y), opt)
+    rng = np.random.RandomState(1)
+    ids = paddle.to_tensor(rng.randint(0, 128, (8, 12)).astype(np.int32))
+    y = paddle.to_tensor(rng.randint(0, 2, (8,)).astype(np.int64))
+    losses = [float(np.asarray(step(ids, y).value)) for _ in range(10)]
+    assert losses[-1] < losses[0]
+
+
+def test_ernie_mlm_bf16_compute():
+    from paddle_tpu.models.ernie import ErnieForMaskedLM, ernie_tiny_config
+    paddle.seed(0)
+    cfg = ernie_tiny_config(dtype="bfloat16")
+    m = ErnieForMaskedLM(cfg)
+    for n, p in m.state_dict().items():
+        assert str(p.value.dtype) == "float32", n   # fp32 masters
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 12)).astype(np.int32))
+    logits = m(ids)
+    assert str(logits.value.dtype) == "bfloat16"
+    loss = m.compute_loss(logits, ids)
+    assert np.isfinite(float(np.asarray(loss.value)))

@@ -99,3 +99,33 @@ class TestRound3Zoo:
         y = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
         losses = [float(np.asarray(step(x, y).value)) for _ in range(4)]
         assert losses[-1] < losses[0]
+
+
+class TestViT:
+    """Round-4 addition: Vision Transformer (patchify conv + pre-LN
+    encoder over ops.attention)."""
+
+    def test_forward_shape(self):
+        from paddle_tpu.vision.models import vit_tiny_patch4
+        paddle.seed(0)
+        m = vit_tiny_patch4()
+        m.eval()
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 3, 32, 32).astype(np.float32))
+        out = m(x)
+        assert tuple(out.shape) == (2, 10)
+
+    def test_trains(self):
+        from paddle_tpu.vision.models import vit_tiny_patch4
+        from paddle_tpu.jit import TrainStep
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        m = vit_tiny_patch4(num_classes=4)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        step = TrainStep(m, lambda o, y:
+                         nn.functional.cross_entropy(o, y), opt)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 3, 32, 32).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+        losses = [float(np.asarray(step(x, y).value)) for _ in range(6)]
+        assert losses[-1] < losses[0]
