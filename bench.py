@@ -329,6 +329,9 @@ def bench_unet():
     paddle.seed(0)
     if on_tpu:
         cfg = unet_sd_config()
+        # r4: bf16 compute (fp32 masters) via nn.set_compute_dtype —
+        # convs on the MXU at full bf16 rate
+        cfg.dtype = os.environ.get("BENCH_UNET_DTYPE", "bfloat16")
         batch, hw, ctx_len, steps = 8, 64, 77, 6
     else:
         cfg = unet_tiny_config()

@@ -105,14 +105,14 @@ def _write_files(path, rank, shards, meta, coordinator_rank):
         # multi-GB tensors still stream without a full-payload join
         io.write(fname, prefix, 0, 1)
         pos = len(prefix)
-        buf, buf_pos = [], pos
+        buf, buf_pos, buf_size = [], pos, 0
         FLUSH = 64 * 1024 * 1024
 
         def flush():
-            nonlocal buf, buf_pos
+            nonlocal buf, buf_size
             if buf:
                 io.write(fname, b"".join(buf), buf_pos, 8)
-                buf = []
+                buf, buf_size = [], 0
 
         for raw in blobs:
             if len(raw) >= FLUSH:
@@ -122,7 +122,8 @@ def _write_files(path, rank, shards, meta, coordinator_rank):
                 if not buf:
                     buf_pos = pos
                 buf.append(raw)
-                if sum(len(b) for b in buf) >= FLUSH:
+                buf_size += len(raw)
+                if buf_size >= FLUSH:
                     flush()
             pos += len(raw)
         flush()

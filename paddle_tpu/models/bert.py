@@ -157,10 +157,13 @@ class BertModel(nn.Layer):
     """Reference surface: paddlenlp BertModel(input_ids, token_type_ids,
     attention_mask) -> (sequence_output, pooled_output)."""
 
+    embeddings_cls: type = None   # subclass hook (ERNIE task-type table)
+
     def __init__(self, config: BertConfig):
         super().__init__()
         self.config = config
-        self.embeddings = BertEmbeddings(config)
+        emb_cls = type(self).embeddings_cls or BertEmbeddings
+        self.embeddings = emb_cls(config)
         self.layers = nn.LayerList(
             [BertLayer(config) for _ in range(config.num_hidden_layers)])
         self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
@@ -178,10 +181,12 @@ class BertModel(nn.Layer):
 class BertForMaskedLM(nn.Layer):
     """MLM head: dense + gelu + LN + tied-embedding decoder."""
 
+    backbone_cls: type = None     # subclass hook (ERNIE backbone)
+
     def __init__(self, config: BertConfig):
         super().__init__()
         self.config = config
-        self.bert = BertModel(config)
+        self.bert = (type(self).backbone_cls or BertModel)(config)
         self.transform = nn.Linear(config.hidden_size, config.hidden_size)
         self.transform_norm = nn.LayerNorm(config.hidden_size,
                                            epsilon=config.layer_norm_eps)

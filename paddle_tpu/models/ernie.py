@@ -57,10 +57,12 @@ class ErnieEmbeddings(nn.Layer):
         self.layer_norm = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
-    def forward(self, input_ids, token_type_ids=None, task_type_ids=None):
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None,
+                position_ids=None):
         (input_ids,) = to_tensor_args(input_ids)
         seq = input_ids.shape[1]
-        pos = Tensor(jnp.arange(seq, dtype=jnp.int32)[None, :])
+        pos = position_ids if position_ids is not None else \
+            Tensor(jnp.arange(seq, dtype=jnp.int32)[None, :])
         x = self.word_embeddings(input_ids) \
             + self.position_embeddings(pos)
         if token_type_ids is not None:
@@ -80,15 +82,13 @@ class ErnieModel(BertModel):
     encoder/pooler are SHARED code; only the embeddings (task-type
     table) and their threading differ."""
 
-    def __init__(self, config: ErnieConfig):
-        super().__init__(config)
-        self.embeddings = ErnieEmbeddings(config)
-        if config.dtype != "float32":
-            nn.set_compute_dtype(self.embeddings, config.dtype)
+    embeddings_cls = ErnieEmbeddings   # consumed by BertModel.__init__
 
     def forward(self, input_ids, token_type_ids=None,
-                attention_mask=None, task_type_ids=None):
-        x = self.embeddings(input_ids, token_type_ids, task_type_ids)
+                position_ids=None, attention_mask=None,
+                task_type_ids=None):
+        x = self.embeddings(input_ids, token_type_ids, task_type_ids,
+                            position_ids=position_ids)
         for layer in self.layers:
             x = layer(x, attention_mask)
         pooled = nn.functional.tanh(self.pooler(x[:, 0]))
@@ -119,9 +119,10 @@ class ErnieForMaskedLM(BertForMaskedLM):
     fused picked-logit CE) — only the backbone and the task-id
     threading differ."""
 
+    backbone_cls = ErnieModel              # consumed by BertForMaskedLM
+
     def __init__(self, config: ErnieConfig):
         super().__init__(config)
-        self.bert = ErnieModel(config)      # replace the BERT backbone
         self.ernie = self.bert              # reference attribute name
 
     def forward(self, input_ids, token_type_ids=None,

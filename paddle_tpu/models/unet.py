@@ -204,6 +204,10 @@ class UNet2DConditionModel(nn.Layer):
         self.norm_out = nn.GroupNorm(g, chans[0])
         self.conv_out = nn.Conv2D(chans[0], cfg.out_channels, 3,
                                   padding=1)
+        if cfg.dtype != "float32":
+            # flax idiom: fp32 params as masters, convs/linears/norm
+            # outputs in the compute dtype (nn.set_compute_dtype)
+            nn.set_compute_dtype(self, cfg.dtype)
 
     def _skip_channels(self):
         cfg = self.config

@@ -16,6 +16,11 @@ def _tuplize(v, n):
 
 
 class _ConvNd(Layer):
+    # flax-idiom mixed precision (see nn.set_compute_dtype): fp32
+    # params are the masters; the conv runs in the compute dtype with
+    # the casts fused into the convolution by XLA
+    _compute_dtype = None
+
     def __init__(self, in_channels, out_channels, kernel_size, nd,
                  stride=1, padding=0, dilation=1, groups=1,
                  padding_mode="zeros", weight_attr=None, bias_attr=None,
@@ -58,11 +63,19 @@ class _ConvNd(Layer):
                (3, False): F.conv3d, (1, True): F.conv1d_transpose,
                (2, True): F.conv2d_transpose, (3, True): F.conv3d_transpose}
         fn = fns[(self._nd, self._transpose)]
+        weight, bias = self.weight, self.bias
+        if self._compute_dtype is not None:
+            # same arg lists as below, just with casted operands — the
+            # casts fuse into the convolution under XLA
+            cd = self._compute_dtype
+            x = x.astype(cd) if hasattr(x, "astype") else x
+            weight = weight.astype(cd)
+            bias = bias.astype(cd) if bias is not None else None
         if self._transpose:
-            return fn(x, self.weight, self.bias, self._stride, self._padding,
+            return fn(x, weight, bias, self._stride, self._padding,
                       self._output_padding, self._groups, self._dilation,
                       None, self._data_format)
-        return fn(x, self.weight, self.bias, self._stride, self._padding,
+        return fn(x, weight, bias, self._stride, self._padding,
                   self._dilation, self._groups, self._data_format)
 
     def extra_repr(self):

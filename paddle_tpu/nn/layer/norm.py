@@ -186,9 +186,14 @@ class GroupNorm(Layer):
         self.bias = None if bias_attr is False else self.create_parameter(
             shape=[num_channels], attr=bias_attr, is_bias=True)
 
+    _compute_dtype = None
+
     def forward(self, input):
-        return F.group_norm(input, self._num_groups, self._epsilon,
-                            self.weight, self.bias, self._data_format)
+        out = F.group_norm(input, self._num_groups, self._epsilon,
+                           self.weight, self.bias, self._data_format)
+        if self._compute_dtype is not None:
+            out = out.astype(self._compute_dtype)
+        return out
 
 
 class _InstanceNormBase(Layer):

@@ -11,13 +11,10 @@ whole forward jits into a single XLA program.
 """
 from __future__ import annotations
 
-import math
-
-import numpy as np
 import jax.numpy as jnp
 
 from ... import nn
-from ...framework.tensor import Tensor, Parameter
+from ...framework.tensor import Parameter
 from ...framework.dispatch import run, to_tensor_args
 from ... import ops as tpu_ops
 

@@ -39,3 +39,26 @@ def test_unet_trains():
               for _ in range(6)]
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_unet_bf16_compute_dtype():
+    """cfg.dtype='bfloat16' → fp32 master params, bf16 conv/linear
+    compute (nn.set_compute_dtype now covers _ConvNd/GroupNorm)."""
+    from paddle_tpu.models.unet import UNet2DConditionModel, unet_tiny_config
+    paddle.seed(0)
+    cfg = unet_tiny_config()
+    cfg.dtype = "bfloat16"
+    m = UNet2DConditionModel(cfg)
+    for n, p in m.state_dict().items():
+        assert str(p.value.dtype) == "float32", n
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(1, cfg.in_channels, 16, 16)
+                         .astype(np.float32))
+    t = paddle.to_tensor(np.array([3], np.int32))
+    ctx = paddle.to_tensor(rng.randn(1, 4, cfg.cross_attention_dim)
+                           .astype(np.float32))
+    out = m(x, t, ctx)
+    assert str(out.value.dtype) == "bfloat16"
+    eps = paddle.to_tensor(rng.randn(*out.shape).astype(np.float32))
+    loss = m.compute_loss(out, eps)
+    assert np.isfinite(float(np.asarray(loss.value)))
