@@ -734,6 +734,200 @@ def build_extra(OpSpec, _n, _u, _rs, _seed_of):
         w = qw.astype(np.float32) * scale
         return (x @ w).astype(np.float32)
 
+    def sequence_mask_j(lengths, maxlen=None):
+        lengths = lengths.astype(jnp.int32)
+        m = int(maxlen) if maxlen is not None else int(lengths.max())
+        return (jnp.arange(m, dtype=jnp.int32)[None, :]
+                < lengths[:, None]).astype(jnp.int64)
+
+    def sequence_mask_np(lengths, maxlen=None):
+        lengths = lengths.astype(np.int64)
+        m = int(maxlen) if maxlen is not None else int(lengths.max())
+        return (np.arange(m)[None, :] < lengths[:, None]) \
+            .astype(np.int64)
+
+    def edit_distance_j(a, b, normalized=False):
+        """Levenshtein over two id sequences (reference edit_distance
+        op, per-pair form).  DP rows via lax.scan — compiled loop."""
+        a = a.astype(jnp.int32)
+        b = b.astype(jnp.int32)
+        n = b.shape[0]
+        row0 = jnp.arange(n + 1, dtype=jnp.float32)
+
+        def step(prev, ai):
+            def inner(carry, j):
+                left, prev_row = carry
+                sub = prev_row[j - 1] + (ai != b[j - 1])
+                val = jnp.minimum(jnp.minimum(left + 1,
+                                              prev_row[j] + 1), sub)
+                return (val, prev_row), val
+            (_, _), vals = jax.lax.scan(
+                inner, (prev[0] + 1.0, prev),
+                jnp.arange(1, n + 1))
+            row = jnp.concatenate([(prev[0] + 1.0)[None], vals])
+            return row, ()
+        row, _ = jax.lax.scan(step, row0, a)
+        d = row[-1]
+        return d / n if normalized else d
+
+    def edit_distance_np(a, b, normalized=False):
+        a, b = a.astype(np.int64), b.astype(np.int64)
+        m, n = len(a), len(b)
+        d = np.zeros((m + 1, n + 1), np.float32)
+        d[:, 0] = np.arange(m + 1)
+        d[0, :] = np.arange(n + 1)
+        for i in range(1, m + 1):
+            for j in range(1, n + 1):
+                d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                              d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+        out = d[m, n]
+        return np.float32(out / n) if normalized else np.float32(out)
+
+    def _roi_sample(x, roi, out_h, out_w, ratio):
+        """Average-pooled bilinear samples inside one box of one image
+        channelwise ([C, H, W] -> [C, out_h, out_w])."""
+        x0, y0, x1, y1 = roi[0], roi[1], roi[2], roi[3]
+        bh = (y1 - y0) / out_h
+        bw = (x1 - x0) / out_w
+        iy = jnp.arange(out_h, dtype=jnp.float32)
+        ix = jnp.arange(out_w, dtype=jnp.float32)
+        sy = jnp.arange(ratio, dtype=jnp.float32)
+        ys = y0 + (iy[:, None] + (sy[None, :] + 0.5) / ratio) * bh
+        xs = x0 + (ix[:, None] + (sy[None, :] + 0.5) / ratio) * bw
+        ys = ys.reshape(-1)                     # [out_h*ratio]
+        xs = xs.reshape(-1)
+        h, w = x.shape[-2], x.shape[-1]
+
+        def bilerp(yy, xx):
+            # reference kernel semantics: points beyond [-1, H]/[-1, W]
+            # contribute zero; in-range coords are CLAMPED before the
+            # weights are derived (no extrapolated >1 weights)
+            valid = ((yy > -1.0) & (yy < h) & (xx > -1.0) & (xx < w))
+            yy = jnp.clip(yy, 0.0, h - 1)
+            xx = jnp.clip(xx, 0.0, w - 1)
+            yy0 = jnp.floor(yy)
+            xx0 = jnp.floor(xx)
+            yy1 = jnp.clip(yy0 + 1, 0, h - 1)
+            xx1 = jnp.clip(xx0 + 1, 0, w - 1)
+            wy = yy - yy0
+            wx = xx - xx0
+            g = lambda a, b_: x[:, a.astype(jnp.int32),
+                                b_.astype(jnp.int32)]
+            out = (g(yy0, xx0) * (1 - wy) * (1 - wx)
+                   + g(yy0, xx1) * (1 - wy) * wx
+                   + g(yy1, xx0) * wy * (1 - wx)
+                   + g(yy1, xx1) * wy * wx)
+            return jnp.where(valid[None, :], out, 0.0)
+        grid_y, grid_x = jnp.meshgrid(ys, xs, indexing="ij")
+        vals = bilerp(grid_y.reshape(-1), grid_x.reshape(-1))
+        vals = vals.reshape(x.shape[0], out_h, ratio, out_w, ratio)
+        return vals.mean(axis=(2, 4))
+
+    def roi_align_j(x, boxes, boxes_num=None, output_size=2,
+                    spatial_scale=1.0, sampling_ratio=2, aligned=True):
+        """Reference vision/ops roi_align, single-image form: boxes
+        [K, 4] on x [1, C, H, W].  Batched x + boxes_num (the
+        reference's multi-image contract) is refused, not silently
+        pooled from image 0."""
+        if x.shape[0] != 1 or boxes_num is not None:
+            raise NotImplementedError(
+                "roi_align: single-image form only (x batch == 1, "
+                "boxes_num=None); split the batch at the call site")
+        off = 0.5 if aligned else 0.0
+        rois = boxes * spatial_scale - off
+        outs = jax.vmap(lambda r: _roi_sample(
+            x[0], r, output_size, output_size, sampling_ratio))(rois)
+        return outs                                # [K, C, oh, ow]
+
+    def roi_align_np(x, boxes, boxes_num=None, output_size=2,
+                     spatial_scale=1.0, sampling_ratio=2, aligned=True):
+        if x.shape[0] != 1 or boxes_num is not None:
+            raise NotImplementedError(
+                "roi_align: single-image form only")
+        off = 0.5 if aligned else 0.0
+        k = boxes.shape[0]
+        c, h, w = x.shape[1], x.shape[2], x.shape[3]
+        out = np.zeros((k, c, output_size, output_size), np.float32)
+        for bi in range(k):
+            x0, y0, x1, y1 = boxes[bi] * spatial_scale - off
+            bh = (y1 - y0) / output_size
+            bw = (x1 - x0) / output_size
+            for oy in range(output_size):
+                for ox in range(output_size):
+                    acc = np.zeros((c,), np.float64)
+                    for sy in range(sampling_ratio):
+                        for sx in range(sampling_ratio):
+                            yy = y0 + (oy + (sy + 0.5) / sampling_ratio) * bh
+                            xx = x0 + (ox + (sx + 0.5) / sampling_ratio) * bw
+                            if yy <= -1.0 or yy >= h or \
+                                    xx <= -1.0 or xx >= w:
+                                continue
+                            yy = min(max(yy, 0.0), h - 1)
+                            xx = min(max(xx, 0.0), w - 1)
+                            yy0 = int(np.floor(yy))
+                            xx0 = int(np.floor(xx))
+                            yy1 = min(yy0 + 1, h - 1)
+                            xx1 = min(xx0 + 1, w - 1)
+                            wy = yy - yy0
+                            wx = xx - xx0
+                            acc += (x[0, :, yy0, xx0] * (1 - wy) * (1 - wx)
+                                    + x[0, :, yy0, xx1] * (1 - wy) * wx
+                                    + x[0, :, yy1, xx0] * wy * (1 - wx)
+                                    + x[0, :, yy1, xx1] * wy * wx)
+                    out[bi, :, oy, ox] = acc / (sampling_ratio ** 2)
+        return out
+
+    def nms_j(boxes, scores, iou_threshold=0.5, max_out=None):
+        """Greedy NMS, compiled form: fixed max_out iterations of
+        argmax + suppress (reference vision/ops nms)."""
+        n = boxes.shape[0]
+        k = int(max_out) if max_out is not None else n
+        area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+        def iou(i, js):
+            x0 = jnp.maximum(boxes[i, 0], boxes[js, 0])
+            y0 = jnp.maximum(boxes[i, 1], boxes[js, 1])
+            x1 = jnp.minimum(boxes[i, 2], boxes[js, 2])
+            y1 = jnp.minimum(boxes[i, 3], boxes[js, 3])
+            inter = jnp.maximum(x1 - x0, 0) * jnp.maximum(y1 - y0, 0)
+            return inter / jnp.maximum(area[i] + area[js] - inter, 1e-9)
+
+        def body(carry, _):
+            live, _scores = carry
+            i = jnp.argmax(jnp.where(live, _scores, -jnp.inf))
+            any_live = live.any()
+            sel = jnp.where(any_live, i, -1)
+            ious = iou(i, jnp.arange(n))
+            live = live & (ious <= iou_threshold)
+            live = live.at[i].set(False)
+            live = live & any_live
+            return (live, _scores), sel
+        (_, _), picks = jax.lax.scan(
+            body, (jnp.ones((n,), bool), scores), None, length=k)
+        return picks.astype(jnp.int64)
+
+    def nms_np(boxes, scores, iou_threshold=0.5, max_out=None):
+        n = boxes.shape[0]
+        k = int(max_out) if max_out is not None else n
+        area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        live = np.ones(n, bool)
+        out = []
+        for _ in range(k):
+            if not live.any():
+                out.append(-1)
+                continue
+            i = int(np.argmax(np.where(live, scores, -np.inf)))
+            out.append(i)
+            x0 = np.maximum(boxes[i, 0], boxes[:, 0])
+            y0 = np.maximum(boxes[i, 1], boxes[:, 1])
+            x1 = np.minimum(boxes[i, 2], boxes[:, 2])
+            y1 = np.minimum(boxes[i, 3], boxes[:, 3])
+            inter = np.maximum(x1 - x0, 0) * np.maximum(y1 - y0, 0)
+            ious = inter / np.maximum(area[i] + area - inter, 1e-9)
+            live = live & (ious <= iou_threshold)
+            live[i] = False
+        return np.asarray(out, np.int64)
+
     def send_uv_j(x, y, src_index, dst_index, message_op="ADD"):
         """Graph per-edge message (reference geometric send_uv):
         out[e] = x[src[e]] (op) y[dst[e]]."""
@@ -878,6 +1072,24 @@ def build_extra(OpSpec, _n, _u, _rs, _seed_of):
                         _ints(0, 4, 10, seed_key="ap"),
                         minlength=4)).astype(np.int64)], {}),
           n_tensors=2, grad=False),
+        S("sequence_mask", sequence_mask_j, sequence_mask_np,
+          lambda: ([_ints(1, 7, 5, seed_key="sm")], {"maxlen": 8}),
+          grad=False),
+        S("edit_distance", edit_distance_j, edit_distance_np,
+          lambda: ([_ints(0, 5, 7, seed_key="ed_a"),
+                    _ints(0, 5, 9, seed_key="ed_b")], {}),
+          n_tensors=2, grad=False),
+        S("roi_align", roi_align_j, roi_align_np,
+          lambda: ([_n(1, 2, 8, 8),
+                    np.array([[1.0, 1.0, 6.0, 6.0],
+                              [0.0, 2.0, 4.0, 7.0]], np.float32)],
+                   {"output_size": 2}), n_tensors=2, grad=False,
+          atol=1e-3),
+        S("nms", nms_j, nms_np,
+          lambda: ([np.array([[0, 0, 4, 4], [1, 1, 5, 5],
+                              [8, 8, 12, 12]], np.float32),
+                    np.array([0.9, 0.8, 0.7], np.float32)],
+                   {"iou_threshold": 0.3}), n_tensors=2, grad=False),
         S("send_uv", send_uv_j, send_uv_np,
           lambda: ([_n(5, 4), _n(5, 4),
                     _ints(0, 5, 7, seed_key="suv_s"),
