@@ -32,9 +32,11 @@ __all__ = ["CollectiveController", "ProcEntry"]
 
 HEARTBEAT_INTERVAL = 2.0
 # lease TTL >> interval: a saturated host (parallel compiles, CI load)
-# can starve the heartbeat thread for several seconds, and a false
-# dead-peer verdict tears the gang down
-HEARTBEAT_TTL = 20.0
+# can starve the heartbeat thread for TENS of seconds — observed: a
+# full-suite run + XLA compiles starved a launcher past 20s and a
+# false dead-peer verdict tore the gang down.  Env-overridable so
+# latency-sensitive deployments can tighten it.
+HEARTBEAT_TTL = float(os.environ.get("PADDLE_HEARTBEAT_TTL", "45"))
 ELASTIC_SETTLE = 2.0   # absorb late joiners up to nnodes_max for this long
 # reference fleet/elastic/manager.py:33 — a child exiting with this code
 # asks the launcher to re-form the gang instead of counting a failure
@@ -226,9 +228,13 @@ class CollectiveController:
         committed = None
         # elastic jobs: a late joiner keeps its registration visible and
         # waits for the running gang to re-form around it (scale-out)
-        commit_deadline = time.time() + (
+        # deadline must EXCEED the heartbeat TTL: disambiguating a dead
+        # epoch (stale-but-unexpired leases) from a live one relies on
+        # outwaiting the leases (see the dead-epoch reap below)
+        commit_deadline = time.time() + max(
             a.elastic_timeout if self._is_elastic()
-            else max(30, ELASTIC_SETTLE * 5))
+            else max(30.0, ELASTIC_SETTLE * 5),
+            HEARTBEAT_TTL * 1.5)
         while committed is None:
             raw = self.kv.get(commit_key)
             if raw:

@@ -61,16 +61,19 @@ def _rewrap(tree, like):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def convert_ifelse(pred, true_fn, false_fn):
+def convert_ifelse(pred, true_fn, false_fn, args=()):
     """Reference: convert_operators.py convert_ifelse.  Tensor/tracer
     predicate → lax.cond over the branch outputs; Python predicate →
-    plain call."""
+    plain call.  `args` carries the pre-bound locals the branches read
+    or rebind — they are branch-function PARAMETERS because a nested
+    function that reads-then-writes a name cannot reach it by closure
+    (the write makes it local → UnboundLocalError)."""
     if not _is_traced_pred(pred):
         if isinstance(pred, Tensor):
             pred = bool(jax.device_get(pred._value))
-        return true_fn() if pred else false_fn()
-    t_out = true_fn()
-    f_out = false_fn()
+        return true_fn(*args) if pred else false_fn(*args)
+    t_out = true_fn(*args)
+    f_out = false_fn(*args)
     t_val, f_val = _unwrap(t_out), _unwrap(f_out)
     out = jax.lax.cond(_pred_value(pred), lambda: t_val, lambda: f_val)
     return _rewrap(out, t_out)
@@ -137,7 +140,10 @@ class _AssignedNames(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_FunctionDef(self, node):
-        pass  # nested defs own their scope
+        # a def BINDS its name in the enclosing scope; its body owns
+        # its own scope (not recursed)
+        if node.name not in self.names:
+            self.names.append(node.name)
 
 
 class _LoadedNames(ast.NodeVisitor):
@@ -150,10 +156,14 @@ class _LoadedNames(ast.NodeVisitor):
 
 
 def _assigned(stmts):
+    """Names a block binds — the transform's OWN synthesized helper
+    functions (__jst_*) are not user state and are excluded (they made
+    every converted inner-if look like a one-sided binding, refusing
+    the enclosing statement)."""
     v = _AssignedNames()
     for s in stmts:
         v.visit(s)
-    return v.names
+    return [n for n in v.names if not n.startswith("__jst_")]
 
 
 def _loaded(nodes):
@@ -226,8 +236,23 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         return out
 
     def _has_escape(self, stmts):
+        """True when the block itself can escape.  Nested function
+        bodies own their control flow — walking into them would see
+        the Returns of ALREADY-CONVERTED inner branches and falsely
+        refuse the enclosing statement."""
+        def walk_shallow(node):
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                yield from walk_shallow(child)
+
         for s in stmts:
-            for node in ast.walk(s):
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue            # a def statement is its own scope
+            for node in walk_shallow(s):
                 if isinstance(node, (ast.Return, ast.Break,
                                      ast.Continue, ast.Yield,
                                      ast.YieldFrom)):
@@ -235,8 +260,14 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         return False
 
     def visit_If(self, node):
+        # branch sub-visits must not pollute the enclosing bound-set:
+        # params/one-sided checks below are about names bound BEFORE
+        # this statement
+        outer = set(self._bound)
         node.body = self._visit_block(node.body)
+        self._bound = set(outer)
         node.orelse = self._visit_block(node.orelse)
+        self._bound = outer
         if self._has_escape(node.body) or self._has_escape(node.orelse):
             return node
         t_set, f_set = set(_assigned(node.body)), \
@@ -245,23 +276,32 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         if one_sided:
             return node  # a synthesized branch would read an unbound name
         assigned = sorted(t_set | f_set)
+        # pre-bound locals the branches touch become branch-fn
+        # PARAMETERS: a nested def that reads-then-writes a name makes
+        # it local, so closure capture alone raises UnboundLocalError
+        # (the bug that silently graph-broke every zoo model)
+        used = (t_set | f_set
+                | _loaded(node.body) | _loaded(node.orelse))
+        params = sorted(used & self._bound)
         t_name, f_name = _uniq("true"), _uniq("false")
         ret = ast.Return(value=ast.Tuple(
             elts=[ast.Name(id=n, ctx=ast.Load()) for n in assigned],
             ctx=ast.Load()))
         t_def = ast.FunctionDef(
-            name=t_name, args=_no_args(),
+            name=t_name, args=_names_args(params),
             body=(list(node.body) or [ast.Pass()]) + [ret],
             decorator_list=[])
         f_def = ast.FunctionDef(
-            name=f_name, args=_no_args(),
+            name=f_name, args=_names_args(params),
             body=(list(node.orelse) or [ast.Pass()]) + [ret],
             decorator_list=[])
         call = ast.Call(
             func=ast.Name(id="__jst_ifelse", ctx=ast.Load()),
             args=[node.test,
                   ast.Name(id=t_name, ctx=ast.Load()),
-                  ast.Name(id=f_name, ctx=ast.Load())],
+                  ast.Name(id=f_name, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in params], ctx=ast.Load())],
             keywords=[])
         if assigned:
             assign = ast.Assign(
@@ -275,7 +315,9 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         return [t_def, f_def, assign]
 
     def visit_While(self, node):
+        outer = set(self._bound)
         node.body = self._visit_block(node.body)
+        self._bound = outer
         if node.orelse or self._has_escape(node.body):
             return node
         assigned = set(_assigned(node.body))
@@ -310,12 +352,6 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                 ctx=ast.Store())],
             value=call)
         return [c_def, b_def, assign]
-
-
-def _no_args():
-    return ast.arguments(posonlyargs=[], args=[], vararg=None,
-                         kwonlyargs=[], kw_defaults=[], kwarg=None,
-                         defaults=[])
 
 
 def _names_args(names):

@@ -9,6 +9,10 @@ import os
 # force CPU unconditionally: unit tests must not burn (or depend on) the
 # real TPU; the driver's bench run uses the chip.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# deterministic fast lease-lapse in launcher/elastic tests (production
+# default is 45s for saturated-host robustness; tests simulate death
+# explicitly and need not wait that long)
+os.environ.setdefault("PADDLE_HEARTBEAT_TTL", "20")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -183,3 +183,63 @@ def test_branch_structure_mismatch_graph_breaks():
     with pytest.warns(RuntimeWarning, match="graph break"):
         out = f(x)
     np.testing.assert_allclose(np.asarray(out[1].value), [2.0])
+
+
+def test_branch_read_then_write_prebound_compiles():
+    """A branch that READS a pre-bound name and REBINDS it must compile
+    (regression: closure capture made the name local → UnboundLocal,
+    silently graph-breaking every vision-zoo forward)."""
+    @to_static
+    def f(x):
+        h = x * 2.0
+        if (h.sum() > 0):
+            h = h + 1.0
+        else:
+            h = h - 1.0
+        return h
+
+    pos = paddle.to_tensor(np.float32([1.0]))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")     # graph break would raise
+        np.testing.assert_allclose(np.asarray(f(pos).value), [3.0])
+
+
+def test_zoo_model_compiles_without_graph_break():
+    """mobilenet-style forward (loops + one-sided prebound ifs) must
+    jit cleanly under to_static."""
+    from paddle_tpu.vision.models import mobilenet_v3_small
+    paddle.seed(0)
+    m = mobilenet_v3_small(num_classes=4, scale=0.35)
+    m.eval()
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 3, 32, 32).astype(np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        out = to_static(m)(x)
+    assert tuple(out.shape) == (1, 4)
+
+
+def test_nested_tensor_if_converts():
+    """A tensor-if nested inside a tensor-if must convert fully — the
+    inner conversion's synthesized Returns must not make the outer
+    statement look escaping (review-found regression)."""
+    @to_static
+    def f(x):
+        y = x * 1.0
+        if (y.sum() > 0):
+            if (y.max() > 2.0):
+                y = y * 10.0
+            else:
+                y = y + 1.0
+        else:
+            y = y - 1.0
+        return y
+
+    big = paddle.to_tensor(np.float32([3.0]))
+    small = paddle.to_tensor(np.float32([1.0]))
+    neg = paddle.to_tensor(np.float32([-1.0]))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        np.testing.assert_allclose(np.asarray(f(big).value), [30.0])
+        np.testing.assert_allclose(np.asarray(f(small).value), [2.0])
+        np.testing.assert_allclose(np.asarray(f(neg).value), [-2.0])
