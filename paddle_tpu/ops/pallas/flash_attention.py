@@ -460,7 +460,7 @@ def _bwd_1b(scale, causal, gh, res, do3):
 # rows per head, DOUBLE-buffered by Mosaic, + ~4 concurrent fp32 s×s
 # intermediates (scores, p, dp + spill); the scoped limit is 16M so
 # leave real headroom
-ONE_BLOCK_BUDGET = 9 * 1024 * 1024
+ONE_BLOCK_BUDGET = int(__import__('os').environ.get('PD_FLASH_1B_BUDGET', 9 * 1024 * 1024))
 
 
 def _pick_gh(bh, sq, sk, d, esize):
