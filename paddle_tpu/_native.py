@@ -16,6 +16,8 @@ import hashlib
 import os
 import subprocess
 
+import numpy as np
+
 _CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
 
 
@@ -83,23 +85,36 @@ class _IoLib:
         cdll.pd_file_read.restype = ctypes.c_int
 
     @staticmethod
-    def _as_bytes(buf) -> bytes:
-        """bytes view for the C call — zero-copy when the caller already
-        holds bytes (the checkpoint payload path); an extra copy of a
-        multi-GB payload would double peak host memory."""
+    def _cbuf(buf):
+        """(owner, c-arg, nbytes) WITHOUT copying — an extra copy of a
+        multi-GB checkpoint payload would double peak host memory.  The
+        C side only READS the buffer (const), so read-only host
+        snapshots (np.asarray over a jax.Array) and ml_dtypes arrays
+        (no PEP-3118 export) pass by ADDRESS.  `owner` must stay
+        referenced for the duration of the C call."""
         if isinstance(buf, bytes):
-            return buf
-        return bytes(memoryview(buf))
+            return buf, buf, len(buf)
+        if isinstance(buf, bytearray):     # c_void_p rejects bytearray
+            return buf, (ctypes.c_char * len(buf)).from_buffer(buf), \
+                len(buf)
+        a = buf if isinstance(buf, np.ndarray) else \
+            np.asarray(memoryview(buf))
+        if not a.flags.c_contiguous:
+            a = np.ascontiguousarray(a)
+        return a, a.ctypes.data, a.nbytes
 
     def crc32(self, buf) -> int:
-        b = self._as_bytes(buf)
-        return int(self._lib.pd_crc32(b, len(b)))
+        owner, p, n = self._cbuf(buf)
+        v = int(self._lib.pd_crc32(p, n))
+        del owner                          # alive through the call
+        return v
 
     def write(self, path: str, buf, offset: int = 0,
               n_threads: int = 8) -> None:
-        b = self._as_bytes(buf)
-        rc = self._lib.pd_file_write(path.encode(), b, len(b),
+        owner, p, n = self._cbuf(buf)
+        rc = self._lib.pd_file_write(path.encode(), p, n,
                                      offset, n_threads)
+        del owner                          # alive through the call
         if rc != 0:
             raise OSError(f"pd_file_write({path}) failed rc={rc}")
 

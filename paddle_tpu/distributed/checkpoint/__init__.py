@@ -70,14 +70,20 @@ def _write_files(path, rank, shards, meta, coordinator_rank):
     off = 0
 
     def add(arr):
+        # keep the contiguous ARRAY, not a tobytes() copy — holding raw
+        # bytes for every tensor doubles peak host memory on multi-GB
+        # states; crc and the write both go through the buffer protocol
         nonlocal off
         arr = np.ascontiguousarray(arr)
-        raw = arr.tobytes()
-        ent = {"offset": off, "nbytes": len(raw),
+        # uint8 view (not a copy): ml_dtypes arrays (bfloat16/fp8)
+        # refuse PEP-3118 memoryview export, so downstream buffer
+        # consumers need a native-dtype view of the same bytes
+        u8 = arr.reshape(-1).view(np.uint8)
+        ent = {"offset": off, "nbytes": arr.nbytes,
                "dtype": str(arr.dtype), "shape": list(arr.shape),
-               "crc": zlib.crc32(raw) & 0xFFFFFFFF}
-        blobs.append(raw)
-        off += len(raw)
+               "crc": zlib.crc32(u8) & 0xFFFFFFFF}
+        blobs.append(u8)
+        off += arr.nbytes
         return ent
 
     for k, v in shards.items():
@@ -114,24 +120,24 @@ def _write_files(path, rank, shards, meta, coordinator_rank):
                 io.write(fname, b"".join(buf), buf_pos, 8)
                 buf, buf_size = [], 0
 
-        for raw in blobs:
-            if len(raw) >= FLUSH:
+        for arr in blobs:
+            if arr.nbytes >= FLUSH:
                 flush()
-                io.write(fname, raw, pos, 8)
+                io.write(fname, arr, pos, 8)   # zero-copy buffer write
             else:
                 if not buf:
                     buf_pos = pos
-                buf.append(raw)
-                buf_size += len(raw)
+                buf.append(arr)       # b"".join accepts uint8 views
+                buf_size += arr.nbytes
                 if buf_size >= FLUSH:
                     flush()
-            pos += len(raw)
+            pos += arr.nbytes
         flush()
     else:
         with open(fname, "wb") as f:
             f.write(prefix)
-            for raw in blobs:
-                f.write(raw)
+            for arr in blobs:
+                f.write(arr)          # uint8 views: buffer write, no copy
     if rank == coordinator_rank:
         with open(os.path.join(path, "metadata.json"), "w") as f:
             json.dump(meta, f)

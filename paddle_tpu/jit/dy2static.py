@@ -72,11 +72,35 @@ def convert_ifelse(pred, true_fn, false_fn, args=()):
         if isinstance(pred, Tensor):
             pred = bool(jax.device_get(pred._value))
         return true_fn(*args) if pred else false_fn(*args)
-    t_out = true_fn(*args)
-    f_out = false_fn(*args)
-    t_val, f_val = _unwrap(t_out), _unwrap(f_out)
-    out = jax.lax.cond(_pred_value(pred), lambda: t_val, lambda: f_val)
-    return _rewrap(out, t_out)
+
+    # The branch callables go INTO lax.cond so only the selected branch
+    # executes at runtime (guarded patterns like `if s > 0: y = x / s`
+    # must not evaluate x/0 on the untaken path).  Tensor/tracer leaves
+    # of `args` ride as cond operands; everything else (shapes, flags,
+    # modules) stays closed-over and static.
+    flat, treedef = jax.tree_util.tree_flatten(
+        args, is_leaf=lambda x: isinstance(x, Tensor))
+    dyn_mask = [isinstance(x, (Tensor, jax.Array, jax.core.Tracer))
+                for x in flat]
+    operands = [x._value if isinstance(x, Tensor) else x
+                for x, d in zip(flat, dyn_mask) if d]
+    out_like = []
+
+    def _branch(fn):
+        def run(dyn_vals):
+            it = iter(dyn_vals)
+            rebuilt = [(Tensor(next(it)) if isinstance(x, Tensor)
+                        else next(it)) if d else x
+                       for x, d in zip(flat, dyn_mask)]
+            r = fn(*jax.tree_util.tree_unflatten(treedef, rebuilt))
+            if not out_like:
+                out_like.append(r)
+            return _unwrap(r)
+        return run
+
+    out = jax.lax.cond(_pred_value(pred), _branch(true_fn),
+                       _branch(false_fn), operands)
+    return _rewrap(out, out_like[0])
 
 
 def convert_while_loop(cond_fn, body_fn, loop_vars: tuple):

@@ -115,6 +115,10 @@ class BertSelfAttention(nn.Layer):
         (q, k, v) = to_tensor_args(q, k, v)
         mask = attention_mask.value if isinstance(attention_mask, Tensor) \
             else attention_mask
+        if mask is not None and mask.ndim == 2:
+            # reference surface: [batch, seq] keep-mask (1=attend,
+            # 0=pad) → broadcastable bool [b, 1, 1, sk]
+            mask = (mask > 0)[:, None, None, :]
 
         def _fn(qv, kv, vv):
             b, s, h = qv.shape

@@ -106,8 +106,9 @@ class ErnieForSequenceClassification(nn.Layer):
 
     def forward(self, input_ids, token_type_ids=None,
                 attention_mask=None, task_type_ids=None):
-        _, pooled = self.ernie(input_ids, token_type_ids,
-                               attention_mask, task_type_ids)
+        _, pooled = self.ernie(input_ids, token_type_ids=token_type_ids,
+                               attention_mask=attention_mask,
+                               task_type_ids=task_type_ids)
         return self.classifier(self.dropout(pooled))
 
     def compute_loss(self, logits, labels):
@@ -127,8 +128,9 @@ class ErnieForMaskedLM(BertForMaskedLM):
 
     def forward(self, input_ids, token_type_ids=None,
                 attention_mask=None, task_type_ids=None):
-        seq_out, _ = self.bert(input_ids, token_type_ids,
-                               attention_mask, task_type_ids)
+        seq_out, _ = self.bert(input_ids, token_type_ids=token_type_ids,
+                               attention_mask=attention_mask,
+                               task_type_ids=task_type_ids)
         x = self.transform_norm(nn.functional.gelu(
             self.transform(seq_out),
             approximate=self.config.hidden_act == "gelu_tanh"))

@@ -86,6 +86,34 @@ def test_ernie_forward_and_task_embeddings():
                            np.asarray(seq2.value))
 
 
+def test_ernie_heads_thread_attention_mask_correctly():
+    """Regression (r4 advisor, high): the task heads passed backbone
+    args positionally, so attention_mask landed in position_ids.  An
+    all-ones mask must be a no-op; a real padding mask must change the
+    logits and task_type_ids must still reach the task table."""
+    from paddle_tpu.models.ernie import (ErnieForSequenceClassification,
+                                         ernie_tiny_config)
+    paddle.seed(0)
+    cfg = ernie_tiny_config()
+    m = ErnieForSequenceClassification(cfg, num_classes=2)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                       (2, 12)).astype(np.int32))
+    base = np.asarray(m(ids).value)
+    ones = paddle.to_tensor(np.ones((2, 12), np.float32))
+    np.testing.assert_allclose(
+        np.asarray(m(ids, attention_mask=ones).value), base,
+        rtol=2e-5, atol=2e-5)
+    pad = np.ones((2, 12), np.float32)
+    pad[:, 6:] = 0.0
+    masked = np.asarray(
+        m(ids, attention_mask=paddle.to_tensor(pad)).value)
+    assert not np.allclose(masked, base)
+    task = paddle.to_tensor(np.ones((2, 12), np.int32))
+    assert not np.allclose(
+        np.asarray(m(ids, task_type_ids=task).value), base)
+
+
 def test_ernie_classifier_trains():
     from paddle_tpu.models.ernie import (ErnieForSequenceClassification,
                                          ernie_tiny_config)

@@ -33,6 +33,46 @@ def test_tensor_ifelse_compiles():
                                    [-2.0, -3.0])
 
 
+def test_untaken_branch_does_not_execute():
+    """Regression (r4 advisor, medium): branches must go INTO lax.cond
+    so the untaken side never runs — `if s > 0: y = x / s` with s == 0
+    must not evaluate x/0 (which poisons gradients through the select
+    with NaN even though the false branch is chosen)."""
+    @to_static
+    def f(x, s):
+        if (s > 0):
+            y = x / s
+        else:
+            y = x * 0.0
+        return y.sum()
+
+    x = paddle.to_tensor(np.float32([1.0, 2.0]))
+    zero = paddle.to_tensor(np.float32(0.0))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert float(np.asarray(f(x, zero).value)) == 0.0
+    two = paddle.to_tensor(np.float32(2.0))
+    assert float(np.asarray(f(x, two).value)) == pytest.approx(1.5)
+
+    # gradient-level check via jax.grad over the transformed function:
+    # d/dx at s=0 must be exactly 0, not NaN-through-select
+    import jax
+    from paddle_tpu.jit.dy2static import ast_transform
+    from paddle_tpu.framework.tensor import Tensor
+
+    def g(x, s):
+        if (s > 0):
+            y = x / s
+        else:
+            y = x * 0.0
+        return y.sum()
+
+    tg = ast_transform(g)
+    grad = jax.grad(lambda xv, sv: tg(Tensor(xv), Tensor(sv))._value)
+    gv = np.asarray(grad(np.float32([1.0, 2.0]), np.float32(0.0)))
+    assert np.all(np.isfinite(gv)) and np.allclose(gv, 0.0)
+
+
 def test_tensor_while_loop_compiles():
     @to_static
     def f(x):
