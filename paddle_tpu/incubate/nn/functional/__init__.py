@@ -128,3 +128,13 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
     x = add(x, residual)
     d = x.shape[-1]
     return F.layer_norm(x, d, ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """Reference: incubate/nn/functional/fused_dropout_add.py — the
+    fused dropout(x)+y kernel.  TPU-native: XLA fuses the two ops; this
+    is the same single compiled kernel."""
+    from ....nn import functional as F
+    from ....tensor.math import add
+    return add(F.dropout(x, p, training=training, mode=mode), y)

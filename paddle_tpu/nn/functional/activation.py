@@ -169,9 +169,11 @@ def maxout(x, groups, axis=1, name=None):
     (x,) = to_tensor_args(x)
 
     def _fn(v):
+        # reference formula (activation.py:873): out channel i = max
+        # over the CONSECUTIVE group [g*i, g*i+g) → Co = Ci/groups
         shp = list(v.shape)
         c = shp[axis]
-        shp[axis:axis + 1] = [groups, c // groups]
+        shp[axis:axis + 1] = [c // groups, groups]
         return jnp.max(v.reshape(shp), axis=axis + 1)
     return run(_fn, x, name="maxout")
 

@@ -231,6 +231,31 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
              "trilinear": "linear", "bicubic": "cubic", "area": "linear",
              "cubic": "cubic"}[mode]
 
+    def _cubic_axis(v, ax, in_s, out_s):
+        # reference bicubic kernel: cubic convolution with A=-0.75
+        # (phi kernels/funcs/interpolate_function.h cubic_interp) —
+        # jax.image's "cubic" is the Keys A=-0.5 kernel, which is NOT
+        # what the reference (or torch/OpenCV) computes
+        A = -0.75
+        if align_corners:
+            pos = jnp.arange(out_s) * ((in_s - 1) / max(out_s - 1, 1))
+        else:
+            pos = (jnp.arange(out_s) + 0.5) * (in_s / out_s) - 0.5
+        lo = jnp.floor(pos).astype(jnp.int32)
+        t = (pos - lo).astype(v.dtype)
+        d = [1.0 + t, t, 1.0 - t, 2.0 - t]
+        w = [A * d[0] ** 3 - 5 * A * d[0] ** 2 + 8 * A * d[0] - 4 * A,
+             (A + 2) * d[1] ** 3 - (A + 3) * d[1] ** 2 + 1,
+             (A + 2) * d[2] ** 3 - (A + 3) * d[2] ** 2 + 1,
+             A * d[3] ** 3 - 5 * A * d[3] ** 2 + 8 * A * d[3] - 4 * A]
+        shp = [1] * v.ndim
+        shp[ax] = out_s
+        out = 0.0
+        for k in range(4):
+            idx = jnp.clip(lo - 1 + k, 0, in_s - 1)
+            out = out + jnp.take(v, idx, axis=ax) * w[k].reshape(shp)
+        return out
+
     def _fn(v):
         if chan_last:
             shape = (v.shape[0],) + tuple(out_size) + (v.shape[-1],)
@@ -238,6 +263,13 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             shape = v.shape[:2] + tuple(out_size)
         if jmode == "nearest":
             return jax.image.resize(v, shape, method="nearest")
+        sp_axes0 = list(range(1, 1 + nd)) if chan_last \
+            else list(range(2, 2 + nd))
+        if jmode == "cubic":
+            out = v
+            for ax_i, ax in enumerate(sp_axes0):
+                out = _cubic_axis(out, ax, v.shape[ax], out_size[ax_i])
+            return out
         # jax.image linear matches align_corners=False (half-pixel centers)
         if align_corners:
             # explicit gather for align_corners semantics

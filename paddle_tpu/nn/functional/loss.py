@@ -297,5 +297,65 @@ def log_loss(input, label, epsilon=1e-4, name=None):
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
-    raise NotImplementedError(
-        "ctc_loss lands with the audio subsystem (tracked in SURVEY §2.2)")
+    """Reference: nn/functional/loss.py:1908 (warpctc kernel) — takes
+    UNSCALED logits [T, B, C] ("a native softmax activation is
+    interlaced"), labels [B, L] padded, per-sample lengths.
+
+    TPU-native: the forward-algorithm alpha recursion in log space as
+    ONE lax.scan over time (static [B, 2L+1] state — no per-sample
+    Python control flow), gradients via autodiff instead of the
+    reference's hand-written warpctc backward.
+    """
+    log_probs, labels, input_lengths, label_lengths = to_tensor_args(
+        log_probs, labels, input_lengths, label_lengths)
+
+    def _fn(logits, lab, ilen, llen):
+        t_max, b, _ = logits.shape
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lab = lab.astype(jnp.int32)
+        ilen = ilen.astype(jnp.int32)
+        llen = llen.astype(jnp.int32)
+        s = 2 * lab.shape[1] + 1
+        ext = jnp.full((b, s), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        neg = jnp.float32(-1e30)
+        ext_lp = jnp.take_along_axis(
+            lp, ext[None, :, :].repeat(t_max, 0), axis=-1)  # [T, B, S]
+        alpha0 = jnp.full((b, s), neg)
+        alpha0 = alpha0.at[:, 0].set(ext_lp[0, :, 0])
+        if s > 1:
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.where(llen > 0, ext_lp[0, :, 1], neg))
+        can_skip = (ext != blank) & (ext != jnp.roll(ext, 2, axis=1))
+        can_skip = can_skip.at[:, :2].set(False)
+
+        def step(alpha, t):
+            a1 = jnp.concatenate(
+                [jnp.full((b, 1), neg), alpha[:, :-1]], 1)
+            a2 = jnp.concatenate(
+                [jnp.full((b, 2), neg), alpha[:, :-2]], 1)
+            a2 = jnp.where(can_skip, a2, neg)
+            new = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2) \
+                + ext_lp[t]
+            # samples shorter than t keep their final alpha
+            new = jnp.where((t < ilen)[:, None], new, alpha)
+            return new, None
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t_max))
+        rows = jnp.arange(b)
+        end = 2 * llen
+        last_blank = alpha[rows, end]
+        last_label = jnp.where(llen > 0,
+                               alpha[rows, jnp.maximum(end - 1, 0)],
+                               neg)
+        loss = -jnp.logaddexp(last_blank, last_label)
+        if norm_by_times:
+            loss = loss / jnp.maximum(ilen.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # reference: divide by label_lengths, then batch mean
+            return jnp.mean(loss / jnp.maximum(
+                llen.astype(jnp.float32), 1.0))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return run(_fn, log_probs, labels, input_lengths, label_lengths,
+               name="ctc_loss")

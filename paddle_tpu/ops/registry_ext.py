@@ -970,6 +970,438 @@ def build_extra(OpSpec, _n, _u, _rs, _seed_of):
         return p.astype(np.float32), low.astype(np.float32), \
             up.astype(np.float32)
 
+    # -- detection suite (reference: phi/kernels/cpu/{box_coder,
+    #    prior_box,yolo_box,generate_proposals}_kernel.cc) -------------
+    def _expand_ars(aspect_ratios, flip):
+        out = [1.0]
+        for ar in aspect_ratios:
+            if any(abs(ar - o) < 1e-6 for o in out):
+                continue
+            out.append(float(ar))
+            if flip:
+                out.append(1.0 / ar)
+        return out
+
+    def box_coder_j(prior_box, target_box, prior_box_var=None,
+                    code_type="encode_center_size", box_normalized=True,
+                    axis=0, variance=None):
+        """Reference: phi/kernels/cpu/box_coder_kernel.cc.  The optional
+        per-prior variance rides as the `prior_box_var` attr (array) —
+        the capability of the reference's third tensor input."""
+        add = 0.0 if box_normalized else 1.0
+        pw = prior_box[:, 2] - prior_box[:, 0] + add
+        ph = prior_box[:, 3] - prior_box[:, 1] + add
+        pcx = prior_box[:, 0] + pw / 2
+        pcy = prior_box[:, 1] + ph / 2
+        if code_type == "encode_center_size":
+            tw = target_box[:, 2] - target_box[:, 0] + add
+            th = target_box[:, 3] - target_box[:, 1] + add
+            tcx = (target_box[:, 2] + target_box[:, 0]) / 2
+            tcy = (target_box[:, 3] + target_box[:, 1]) / 2
+            ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+            oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+            out = jnp.stack([ox, oy, ow, oh], -1)
+            if prior_box_var is not None:
+                out = out / jnp.asarray(prior_box_var)[None, :, :]
+            elif variance:
+                out = out / jnp.asarray(variance, out.dtype)
+            return out
+        t = target_box            # decode: [row, col, 4]
+        if prior_box_var is not None:
+            v = jnp.asarray(prior_box_var)
+            var = v[None, :, :] if axis == 0 else v[:, None, :]
+        elif variance:
+            var = jnp.asarray(variance, t.dtype).reshape(1, 1, 4)
+        else:
+            var = jnp.ones((1, 1, 4), t.dtype)
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (a[None, :] for a in
+                                    (pw, ph, pcx, pcy))
+        else:
+            pw_, ph_, pcx_, pcy_ = (a[:, None] for a in
+                                    (pw, ph, pcx, pcy))
+        tcx = var[..., 0] * t[..., 0] * pw_ + pcx_
+        tcy = var[..., 1] * t[..., 1] * ph_ + pcy_
+        tw = jnp.exp(var[..., 2] * t[..., 2]) * pw_
+        th = jnp.exp(var[..., 3] * t[..., 3]) * ph_
+        return jnp.stack([tcx - tw / 2, tcy - th / 2,
+                          tcx + tw / 2 - add, tcy + th / 2 - add], -1)
+
+    def box_coder_np(prior_box, target_box, prior_box_var=None,
+                     code_type="encode_center_size", box_normalized=True,
+                     axis=0, variance=None):
+        add = 0.0 if box_normalized else 1.0
+        p = prior_box.astype(np.float64)
+        t = target_box.astype(np.float64)
+        if code_type == "encode_center_size":
+            rows, cols = t.shape[0], p.shape[0]
+            out = np.zeros((rows, cols, 4))
+            for i in range(rows):
+                for j in range(cols):
+                    pw = p[j, 2] - p[j, 0] + add
+                    ph = p[j, 3] - p[j, 1] + add
+                    pcx = p[j, 0] + pw / 2
+                    pcy = p[j, 1] + ph / 2
+                    tw = t[i, 2] - t[i, 0] + add
+                    th = t[i, 3] - t[i, 1] + add
+                    tcx = (t[i, 2] + t[i, 0]) / 2
+                    tcy = (t[i, 3] + t[i, 1]) / 2
+                    o = [(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         np.log(abs(tw / pw)), np.log(abs(th / ph))]
+                    for k in range(4):
+                        if prior_box_var is not None:
+                            o[k] /= prior_box_var[j, k]
+                        elif variance:
+                            o[k] /= variance[k]
+                    out[i, j] = o
+            return out.astype(np.float32)
+        rows, cols = t.shape[0], t.shape[1]
+        out = np.zeros((rows, cols, 4))
+        for i in range(rows):
+            for j in range(cols):
+                pi = j if axis == 0 else i
+                pw = p[pi, 2] - p[pi, 0] + add
+                ph = p[pi, 3] - p[pi, 1] + add
+                pcx = p[pi, 0] + pw / 2
+                pcy = p[pi, 1] + ph / 2
+                if prior_box_var is not None:
+                    v = prior_box_var[pi]
+                elif variance:
+                    v = variance
+                else:
+                    v = [1.0] * 4
+                cx = v[0] * t[i, j, 0] * pw + pcx
+                cy = v[1] * t[i, j, 1] * ph + pcy
+                w_ = np.exp(v[2] * t[i, j, 2]) * pw
+                h_ = np.exp(v[3] * t[i, j, 3]) * ph
+                out[i, j] = [cx - w_ / 2, cy - h_ / 2,
+                             cx + w_ / 2 - add, cy + h_ / 2 - add]
+        return out.astype(np.float32)
+
+    def _prior_wh(min_sizes, max_sizes, ars, order):
+        whs = []
+        for s, ms in enumerate(min_sizes):
+            if order:
+                whs.append((ms / 2.0, ms / 2.0))
+                if max_sizes:
+                    d = math.sqrt(ms * max_sizes[s]) / 2.0
+                    whs.append((d, d))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    whs.append((ms * math.sqrt(ar) / 2.0,
+                                ms / math.sqrt(ar) / 2.0))
+            else:
+                for ar in ars:
+                    whs.append((ms * math.sqrt(ar) / 2.0,
+                                ms / math.sqrt(ar) / 2.0))
+                if max_sizes:
+                    d = math.sqrt(ms * max_sizes[s]) / 2.0
+                    whs.append((d, d))
+        return whs
+
+    def prior_box_j(input, image, min_sizes=(64.0,), max_sizes=(),
+                    aspect_ratios=(1.0,), variances=(0.1, 0.1, 0.2, 0.2),
+                    flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+                    min_max_aspect_ratios_order=False):
+        """Reference: phi/kernels/cpu/prior_box_kernel.cc → (boxes,
+        variances), both [H, W, num_priors, 4]."""
+        fh, fw = input.shape[2], input.shape[3]
+        ih, iw = image.shape[2], image.shape[3]
+        sw = steps[0] or iw / fw
+        sh = steps[1] or ih / fh
+        ars = _expand_ars(aspect_ratios, flip)
+        whs = _prior_wh(list(min_sizes), list(max_sizes), ars,
+                        min_max_aspect_ratios_order)
+        p = len(whs)
+        cx = (jnp.arange(fw) + offset) * sw          # [W]
+        cy = (jnp.arange(fh) + offset) * sh          # [H]
+        bw = jnp.asarray([w for w, _ in whs])        # [P]
+        bh = jnp.asarray([h for _, h in whs])
+        x0 = (cx[None, :, None] - bw[None, None, :]) / iw
+        y0 = (cy[:, None, None] - bh[None, None, :]) / ih
+        x1 = (cx[None, :, None] + bw[None, None, :]) / iw
+        y1 = (cy[:, None, None] + bh[None, None, :]) / ih
+        boxes = jnp.stack(jnp.broadcast_arrays(
+            x0, y0, x1, y1), -1).astype(jnp.float32)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                               (fh, fw, p, 4))
+        return boxes, var
+
+    def prior_box_np(input, image, min_sizes=(64.0,), max_sizes=(),
+                     aspect_ratios=(1.0,),
+                     variances=(0.1, 0.1, 0.2, 0.2), flip=False,
+                     clip=False, steps=(0.0, 0.0), offset=0.5,
+                     min_max_aspect_ratios_order=False):
+        fh, fw = input.shape[2], input.shape[3]
+        ih, iw = image.shape[2], image.shape[3]
+        sw = steps[0] or iw / fw
+        sh = steps[1] or ih / fh
+        ars = _expand_ars(aspect_ratios, flip)
+        whs = _prior_wh(list(min_sizes), list(max_sizes), ars,
+                        min_max_aspect_ratios_order)
+        boxes = np.zeros((fh, fw, len(whs), 4), np.float32)
+        for h in range(fh):
+            for w in range(fw):
+                c_x = (w + offset) * sw
+                c_y = (h + offset) * sh
+                for k, (bw, bh) in enumerate(whs):
+                    boxes[h, w, k] = [(c_x - bw) / iw, (c_y - bh) / ih,
+                                      (c_x + bw) / iw, (c_y + bh) / ih]
+        if clip:
+            boxes = np.clip(boxes, 0.0, 1.0)
+        var = np.broadcast_to(np.asarray(variances, np.float32),
+                              boxes.shape).copy()
+        return boxes, var
+
+    def yolo_box_j(x, img_size, anchors=(10, 13, 16, 30),
+                   class_num=2, conf_thresh=0.01, downsample_ratio=32,
+                   clip_bbox=True, scale_x_y=1.0, iou_aware=False,
+                   iou_aware_factor=0.5):
+        """Reference: phi/kernels/cpu/yolo_box_kernel.cc → boxes
+        [N, an*H*W, 4] (anchor-major), scores [N, an*H*W, class_num];
+        sub-threshold entries are zeroed, matching the kernel's memset."""
+        n, _, h, w = x.shape
+        an = len(anchors) // 2
+        anc = jnp.asarray(anchors, jnp.float32).reshape(an, 2)
+        bias = -0.5 * (scale_x_y - 1.0)
+        if iou_aware:
+            iou = jax.nn.sigmoid(x[:, :an].reshape(n, an, h, w))
+            xr = x[:, an:].reshape(n, an, 5 + class_num, h, w)
+        else:
+            xr = x.reshape(n, an, 5 + class_num, h, w)
+        sig = jax.nn.sigmoid
+        img_h = img_size[:, 0].astype(jnp.float32).reshape(n, 1, 1, 1)
+        img_w = img_size[:, 1].astype(jnp.float32).reshape(n, 1, 1, 1)
+        gx = jnp.arange(w).reshape(1, 1, 1, w)
+        gy = jnp.arange(h).reshape(1, 1, h, 1)
+        bx = (gx + sig(xr[:, :, 0]) * scale_x_y + bias) * img_w / w
+        by = (gy + sig(xr[:, :, 1]) * scale_x_y + bias) * img_h / h
+        bw = jnp.exp(xr[:, :, 2]) * anc[:, 0].reshape(1, an, 1, 1) \
+            * img_w / (downsample_ratio * w)
+        bh = jnp.exp(xr[:, :, 3]) * anc[:, 1].reshape(1, an, 1, 1) \
+            * img_h / (downsample_ratio * h)
+        conf = sig(xr[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1.0 - iou_aware_factor) \
+                * iou ** iou_aware_factor
+        valid = conf >= conf_thresh
+        x0, y0 = bx - bw / 2, by - bh / 2
+        x1, y1 = bx + bw / 2, by + bh / 2
+        if clip_bbox:
+            x0 = jnp.maximum(x0, 0.0)
+            y0 = jnp.maximum(y0, 0.0)
+            x1 = jnp.minimum(x1, img_w - 1)
+            y1 = jnp.minimum(y1, img_h - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], -1) * valid[..., None]
+        cls = sig(xr[:, :, 5:])                     # [n, an, C, h, w]
+        scores = conf[:, :, None] * cls * valid[:, :, None]
+        return (boxes.reshape(n, an * h * w, 4),
+                scores.transpose(0, 1, 3, 4, 2)
+                .reshape(n, an * h * w, class_num))
+
+    def yolo_box_np(x, img_size, anchors=(10, 13, 16, 30),
+                    class_num=2, conf_thresh=0.01, downsample_ratio=32,
+                    clip_bbox=True, scale_x_y=1.0, iou_aware=False,
+                    iou_aware_factor=0.5):
+        def s(v):
+            return 1.0 / (1.0 + np.exp(-v))
+        n, _, h, w = x.shape
+        an = len(anchors) // 2
+        bias = -0.5 * (scale_x_y - 1.0)
+        boxes = np.zeros((n, an * h * w, 4), np.float32)
+        scores = np.zeros((n, an * h * w, class_num), np.float32)
+        for i in range(n):
+            ihh, iww = float(img_size[i, 0]), float(img_size[i, 1])
+            for j in range(an):
+                off = an if iou_aware else 0
+                for k in range(h):
+                    for l in range(w):
+                        e = lambda ent: x[i, off + j * (5 + class_num)
+                                          + ent, k, l]
+                        conf = s(e(4))
+                        if iou_aware:
+                            iou = s(x[i, j, k, l])
+                            conf = conf ** (1 - iou_aware_factor) \
+                                * iou ** iou_aware_factor
+                        idx = j * h * w + k * w + l
+                        if conf < conf_thresh:
+                            continue
+                        cx = (l + s(e(0)) * scale_x_y + bias) * iww / w
+                        cy = (k + s(e(1)) * scale_x_y + bias) * ihh / h
+                        bw = np.exp(e(2)) * anchors[2 * j] * iww \
+                            / (downsample_ratio * w)
+                        bh = np.exp(e(3)) * anchors[2 * j + 1] * ihh \
+                            / (downsample_ratio * h)
+                        b = [cx - bw / 2, cy - bh / 2,
+                             cx + bw / 2, cy + bh / 2]
+                        if clip_bbox:
+                            b = [max(b[0], 0), max(b[1], 0),
+                                 min(b[2], iww - 1), min(b[3], ihh - 1)]
+                        boxes[i, idx] = b
+                        for c in range(class_num):
+                            scores[i, idx, c] = conf * s(e(5 + c))
+        return boxes, scores
+
+    def _gp_anchors():
+        gy, gx = np.meshgrid(np.arange(4.0), np.arange(4.0),
+                             indexing="ij")
+        a = np.arange(3, dtype=np.float32).reshape(1, 1, 3)
+        x0 = gx.astype(np.float32)[:, :, None] * 8.0 + 0.0 * a
+        y0 = gy.astype(np.float32)[:, :, None] * 8.0 + 0.0 * a
+        return np.stack([x0, y0, x0 + 6.0 + 2.0 * a,
+                         y0 + 7.0 + 2.0 * a], -1).astype(np.float32)
+
+    _BBOX_CLIP = float(np.log(1000.0 / 16.0))
+
+    def generate_proposals_j(scores, bbox_deltas, im_shape, anchors,
+                             variances=None, pre_nms_top_n=12,
+                             post_nms_top_n=6, nms_thresh=0.5,
+                             min_size=0.1, eta=1.0, pixel_offset=False):
+        """Reference: phi/kernels/cpu/generate_proposals_kernel.cc,
+        single-image form (N == 1).  TPU-native contract: STATIC output
+        [post_nms_top_n, 4] padded with zeros + rois_num (XLA needs
+        static shapes; the reference's variable-length LoD output maps
+        to the padded form + count).  eta != 1 (adaptive NMS) is
+        refused, not approximated."""
+        assert scores.shape[0] == 1 and eta == 1.0
+        a_num = scores.shape[1]
+        s = scores[0].transpose(1, 2, 0).reshape(-1)
+        d = bbox_deltas[0].transpose(1, 2, 0).reshape(-1, 4)
+        anc = anchors.reshape(-1, 4)
+        var = None if variances is None else variances.reshape(-1, 4)
+        k = min(int(pre_nms_top_n), s.shape[0])
+        topv, topi = jax.lax.top_k(s, k)
+        d, anc = d[topi], anc[topi]
+        if var is not None:
+            var = var[topi]
+        off = 1.0 if pixel_offset else 0.0
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        acx = anc[:, 0] + 0.5 * aw
+        acy = anc[:, 1] + 0.5 * ah
+        v = var if var is not None else jnp.ones_like(anc)
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(v[:, 2] * d[:, 2], _BBOX_CLIP)) * aw
+        bh = jnp.exp(jnp.minimum(v[:, 3] * d[:, 3], _BBOX_CLIP)) * ah
+        props = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2 - off, cy + bh / 2 - off], -1)
+        im_h, im_w = im_shape[0, 0], im_shape[0, 1]
+        props = jnp.stack(
+            [jnp.clip(props[:, 0], 0.0, im_w - off),
+             jnp.clip(props[:, 1], 0.0, im_h - off),
+             jnp.clip(props[:, 2], 0.0, im_w - off),
+             jnp.clip(props[:, 3], 0.0, im_h - off)], -1)
+        ms = jnp.maximum(min_size, 1.0)
+        ws = props[:, 2] - props[:, 0] + off
+        hs = props[:, 3] - props[:, 1] + off
+        keep = (ws >= ms) & (hs >= ms)
+        if pixel_offset:
+            keep = keep & (props[:, 0] + ws / 2 <= im_w) \
+                & (props[:, 1] + hs / 2 <= im_h)
+        live_scores = jnp.where(keep, topv, -jnp.inf)
+        area = ws * hs
+
+        def iou(i, js):
+            xx0 = jnp.maximum(props[i, 0], props[js, 0])
+            yy0 = jnp.maximum(props[i, 1], props[js, 1])
+            xx1 = jnp.minimum(props[i, 2], props[js, 2])
+            yy1 = jnp.minimum(props[i, 3], props[js, 3])
+            inter = jnp.maximum(xx1 - xx0 + off, 0) \
+                * jnp.maximum(yy1 - yy0 + off, 0)
+            return inter / jnp.maximum(area[i] + area[js] - inter,
+                                       1e-10)
+
+        def body(carry, _):
+            live = carry
+            i = jnp.argmax(jnp.where(live, live_scores, -jnp.inf))
+            ok = (live & (live_scores > -jnp.inf)).any()
+            sel = jnp.where(ok, i, -1)
+            supp = iou(i, jnp.arange(props.shape[0])) > nms_thresh
+            live = live & ~supp
+            live = live.at[i].set(False)
+            return live, sel
+        _, picks = jax.lax.scan(body, keep, None,
+                                length=int(post_nms_top_n))
+        valid = picks >= 0
+        safe = jnp.maximum(picks, 0)
+        rois = props[safe] * valid[:, None]
+        probs = (topv[safe] * valid)[:, None]
+        return rois, probs, jnp.sum(valid).astype(jnp.int32)[None]
+
+    def generate_proposals_np(scores, bbox_deltas, im_shape, anchors,
+                              variances=None, pre_nms_top_n=12,
+                              post_nms_top_n=6, nms_thresh=0.5,
+                              min_size=0.1, eta=1.0,
+                              pixel_offset=False):
+        s = scores[0].transpose(1, 2, 0).reshape(-1).astype(np.float64)
+        d = bbox_deltas[0].transpose(1, 2, 0).reshape(-1, 4)
+        anc = anchors.reshape(-1, 4).astype(np.float64)
+        var = None if variances is None else variances.reshape(-1, 4)
+        order = np.argsort(-s, kind="stable")[:int(pre_nms_top_n)]
+        off = 1.0 if pixel_offset else 0.0
+        im_h, im_w = float(im_shape[0, 0]), float(im_shape[0, 1])
+        props, vals = [], []
+        for i in order:
+            aw = anc[i, 2] - anc[i, 0] + off
+            ah = anc[i, 3] - anc[i, 1] + off
+            acx = anc[i, 0] + 0.5 * aw
+            acy = anc[i, 1] + 0.5 * ah
+            v = var[i] if var is not None else np.ones(4)
+            cx = v[0] * d[i, 0] * aw + acx
+            cy = v[1] * d[i, 1] * ah + acy
+            bw = np.exp(min(v[2] * d[i, 2], _BBOX_CLIP)) * aw
+            bh = np.exp(min(v[3] * d[i, 3], _BBOX_CLIP)) * ah
+            b = [cx - bw / 2, cy - bh / 2,
+                 cx + bw / 2 - off, cy + bh / 2 - off]
+            b = [min(max(b[0], 0), im_w - off),
+                 min(max(b[1], 0), im_h - off),
+                 min(max(b[2], 0), im_w - off),
+                 min(max(b[3], 0), im_h - off)]
+            props.append(b)
+            vals.append(s[i])
+        props = np.asarray(props)
+        vals = np.asarray(vals)
+        ms = max(min_size, 1.0)
+        ws = props[:, 2] - props[:, 0] + off
+        hs = props[:, 3] - props[:, 1] + off
+        keep = (ws >= ms) & (hs >= ms)
+        if pixel_offset:
+            keep &= (props[:, 0] + ws / 2 <= im_w) \
+                & (props[:, 1] + hs / 2 <= im_h)
+        area = ws * hs
+        live = keep.copy()
+        picks = []
+        for _ in range(int(post_nms_top_n)):
+            if not live.any():
+                picks.append(-1)
+                continue
+            i = int(np.argmax(np.where(live, vals, -np.inf)))
+            picks.append(i)
+            xx0 = np.maximum(props[i, 0], props[:, 0])
+            yy0 = np.maximum(props[i, 1], props[:, 1])
+            xx1 = np.minimum(props[i, 2], props[:, 2])
+            yy1 = np.minimum(props[i, 3], props[:, 3])
+            inter = np.maximum(xx1 - xx0 + off, 0) \
+                * np.maximum(yy1 - yy0 + off, 0)
+            ious = inter / np.maximum(area[i] + area - inter, 1e-10)
+            live &= ious <= nms_thresh
+            live[i] = False
+        rois = np.zeros((int(post_nms_top_n), 4), np.float32)
+        probs = np.zeros((int(post_nms_top_n), 1), np.float32)
+        cnt = 0
+        for j, p_ in enumerate(picks):
+            if p_ >= 0:
+                rois[j] = props[p_]
+                probs[j, 0] = vals[p_]
+                cnt += 1
+        return rois, probs, np.asarray([cnt], np.int32)
+
     R = "paddle/phi/ops/yaml/ops.yaml"
 
     def S(name, fn, ref, samples, **kw):
@@ -1090,6 +1522,36 @@ def build_extra(OpSpec, _n, _u, _rs, _seed_of):
                               [8, 8, 12, 12]], np.float32),
                     np.array([0.9, 0.8, 0.7], np.float32)],
                    {"iou_threshold": 0.3}), n_tensors=2, grad=False),
+        S("box_coder", box_coder_j, box_coder_np,
+          lambda: ([np.array([[0., 0., 4., 4.], [2., 2., 8., 8.]],
+                             np.float32),
+                    np.array([[1., 1., 5., 5.], [0., 2., 6., 10.],
+                              [2., 0., 3., 7.]], np.float32)],
+                   {"variance": [0.1, 0.1, 0.2, 0.2]}),
+          n_tensors=2, grad=False, atol=1e-4),
+        S("prior_box", prior_box_j, prior_box_np,
+          lambda: ([_n(1, 3, 4, 4), _n(1, 3, 32, 32)],
+                   {"min_sizes": [4.0, 8.0], "max_sizes": [10.0, 16.0],
+                    "aspect_ratios": [1.0, 2.0], "flip": True,
+                    "clip": True, "offset": 0.5,
+                    "min_max_aspect_ratios_order": True}),
+          n_tensors=2, grad=False, atol=1e-5),
+        S("yolo_box", yolo_box_j, yolo_box_np,
+          lambda: ([_n(1, 14, 3, 3),
+                    np.array([[96, 64]], np.float32)],
+                   {"anchors": [10, 13, 16, 30], "class_num": 2,
+                    "conf_thresh": 0.3, "downsample_ratio": 32}),
+          n_tensors=2, grad=False, atol=1e-4),
+        S("generate_proposals", generate_proposals_j,
+          generate_proposals_np,
+          lambda: ([_n(1, 3, 4, 4),
+                    _n(1, 12, 4, 4) * 0.2,
+                    np.array([[32.0, 32.0]], np.float32),
+                    _gp_anchors()],
+                   {"pre_nms_top_n": 12, "post_nms_top_n": 5,
+                    "nms_thresh": 0.5, "min_size": 1.0,
+                    "pixel_offset": True}),
+          n_tensors=4, grad=False, atol=1e-3),
         S("send_uv", send_uv_j, send_uv_np,
           lambda: ([_n(5, 4), _n(5, 4),
                     _ints(0, 5, 7, seed_key="suv_s"),

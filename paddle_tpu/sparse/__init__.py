@@ -315,3 +315,202 @@ def transpose(x, perm, name=None):
         raise ValueError("sparse.transpose expects a sparse tensor")
     out = jsparse.bcoo_transpose(x._bcoo, permutation=tuple(perm))
     return SparseCooTensor(out)
+
+
+def _unary_op(name, jfn):
+    def op(x, name=None, _f=jfn, _n=name):
+        return _unary(x, _f, _n)
+    op.__name__ = name
+    op.__doc__ = (f"sparse.{name}: value-wise on the nnz vector "
+                  "(reference: sparse/unary.py — sparse unary kernels "
+                  "keep the sparsity pattern).")
+    return op
+
+
+acos = _unary_op("acos", jnp.arccos)
+acosh = _unary_op("acosh", jnp.arccosh)
+asin = _unary_op("asin", jnp.arcsin)
+asinh = _unary_op("asinh", jnp.arcsinh)
+atan = _unary_op("atan", jnp.arctan)
+atanh = _unary_op("atanh", jnp.arctanh)
+expm1 = _unary_op("expm1", jnp.expm1)
+isnan = _unary_op("isnan", jnp.isnan)
+log1p = _unary_op("log1p", jnp.log1p)
+relu6 = _unary_op("relu6", lambda v: jnp.clip(v, 0, 6))
+sinh = _unary_op("sinh", jnp.sinh)
+tan = _unary_op("tan", jnp.tan)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary(x, lambda v: jnp.where(v > 0, v, negative_slope * v),
+                  "leaky_relu")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
+    """Reference sparse scale: bias applies to the nnz VALUES (the
+    implicit zeros stay zero only when bias == 0, matching phi)."""
+    return _unary(x, lambda v: v * scale + bias, "scale")
+
+
+def divide_scalar(x, scalar, name=None):
+    return _unary(x, lambda v: v / scalar, "divide_scalar")
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Reference: sparse/unary.py sum — reduces over the nonzeros;
+    sparse output keeps COO structure on the remaining axes."""
+    bcoo = x._bcoo
+    if dtype is not None:
+        bcoo = jsparse.BCOO((bcoo.data.astype(dtype), bcoo.indices),
+                            shape=bcoo.shape)
+    if axis is None:
+        return run(lambda d: jnp.sum(d), Tensor(bcoo.data),
+                   name="sparse_sum")
+    axes = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    axes = tuple(a if a >= 0 else a + bcoo.ndim for a in axes)
+    out = jsparse.bcoo_reduce_sum(bcoo, axes=axes)
+    out = jsparse.bcoo_sum_duplicates(out)
+    if keepdim:
+        shape = [1 if i in axes else s
+                 for i, s in enumerate(bcoo.shape)]
+        out = jsparse.bcoo_reshape(out, new_sizes=tuple(shape))
+    return SparseCooTensor(out)
+
+
+def mv(x, vec, name=None):
+    """sparse matrix @ dense vector over the nonzeros only."""
+    xs = _bcoo_of(x)
+    idx, shape = xs.indices, xs.shape
+    v = vec if isinstance(vec, Tensor) else Tensor(vec)
+    return run(
+        lambda d, dv: jsparse.bcoo_dot_general(
+            jsparse.BCOO((d, idx), shape=shape), dv,
+            dimension_numbers=(((1,), (0,)), ((), ()))),
+        Tensor(xs.data), v, name="sparse_mv")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(sparse x @ dense y).  Reference:
+    sparse/binary.py addmm."""
+    prod = matmul(x, y)
+    inp = input if isinstance(input, Tensor) else Tensor(input)
+    return run(lambda a, b: beta * a + alpha * b, inp, prod,
+               name="sparse_addmm")
+
+
+def coalesce(x, name=None):
+    """Merge duplicate indices (reference: sparse coalesce kernel)."""
+    return SparseCooTensor(jsparse.bcoo_sum_duplicates(x._bcoo))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    vals = jnp.full(x._bcoo.data.shape, fill_value,
+                    dtype or x._bcoo.data.dtype)
+    return SparseCooTensor(jsparse.BCOO((vals, x._bcoo.indices),
+                                        shape=x._bcoo.shape))
+
+
+def mask_as(x, mask, name=None):
+    """Sample dense x at mask's nonzero positions → sparse (reference:
+    sparse mask_as / sparse_mask)."""
+    m = _bcoo_of(mask)
+    xv = x if isinstance(x, Tensor) else Tensor(x)
+    idx = m.indices
+    vals = run(lambda d: d[tuple(idx[:, i] for i in range(idx.shape[1]))],
+               xv, name="sparse_mask_as")
+    return SparseCooTensor(jsparse.BCOO((vals._value, idx),
+                                        shape=m.shape))
+
+
+def reshape(x, shape, name=None):
+    out = jsparse.bcoo_reshape(x._bcoo,
+                               new_sizes=tuple(int(s) for s in shape))
+    return SparseCooTensor(out)
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Structural slice: filters/shifts the nnz index list (eager —
+    nnz is data-dependent; reference: sparse slice kernel)."""
+    idx = np.asarray(x._bcoo.indices)
+    data = np.asarray(jax.device_get(x._bcoo.data))
+    shape = list(x._bcoo.shape)
+    keep = np.ones(idx.shape[0], bool)
+    clamped = []
+    for ax, s, e in zip(axes, starts, ends):
+        # reference clamps to [0, dim] (negative wraps first), and an
+        # empty range yields a zero-size dim, never a negative one
+        dim = shape[ax]
+        s = min(max(s + dim if s < 0 else s, 0), dim)
+        e = min(max(e + dim if e < 0 else e, 0), dim)
+        e = max(e, s)
+        keep &= (idx[:, ax] >= s) & (idx[:, ax] < e)
+        shape[ax] = e - s
+        clamped.append((ax, s))
+    new_idx = idx[keep].copy()
+    for ax, s in clamped:
+        new_idx[:, ax] -= s
+    return SparseCooTensor(jsparse.BCOO(
+        (jnp.asarray(data[keep]), jnp.asarray(new_idx)),
+        shape=tuple(shape)))
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over the STORED values only (reference: sparse
+    softmax treats implicit zeros as -inf, CSR row semantics)."""
+    bcoo = x._bcoo
+    assert bcoo.ndim == 2 and axis in (-1, 1), \
+        "sparse.softmax: 2-D, last axis (reference CSR semantics)"
+    idx = bcoo.indices
+    n_rows = bcoo.shape[0]
+
+    def _fn(v):
+        rows = idx[:, 0]
+        rmax = jax.ops.segment_max(v, rows, num_segments=n_rows)
+        e = jnp.exp(v - rmax[rows])
+        rsum = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+        return e / rsum[rows]
+    out = run(_fn, Tensor(bcoo.data), name="sparse_softmax")
+    return SparseCooTensor(jsparse.BCOO((out._value, idx),
+                                        shape=bcoo.shape))
+
+
+def to_sparse_coo(x, sparse_dim=None, name=None):
+    """Dense Tensor → SparseCooTensor (eager: nnz is data-dependent).
+    sparse_dim < ndim yields the reference's hybrid form: the leading
+    `sparse_dim` axes are sparse, trailing axes stay dense blocks
+    (BCOO n_dense)."""
+    d = np.asarray(jax.device_get(
+        x._value if isinstance(x, Tensor) else x))
+    if sparse_dim is None or sparse_dim >= d.ndim:
+        idx = np.argwhere(d != 0)
+        return SparseCooTensor.from_parts(idx.T, d[tuple(idx.T)],
+                                          d.shape)
+    flat = d.reshape(d.shape[:sparse_dim] + (-1,))
+    idx = np.argwhere(np.any(flat != 0, axis=-1))
+    vals = d[tuple(idx.T)]                   # [nnz, *dense_shape]
+    bcoo = jsparse.BCOO((jnp.asarray(vals),
+                         jnp.asarray(idx.astype(np.int32))),
+                        shape=d.shape)
+    return SparseCooTensor(bcoo)
+
+
+def to_sparse_csr(x, name=None):
+    d = np.asarray(jax.device_get(
+        x._value if isinstance(x, Tensor) else x))
+    assert d.ndim == 2, "to_sparse_csr: 2-D"
+    rows, cols = np.nonzero(d)
+    crows = np.zeros(d.shape[0] + 1, np.int64)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows)
+    return SparseCsrTensor.from_csr(crows, cols, d[rows, cols], d.shape)
+
+
+def to_dense(x, name=None):
+    return x.to_dense()
+
+
+__all__ += ["acos", "acosh", "asin", "asinh", "atan", "atanh", "expm1",
+            "isnan", "log1p", "relu6", "sinh", "tan", "leaky_relu",
+            "scale", "divide_scalar", "sum", "mv", "addmm", "coalesce",
+            "full_like", "mask_as", "reshape", "slice", "softmax",
+            "to_sparse_coo", "to_sparse_csr", "to_dense"]

@@ -118,11 +118,11 @@ def qr(x, mode="reduced", name=None):
 
 def svd(x, full_matrices=False, name=None):
     (x,) = to_tensor_args(x)
+    # reference convention (tensor/linalg.py:2858): returns (U, S, VH)
+    # with X = U @ diag(S) @ VH — VH, not V
     u, s, vh = run(lambda v: tuple(jnp.linalg.svd(
         v, full_matrices=full_matrices)), x, name="svd")
-    # paddle returns V not V^H
-    from .manipulation import swapaxes
-    return u, s, swapaxes(vh, -1, -2)
+    return u, s, vh
 
 
 def svdvals(x, name=None):

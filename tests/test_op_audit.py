@@ -1,5 +1,7 @@
-"""Op-coverage audit regression (VERDICT r3 item 4): the checked-in
-audit must keep coverage over the bar and leave no uncategorized miss."""
+"""Op-coverage audit regression (VERDICT r3 item 4, r5 item 1): the
+checked-in audit must keep coverage over the bar, leave no uncategorized
+miss, and prove EXECUTED coverage (ops with passing numeric tests) —
+including the fused/sparse yaml tables."""
 import os
 import sys
 
@@ -8,17 +10,58 @@ import pytest
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
                                 "tools"))
 
+_REF = os.path.exists("/root/reference/paddle/phi/ops/yaml/ops.yaml")
 
-@pytest.mark.skipif(
-    not os.path.exists("/root/reference/paddle/phi/ops/yaml/ops.yaml"),
-    reason="reference checkout not present")
+
+@pytest.mark.skipif(not _REF, reason="reference checkout not present")
 def test_ops_yaml_coverage():
     from op_audit import audit
     rows = audit()
     by = {}
-    for op, cat in rows:
+    executed = 0
+    for op, cat, ex in rows:
         by.setdefault(cat, []).append(op)
+        if ex and cat == "covered":
+            executed += 1
     total = len(rows)
     covered = len(by.get("covered", []))
     assert covered / total >= 0.70, f"{covered}/{total}"
     assert not by.get("todo"), by.get("todo")
+    # round-5 bar: executed coverage ≥70% of the yaml, and every covered
+    # op must have a numeric test behind it
+    assert executed / total >= 0.70, f"executed {executed}/{total}"
+    assert executed == covered, \
+        f"{covered - executed} covered ops lack numeric tests"
+
+
+@pytest.mark.skipif(not _REF, reason="reference checkout not present")
+def test_fused_sparse_yaml_audited():
+    from op_audit import audit_fused, audit_sparse
+    frows = audit_fused()
+    assert len(frows) >= 70
+    f_cov = [op for op, cat, ex in frows if cat == "covered"]
+    f_exec = [op for op, cat, ex in frows if cat == "covered" and ex]
+    assert f_cov and f_exec == f_cov, set(f_cov) - set(f_exec)
+    srows = audit_sparse()
+    assert len(srows) >= 45
+    s_by = {}
+    for op, cat, ex in srows:
+        s_by.setdefault(cat, []).append((op, ex))
+    assert not s_by.get("todo"), s_by.get("todo")
+    cov = s_by.get("covered", [])
+    assert len(cov) >= 40
+    missing = [op for op, ex in cov if not ex]
+    assert not missing, missing
+
+
+@pytest.mark.skipif(not _REF, reason="reference checkout not present")
+def test_specialized_bucket_is_justified():
+    """Round-5 verdict item 10: `todo: 0` must be earned — every
+    specialized exclusion carries a written justification."""
+    from op_audit import SPECIALIZED_OPS
+    for op, why in SPECIALIZED_OPS.items():
+        assert isinstance(why, str) and len(why) > 20, op
+    # the detection core is implemented, not excluded
+    for op in ("yolo_box", "box_coder", "prior_box",
+               "generate_proposals", "nms", "roi_align"):
+        assert op not in SPECIALIZED_OPS, op

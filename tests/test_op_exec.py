@@ -1,0 +1,31 @@
+"""Generated execution tests for the exec-spec table.
+
+One parametrized test per ExecSpec (`paddle_tpu/ops/exec_specs.py`):
+runs the op on sampled inputs and checks against the numpy/scipy
+reference (or the spec's property check).  Together with the OpSpec
+registry tests this is the executed-coverage evidence the op audit
+reports — the TPU analog of the reference's OpTest matrix
+(test/legacy_test/op_test.py check_output).
+"""
+import pytest
+
+from paddle_tpu.ops.exec_specs import EXEC_SPECS, run_spec
+
+_BY_ID = {}
+for i, s in enumerate(EXEC_SPECS):
+    _BY_ID[f"{s.op}#{i}" if s.op in {t.op for t in EXEC_SPECS[:i]}
+           else s.op] = s
+
+
+@pytest.mark.parametrize("name", sorted(_BY_ID))
+def test_exec_spec(name):
+    run_spec(_BY_ID[name])
+
+
+def test_no_duplicate_full_specs():
+    """Each yaml op gets counted once in the audit even if multiple
+    specs exist; sanity-check the table is non-empty and well-formed."""
+    assert len(EXEC_SPECS) >= 150
+    for s in EXEC_SPECS:
+        assert (s.ref is not None or s.check is not None
+                or s.custom is not None), s.op

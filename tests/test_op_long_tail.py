@@ -158,3 +158,49 @@ class TestInplace:
         assert tuple(x.shape) == (3, 2)
         paddle.flatten_(x)
         assert tuple(x.shape) == (6,)
+
+
+def test_box_coder_decode_center_size():
+    """decode path vs direct formula (encode path is registry-tested)."""
+    prior = np.array([[0., 0., 4., 4.], [2., 2., 8., 8.]], np.float32)
+    deltas = np.random.RandomState(0).randn(3, 2, 4).astype(np.float32) * 0.3
+    out = paddle.box_coder(paddle.to_tensor(prior),
+                           paddle.to_tensor(deltas),
+                           code_type="decode_center_size",
+                           variance=[0.1, 0.1, 0.2, 0.2])
+    got = np.asarray(out.value)
+    assert got.shape == (3, 2, 4)
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    cx = 0.1 * deltas[..., 0] * pw + pcx
+    cy = 0.1 * deltas[..., 1] * ph + pcy
+    w = np.exp(0.2 * deltas[..., 2]) * pw
+    h = np.exp(0.2 * deltas[..., 3]) * ph
+    want = np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_yolo_box_iou_aware():
+    """iou_aware layout: first an_num channels are IoU predictions."""
+    rs = np.random.RandomState(3)
+    x = rs.randn(1, 16, 3, 3).astype(np.float32)    # 2 anchors, 2 cls
+    img = np.array([[96, 64]], np.float32)
+    b, s = paddle.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                           anchors=[10, 13, 16, 30], class_num=2,
+                           conf_thresh=0.0, downsample_ratio=32,
+                           iou_aware=True, iou_aware_factor=0.4)
+    b, s = np.asarray(b.value), np.asarray(s.value)
+    assert b.shape == (1, 18, 4) and s.shape == (1, 18, 2)
+    # spot-check one cell against the reference formulas
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    an, cls, k, l, j = 2, 2, 1, 2, 1                # anchor 1, cell (1,2)
+    e = lambda ent: x[0, an + j * (5 + cls) + ent, k, l]
+    conf = sig(e(4)) ** 0.6 * sig(x[0, j, k, l]) ** 0.4
+    cx = (l + sig(e(0))) * 64 / 3
+    np.testing.assert_allclose(b[0, j * 9 + k * 3 + l, 0],
+                               max(cx - np.exp(e(2)) * 16 * 64 /
+                                   (32 * 3) / 2, 0), rtol=1e-4)
+    np.testing.assert_allclose(s[0, j * 9 + k * 3 + l, 1],
+                               conf * sig(e(6)), rtol=1e-4)

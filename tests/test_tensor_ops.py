@@ -269,8 +269,8 @@ class TestLinalg:
 
     def test_svd_qr_cholesky(self):
         a = r(4, 3)
-        u, s, v = paddle.linalg.svd(paddle.to_tensor(a))
-        rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        u, s, vh = paddle.linalg.svd(paddle.to_tensor(a))
+        rec = u.numpy() @ np.diag(s.numpy()) @ vh.numpy()
         np.testing.assert_allclose(rec, a, atol=1e-4)
         spd = a.T @ a + np.eye(3, dtype=np.float32)
         l = paddle.linalg.cholesky(paddle.to_tensor(spd))
