@@ -171,10 +171,11 @@ def maxout(x, groups, axis=1, name=None):
     def _fn(v):
         # reference formula (activation.py:873): out channel i = max
         # over the CONSECUTIVE group [g*i, g*i+g) → Co = Ci/groups
+        ax = axis if axis >= 0 else axis + v.ndim   # NHWC uses axis=-1
         shp = list(v.shape)
-        c = shp[axis]
-        shp[axis:axis + 1] = [c // groups, groups]
-        return jnp.max(v.reshape(shp), axis=axis + 1)
+        c = shp[ax]
+        shp[ax:ax + 1] = [c // groups, groups]
+        return jnp.max(v.reshape(shp), axis=ax + 1)
     return run(_fn, x, name="maxout")
 
 

@@ -1387,9 +1387,11 @@ def _sp_misc_specs():
 
     def sum_():
         d = _sp_sample("sm")
-        tot = sp.sum(_sp_of(d))
-        np.testing.assert_allclose(float(np.asarray(tot.value)),
-                                   d.sum(), rtol=1e-4)
+        tot = sp.sum(_sp_of(d))          # sparse scalar (reference)
+        np.testing.assert_allclose(
+            float(np.asarray(tot.to_dense().value)), d.sum(), rtol=1e-4)
+        kd = sp.sum(_sp_of(d), keepdim=True)
+        assert tuple(kd.shape) == (1, 1), kd.shape
         ax = sp.sum(_sp_of(d), axis=1)
         np.testing.assert_allclose(_sp_dense(ax), d.sum(1), rtol=1e-4,
                                    atol=1e-5)

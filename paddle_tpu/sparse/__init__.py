@@ -364,8 +364,14 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
         bcoo = jsparse.BCOO((bcoo.data.astype(dtype), bcoo.indices),
                             shape=bcoo.shape)
     if axis is None:
-        return run(lambda d: jnp.sum(d), Tensor(bcoo.data),
-                   name="sparse_sum")
+        # reference returns a SPARSE scalar (all-ones shape with
+        # keepdim), not a dense Tensor
+        total = run(lambda d: jnp.sum(d)[None], Tensor(bcoo.data),
+                    name="sparse_sum")
+        shape = (1,) * bcoo.ndim if keepdim else ()
+        idx = jnp.zeros((1, len(shape)), jnp.int32)
+        return SparseCooTensor(jsparse.BCOO((total._value, idx),
+                                            shape=shape))
     axes = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
     axes = tuple(a if a >= 0 else a + bcoo.ndim for a in axes)
     out = jsparse.bcoo_reduce_sum(bcoo, axes=axes)

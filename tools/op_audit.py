@@ -396,6 +396,16 @@ FUSED_DELEGATED = {
     "squeeze_excitation_block", "add_group_norm_silu", "fc",
     "fp8_fp8_half_gemm_fused",
 }
+# GPU-serving/recommender fused plumbing, justified wholesale: the
+# unfused math is covered+executed, and serving fusion on TPU is XLA's
+# job (same stance as FUSED_DELEGATED, but these have extra scheduler
+# state — paged KV, seqpool — that v1's serving path does not model)
+FUSED_SPECIALIZED = {
+    "fused_seqpool_cvm", "fused_embedding_fc_lstm", "fused_token_prune",
+    "distributed_fused_lamb_init", "blha_get_max_len",
+    "block_multihead_attention_",
+}
+
 SPARSE_SPECIALIZED = {
     "conv3d": "submanifold sparse 3-D conv (point-cloud suite) — out of "
               "v1 scope",
@@ -420,10 +430,13 @@ def audit_fused():
             cat = "delegated"
         elif op.endswith(("_xpu", "_int8_xpu")) or "xpu" in op:
             cat = "infra"
-        else:
-            # CPU-fusion (fusion_*) and GPU-serving plumbing alike:
-            # niche fusions with no TPU lowering
+        elif op.startswith("fusion_") or op in FUSED_SPECIALIZED:
+            # fusion_* = CPU/OneDNN fusion family; the explicit list is
+            # GPU-serving plumbing.  Anything NEW in the yaml falls to
+            # todo so the audit catches coverage regressions.
             cat = "specialized"
+        else:
+            cat = "todo"
         rows.append((op, cat, executed))
     return rows
 
