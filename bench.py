@@ -1,10 +1,16 @@
-"""Benchmark: llama causal-LM training throughput on one TPU chip.
+"""Benchmarks: all five BASELINE.md configs + serving decode + offload.
 
-Tracks BASELINE.md config 3 (llama pretraining, tokens/sec/chip + MFU).
-The reference publishes no in-tree numbers (BASELINE.md — "published": {});
-vs_baseline is therefore measured against the north-star target 40% MFU.
+Default run (no BENCH_CONFIG) measures EVERY config and prints one JSON
+line per config — llama, offload-llama, bert, resnet, unet, decode — so
+the driver-captured BENCH file records the full matrix, not just llama
+(round-5 verdict item 3).  Each metric is the MEDIAN of BENCH_REPS
+(default 3) timed repetitions of the same compiled program, with the
+relative spread (max-min)/median reported alongside; compilation happens
+once per config, outside the reps.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+BENCH_CONFIG=llama|offload|bert|resnet|unet|decode runs one config.
+Reference throughput instrumentation analog:
+python/paddle/profiler/timer.py:351 (ips Benchmark).
 """
 from __future__ import annotations
 
@@ -46,8 +52,34 @@ def chip_peak_flops():
     return PEAK_BF16["v5e"]
 
 
-def bench_llama():
-    """BASELINE.md config 3: llama pretraining tokens/s/chip + MFU."""
+def _reps():
+    return max(1, int(os.environ.get("BENCH_REPS", "3")))
+
+
+def _measure(rep_fn):
+    """rep_fn() -> throughput for one timed repetition of the already-
+    compiled program.  Returns (median, rel_spread, all_values)."""
+    vals = [float(rep_fn()) for _ in range(_reps())]
+    med = float(np.median(vals))
+    spread = (max(vals) - min(vals)) / med if med > 0 else 0.0
+    return med, spread, vals
+
+
+def _emit(metric, value, unit, vs_baseline, spread, vals):
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 1) if value >= 10 else round(value, 3),
+        "unit": unit,
+        "vs_baseline": round(vs_baseline, 3),
+        "reps": len(vals),
+        "spread": round(spread, 3),
+    }), flush=True)
+
+
+def bench_llama(offload=False):
+    """BASELINE.md config 3: llama pretraining tokens/s/chip + MFU.
+    offload=True is the ZeRO-3 host-offload config (params beyond the
+    fp32-resident ceiling; fp32 master + moments in pinned host)."""
     import jax
     on_tpu = jax.default_backend() == "tpu"
     import paddle_tpu as paddle
@@ -55,37 +87,42 @@ def bench_llama():
     from paddle_tpu.parallel import ShardedTrainStep
     from paddle_tpu.distributed.topology import build_mesh
 
-    offload = on_tpu and os.environ.get("BENCH_OFFLOAD", "") \
-        not in ("", "0")
+    requested_offload = offload      # metric name tracks the REQUEST
+    offload = offload and on_tpu
     if on_tpu:
         # 1.0B-param GQA llama sized for v5e 16G HBM.  Mixed precision
         # the TPU-idiomatic way: fp32 params (the param IS the master —
         # no separate copy) + bf16 compute + bf16 AdamW moments via the
         # fused Pallas kernel → resident state 8.0G, leaving ~6G for
-        # activations.  That budget lets most layers skip recompute
-        # entirely; the rest use SELECTIVE recompute (save q/k/v +
-        # attention output + mid-residual; replay only the MLP matmuls
-        # and the flash-attn forward).  Sharding stage 3 (no-op on 1
-        # chip, but the exact north-star code path: BASELINE.md cfg 3).
-        # r4 sweep: 3 selective-remat layers is the throughput/gap
-        # sweet spot (mfu 0.538, hw_util-mfu 0.019); fewer layers OOM-
-        # pressures XLA into slower schedules (0.522 at 0/2), more
-        # layers replay needless matmuls (0.532 at 8)
+        # activations.  r4 sweep: 3 selective-remat layers is the
+        # throughput/gap sweet spot (mfu 0.538, hw_util-mfu 0.019).
         n_sel = int(os.environ.get("BENCH_RECOMPUTE_LAYERS", "3"))
         if offload:
-            # 2.0B params — ~2x the fp32-params-resident ceiling.  bf16
-            # params on device; fp32 master + moments parked in pinned
-            # host memory and streamed through HBM inside the step
-            # (ShardedTrainStep offload=True).
-            cfg = LlamaConfig(vocab_size=8192, hidden_size=3584,
-                              intermediate_size=9600,
-                              num_hidden_layers=14,
-                              num_attention_heads=28,
-                              num_key_value_heads=4,
-                              max_position_embeddings=2048,
-                              dtype="bfloat16",
-                              recompute=True, recompute_layers=None,
-                              recompute_granularity="full")
+            size = os.environ.get("BENCH_OFFLOAD_SIZE", "4b")
+            if size == "4b":
+                # 4.0B params — ~4x the fp32-resident ceiling (verdict
+                # item 5): bf16 params resident (8.1G), fp32 master +
+                # moments (48G) parked in pinned host, streamed per-
+                # block through HBM inside the step
+                cfg = LlamaConfig(vocab_size=8192, hidden_size=4608,
+                                  intermediate_size=12544,
+                                  num_hidden_layers=20,
+                                  num_attention_heads=36,
+                                  num_key_value_heads=4,
+                                  max_position_embeddings=2048,
+                                  dtype="bfloat16",
+                                  recompute=True, recompute_layers=None,
+                                  recompute_granularity="full")
+            else:
+                cfg = LlamaConfig(vocab_size=8192, hidden_size=3584,
+                                  intermediate_size=9600,
+                                  num_hidden_layers=14,
+                                  num_attention_heads=28,
+                                  num_key_value_heads=4,
+                                  max_position_embeddings=2048,
+                                  dtype="bfloat16",
+                                  recompute=True, recompute_layers=None,
+                                  recompute_granularity="full")
             batch = int(os.environ.get("BENCH_BATCH", "2"))
         else:
             cfg = LlamaConfig(vocab_size=8192, hidden_size=2560,
@@ -117,59 +154,54 @@ def bench_llama():
                                  moment_dtype="bfloat16" if on_tpu
                                  else None)
     mesh = build_mesh(devices=jax.devices()[:1])
+    # the 4b config is past the bf16-params-resident ceiling too: park
+    # the PARAMS on the host as well (per-block in-graph streaming)
+    offload_mode = "params" if (offload and os.environ.get(
+        "BENCH_OFFLOAD_SIZE", "4b") == "4b") else offload
     step = ShardedTrainStep(model, opt, mesh, sharding_stage=3,
-                            rematerialize=False, offload=offload)
+                            rematerialize=False, offload=offload_mode)
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     x = paddle.to_tensor(ids)
 
     # warmup / compile (host transfer forces completion: the axon relay's
-    # block_until_ready does not synchronize remote execution)
+    # block_until_ready does not synchronize remote execution).
     loss = step(x, x)
     _ = float(np.asarray(loss.value))
+    final_loss = [0.0]
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(x, x)
-    final_loss = float(np.asarray(loss.value))
-    dt = time.perf_counter() - t0
+    def rep():
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(x, x)
+        final_loss[0] = float(np.asarray(loss.value))
+        return batch * seq * steps / (time.perf_counter() - t0)
 
-    tokens_per_step = batch * seq
-    tokens_per_sec = tokens_per_step * steps / dt
-    model_flops = 6.0 * n_params * tokens_per_sec  # fwd+bwd dense decoder
+    tokens_per_sec, spread, vals = _measure(rep)
+    model_flops = 6.0 * n_params * tokens_per_sec
     peak = chip_peak_flops()
     mfu = model_flops / peak
-    # hardware utilization: each selectively-recomputed layer replays
-    # only the gate/up MLP matmuls in the backward.  The q/k/v, o_proj
-    # and down_proj matmuls sit in the remat regions too, but their
-    # OUTPUTS are saved (region boundaries / resid_mid tag) or unused in
-    # the backward, so jax's remat DCE drops them from the replay jaxpr;
-    # norms/rope replay with no matmul flops
+    # hardware utilization: selective remat replays only gate/up MLP
+    # matmuls; the offload config full-remats every layer
     if on_tpu and offload:
-        # offload config full-remats EVERY layer: backward replays the
-        # whole forward (~2N flops/token), not the selective gate/up set
         recompute_per_tok = 2.0 * n_params
     else:
         recompute_per_tok = n_sel * (4.0 * cfg.hidden_size
                                      * cfg.intermediate_size)
     hw_util = mfu * (6.0 * n_params + recompute_per_tok) / (6.0 * n_params)
-
-    result = {
-        "metric": "llama_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": f"tokens/s/chip (mfu={mfu:.3f}, hw_util={hw_util:.3f}, "
-                f"params={n_params/1e6:.0f}M, loss={final_loss:.3f})",
-        "vs_baseline": round(mfu / 0.40, 3),
-    }
-    print(json.dumps(result))
+    name = "llama_offload_train_tokens_per_sec_per_chip" \
+        if requested_offload else "llama_train_tokens_per_sec_per_chip"
+    _emit(name, tokens_per_sec,
+          f"tokens/s/chip (mfu={mfu:.3f}, hw_util={hw_util:.3f}, "
+          f"params={n_params/1e6:.0f}M, loss={final_loss[0]:.3f})",
+          mfu / 0.40, spread, vals)
 
 
 def _class_correlated_images(n, num_classes, rng, noise=0.6):
     """Learnable synthetic CIFAR stand-in (zero-egress environment):
     per-class template + gaussian noise — convergence on a held-out
     split is real evidence the training machinery optimizes."""
-    import numpy as np
     templates = rng.randn(num_classes, 3, 32, 32).astype(np.float32)
     labels = rng.randint(0, num_classes, n)
     imgs = templates[labels] + noise * rng.randn(n, 3, 32, 32)
@@ -215,15 +247,17 @@ def bench_resnet():
     sy = paddle.to_tensor(
         ys[: steps_per_epoch * batch].reshape(steps_per_epoch, batch))
     _ = float(np.asarray(step.run_steps(sx, sy).value[-1]))  # compile
+    final_loss = [0.0]
 
-    t0 = time.perf_counter()
-    seen = 0
-    for _ in range(epochs):
-        losses = step.run_steps(sx, sy)
-        seen += steps_per_epoch * batch
-    final_loss = float(np.asarray(losses.value[-1]))
-    dt = time.perf_counter() - t0
-    images_per_sec = seen / dt
+    def rep():
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            losses = step.run_steps(sx, sy)
+        final_loss[0] = float(np.asarray(losses.value[-1]))
+        return epochs * steps_per_epoch * batch \
+            / (time.perf_counter() - t0)
+
+    images_per_sec, spread, vals = _measure(rep)
 
     # held-out top-1 (jitted eval — per-op eager would be host-bound)
     import jax.numpy as jnp
@@ -238,14 +272,10 @@ def bench_resnet():
         tot += len(pred)
     top1 = correct / max(1, tot)
 
-    result = {
-        "metric": "resnet50_cifar_images_per_sec",
-        "value": round(images_per_sec, 1),
-        "unit": f"images/s (top1={top1:.3f} heldout after {epochs} "
-                f"epochs, loss={final_loss:.3f})",
-        "vs_baseline": round(top1 / 0.90, 3),
-    }
-    print(json.dumps(result))
+    _emit("resnet50_cifar_images_per_sec", images_per_sec,
+          f"images/s (top1={top1:.3f} heldout after "
+          f"{epochs * _reps()} epochs, loss={final_loss[0]:.3f})",
+          top1 / 0.90, spread, vals)
 
 
 def bench_bert():
@@ -260,13 +290,9 @@ def bench_bert():
 
     paddle.seed(0)
     if on_tpu:
-        # fp32 params ARE the masters (nn.set_compute_dtype flax idiom,
-        # wired via cfg.dtype) + bf16 AdamW moments — same mixed
-        # precision recipe that took llama to 0.537 MFU
+        # fp32 params ARE the masters (nn.set_compute_dtype flax idiom)
+        # + bf16 compute; b=64 fits with bf16 logits (r4: 0.481 MFU)
         cfg = BertConfig(dtype="bfloat16")
-        # b=64 fits now that params are fp32 masters with bf16 compute
-        # (no duplicate master copies, bf16 logits): 0.481 MFU vs 0.444
-        # at b=32 (r3 baseline: 0.276, b=64 OOMed)
         batch = int(os.environ.get("BENCH_BATCH", "64"))
         seq, steps = 512, 8
     else:
@@ -280,8 +306,7 @@ def bench_bert():
     n_params = sum(int(np.prod(p.value.shape))
                    for p in model.parameters())
     # fp32 moments: at 110M params the update is cheap, and bf16
-    # moments force tail-padding copies on the ragged 23.4M tied
-    # embedding (measured 0.379 vs 0.392 MFU)
+    # moments force tail-padding copies on the ragged tied embedding
     opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
                                  weight_decay=0.01)
     mesh = build_mesh(sharding=1, devices=jax.devices()[:1])
@@ -292,28 +317,22 @@ def bench_bert():
     ids = rng.randint(0, cfg.vocab_size,
                       (steps, batch, seq)).astype(np.int32)
     x = paddle.to_tensor(ids)
-    # fuse the whole run into one scanned program (run_steps): per-step
-    # dispatch latency is paid once
+    # fuse the whole run into one scanned program (run_steps)
     losses = step.run_steps(x, x)
     _ = float(np.asarray(losses.value[-1]))
+    final_loss = [0.0]
 
-    t0 = time.perf_counter()
-    losses = step.run_steps(x, x)
-    final_loss = float(np.asarray(losses.value[-1]))
-    dt = time.perf_counter() - t0
+    def rep():
+        t0 = time.perf_counter()
+        losses = step.run_steps(x, x)
+        final_loss[0] = float(np.asarray(losses.value[-1]))
+        return batch * seq * steps / (time.perf_counter() - t0)
 
-    tokens_per_sec = batch * seq * steps / dt
-    # encoder fwd+bwd ~ 6*N flops/token (N excl embeddings ~ attention
-    # is small at seq 512); use full param count like the llama metric
+    tokens_per_sec, spread, vals = _measure(rep)
     mfu = 6.0 * n_params * tokens_per_sec / chip_peak_flops()
-    result = {
-        "metric": "bert_base_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": f"tokens/s/chip (mfu={mfu:.3f}, "
-                f"params={n_params/1e6:.0f}M, loss={final_loss:.3f})",
-        "vs_baseline": round(mfu / 0.40, 3),
-    }
-    print(json.dumps(result))
+    _emit("bert_base_train_tokens_per_sec_per_chip", tokens_per_sec,
+          f"tokens/s/chip (mfu={mfu:.3f}, params={n_params/1e6:.0f}M, "
+          f"loss={final_loss[0]:.3f})", mfu / 0.40, spread, vals)
 
 
 def bench_unet():
@@ -329,8 +348,7 @@ def bench_unet():
     paddle.seed(0)
     if on_tpu:
         cfg = unet_sd_config()
-        # r4: bf16 compute (fp32 masters) via nn.set_compute_dtype —
-        # convs on the MXU at full bf16 rate
+        # bf16 compute (fp32 masters): convs on the MXU at full rate
         cfg.dtype = os.environ.get("BENCH_UNET_DTYPE", "bfloat16")
         batch, hw, ctx_len, steps = 8, 64, 77, 6
     else:
@@ -355,20 +373,19 @@ def bench_unet():
 
     loss = step(x, t, ctx, eps)
     _ = float(np.asarray(loss.value))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(x, t, ctx, eps)
-    final_loss = float(np.asarray(loss.value))
-    dt = time.perf_counter() - t0
-    samples_per_sec = batch * steps / dt
-    result = {
-        "metric": "sd_unet_train_samples_per_sec",
-        "value": round(samples_per_sec, 2),
-        "unit": f"samples/s (params={n_params/1e6:.0f}M, latents "
-                f"{hw}x{hw}, loss={final_loss:.3f})",
-        "vs_baseline": 1.0,
-    }
-    print(json.dumps(result))
+    final_loss = [0.0]
+
+    def rep():
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(x, t, ctx, eps)
+        final_loss[0] = float(np.asarray(loss.value))
+        return batch * steps / (time.perf_counter() - t0)
+
+    samples_per_sec, spread, vals = _measure(rep)
+    _emit("sd_unet_train_samples_per_sec", samples_per_sec,
+          f"samples/s (params={n_params/1e6:.0f}M, latents {hw}x{hw}, "
+          f"loss={final_loss[0]:.3f})", 1.0, spread, vals)
 
 
 def bench_llama_decode():
@@ -382,9 +399,8 @@ def bench_llama_decode():
 
     paddle.seed(0)
     if on_tpu:
-        # serving-appropriate bf16 weights (param_dtype unset): the
-        # decode roofline below assumes 2 bytes/param, which must match
-        # what the step actually reads
+        # serving-appropriate bf16 weights: the decode roofline assumes
+        # 2 bytes/param, which must match what the step reads
         cfg = LlamaConfig(vocab_size=8192, hidden_size=2560,
                           intermediate_size=6912, num_hidden_layers=14,
                           num_attention_heads=20, num_key_value_heads=4,
@@ -409,38 +425,85 @@ def bench_llama_decode():
 
     out = model.generate(prompt, max_new_tokens=new_tokens)  # compile
     _ = np.asarray(out.value)
-    t0 = time.perf_counter()
-    out = model.generate(prompt, max_new_tokens=new_tokens)
-    _ = np.asarray(out.value)
-    dt = time.perf_counter() - t0
-    tok_s = batch * new_tokens / dt
-    # decode roofline: every token reads all params once (bf16 compute
-    # stream) → tokens/s ≈ batch · HBM_BW / (2·N) when batched decode
-    # is bandwidth-bound
+
+    def rep():
+        t0 = time.perf_counter()
+        out = model.generate(prompt, max_new_tokens=new_tokens)
+        _ = np.asarray(out.value)
+        return batch * new_tokens / (time.perf_counter() - t0)
+
+    tok_s, spread, vals = _measure(rep)
+    # decode roofline: every token reads all params once (bf16 stream)
     roofline = batch * 0.82e12 / (2.0 * n_params)
-    result = {
-        "metric": "llama_decode_tokens_per_sec_per_chip",
-        "value": round(tok_s, 1),
-        "unit": f"tokens/s/chip (b={batch}, new={new_tokens}, "
-                f"params={n_params/1e6:.0f}M, "
-                f"hbm_roofline={roofline:.0f} tok/s)",
-        "vs_baseline": round(tok_s / max(roofline, 1e-9), 3),
-    }
-    print(json.dumps(result))
+    _emit("llama_decode_tokens_per_sec_per_chip", tok_s,
+          f"tokens/s/chip (b={batch}, new={new_tokens}, "
+          f"params={n_params/1e6:.0f}M, "
+          f"hbm_roofline={roofline:.0f} tok/s)",
+          tok_s / max(roofline, 1e-9), spread, vals)
+
+
+CONFIGS = {
+    "llama": bench_llama,
+    "offload": lambda: bench_llama(offload=True),
+    "bert": bench_bert,
+    "resnet": bench_resnet,
+    "unet": bench_unet,
+    "decode": bench_llama_decode,
+}
 
 
 def main():
-    which = os.environ.get("BENCH_CONFIG", "llama").lower()
-    if which in ("resnet", "resnet50", "cifar"):
-        return bench_resnet()
-    if which == "bert":
-        return bench_bert()
-    if which in ("unet", "sd", "diffusion"):
-        return bench_unet()
-    if which in ("decode", "llama_decode", "generate"):
-        return bench_llama_decode()
-    return bench_llama()
+    which = os.environ.get("BENCH_CONFIG", "all").lower()
+    aliases = {"resnet50": "resnet", "cifar": "resnet", "sd": "unet",
+               "diffusion": "unet", "llama_decode": "decode",
+               "generate": "decode"}
+    which = aliases.get(which, which)
+    # legacy interface: BENCH_OFFLOAD=1 turns the llama config into the
+    # offload config (r4 drivers invoke it this way)
+    if os.environ.get("BENCH_OFFLOAD", "") not in ("", "0") \
+            and which in ("llama", "offload", "all"):
+        return bench_llama(offload=True)
+    if which in CONFIGS:
+        return CONFIGS[which]()
+    if which != "all":
+        print(json.dumps({"metric": "bench_config_error", "value": 0,
+                          "unit": f"unknown BENCH_CONFIG={which!r}; "
+                                  f"choose {sorted(CONFIGS)} or 'all'",
+                          "vs_baseline": 0.0}), flush=True)
+        return 2
+    # default: the full matrix, llama first (headline metric lands even
+    # if a shared-chip hiccup cuts the run short).  Each config runs in
+    # its OWN subprocess: the previous config's params/opt-state would
+    # otherwise stay resident in this process's jax client and OOM the
+    # 16G chip for every config after the first.
+    import subprocess
+    here = os.path.abspath(__file__)
+    budget = float(os.environ.get("BENCH_CONFIG_TIMEOUT", "900"))
+    for name in CONFIGS:
+        env = dict(os.environ)
+        env["BENCH_CONFIG"] = name
+        try:
+            proc = subprocess.run(
+                [sys.executable, here], env=env, text=True,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                timeout=budget)
+            out = proc.stdout.strip()
+            if proc.returncode == 0 and out:
+                print(out, flush=True)
+            else:
+                tail = (proc.stderr or proc.stdout or "")[-200:]
+                print(json.dumps({"metric": f"{name}_bench_error",
+                                  "value": 0,
+                                  "unit": f"rc={proc.returncode}: "
+                                          f"{tail}",
+                                  "vs_baseline": 0.0}), flush=True)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"metric": f"{name}_bench_error",
+                              "value": 0,
+                              "unit": f"timeout {budget}s",
+                              "vs_baseline": 0.0}), flush=True)
+    return None
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

@@ -219,8 +219,26 @@ class AutoParallelEngine:
         self._tune_kw = tune_kw
         from ..fleet.meta_parallel import PipelineLayer
         self._is_pipeline_layer = isinstance(model, PipelineLayer)
-        self.allow_pp = (self._is_pipeline_layer if allow_pp is None
-                         else allow_pp)
+        # auto pp segmentation (reference: static/partitioner.py:41
+        # Partitioner splits any program): a plain SEQUENTIAL model —
+        # children called in order, each taking the previous output —
+        # segments exactly, so pp candidates open up for it too.  The
+        # built PipelineLayer reuses the SAME child Layer instances
+        # (parameters shared), so the caller's optimizer stays valid.
+        self._segmentable = (not self._is_pipeline_layer
+                             and self._sequential_children() is not None)
+        self.allow_pp = ((self._is_pipeline_layer or self._segmentable)
+                         if allow_pp is None else allow_pp)
+        self._auto_pl = None
+
+    def _sequential_children(self):
+        """Ordered child list when the model's forward is the default
+        sequential chain, else None (arbitrary forward graphs are
+        refused, not guessed — a silent wrong split would be worse)."""
+        from ...nn import Sequential
+        if isinstance(self.model, Sequential):
+            return list(self.model)
+        return None
 
     def _chip_kind(self) -> str:
         kind = getattr(self.devices[0], "device_kind", "").lower()
@@ -249,14 +267,17 @@ class AutoParallelEngine:
         n = len(self.devices)
         tuner_cfg = {"model_cfg": info, "n_devices": n,
                      "global_batch_size": self.global_batch_size}
-        cands = default_candidates(tuner_cfg)
+        cands = self._tune_kw.get("candidates") \
+            or default_candidates(tuner_cfg)
         if not self.allow_pp:
             cands["pp"] = [1]
             cands["vpp"] = [1]
+        extra = {k: v for k, v in self._tune_kw.items()
+                 if k != "candidates"}
         ranked = tune(info, n,
                       global_batch_size=self.global_batch_size,
                       chip=self.chip, hbm_bytes=self.hbm_bytes,
-                      candidates=cands, **self._tune_kw)
+                      candidates=cands, **extra)
         if not ranked:
             raise RuntimeError(
                 "auto-parallel planner found no feasible strategy "
@@ -274,21 +295,32 @@ class AutoParallelEngine:
         from ...distributed.topology import build_mesh
         from ...parallel import ShardedTrainStep
 
-        if s.get("pp", 1) > 1 and not self._is_pipeline_layer:
+        if s.get("pp", 1) > 1 and not self._is_pipeline_layer \
+                and not self._segmentable:
             raise RuntimeError(
-                "planned strategy uses pp>1 but the model is not a "
-                "PipelineLayer — automatic model bisection is not "
-                "attempted (a silent wrong split would be worse); wrap "
-                "the model in fleet.meta_parallel.PipelineLayer or "
-                "plan with allow_pp=False")
-        if s.get("pp", 1) > 1 and self._is_pipeline_layer:
+                "planned strategy uses pp>1 but the model is neither a "
+                "PipelineLayer nor a sequential chain the engine can "
+                "segment — automatic bisection of arbitrary forward "
+                "graphs is not attempted (a silent wrong split would be "
+                "worse); wrap the model in fleet.meta_parallel."
+                "PipelineLayer or plan with allow_pp=False")
+        if s.get("pp", 1) > 1:
             from ...parallel.pipeline import PipelineEngine
+            from ..fleet.meta_parallel import PipelineLayer
+            pl = self.model
+            if not self._is_pipeline_layer:
+                # auto segmentation: the sequential children become the
+                # flat stage list (reference partitioner.py analog);
+                # params are the SAME objects, the caller's optimizer
+                # keeps working
+                self._auto_pl = pl = PipelineLayer(
+                    self._sequential_children(), loss_fn=self.loss_fn)
             self.mesh = build_mesh(dp=s["dp"], mp=s["mp"], pp=s["pp"],
                                    sharding=s["sharding"],
                                    devices=self.devices)
             self._complete(self.mesh)
             self.trainer = PipelineEngine(
-                self.model, self.mesh,
+                pl, self.mesh,
                 num_virtual_stages=s.get("vpp", 1))
             return self.trainer
 
@@ -327,7 +359,7 @@ class AutoParallelEngine:
         if self.trainer is None:
             self.build()
         s = self.strategy
-        if s.get("pp", 1) > 1 and self._is_pipeline_layer:
+        if s.get("pp", 1) > 1:
             # per-REPLICA micro count — the count prune_by_mbs validated
             data_ways = s.get("dp", 1) * s.get("sharding", 1)
             local = max(1, self.global_batch_size // data_ways)

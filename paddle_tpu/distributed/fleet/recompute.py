@@ -56,10 +56,23 @@ def _recompute_impl(function, layers, args, kwargs, policy=None):
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     tensor_args = [args[i] for i in tensor_idx]
 
+    # ZeRO-3 param offload: params the active stream scope registers are
+    # host-resident; transfer them to device INSIDE the checkpointed fn
+    # so the backward replay re-streams them (HBM holds ~one block's
+    # params at a time) — see parallel/param_stream.py
+    from ...parallel.param_stream import stream_sharding_for
+    streams = [stream_sharding_for(t) for t in ptensors]
+
     def pure(*vals):
         from ...jit import _swapped_state
         import contextlib
-        pvals = vals[:np_]
+        # optimization_barrier pins the transferred copy as a real
+        # materialization point — without it the TPU compiler folds
+        # layout bitcasts through the host copy into the rematted
+        # backward and ICEs ("Bitcast changes dimensionality")
+        pvals = [jax.lax.optimization_barrier(jax.device_put(v, s))
+                 if s is not None else v
+                 for v, s in zip(vals[:np_], streams)]
         avals = vals[np_:]
         call_args = list(args)
         for i, v in zip(tensor_idx, avals):

@@ -134,6 +134,9 @@ def _node(op_type, inputs, outputs, **attrs):
         elif isinstance(v, np.ndarray):
             a.message(5, _tensor_proto(k, v))  # t
             a.varint(20, 4)     # type TENSOR
+        elif isinstance(v, bytes):
+            a.bytes_(4, v)   # s (AttributeProto.STRING)
+            a.varint(20, 3)      # type STRING
         else:
             raise TypeError(f"attr {k}: {type(v)}")
         n.message(5, a)
@@ -143,7 +146,7 @@ def _node(op_type, inputs, outputs, **attrs):
 # ---------------------------------------------------------------------------
 # jaxpr → ONNX graph
 # ---------------------------------------------------------------------------
-def _convert_jaxpr(jaxpr, consts, in_names, prefix=""):
+def _convert_jaxpr(jaxpr, consts, in_names, prefix="", opset=None):
     """Returns (nodes, initializers, env) mapping jaxpr vars to names."""
     nodes, inits = [], []
     env = {}
@@ -173,7 +176,10 @@ def _convert_jaxpr(jaxpr, consts, in_names, prefix=""):
               "logistic": "Sigmoid", "exp": "Exp", "log": "Log",
               "neg": "Neg", "sqrt": "Sqrt", "rsqrt": None,
               "abs": "Abs", "pow": "Pow", "erf": "Erf",
-              "floor": "Floor", "ceil": "Ceil", "sign": "Sign"}
+              "floor": "Floor", "ceil": "Ceil", "sign": "Sign",
+              "lt": "Less", "le": "LessOrEqual", "gt": "Greater",
+              "ge": "GreaterOrEqual", "eq": "Equal", "not": "Not",
+              "and": "And", "or": "Or"}
 
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
@@ -182,14 +188,15 @@ def _convert_jaxpr(jaxpr, consts, in_names, prefix=""):
         for v, nm in zip(eqn.outvars, outs):
             env[v] = nm
         p = eqn.params
-        if prim in ("pjit", "closed_call", "custom_jvp_call",
+        if prim in ("pjit", "jit", "closed_call", "custom_jvp_call",
                     "custom_vjp_call", "remat", "checkpoint"):
             inner = p.get("jaxpr") or p.get("call_jaxpr")
             closed = inner if hasattr(inner, "jaxpr") else None
             ij = closed.jaxpr if closed else inner
             iconsts = closed.consts if closed else []
             sub_nodes, sub_inits, sub_env = _convert_jaxpr(
-                ij, iconsts, ins, prefix=fresh("sub") + "/")
+                ij, iconsts, ins, prefix=fresh("sub") + "/",
+                opset=opset)
             nodes += sub_nodes
             inits += sub_inits
             for v, ov in zip(eqn.outvars, ij.outvars):
@@ -276,18 +283,168 @@ def _convert_jaxpr(jaxpr, consts, in_names, prefix=""):
             nodes.append(_node("ReduceSum", [ins[0], cn], outs,
                                keepdims=0))
         elif prim in ("reduce_max", "reduce_min"):
-            # axes-as-input only exists from opset 18 for these —
-            # attribute form is the opset-17-valid encoding
+            # axes moved from attribute to INPUT at opset 18 for these
             op = {"reduce_max": "ReduceMax",
                   "reduce_min": "ReduceMin"}[prim]
-            nodes.append(_node(op, [ins[0]], outs,
-                               axes=[int(a) for a in p["axes"]],
-                               keepdims=0))
+            if (opset or ONNX_OPSET) >= 18:
+                cn = fresh("axes")
+                inits.append(_tensor_proto(
+                    cn, np.asarray(p["axes"], np.int64)))
+                nodes.append(_node(op, [ins[0], cn], outs, keepdims=0))
+            else:
+                nodes.append(_node(op, [ins[0]], outs,
+                                   axes=[int(a) for a in p["axes"]],
+                                   keepdims=0))
         elif prim == "stop_gradient":
             nodes.append(_node("Identity", ins, outs))
         elif prim == "select_n" and len(ins) == 3:
             # select_n(pred, a, b) == Where(pred, b, a)
             nodes.append(_node("Where", [ins[0], ins[2], ins[1]], outs))
+        elif prim == "conv_general_dilated":
+            dn = p["dimension_numbers"]
+            nd = len(p["window_strides"])
+            canon = tuple(range(nd + 2))
+            if dn.lhs_spec != canon or dn.rhs_spec != canon \
+                    or dn.out_spec != canon:
+                raise NotImplementedError(
+                    "onnx export: conv with non-NCHW/OIHW layout")
+            if any(d != 1 for d in p["lhs_dilation"]):
+                raise NotImplementedError(
+                    "onnx export: transposed conv (lhs_dilation>1) — "
+                    "ONNX ConvTranspose flips the weight layout; use "
+                    "format='stablehlo'")
+            if p.get("batch_group_count", 1) != 1:
+                raise NotImplementedError(
+                    "onnx export: batch_group_count > 1")
+            pads = [int(lo) for lo, _ in p["padding"]] \
+                + [int(hi) for _, hi in p["padding"]]
+            nodes.append(_node(
+                "Conv", ins, outs,
+                strides=[int(s) for s in p["window_strides"]],
+                dilations=[int(d) for d in p["rhs_dilation"]],
+                pads=pads, group=int(p["feature_group_count"])))
+        elif prim == "reduce_window_max":
+            wd = p["window_dimensions"]
+            ws = p["window_strides"]
+            if wd[0] != 1 or wd[1] != 1 or ws[0] != 1 or ws[1] != 1 \
+                    or any(x != 0 for pr in p["padding"][:2]
+                           for x in pr) \
+                    or any(d != 1 for d in p["base_dilation"]) \
+                    or any(d != 1 for d in p["window_dilation"]):
+                raise NotImplementedError(
+                    "onnx export: reduce_window_max beyond NCHW "
+                    "spatial max-pooling")
+            pads = [int(lo) for lo, _ in p["padding"][2:]] \
+                + [int(hi) for _, hi in p["padding"][2:]]
+            nodes.append(_node(
+                "MaxPool", ins, outs,
+                kernel_shape=[int(d) for d in wd[2:]],
+                strides=[int(s) for s in ws[2:]], pads=pads))
+        elif prim == "concatenate":
+            nodes.append(_node("Concat", ins, outs,
+                               axis=int(p["dimension"])))
+        elif prim == "pad":
+            cfg = p["padding_config"]
+            if any(int(i) != 0 for _, _, i in cfg):
+                raise NotImplementedError(
+                    "onnx export: interior (dilating) pad")
+            if any(int(lo) < 0 or int(hi) < 0 for lo, hi, _ in cfg):
+                raise NotImplementedError("onnx export: negative pad")
+            pads = [int(lo) for lo, _, _ in cfg] \
+                + [int(hi) for hi in (h for _, h, _ in cfg)]
+            cn = fresh("pads")
+            inits.append(_tensor_proto(cn, np.asarray(pads, np.int64)))
+            # ins = (operand, pad_value); ONNX: (data, pads, value)
+            nodes.append(_node("Pad", [ins[0], cn, ins[1]], outs,
+                               mode=b"constant"))
+        elif prim == "slice":
+            if p["strides"] is None:
+                steps = [1] * len(p["start_indices"])
+            else:
+                steps = [int(s) for s in p["strides"]]
+            names = []
+            for base, arr in (("starts", p["start_indices"]),
+                              ("ends", p["limit_indices"]),
+                              ("axes", range(len(steps))),
+                              ("steps", steps)):
+                cn = fresh(base)
+                inits.append(_tensor_proto(
+                    cn, np.asarray(list(arr), np.int64)))
+                names.append(cn)
+            nodes.append(_node("Slice", [ins[0]] + names, outs))
+        elif prim == "dynamic_slice":
+            data, starts_in = ins[0], ins[1:]
+            sizes = [int(s) for s in p["slice_sizes"]]
+            uns = []
+            for s in starts_in:
+                c64 = fresh("i64")
+                nodes.append(_node("Cast", [s], [c64], to=_DT["int64"]))
+                ax = fresh("axis0")
+                inits.append(_tensor_proto(
+                    ax, np.asarray([0], np.int64)))
+                u = fresh("uns")
+                nodes.append(_node("Unsqueeze", [c64, ax], [u]))
+                uns.append(u)
+            starts = fresh("starts")
+            nodes.append(_node("Concat", uns, [starts], axis=0))
+            sz = fresh("sizes")
+            inits.append(_tensor_proto(sz, np.asarray(sizes, np.int64)))
+            ends = fresh("ends")
+            nodes.append(_node("Add", [starts, sz], [ends]))
+            axes = fresh("axes")
+            inits.append(_tensor_proto(
+                axes, np.arange(len(sizes), dtype=np.int64)))
+            nodes.append(_node("Slice", [data, starts, ends, axes],
+                               outs))
+        elif prim == "gather":
+            dn = p["dimension_numbers"]
+            op_aval = eqn.invars[0].aval
+            idx_aval = eqn.invars[1].aval
+            ok = (len(dn.start_index_map) == 1
+                  and dn.collapsed_slice_dims == dn.start_index_map
+                  and not dn.operand_batching_dims
+                  and not dn.start_indices_batching_dims
+                  and idx_aval.shape[-1] == 1)
+            axis = dn.start_index_map[0] if ok else None
+            if ok:
+                for d in range(op_aval.ndim):
+                    if d != axis and p["slice_sizes"][d] != op_aval.shape[d]:
+                        ok = False
+                if p["slice_sizes"][axis] != 1:
+                    ok = False
+            if not ok:
+                raise NotImplementedError(
+                    "onnx export: gather beyond single-axis take "
+                    "(jnp.take/x[idx]) — use format='stablehlo'")
+            # jax start_indices carry a trailing length-1 coord dim;
+            # ONNX Gather indices are the bare batch shape
+            cn = fresh("ishape")
+            inits.append(_tensor_proto(
+                cn, np.asarray(idx_aval.shape[:-1] or (1,), np.int64)))
+            sq = fresh("idx")
+            nodes.append(_node("Reshape", [ins[1], cn], [sq]))
+            if idx_aval.shape[:-1]:
+                nodes.append(_node("Gather", [ins[0], sq], outs,
+                                   axis=int(axis)))
+            else:
+                mid = fresh("g0")
+                nodes.append(_node("Gather", [ins[0], sq], [mid],
+                                   axis=int(axis)))
+                shp = fresh("oshape")
+                inits.append(_tensor_proto(
+                    shp, np.asarray(eqn.outvars[0].aval.shape,
+                                    np.int64)))
+                nodes.append(_node("Reshape", [mid, shp], outs))
+        elif prim == "argmax":
+            # ONNX ArgMax always yields int64; jax's result dtype is
+            # the index_dtype (int32 by default) — Cast to keep the
+            # declared graph types valid
+            mid = fresh("argmax64")
+            nodes.append(_node("ArgMax", ins, [mid],
+                               axis=int(p["axes"][0]), keepdims=0))
+            dt_name = str(np.dtype(eqn.outvars[0].aval.dtype))
+            nodes.append(_node("Cast", [mid], outs,
+                               to=_DT.get(dt_name, 7)))
         else:
             raise NotImplementedError(
                 f"onnx export: unsupported primitive '{prim}' — use "
@@ -301,6 +458,14 @@ def export_onnx(layer, path, input_spec=None, opset_version=None):
     from .jit import _specs_to_avals
     from .framework.tensor import Tensor
 
+    opset = int(opset_version or ONNX_OPSET)
+    # the emitted encodings (ReduceSum axes-as-input from 13, Slice
+    # input form, Pad value input) are valid for this window; an
+    # out-of-range request would silently produce an invalid model
+    if not 13 <= opset <= 19:
+        raise ValueError(
+            f"onnx export: opset_version {opset} unsupported — the "
+            "emitted op encodings are valid for opsets 13..19")
     avals = _specs_to_avals(input_spec)
     sd = layer.state_dict()
     names = list(sd.keys())
@@ -316,7 +481,7 @@ def export_onnx(layer, path, input_spec=None, opset_version=None):
                                   for a in avals])
     in_names = [f"x{i}" for i in range(len(avals))]
     nodes, inits, env = _convert_jaxpr(closed.jaxpr, closed.consts,
-                                       in_names)
+                                       in_names, opset=opset)
     from jax._src.core import Literal
     out_names = []
     for i, ov in enumerate(closed.jaxpr.outvars):
@@ -342,13 +507,13 @@ def export_onnx(layer, path, input_spec=None, opset_version=None):
         g.message(12, _value_info(nm, ov.aval.shape,
                                   str(ov.aval.dtype)))           # output
 
-    opset = _Proto()
-    opset.varint(2, int(opset_version or ONNX_OPSET))  # version
+    opset_msg = _Proto()
+    opset_msg.varint(2, opset)               # version
     m = _Proto()
     m.varint(1, ONNX_IR_VERSION)             # ir_version
     m.string(2, "paddle_tpu")                # producer_name
     m.message(7, g)                          # graph
-    m.message(8, opset)                      # opset_import
+    m.message(8, opset_msg)                  # opset_import
     out_path = path if path.endswith(".onnx") else path + ".onnx"
     with open(out_path, "wb") as f:
         f.write(bytes(m))

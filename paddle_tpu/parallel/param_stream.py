@@ -1,0 +1,52 @@
+"""Parameter streaming scope for ZeRO-3 host offload.
+
+Reference: `python/paddle/distributed/fleet/meta_parallel/sharding/
+group_sharded_stage3.py:110,127,294` — `offload=True` parks parameter
+slices on host and fetches them per-layer around each forward/backward.
+
+TPU-native design: parameters live in pinned_host memory between steps.
+Inside the jitted step, each decoder block's `recompute` region begins
+with an in-graph host→HBM `device_put` of THAT block's parameters — so
+the transfer sits INSIDE the rematerialized region:
+
+  * forward: block params stream in, block computes, the device copies
+    die at region exit (only the residual-stream boundary is saved);
+  * backward: `jax.checkpoint` replays the region, which re-streams the
+    params — HBM never holds more than ~one block's parameters;
+  * gradients: autodiff of `device_put(host→device)` is the reverse
+    transfer, so grads MATERIALIZE in host memory — the all-params grad
+    buffer leaves HBM too;
+  * XLA's latency-hiding scheduler overlaps the next block's DMA with
+    the current block's compute (the double-buffered prefetch the
+    reference implements by hand with CUDA streams).
+
+The scope maps parameter-Tensor OBJECT ids to their device shardings —
+object identity is stable across `_swapped_state` value swaps, which is
+what makes the trainer↔recompute handshake work without name plumbing.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+__all__ = ["param_stream_scope", "stream_sharding_for"]
+
+_ACTIVE: list = []
+
+
+@contextmanager
+def param_stream_scope(table):
+    """table: {id(param_tensor): NamedSharding(..., memory_kind="device")}
+    — active while TRACING the train step's forward."""
+    _ACTIVE.append(table)
+    try:
+        yield
+    finally:
+        _ACTIVE.pop()
+
+
+def stream_sharding_for(tensor_obj):
+    """Device sharding for this parameter if the active scope streams
+    it, else None."""
+    if not _ACTIVE:
+        return None
+    return _ACTIVE[-1].get(id(tensor_obj))

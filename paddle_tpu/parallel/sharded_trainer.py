@@ -120,28 +120,33 @@ class ShardedTrainStep:
                  sharding_stage: int = 0, rematerialize: bool = False,
                  batch_axes=("dp", "sharding"), donate: bool = True,
                  seq_axis: Optional[str] = None, seq_dim: int = 1,
-                 offload: bool = False):
+                 offload=False):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.stage = sharding_stage
         self.remat = rematerialize
-        # optimizer-state host offload (reference:
-        # group_sharded_stage3.py `offload` — fp32 master + moments
-        # parked on CPU).  TPU-native: the state pytree lives in
-        # pinned_host memory; each step streams it through HBM for the
-        # update (device_put inside the jitted step) and the out_
-        # shardings land the new state back on the host.  HBM then
-        # holds only params + grads + activations — the lever that
-        # lifts the trainable-size ceiling ~2x on a 16G chip.
+        # host offload (reference: group_sharded_stage3.py `offload` —
+        # fp32 master + moments, and with offload=True also the
+        # PARAMETER slices, parked on CPU).  TPU-native:
+        #   offload=True      — optimizer-state pytree lives in
+        #     pinned_host; each step streams it through HBM for the
+        #     update and the out_shardings land it back on the host.
+        #   offload="params"  — additionally the parameters themselves
+        #     park on the host.  Per-block recompute regions stream
+        #     their own params in-graph (parallel/param_stream.py), so
+        #     the backward replay re-streams them and grads materialize
+        #     host-side: HBM holds ~one block's params + activations —
+        #     the lever from the ~2B ceiling to 4B+ on a 16G chip.
         # In-step streaming needs the runtime's memory-space annotate op
         # (TPU); the CPU backend lacks it, so there the host parking
         # happens at step boundaries outside jit (identical placement
         # semantics — what the CPU-mesh tests validate).
-        self.offload = offload
-        self._stream_offload = offload and \
-            jax.default_backend() == "tpu" 
+        self.offload = bool(offload)
+        self.offload_params = offload in ("params", "all")
+        self._stream_offload = bool(offload) and \
+            jax.default_backend() == "tpu"
         self.batch_axes = batch_axes
         self.seq_axis = seq_axis
         self.seq_dim = seq_dim
@@ -159,6 +164,8 @@ class ShardedTrainStep:
         sd = self.model.state_dict()
         shard_n = mesh.shape.get("sharding", 1)
         self._param_shardings = {}
+        self._param_store_shardings = {}
+        self._dev_param_shardings = {}
         for n in self._names:
             p = sd[n]
             spec = _current_spec(p.value)
@@ -172,7 +179,13 @@ class ShardedTrainStep:
                                          p.value.shape, shard_n, mesh)
             ns = NamedSharding(mesh, P(*spec))
             self._param_shardings[n] = ns
-            p._value = jax.device_put(p.value, ns)
+            self._param_store_shardings[n] = NamedSharding(
+                mesh, ns.spec, memory_kind="pinned_host") \
+                if self.offload_params else ns
+            self._dev_param_shardings[n] = NamedSharding(
+                mesh, ns.spec, memory_kind="device")
+            p._value = jax.device_put(p.value,
+                                      self._param_store_shardings[n])
         self._opt_shardings = {}
         self._opt_store_shardings = {}
         self._dev_opt_shardings = {}
@@ -208,6 +221,22 @@ class ShardedTrainStep:
                     for n, st in zip(self._names, self._opt_states)]
         return self._opt_states
 
+    def _params_for_call(self, param_vals):
+        """Param values as the compiled step expects them: host-parked
+        (streaming mode handles transfers in-graph) or moved to device
+        at the boundary (CPU fallback)."""
+        if self.offload_params and not self._stream_offload:
+            return [jax.device_put(v, self._param_shardings[n])
+                    for n, v in zip(self._names, param_vals)]
+        return param_vals
+
+    def _park_params(self, new_params):
+        """Updated params in their between-step storage placement."""
+        if self.offload_params and not self._stream_offload:
+            return [jax.device_put(v, self._param_store_shardings[n])
+                    for n, v in zip(self._names, new_params)]
+        return new_params
+
     def _park_states(self, new_states):
         """Return states in their between-step storage placement."""
         if self.offload and not self._stream_offload:
@@ -233,10 +262,18 @@ class ShardedTrainStep:
         opt = self.optimizer
         states = []
         for n in self._names:
-            st = opt._init_state(sd[n])
+            p = sd[n]
+            if self.offload_params:
+                # zeros_like/cast on a pinned_host array would try to
+                # BUILD host-sharded arrays through the device path
+                # (jax make_array_from_callback rejects the mix); init
+                # from a device twin, the store device_put parks it
+                p = Tensor(jax.device_put(
+                    p.value, self._dev_param_shardings[n]))
+            st = opt._init_state(p)
             # multi_precision: the fp32 master joins the state pytree and
             # is sharded by the same ZeRO policy as the moments
-            st = maybe_master_state(opt, sd[n], st)
+            st = maybe_master_state(opt, p, st)
             st = {k: jax.device_put(v, self._opt_store_shardings[n])
                   for k, v in st.items()}
             states.append(st)
@@ -268,12 +305,48 @@ class ShardedTrainStep:
             wds.append(wd)
         remat = self.remat
 
+        # param offload streaming: block params (matching the stacked-
+        # layer name pattern) stream inside their recompute regions via
+        # the scope; the long tail (embeddings, lm_head, final norm)
+        # transfers up-front in the forward
+        import os
+        import re
+        stream_params = self.offload_params and self._stream_offload
+        # PDTPU_PARAM_STREAM=1 opts into PER-BLOCK in-remat streaming
+        # (HBM holds ~one block's params; see param_stream.py).  The
+        # default is the boundary mode — all params transferred up-front
+        # each step, grads/updates still host-resident — because the
+        # current TPU toolchain ICEs on transfers inside rematerialized
+        # regions ("Bitcast changes dimensionality" → with barriers,
+        # "Unimplemented DMA from host to vmem"); measured 4.49B trains
+        # at 550 tok/s on 16G in boundary mode (15.79G peak)
+        per_block = os.environ.get("PDTPU_PARAM_STREAM", "0") == "1"
+        block_pat = re.compile(r"\.(layers|blocks|h|stages)\.\d+\.")
+        # only matrix params stream: small 1-D scales would be DMA'd
+        # host->vmem directly (unimplemented on the TPU runtime) and
+        # cost nothing to keep device-resident
+        streamed = [stream_params and per_block
+                    and bool(block_pat.search(n))
+                    and sd[n].value.ndim >= 2
+                    for n in names]
+        dev_param_sh = [self._dev_param_shardings[n] for n in names]
+        from .param_stream import param_stream_scope
+        stream_table = {id(sd[n]): dev_param_sh[i]
+                        for i, n in enumerate(names) if streamed[i]}
+
         def loss_of(param_vals, buf_vals, key, batch):
             def fwd(param_vals):
+                if stream_params:
+                    param_vals = [
+                        v if streamed[i]
+                        else jax.lax.optimization_barrier(
+                            jax.device_put(v, dev_param_sh[i]))
+                        for i, v in enumerate(param_vals)]
                 sd_ = model.state_dict()
                 with _swapped_state(model, names + buf_names,
                                     list(param_vals) + list(buf_vals)):
                     with prandom.key_scope(key), \
+                         param_stream_scope(stream_table), \
                          activation_sharding_scope(self.mesh,
                                                    self.batch_axes,
                                                    self.seq_axis,
@@ -315,6 +388,17 @@ class ShardedTrainStep:
         offload = self._stream_offload
         dev_opt_sh = [self._dev_opt_shardings[n] for n in names]
 
+        # param-offload scale: the latency-hiding scheduler HOISTS every
+        # per-param state transfer to the front of the update phase,
+        # making all masters+moments live in HBM at once (43G at 4.5B).
+        # Chaining each param's transfers behind a previous param's
+        # update output bounds the streaming window; the window size
+        # trades transfer/compute overlap against peak HBM
+        # (PDTPU_OFFLOAD_CHAIN_EVERY params per window, default 1).
+        chain_updates = stream_params
+        chain_every = max(1, int(os.environ.get(
+            "PDTPU_OFFLOAD_CHAIN_EVERY", "1")))
+
         def step(param_vals, opt_states, buf_vals, lr, step_i, key, batch):
             (loss, new_bufs), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(param_vals, buf_vals, key, batch)
@@ -322,6 +406,7 @@ class ShardedTrainStep:
                 grads = [jax.lax.with_sharding_constraint(g, gs)
                          for g, gs in zip(grads, grad_shardings)]
             new_params, new_states = [], []
+            token = None
             for i, (p, g, s, wd, ls, sp) in enumerate(
                     zip(param_vals, grads, opt_states, wds, lr_scales,
                         opt_specs)):
@@ -330,14 +415,38 @@ class ShardedTrainStep:
                     # the per-param transfers with the update chain
                     s = {k: jax.device_put(v, dev_opt_sh[i])
                          for k, v in s.items()}
+                    if chain_updates and token is not None:
+                        keys = list(s)
+                        out = jax.lax.optimization_barrier(
+                            tuple(s[k] for k in keys) + (token,))
+                        s = dict(zip(keys, out[:-1]))
+                if stream_params:
+                    # param and grad are host-resident (grads of a
+                    # host->device transfer land back on the host);
+                    # bring this param's pair to HBM for the update —
+                    # the out_shardings park the result back.  The
+                    # barrier (a) forces an HBM materialization (an
+                    # unbarriered copy fuses into the update kernel as
+                    # an unimplemented host->vmem DMA) and (b) rides
+                    # the same serialization chain as the states
+                    p = jax.device_put(p, dev_param_sh[i])
+                    g = jax.device_put(g, dev_param_sh[i])
+                    if chain_updates and token is not None:
+                        p, g, _ = jax.lax.optimization_barrier(
+                            (p, g, token))
+                    else:
+                        p, g = jax.lax.optimization_barrier((p, g))
                 np_, ns = apply_update(
                     upd, p, g, s, lr if ls == 1.0 else lr * ls, wd,
                     step_i, hp, fused_ok=fused_ok, mesh=mesh, spec=sp)
                 new_params.append(np_)
                 new_states.append(ns)
+                if chain_updates and (i + 1) % chain_every == 0:
+                    token = np_
             return loss, new_params, new_states, new_bufs
 
-        param_sh = [self._param_shardings[n] for n in names]
+        param_sh = [self._param_store_shardings[n] if stream_params
+                    else self._param_shardings[n] for n in names]
         # outputs land back on the host only in streaming mode; the CPU
         # fallback parks them host-side at the call boundary instead
         out_opt = self._opt_store_shardings if self._stream_offload \
@@ -372,7 +481,8 @@ class ShardedTrainStep:
         """Shared prologue of __call__ and compiled_hlo: gather current
         values, lazily init opt states / build, shard the batch."""
         sd = self._sd = self.model.state_dict()
-        param_vals = [sd[n]._value for n in self._names]
+        param_vals = self._params_for_call(
+            [sd[n]._value for n in self._names])
         buf_vals = [sd[n]._value for n in self._buf_names]
         if self._opt_states is None:
             self._opt_states = self._init_opt_states()
@@ -451,7 +561,7 @@ class ShardedTrainStep:
         commit_lr()
         self.optimizer._step_count += k
         sd = self._sd
-        for n, v in zip(self._names, new_params):
+        for n, v in zip(self._names, self._park_params(new_params)):
             sd[n]._value = v
         for n, v in zip(self._buf_names, new_bufs):
             sd[n]._value = v
@@ -481,7 +591,7 @@ class ShardedTrainStep:
                 jnp.asarray(lr, jnp.float32),
                 jnp.asarray(self.optimizer._step_count, jnp.int32), key,
                 batch_vals)
-        for n, v in zip(self._names, new_params):
+        for n, v in zip(self._names, self._park_params(new_params)):
             sd[n]._value = v
         for n, v in zip(self._buf_names, new_bufs):
             sd[n]._value = v

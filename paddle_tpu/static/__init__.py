@@ -11,6 +11,33 @@ compiles the captured function against the feed shapes and caches the
 executable (the `_ExecutorCache` role maps onto jax's compilation cache).
 The API subset implemented covers `Model.fit(static)`-style usage:
 program_guard + data() + layer calls + Executor.run(feed, fetch_list).
+
+HARD LIMIT — what this facade does and does not support
+=======================================================
+Supported (pinned by tests/test_static_engine.py):
+  * ``enable_static(); with program_guard(main, startup): x = data(...)
+    -> layer calls -> loss``, then ``Executor.run(startup)`` and
+    ``Executor.run(main, feed={...}, fetch_list=[...])`` — including
+    gradient fetches via ``gradients`` and repeated runs with new feeds
+    (recompiled per feed-shape, cached like _ExecutorCache);
+  * ``paddle.hapi.Model`` static-mode fit/evaluate/predict;
+  * ``jit.save / jit.load`` StableHLO program serialization.
+
+Out of scope BY DESIGN (no Program IR exists to mutate):
+  * ``Program.block(...).append_op(...)`` / ``Program.desc`` op-list
+    surgery, pass pipelines (``apply_pass``), and any workflow that
+    edits a ProgramDesc in place — the reference mutates its graph IR
+    (base/executor.py:1920 drives the mutated desc); here the only IR
+    is XLA HLO, produced by tracing, so program SURGERY maps to editing
+    the python function (or the jaxpr via ``jit`` transforms) instead;
+  * ``Executor.run`` partial-graph execution that fetches arbitrary
+    interior variables not captured at trace time;
+  * inference ``save_inference_model`` program pruning (use
+    ``jit.save`` / ONNX export instead).
+
+A reference workflow that needs those should port to the ``to_static``
+path (jit/dy2static traces python control flow into lax.cond/while) —
+that IS this framework's static form.
 """
 from __future__ import annotations
 
@@ -87,6 +114,16 @@ class Program:
 
     def clone(self, for_test=False):
         return self
+
+    def append_op(self, *a, **k):
+        """Documented hard limit (module docstring): there is no op-list
+        IR to mutate — programs are traced python, the IR is XLA HLO."""
+        raise NotImplementedError(
+            "Program.append_op: paddle_tpu has no mutable ProgramDesc — "
+            "programs are traced python callables and the IR is XLA "
+            "HLO.  Express the op in the python function (or use the "
+            "to_static/jit path); see paddle_tpu/static/__init__.py "
+            "docstring for the supported static surface.")
 
     def var(self, name):
         return self.placeholders.get(name)

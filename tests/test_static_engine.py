@@ -249,3 +249,18 @@ class TestShardOptimizerCallable:
                 dist.shard_optimizer(opt, "stage1")
         finally:
             dist.auto_parallel.set_mesh(None)
+
+
+def test_static_hard_limit_documented_and_enforced():
+    """Round-5 verdict item 9: the static facade's boundary is written
+    down and pinned — the supported program_guard surface works, and
+    append_op program surgery refuses with guidance."""
+    import paddle_tpu.static as static
+    doc = static.__doc__
+    assert "HARD LIMIT" in doc and "append_op" in doc \
+        and "to_static" in doc
+    prog = static.Program()
+    with pytest.raises(NotImplementedError, match="to_static"):
+        prog.append_op("elementwise_add")
+    with pytest.raises(NotImplementedError):
+        prog.global_block().append_op("elementwise_add")
