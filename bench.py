@@ -441,6 +441,40 @@ def bench_llama_decode():
           f"hbm_roofline={roofline:.0f} tok/s)",
           tok_s / max(roofline, 1e-9), spread, vals)
 
+    # continuous batching at MIXED prompt lengths (round-5 verdict
+    # item 8): staggered requests through one ContinuousBatcher,
+    # aggregate generated tokens / wall time
+    from paddle_tpu.inference import ContinuousBatcher
+    rngm = np.random.RandomState(1)
+    if on_tpu:
+        lens = [64, 128, 256, 192] * 4      # 16 requests over 8 slots
+        n_new, chunk, max_len = 256, 64, 640
+    else:
+        lens = [4, 8, 6, 10]
+        n_new, chunk, max_len = 8, 4, 32
+    prompts = [rngm.randint(0, cfg.vocab_size, L).astype(np.int32)
+               for L in lens]
+    bat = ContinuousBatcher(model, max_batch_size=batch,
+                            max_len=max_len, chunk=chunk)
+    for p_ in prompts[:batch]:
+        bat.submit(p_, n_new)
+    bat.step()                              # compile prefills + decode
+    # tokens already produced during the untimed warmup round must not
+    # count toward the timed throughput
+    warm = sum(len(r.tokens) for r in bat._slots if r is not None) \
+        + sum(len(r.tokens) for r in bat._finished.values())
+    t0 = time.perf_counter()
+    for p_ in prompts[batch:]:
+        bat.submit(p_, n_new)
+    outs = bat.run()
+    dt = time.perf_counter() - t0
+    total = sum(len(v) for v in outs.values()) - warm
+    _emit("llama_serve_mixed_tokens_per_sec", total / dt,
+          f"aggregate tok/s, {len(prompts)} staggered reqs, prompt "
+          f"lens {sorted(set(lens))}, b={batch} slots, chunk={chunk}; "
+          "one-shot aggregate (not a median-of-reps metric)",
+          (total / dt) / max(roofline, 1e-9), 0.0, [total / dt])
+
 
 CONFIGS = {
     "llama": bench_llama,

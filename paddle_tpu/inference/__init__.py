@@ -28,7 +28,10 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
-           "PrecisionType", "PlaceType", "get_version"]
+           "PrecisionType", "PlaceType", "get_version",
+           "ContinuousBatcher", "Request"]
+
+from .serving import ContinuousBatcher, Request  # noqa: E402
 
 
 def get_version() -> str:

@@ -186,10 +186,20 @@ class LlamaAttention(nn.Layer):
         q, k = tpu_ops.apply_rope(q, k, cos, sin)
         pos = jnp.asarray(pos, jnp.int32)
         z = jnp.zeros((), jnp.int32)
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (z, pos, z, z))
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (z, pos, z, z))
+        if pos.ndim == 0:
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (z, pos, z, z))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (z, pos, z, z))
+        else:
+            # per-slot write depth (continuous batching): each batch
+            # row lands at its own position in its own ring buffer
+            def upd(cb, xb, p):
+                return jax.lax.dynamic_update_slice(cb, xb, (p, z, z))
+            k_cache = jax.vmap(upd)(k_cache, k.astype(k_cache.dtype),
+                                    pos)
+            v_cache = jax.vmap(upd)(v_cache, v.astype(v_cache.dtype),
+                                    pos)
         out = tpu_ops.cached_attention(q, k_cache, v_cache, pos)
         return out.reshape(b, s, -1) @ wo, k_cache, v_cache
 
@@ -374,10 +384,13 @@ class LlamaModel(nn.Layer):
 
     def forward_cached(self, input_ids, cache, pos):
         """input_ids: [b, s_new] jax array; cache: init_cache pytree;
-        pos: int32 scalar.  Returns (hidden [b, s_new, h], new_cache)."""
+        pos: int32 scalar (uniform depth) or [b] vector (per-slot
+        depths — continuous batching).  Returns (hidden [b, s_new, h],
+        new_cache)."""
         cfg = self.config
         s = input_ids.shape[1]
-        positions = pos + jnp.arange(s, dtype=jnp.int32)
+        positions = jnp.asarray(pos, jnp.int32)[..., None] \
+            + jnp.arange(s, dtype=jnp.int32)
         cos, sin = tpu_ops.rope_cos_sin(s, cfg.head_dim, cfg.rope_theta,
                                         jnp.float32,
                                         position_ids=positions)

@@ -117,9 +117,18 @@ def cached_attention(q, k_cache, v_cache, q_pos0, scale=None):
     sk = k_cache.shape[1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     logits = gqa_scores(q, k_cache) * s
-    pos_q = q_pos0 + jnp.arange(sq, dtype=jnp.int32)[:, None]
-    valid = jnp.arange(sk, dtype=jnp.int32)[None, :] <= pos_q
-    logits = jnp.where(valid[None, None], logits, -1e30)
+    pos0 = jnp.asarray(q_pos0, jnp.int32)
+    if pos0.ndim == 0:
+        pos_q = pos0 + jnp.arange(sq, dtype=jnp.int32)[:, None]
+        valid = jnp.arange(sk, dtype=jnp.int32)[None, :] <= pos_q
+        logits = jnp.where(valid[None, None], logits, -1e30)
+    else:
+        # PER-SLOT positions ([b] vector): each sequence in the batch
+        # sits at its own depth — the continuous-batching decode form
+        pos_q = pos0[:, None] + jnp.arange(sq, dtype=jnp.int32)[None]
+        valid = jnp.arange(sk, dtype=jnp.int32)[None, None, :] \
+            <= pos_q[:, :, None]
+        logits = jnp.where(valid[:, None], logits, -1e30)
     w = jax.nn.softmax(logits, axis=-1)
     out = gqa_weighted_v(w.astype(v_cache.dtype), v_cache)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)

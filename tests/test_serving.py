@@ -1,0 +1,103 @@
+"""Continuous batching (inference/serving.py — round-5 verdict item 8).
+
+Reference analog: block_multihead_attention.py paged-KV scheduling.
+The contract under test: staggered requests flowing through ONE
+batcher produce EXACTLY the tokens each request gets from an isolated
+greedy generate() run — admission, eviction, and slot reuse must never
+leak state across sequences.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousBatcher
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(7)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            intermediate_size=128,
+                            num_attention_heads=4,
+                            num_key_value_heads=2, vocab_size=128)
+    return LlamaForCausalLM(cfg)
+
+
+def _isolated(model, ids, n):
+    out = model.generate(paddle.to_tensor(np.asarray([ids], np.int32)),
+                         max_new_tokens=n)
+    return np.asarray(out.value)[0]
+
+
+def test_staggered_requests_match_isolated(model):
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, 128, L).astype(np.int32)
+               for L in (4, 7, 4, 11, 7)]
+    new = [6, 9, 12, 5, 8]
+
+    bat = ContinuousBatcher(model, max_batch_size=2, max_len=64,
+                            chunk=4)
+    # stagger: two submitted up-front, rest arrive while running
+    ids = [bat.submit(prompts[0], new[0]), bat.submit(prompts[1], new[1])]
+    bat.step()
+    ids.append(bat.submit(prompts[2], new[2]))
+    bat.step()
+    ids.append(bat.submit(prompts[3], new[3]))
+    ids.append(bat.submit(prompts[4], new[4]))
+    outs = bat.run()
+
+    assert sorted(outs) == sorted(ids)
+    for rid, prompt, n in zip(ids, prompts, new):
+        want = _isolated(model, prompt, n)
+        got = outs[rid]
+        np.testing.assert_array_equal(got, want[: len(got)])
+        assert len(got) == n
+
+
+def test_slot_reuse_no_state_leak(model):
+    """A slot that served a LONG sequence must serve a later SHORT one
+    identically to isolation (stale cache rows beyond the new prompt
+    must stay invisible)."""
+    rng = np.random.RandomState(9)
+    long_p = rng.randint(1, 128, 20).astype(np.int32)
+    short_p = rng.randint(1, 128, 5).astype(np.int32)
+
+    bat = ContinuousBatcher(model, max_batch_size=1, max_len=64,
+                            chunk=8)
+    r1 = bat.submit(long_p, 16)
+    r2 = bat.submit(short_p, 10)      # queued until slot 0 frees
+    outs = bat.run()
+    np.testing.assert_array_equal(outs[r1],
+                                  _isolated(model, long_p, 16))
+    np.testing.assert_array_equal(outs[r2],
+                                  _isolated(model, short_p, 10))
+
+
+def test_eos_eviction(model):
+    """eos finishes a sequence early; its slot frees for the queue."""
+    rng = np.random.RandomState(1)
+    p = rng.randint(1, 128, 6).astype(np.int32)
+    ref = _isolated(model, p, 24)
+    eos = int(ref[2])                  # force an early-ish stop token
+    bat = ContinuousBatcher(model, max_batch_size=1, max_len=64,
+                            chunk=4, eos_token_id=eos)
+    rid = bat.submit(p, 24)
+    outs = bat.run()
+    got = outs[rid]
+    assert got[-1] == eos and len(got) <= 24
+    np.testing.assert_array_equal(got, ref[: len(got)])
+
+
+def test_mixed_lengths_aggregate(model):
+    """Mixed prompt lengths in flight simultaneously (distinct prefill
+    programs, shared decode program)."""
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(1, 128, L).astype(np.int32)
+               for L in (3, 9, 15, 6)]
+    bat = ContinuousBatcher(model, max_batch_size=4, max_len=64,
+                            chunk=8)
+    rids = [bat.submit(p, 8) for p in prompts]
+    outs = bat.run()
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(outs[rid], _isolated(model, p, 8))

@@ -39,16 +39,65 @@ def _median_time(fn, sync, reps=3, inner=4):
     return float(np.median(vals))
 
 
-def profile_llama():
+def _profile(model, step, batch, seq, n_params, label,
+             remat_flops=0.0):
+    """Shared phase-timing scaffold: forward / forward+backward / full
+    step over one batch; returns the metrics row."""
     import jax
     import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import _swapped_state
+    from paddle_tpu.framework.tensor import Tensor
+    from bench import chip_peak_flops
+
+    rng = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+    ids = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    sd = model.state_dict()
+    names = list(sd)
+    vals = [sd[n]._value for n in names]
+
+    def loss_fn(param_vals, xin):
+        with _swapped_state(model, names, list(param_vals)):
+            out = model(Tensor(xin))
+            loss = model.compute_loss(out, Tensor(xin))
+        return loss._value
+
+    fwd = jax.jit(loss_fn)
+    fwdbwd = jax.jit(lambda pv, xin: jax.value_and_grad(loss_fn)(
+        pv, xin))
+
+    def sync():
+        # host transfer forces completion through the relay
+        _ = float(np.asarray(jax.device_get(jnp.zeros(()) + 0)))
+
+    t_fwd = _median_time(lambda: fwd(vals, x.value), sync)
+    t_fb = _median_time(lambda: fwdbwd(vals, x.value), sync)
+    t_full = _median_time(lambda: step(x, x), sync)
+    tok = batch * seq
+    peak = chip_peak_flops()
+    return {
+        "config": label, "n_params": n_params,
+        "t_fwd_ms": t_fwd * 1e3,
+        "t_fwdbwd_ms": t_fb * 1e3,
+        "t_full_ms": t_full * 1e3,
+        "t_bwd_ms": (t_fb - t_fwd) * 1e3,
+        "t_opt_ms": (t_full - t_fb) * 1e3,
+        "fwd_util": 2.0 * n_params * tok / (t_fwd * peak),
+        "bwd_util": 4.0 * n_params * tok / ((t_fb - t_fwd) * peak),
+        "bwd_util_hw": (4.0 * n_params + remat_flops) * tok
+        / ((t_fb - t_fwd) * peak),
+        "mfu_full": 6.0 * n_params * tok / (t_full * peak),
+    }
+
+
+def profile_llama():
+    import jax
     import paddle_tpu as paddle
     from paddle_tpu.models.llama import LlamaForCausalLM, LlamaConfig
     from paddle_tpu.parallel import ShardedTrainStep
     from paddle_tpu.distributed.topology import build_mesh
-    from paddle_tpu.jit import _swapped_state
-    from paddle_tpu.framework.tensor import Tensor
-    from bench import chip_peak_flops
 
     on_tpu = jax.default_backend() == "tpu"
     n_sel = int(os.environ.get("BENCH_RECOMPUTE_LAYERS", "3"))
@@ -78,62 +127,17 @@ def profile_llama():
                                  else None)
     mesh = build_mesh(devices=jax.devices()[:1])
     step = ShardedTrainStep(model, opt, mesh, sharding_stage=3)
-
-    rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
-    x = paddle.to_tensor(ids)
-
-    sd = model.state_dict()
-    names = list(sd)
-    vals = [sd[n]._value for n in names]
-
-    def loss_fn(param_vals, xin):
-        with _swapped_state(model, names, list(param_vals)):
-            out = model(Tensor(xin))
-            loss = model.compute_loss(out, Tensor(xin))
-        return loss._value
-
-    fwd = jax.jit(loss_fn)
-    fwdbwd = jax.jit(lambda pv, xin: jax.value_and_grad(loss_fn)(
-        pv, xin))
-
-    def sync():
-        # host transfer forces completion through the relay
-        _ = float(np.asarray(jax.device_get(jnp.zeros(()) + 0)))
-
-    out = {"config": f"llama 1B b={batch} seq={seq}",
-           "n_params": n_params}
-    t_fwd = _median_time(lambda: fwd(vals, x.value), sync)
-    t_fb = _median_time(lambda: fwdbwd(vals, x.value), sync)
-    t_full = _median_time(lambda: step(x, x), sync)
-    tok = batch * seq
-    peak = chip_peak_flops()
-    remat_flops = n_sel * 4.0 * cfg.hidden_size * cfg.intermediate_size
-    out.update({
-        "t_fwd_ms": t_fwd * 1e3,
-        "t_fwdbwd_ms": t_fb * 1e3,
-        "t_full_ms": t_full * 1e3,
-        "t_bwd_ms": (t_fb - t_fwd) * 1e3,
-        "t_opt_ms": (t_full - t_fb) * 1e3,
-        "fwd_util": 2.0 * n_params * tok / (t_fwd * peak),
-        "bwd_util": 4.0 * n_params * tok / ((t_fb - t_fwd) * peak),
-        "bwd_util_hw": (4.0 * n_params + remat_flops) * tok
-        / ((t_fb - t_fwd) * peak),
-        "mfu_full": 6.0 * n_params * tok / (t_full * peak),
-    })
-    return out
+    remat = n_sel * 4.0 * cfg.hidden_size * cfg.intermediate_size
+    return _profile(model, step, batch, seq, n_params,
+                    f"llama 1B b={batch} seq={seq}", remat)
 
 
 def profile_bert():
     import jax
-    import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu.models.bert import BertForMaskedLM, BertConfig
     from paddle_tpu.parallel import ShardedTrainStep
     from paddle_tpu.distributed.topology import build_mesh
-    from paddle_tpu.jit import _swapped_state
-    from paddle_tpu.framework.tensor import Tensor
-    from bench import chip_peak_flops
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
@@ -155,46 +159,8 @@ def profile_bert():
     mesh = build_mesh(sharding=1, devices=jax.devices()[:1])
     step = ShardedTrainStep(model, opt, mesh, sharding_stage=1,
                             batch_axes=("dp", "sharding"))
-    rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
-    x = paddle.to_tensor(ids)
-
-    sd = model.state_dict()
-    names = list(sd)
-    vals = [sd[n]._value for n in names]
-
-    def loss_fn(param_vals, xin):
-        with _swapped_state(model, names, list(param_vals)):
-            out = model(Tensor(xin))
-            loss = model.compute_loss(out, Tensor(xin))
-        return loss._value
-
-    fwd = jax.jit(loss_fn)
-    fwdbwd = jax.jit(lambda pv, xin: jax.value_and_grad(loss_fn)(
-        pv, xin))
-
-    def sync():
-        _ = float(np.asarray(jax.device_get(jnp.zeros(()) + 0)))
-
-    out = {"config": f"bert-base b={batch} seq={seq}",
-           "n_params": n_params}
-    t_fwd = _median_time(lambda: fwd(vals, x.value), sync)
-    t_fb = _median_time(lambda: fwdbwd(vals, x.value), sync)
-    t_full = _median_time(lambda: step(x, x), sync)
-    tok = batch * seq
-    peak = chip_peak_flops()
-    out.update({
-        "t_fwd_ms": t_fwd * 1e3,
-        "t_fwdbwd_ms": t_fb * 1e3,
-        "t_full_ms": t_full * 1e3,
-        "t_bwd_ms": (t_fb - t_fwd) * 1e3,
-        "t_opt_ms": (t_full - t_fb) * 1e3,
-        "fwd_util": 2.0 * n_params * tok / (t_fwd * peak),
-        "bwd_util": 4.0 * n_params * tok / ((t_fb - t_fwd) * peak),
-        "bwd_util_hw": 4.0 * n_params * tok / ((t_fb - t_fwd) * peak),
-        "mfu_full": 6.0 * n_params * tok / (t_full * peak),
-    })
-    return out
+    return _profile(model, step, batch, seq, n_params,
+                    f"bert-base b={batch} seq={seq}")
 
 
 def render(rows):
