@@ -460,15 +460,15 @@ def bench_llama_decode():
         bat.submit(p_, n_new)
     bat.step()                              # compile prefills + decode
     # tokens already produced during the untimed warmup round must not
-    # count toward the timed throughput
-    warm = sum(len(r.tokens) for r in bat._slots if r is not None) \
-        + sum(len(r.tokens) for r in bat._finished.values())
+    # count toward the timed throughput (raw counter on the batcher —
+    # consistent units either side of t0)
+    warm = bat.tokens_produced
     t0 = time.perf_counter()
     for p_ in prompts[batch:]:
         bat.submit(p_, n_new)
-    outs = bat.run()
+    bat.run()
     dt = time.perf_counter() - t0
-    total = sum(len(v) for v in outs.values()) - warm
+    total = bat.tokens_produced - warm
     _emit("llama_serve_mixed_tokens_per_sec", total / dt,
           f"aggregate tok/s, {len(prompts)} staggered reqs, prompt "
           f"lens {sorted(set(lens))}, b={batch} slots, chunk={chunk}; "

@@ -156,8 +156,12 @@ class MoELayer(Layer):
     def __init__(self, d_model=None, d_hidden=None, num_experts=None,
                  gate="gshard", experts: Optional[List[Layer]] = None,
                  top_k=None, capacity_factor=None, ep_axis="dp",
-                 moe_group=None, recompute_interval=0, **kw):
+                 moe_group=None, recompute_interval=0,
+                 activation="gelu", **kw):
         super().__init__()
+        # "swiglu": llama/Mixtral-style experts — w1 holds gate+up
+        # halves ([E, d, 2*dh]); "gelu": the reference ExpertLayer MLP
+        self.activation = activation
         if isinstance(gate, str):
             if experts is not None and d_model is None:
                 d_model = experts[0].fc1.weight.shape[0]
@@ -184,11 +188,12 @@ class MoELayer(Layer):
         else:
             assert d_model and d_hidden and num_experts
             self.num_experts = num_experts
+            w1_h = 2 * d_hidden if activation == "swiglu" else d_hidden
             self.w1 = self.create_parameter(
-                shape=[num_experts, d_model, d_hidden],
+                shape=[num_experts, d_model, w1_h],
                 default_initializer=I.XavierUniform())
             self.b1 = self.create_parameter(
-                shape=[num_experts, 1, d_hidden], is_bias=True)
+                shape=[num_experts, 1, w1_h], is_bias=True)
             self.w2 = self.create_parameter(
                 shape=[num_experts, d_hidden, d_model],
                 default_initializer=I.XavierUniform())
@@ -219,9 +224,16 @@ class MoELayer(Layer):
         gate = self.gate
         gw = gate.weight
         if self.experts_list is None:
+            act = self.activation
             params = [gw, self.w1, self.b1, self.w2, self.b2]
 
             def fn(xv, gwv, w1, b1, w2, b2):
+                # storage dtype may be fp32 masters; compute in the
+                # activation dtype like the dense MLP path (a missing
+                # cast silently promotes the residual stream to fp32)
+                cd = xv.dtype
+                w1, b1 = w1.astype(cd), b1.astype(cd)
+                w2, b2 = w2.astype(cd), b2.astype(cd)
                 shape = xv.shape
                 tokens = xv.reshape(-1, shape[-1])
                 logits = tokens.astype(jnp.float32) @ gwv.astype(
@@ -239,8 +251,9 @@ class MoELayer(Layer):
                     for j in range(gate.top_k):
                         cmb = cmb + normv[:, j, None] * jax.nn.one_hot(
                             topi[:, j], gates.shape[-1])
-                    h = jax.nn.gelu(
-                        jnp.einsum("sm,emh->esh", tokens, w1) + b1)
+                    h = _expert_act(
+                        jnp.einsum("sm,emh->esh", tokens, w1) + b1,
+                        act)
                     expert_out = jnp.einsum("esh,ehm->esm", h, w2) + b2
                     y = jnp.einsum("se,esm->sm",
                                    cmb.astype(xv.dtype), expert_out)
@@ -253,8 +266,9 @@ class MoELayer(Layer):
                     aux = jnp.zeros((), jnp.float32)
                 expert_in = jnp.einsum("sec,sm->ecm",
                                        dispatch.astype(xv.dtype), tokens)
-                h = jax.nn.gelu(
-                    jnp.einsum("ecm,emh->ech", expert_in, w1) + b1)
+                h = _expert_act(
+                    jnp.einsum("ecm,emh->ech", expert_in, w1) + b1,
+                    act)
                 expert_out = jnp.einsum("ech,ehm->ecm", h, w2) + b2
                 y = jnp.einsum("sec,ecm->sm",
                                combine.astype(xv.dtype), expert_out)
@@ -289,6 +303,13 @@ class MoELayer(Layer):
             contrib = paddle_matmul(ce, xout)   # [S, d]
             y = contrib if y is None else y + contrib
         return reshape(y, list(shape))
+
+
+def _expert_act(h, act):
+    if act == "swiglu":
+        half = h.shape[-1] // 2
+        return jax.nn.silu(h[..., :half]) * h[..., half:]
+    return jax.nn.gelu(h)
 
 
 def paddle_matmul(a, b):

@@ -79,6 +79,9 @@ class ContinuousBatcher:
         self._done = jnp.ones((self.B,), bool)   # free slots are "done"
         self._prefill_fns: dict = {}
         self._decode_fn = None
+        # raw decoded tokens appended across all slots (prefill firsts
+        # + chunk tokens) — the throughput accounting counter
+        self.tokens_produced = 0
 
     # -- public API --------------------------------------------------------
     def submit(self, input_ids, max_new_tokens: int = 32) -> int:
@@ -143,6 +146,7 @@ class ContinuousBatcher:
             self._slots[i] = req
             first = self._prefill(i, req.prompt)
             req.tokens.append(int(first))
+            self.tokens_produced += 1
             self._tok = self._tok.at[i].set(int(first))
             self._pos = self._pos.at[i].set(len(req.prompt))
             self._done = self._done.at[i].set(False)
@@ -236,3 +240,4 @@ class ContinuousBatcher:
             if req is None:
                 continue
             req.tokens.extend(int(t) for t in toks[i])
+            self.tokens_produced += toks.shape[1]

@@ -36,7 +36,8 @@ from ..framework.dispatch import run, to_tensor_args
 from .. import ops as tpu_ops
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
-           "llama_tiny_config", "llama_7b_config"]
+           "llama_tiny_config", "llama_7b_config",
+           "llama_moe_tiny_config"]
 
 
 @dataclass
@@ -69,6 +70,14 @@ class LlamaConfig:
     # and the post-attention residual; the backward replays only the MLP
     # matmuls + the flash-attn forward (reference recompute_granularity)
     recompute_granularity: str = "full"
+    # sparse-MoE decoder (reference: fused_moe / Mixtral-style models):
+    # >0 replaces each block's dense MLP with moe_num_experts swiglu
+    # experts behind a top-k gate; expert dim shards over the mesh's
+    # expert axis (MoELayer ep_axis)
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_gate: str = "gshard"
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self):
@@ -89,6 +98,16 @@ def llama_tiny_config(**kw):
                       intermediate_size=384, num_hidden_layers=2,
                       num_attention_heads=4, num_key_value_heads=4,
                       max_position_embeddings=256)
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def llama_moe_tiny_config(**kw):
+    """Tiny sparse-MoE llama (Mixtral-style: swiglu experts, top-2
+    gshard gate) for tests and the EP dryrun."""
+    cfg = llama_tiny_config(moe_num_experts=4, moe_top_k=2,
+                            intermediate_size=256)
     for k, v in kw.items():
         setattr(cfg, k, v)
     return cfg
@@ -272,7 +291,16 @@ class LlamaDecoderLayer(nn.Layer):
             config.recompute_layers is None
             or layer_idx < config.recompute_layers)
         self.self_attn = LlamaAttention(config)
-        self.mlp = LlamaMLP(config)
+        if config.moe_num_experts > 0:
+            from ..incubate.distributed.models.moe import MoELayer
+            self.mlp = MoELayer(
+                d_model=config.hidden_size,
+                d_hidden=config.intermediate_size,
+                num_experts=config.moe_num_experts,
+                gate=config.moe_gate, top_k=config.moe_top_k,
+                activation="swiglu")
+        else:
+            self.mlp = LlamaMLP(config)
         self.input_layernorm = LlamaRMSNorm(config)
         self.post_attention_layernorm = LlamaRMSNorm(config)
 
@@ -337,10 +365,15 @@ class LlamaDecoderLayer(nn.Layer):
             h, cos, sin, k_cache, v_cache, pos)
         x = x + attn
         h = tpu_ops.rms_norm(x, ln2.astype(x.dtype), cfg.rms_norm_eps)
-        wg = self.mlp.gate_proj.value.astype(x.dtype)
-        wu = self.mlp.up_proj.value.astype(x.dtype)
-        wd = self.mlp.down_proj.value.astype(x.dtype)
-        x = x + tpu_ops.swiglu(h @ wg, h @ wu) @ wd
+        if cfg.moe_num_experts > 0:
+            # MoE decode: route through the expert layer (dispatch
+            # handles raw jax values; aux loss is irrelevant at decode)
+            x = x + self.mlp(h).value
+        else:
+            wg = self.mlp.gate_proj.value.astype(x.dtype)
+            wu = self.mlp.up_proj.value.astype(x.dtype)
+            wd = self.mlp.down_proj.value.astype(x.dtype)
+            x = x + tpu_ops.swiglu(h @ wg, h @ wu) @ wd
         return x, k_cache, v_cache
 
 
@@ -459,7 +492,20 @@ class LlamaForCausalLM(nn.Layer):
             picked = jnp.take_along_axis(logp, tgt[..., None],
                                          axis=-1)[..., 0]
             return -jnp.mean(picked)
-        return run(_fn, logits, name="causal_lm_loss")
+        loss = run(_fn, logits, name="causal_lm_loss")
+        if self.config.moe_num_experts > 0 \
+                and self.config.moe_aux_weight:
+            # load-balance auxiliary loss from each MoE block's last
+            # forward (reference: moe_layer keeps l_aux the same way)
+            for layer in self.llama.layers:
+                aux = getattr(layer.mlp, "l_aux", None)
+                if aux is not None:
+                    # l_aux is the Tensor run() produced — re-wrapping
+                    # would sever the recorded vjp chain (eager path)
+                    if not isinstance(aux, Tensor):
+                        aux = Tensor(aux)
+                    loss = loss + self.config.moe_aux_weight * aux
+        return loss
 
 
 def shard_llama_tp(model: LlamaForCausalLM, mesh):

@@ -1,0 +1,88 @@
+"""Sparse-MoE llama family (Mixtral-style; reference capability:
+fused_moe + the MoE meta_parallel stack).
+
+Pins: the MoE decoder trains (loss decreases, aux loss flows), the
+KV-cached generate path routes through the experts, and expert
+parallelism over the mesh reproduces the unsharded math.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                     llama_moe_tiny_config)
+
+
+def test_moe_llama_trains():
+    paddle.seed(11)
+    cfg = llama_moe_tiny_config()
+    m = LlamaForCausalLM(cfg)
+    # experts exist: stacked [E, d, 2*dh] swiglu weights per layer
+    sd = dict(m.named_parameters())
+    w1 = [v for n, v in sd.items() if n.endswith("mlp.w1")]
+    assert w1 and tuple(w1[0].shape) == (4, 128, 512)
+    from paddle_tpu.jit import TrainStep
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    step = TrainStep(m, lambda o, y: m.compute_loss(o, y), opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (4, 32)).astype(np.int32))
+    losses = [float(np.asarray(step(ids, ids).value))
+              for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_moe_llama_aux_loss_contributes():
+    paddle.seed(3)
+    cfg = llama_moe_tiny_config()
+    m = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(1)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32))
+    out = m(ids)
+    with_aux = float(np.asarray(m.compute_loss(out, ids).value))
+    m.config.moe_aux_weight = 0.0
+    no_aux = float(np.asarray(m.compute_loss(out, ids).value))
+    assert with_aux != no_aux          # gshard aux actually flows
+
+
+def test_moe_llama_generate():
+    paddle.seed(5)
+    cfg = llama_moe_tiny_config()
+    m = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(2)
+    prompt = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (2, 8)).astype(np.int32))
+    out = m.generate(prompt, max_new_tokens=6)
+    arr = np.asarray(out.value)
+    assert arr.shape == (2, 6)
+    assert (arr >= 0).all() and (arr < cfg.vocab_size).all()
+
+
+def test_moe_llama_expert_parallel_matches_dense():
+    """EP over an 8-way mesh reproduces the unsharded forward."""
+    import jax
+    from paddle_tpu.distributed.topology import (
+        HybridCommunicateGroup, set_hybrid_communicate_group)
+
+    rng = np.random.RandomState(7)
+    # fp32: bf16 would differ by reduction-order ulps under sharding
+    cfg = llama_moe_tiny_config(moe_num_experts=8, dtype="float32")
+    ids = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+
+    def build(with_mesh):
+        if with_mesh:
+            hcg = HybridCommunicateGroup(dp_degree=8,
+                                         devices=jax.devices()[:8])
+            set_hybrid_communicate_group(hcg)
+        else:
+            set_hybrid_communicate_group(None)
+        paddle.seed(13)
+        m = LlamaForCausalLM(cfg)
+        return np.asarray(m(paddle.to_tensor(ids)).value)
+
+    dense = build(False)
+    ep = build(True)
+    set_hybrid_communicate_group(None)
+    np.testing.assert_allclose(ep, dense, rtol=1e-4, atol=1e-4)
