@@ -448,7 +448,7 @@ def bench_llama_decode():
     rngm = np.random.RandomState(1)
     if on_tpu:
         lens = [64, 128, 256, 192] * 4      # 16 requests over 8 slots
-        n_new, chunk, max_len = 256, 64, 640
+        n_new, chunk, max_len = 128, 64, 640
     else:
         lens = [4, 8, 6, 10]
         n_new, chunk, max_len = 8, 4, 32
@@ -512,7 +512,7 @@ def main():
     # 16G chip for every config after the first.
     import subprocess
     here = os.path.abspath(__file__)
-    budget = float(os.environ.get("BENCH_CONFIG_TIMEOUT", "900"))
+    budget = float(os.environ.get("BENCH_CONFIG_TIMEOUT", "1500"))
     for name in CONFIGS:
         env = dict(os.environ)
         env["BENCH_CONFIG"] = name
