@@ -312,16 +312,13 @@ class TrainStep:
                 fwd = jax.checkpoint(fwd)
             return fwd(param_vals)
 
-        from ..optimizer.jit_update import apply_update
+        from ..optimizer.jit_update import apply_updates
 
         def step(param_vals, opt_states, buf_vals, lr, step_i, key, *batch):
             (loss, new_bufs), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(param_vals, buf_vals, key, *batch)
-            new_params, new_states = [], []
-            for p, g, s, wd in zip(param_vals, grads, opt_states, wds):
-                np_, ns = apply_update(upd, p, g, s, lr, wd, step_i, hp)
-                new_params.append(np_)
-                new_states.append(ns)
+            new_params, new_states = apply_updates(
+                upd, param_vals, grads, opt_states, lr, wds, step_i, hp)
             return loss, new_params, new_states, new_bufs
 
         self._step_fn = step

@@ -23,13 +23,16 @@ no separate copy exists.
 """
 from __future__ import annotations
 
+import itertools
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..framework.flags import get_flag, define_flag
 
-__all__ = ["apply_update", "maybe_master_state", "wants_master"]
+__all__ = ["apply_update", "apply_updates", "maybe_master_state",
+           "wants_master"]
 
 # r5 measurement note (tools/profile_mfu.py): STANDALONE the XLA
 # elementwise update beats the Pallas kernel 775 vs ~200 GB/s, but
@@ -42,6 +45,16 @@ define_flag("use_fused_adamw", True,
 define_flag("fused_adamw_interpret", False,
             "allow the fused AdamW path off-TPU (Pallas interpret mode) — "
             "for tests exercising the shard_map-wrapped kernel on CPU")
+define_flag("multi_tensor_adamw", True,
+            "flatten same-(wd, dtype, state-layout) SMALL params into one "
+            "fused AdamW call inside the jitted step (reference: "
+            "fused_adam_kernel.cu multi-tensor); large params keep "
+            "per-param calls — concatenating them would add full-buffer "
+            "copy traffic that outweighs the saved launches")
+
+# params below this element count are batched into one flat update; the
+# big matmul weights above it dominate HBM traffic, not launch count
+_MULTI_TENSOR_MAX = 1 << 20
 
 _HALF = (jnp.bfloat16, jnp.float16)
 
@@ -130,3 +143,81 @@ def apply_update(upd, p, g, s, lr, wd, step_i, hp, fused_ok=True,
         ns["master"] = new_master
         return new_master.astype(p.dtype), ns
     return upd(p, g, s, lr, wd, step_i, **hp)
+
+
+def apply_updates(upd, params, grads, states, lr, wds, step_i, hp,
+                  lr_scales=None):
+    """All parameters' updates inside a single-device jitted step.
+
+    Multi-tensor batching (reference: `fused_adam_kernel.cu` multi-tensor
+    AdamW): the MANY small params (norm scales, biases) are raveled and
+    concatenated per (wd, lr_scale, param dtype, moment dtypes, master?)
+    group and updated with ONE fused kernel call, then split back — the
+    per-launch overhead of ~N small kernels goes away while the copy
+    traffic added by the concat/split is bounded by the group's total
+    bytes (small by construction; params above _MULTI_TENSOR_MAX keep
+    their per-param call because for them traffic, not launches, is the
+    cost).  Falls back to the per-param path wholesale when the flag is
+    off or the state layout is not the fused Adam one.
+    """
+    if lr_scales is None:
+        lr_scales = [1.0] * len(params)
+
+    def _one(i):
+        ls = lr_scales[i]
+        return apply_update(upd, params[i], grads[i], states[i],
+                            lr if ls == 1.0 else lr * ls, wds[i],
+                            step_i, hp)
+
+    if not get_flag("multi_tensor_adamw"):
+        out = [_one(i) for i in range(len(params))]
+        return [o[0] for o in out], [o[1] for o in out]
+
+    groups: dict = {}
+    for i, (p, s) in enumerate(zip(params, states)):
+        if (p.size < _MULTI_TENSOR_MAX
+                and _fusable(hp, s, jnp.dtype(p.dtype))):
+            key = (float(wds[i]), float(lr_scales[i]),
+                   jnp.dtype(p.dtype).name, "master" in s,
+                   jnp.dtype(s["moment1"].dtype).name,
+                   jnp.dtype(s["moment2"].dtype).name)
+            groups.setdefault(key, []).append(i)
+
+    new_params = [None] * len(params)
+    new_states = [None] * len(params)
+    grouped = set()
+    from ..ops.pallas.fused_adamw import fused_adamw
+    for (wd, ls, _pd, has_master, _m1d, _m2d), idxs in groups.items():
+        if len(idxs) < 2:
+            continue
+        grouped.update(idxs)
+        sizes = [params[i].size for i in idxs]
+        flat_g = jnp.concatenate([grads[i].ravel() for i in idxs])
+        flat_m1 = jnp.concatenate(
+            [states[i]["moment1"].ravel() for i in idxs])
+        flat_m2 = jnp.concatenate(
+            [states[i]["moment2"].ravel() for i in idxs])
+        flat_mst = jnp.concatenate(
+            [(states[i]["master"] if has_master else params[i]).ravel()
+             for i in idxs])
+        new_p, m1, m2, mst = fused_adamw(
+            flat_g, flat_m1, flat_m2, flat_mst,
+            lr if ls == 1.0 else lr * ls, step_i,
+            b1=hp["b1"], b2=hp["b2"], eps=hp["eps"], wd=wd,
+            decoupled=hp["decoupled"], out_dtype=params[idxs[0]].dtype)
+        splits = [int(x) for x in itertools.accumulate(sizes)][:-1]
+        p_parts, m1_parts, m2_parts = (jnp.split(a, splits)
+                                       for a in (new_p, m1, m2))
+        mst_parts = jnp.split(mst, splits) if has_master else None
+        for j, i in enumerate(idxs):
+            shape = params[i].shape
+            new_params[i] = p_parts[j].reshape(shape)
+            ns = {"moment1": m1_parts[j].reshape(shape),
+                  "moment2": m2_parts[j].reshape(shape)}
+            if has_master:
+                ns["master"] = mst_parts[j].reshape(shape)
+            new_states[i] = ns
+    for i in range(len(params)):
+        if i not in grouped:
+            new_params[i], new_states[i] = _one(i)
+    return new_params, new_states

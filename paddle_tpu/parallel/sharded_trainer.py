@@ -376,7 +376,7 @@ class ShardedTrainStep:
         if self.stage == 2 and self.mesh.shape.get("sharding", 1) > 1:
             grad_shardings = [self._opt_shardings[n] for n in names]
 
-        from ..optimizer.jit_update import apply_update
+        from ..optimizer.jit_update import apply_update, apply_updates
         # single device: plain fused pallas update.  Sharded mesh: the
         # fused kernel is shard_map-wrapped over each state's spec inside
         # apply_update, so every chip updates only its ZeRO shard (a bare
@@ -405,6 +405,13 @@ class ShardedTrainStep:
             if grad_shardings is not None:
                 grads = [jax.lax.with_sharding_constraint(g, gs)
                          for g, gs in zip(grads, grad_shardings)]
+            if fused_ok and not offload and not stream_params:
+                # single device, nothing host-resident: multi-tensor
+                # batching of the small params (see jit_update)
+                new_params, new_states = apply_updates(
+                    upd, param_vals, grads, opt_states, lr, wds, step_i,
+                    hp, lr_scales=lr_scales)
+                return loss, new_params, new_states, new_bufs
             new_params, new_states = [], []
             token = None
             for i, (p, g, s, wd, ls, sp) in enumerate(
