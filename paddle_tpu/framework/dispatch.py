@@ -24,7 +24,7 @@ from .tensor import Tensor
 from . import dtypes
 
 __all__ = ["run", "run_inplace", "to_tensor_args", "wrap_out",
-           "set_amp_hook"]
+           "set_amp_hook", "set_static_hook"]
 
 # AMP O1 input-cast hook, registered by paddle_tpu.amp at import time
 # (reference: the generated ad_funcs call amp_auto_cast before dispatch,
@@ -35,6 +35,18 @@ _amp_hook = None
 def set_amp_hook(hook):
     global _amp_hook
     _amp_hook = hook
+
+
+# static-Program op recorder, registered by paddle_tpu.static at import
+# (reference: static mode routes ops through Block.append_op instead of
+# _C_ops; here the SAME eager execution additionally records an OpDesc
+# tape when a program_guard is active — see static/program.py)
+_static_hook = None
+
+
+def set_static_hook(hook):
+    global _static_hook
+    _static_hook = hook
 
 _FLOAT_KINDS = ("f", "c", "V")  # V covers bfloat16 (numpy void-backed)
 
@@ -73,10 +85,11 @@ def run(raw_fn, *tensors: Tensor, name: str = "", n_outs: Optional[int] = None):
     vals = [t._value for t in tensors]
     if _amp_hook is not None:
         vals = _amp_hook(name, vals)
+    has_tracer = any(_is_tracer(v) for v in vals)
     record = (
         is_grad_enabled()
         and any((not t.stop_gradient) for t in tensors)
-        and not any(_is_tracer(v) for v in vals)
+        and not has_tracer
     )
     if record:
         outs, vjp_fn = jax.vjp(raw_fn, *vals)
@@ -131,6 +144,9 @@ def run(raw_fn, *tensors: Tensor, name: str = "", n_outs: Optional[int] = None):
         for i, r in enumerate(out_refs):
             r.index = i
 
+    if _static_hook is not None and not has_tracer:
+        _static_hook(name, raw_fn, tensors, out_tensors)
+
     return out_tensors[0] if single else tuple(out_tensors)
 
 
@@ -141,4 +157,17 @@ def run_inplace(target: Tensor, raw_fn, *tensors: Tensor, name: str = ""):
     target._value = out._value
     target._set_ref(out._ref)
     target.stop_gradient = out.stop_gradient
+    # static tape: the in-place result is a NEW program variable; the
+    # python object adopts its vid so later recorded ops read the
+    # post-update value.  The OLD vid's leaf entries must freeze to
+    # their pre-update snapshot first — the live object no longer
+    # represents that variable (otherwise replay would read the
+    # post-update value AND re-apply the recorded mutation).
+    vid = getattr(out, "_static_vid", None)
+    if vid is not None:
+        old_vid = getattr(target, "_static_vid", None)
+        if old_vid is not None and old_vid != vid:
+            from ..static.program import on_inplace_retag
+            on_inplace_retag(target, old_vid)
+        target._static_vid = vid
     return target

@@ -4,40 +4,42 @@ Reference: `python/paddle/static/` + `python/paddle/base/executor.py`
 (Executor at :1234, _ExecutorCache :871) and the C++ StandaloneExecutor /
 PirInterpreter stack.
 
-TPU-native redesign: a Program is a captured python callable (traced by
-jax.jit at run time), not an op-list IR — XLA's HLO is the real IR
-(replacing ProgramDesc/PIR), and `Executor.run` is a facade that jit-
-compiles the captured function against the feed shapes and caches the
-executable (the `_ExecutorCache` role maps onto jax's compilation cache).
-The API subset implemented covers `Model.fit(static)`-style usage:
-program_guard + data() + layer calls + Executor.run(feed, fetch_list).
+TPU-native redesign (round 5): ops executed under an active
+``program_guard`` in static mode run eagerly (concrete shapes/values,
+same kernels as dygraph) AND record an op tape — ``static/program.py``
+``OpDesc`` entries of (pure jax fn, input vids, output vids) — which is
+this framework's ProgramDesc.  ``Executor.run(program, feed,
+fetch_list)`` REPLAYS the tape under ``jax.jit`` with feeds substituted
+for placeholders, re-executing the graph against new data every call
+(the jitted replay is cached per (fetch-set, feed-shapes), playing the
+`_ExecutorCache` role).  Fetching an interior variable prunes the tape
+to its ancestors (dead-op elimination) — partial-graph execution works.
 
-HARD LIMIT — what this facade does and does not support
-=======================================================
-Supported (pinned by tests/test_static_engine.py):
+Supported static surface (pinned by tests/test_static_engine.py +
+tests/test_static_program.py):
   * ``enable_static(); with program_guard(main, startup): x = data(...)
-    -> layer calls -> loss``, then ``Executor.run(startup)`` and
-    ``Executor.run(main, feed={...}, fetch_list=[...])`` — including
-    gradient fetches via ``gradients`` and repeated runs with new feeds
-    (recompiled per feed-shape, cached like _ExecutorCache);
-  * ``paddle.hapi.Model`` static-mode fit/evaluate/predict;
-  * ``jit.save / jit.load`` StableHLO program serialization.
+    -> layer calls -> loss`` then ``Executor.run(main, feed={...},
+    fetch_list=[...])`` — repeated runs with NEW feeds recompute, fetch
+    of any recorded interior variable works, ``gradients`` records a
+    differentiable slice replayed with the feeds;
+  * ``Block.append_op(type, inputs, outputs, attrs)`` for the curated
+    op set in ``_APPEND_OPS`` (elementwise_*, matmul/mul, activations,
+    scale, softmax, reduce/cast/reshape/transpose/concat) — op-list
+    program construction without a python callable;
+  * a tape pass pipeline: ``apply_pass(prog, "dead_code_elimination" |
+    "constant_folding")``;
+  * ``paddle_tpu.hapi.Model`` static-mode fit/evaluate/predict, and
+    ``jit.save / jit.load`` StableHLO serialization.
 
-Out of scope BY DESIGN (no Program IR exists to mutate):
-  * ``Program.block(...).append_op(...)`` / ``Program.desc`` op-list
-    surgery, pass pipelines (``apply_pass``), and any workflow that
-    edits a ProgramDesc in place — the reference mutates its graph IR
-    (base/executor.py:1920 drives the mutated desc); here the only IR
-    is XLA HLO, produced by tracing, so program SURGERY maps to editing
-    the python function (or the jaxpr via ``jit`` transforms) instead;
-  * ``Executor.run`` partial-graph execution that fetches arbitrary
-    interior variables not captured at trace time;
-  * inference ``save_inference_model`` program pruning (use
-    ``jit.save`` / ONNX export instead).
-
-A reference workflow that needs those should port to the ``to_static``
-path (jit/dy2static traces python control flow into lax.cond/while) —
-that IS this framework's static form.
+Out of scope BY DESIGN:
+  * append_op types outside the curated set (the YAML-wide op surface is
+    the functional API's job — wrap the python call in a program_guard
+    instead), and pass pipelines beyond the tape passes above — XLA is
+    the real optimizing compiler here, per SURVEY §7's design stance;
+  * re-running a recorded tape with feed SHAPES whose eager trace baked
+    in different static shapes (reshape with literal dims, etc.) —
+    recompile via a fresh guard, or use the ``to_static`` path
+    (jit/dy2static), which remains the idiomatic static form.
 """
 from __future__ import annotations
 
@@ -50,14 +52,18 @@ import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
 from ..framework import dtypes
+from ..framework import dispatch as _dispatch
 from .state import enable_static, disable_static, in_dynamic_mode, \
     in_static_mode
+from . import program as _prog_mod
+from .program import OpDesc, apply_pass, needed_ops, replay, tag_tensor
 
 __all__ = ["InputSpec", "Program", "default_main_program",
            "default_startup_program", "program_guard", "data", "Executor",
            "enable_static", "disable_static", "in_dynamic_mode",
            "in_static_mode", "name_scope", "gradients", "cpu_places",
-           "device_guard", "scope_guard", "global_scope", "Variable"]
+           "device_guard", "scope_guard", "global_scope", "Variable",
+           "apply_pass"]
 
 
 class InputSpec:
@@ -95,42 +101,376 @@ class _DataPlaceholder(Tensor):
         self.is_placeholder = True
 
 
-class Program:
-    """A recorded computation: placeholders + a deferred trace.
+# --------------------------------------------------------------------------
+# curated append_op surface: type -> (input keys, output keys, builder)
+# builder(attrs) returns the pure jax fn recorded on the tape.  Covers the
+# reference's most-used raw ProgramDesc ops (base/framework.py append_op
+# call sites in static nn).
 
-    Ops executed under `program_guard` run eagerly (building real Tensors);
-    `Executor.run` re-binds placeholder values and replays the recorded
-    fetch closure under jit.
+_APPEND_OPS: Dict[str, Any] = {}
+
+
+def _defop(name, in_keys, out_keys=("Out",)):
+    def deco(builder):
+        builder._in_keys = in_keys
+        builder._out_keys = out_keys
+        _APPEND_OPS[name] = builder
+        return builder
+    return deco
+
+
+@_defop("elementwise_add", ("X", "Y"))
+def _op_add(attrs):
+    return lambda x, y: x + y
+
+
+@_defop("elementwise_sub", ("X", "Y"))
+def _op_sub(attrs):
+    return lambda x, y: x - y
+
+
+@_defop("elementwise_mul", ("X", "Y"))
+def _op_mul(attrs):
+    return lambda x, y: x * y
+
+
+@_defop("elementwise_div", ("X", "Y"))
+def _op_div(attrs):
+    return lambda x, y: x / y
+
+
+@_defop("matmul_v2", ("X", "Y"))
+def _op_matmul(attrs):
+    tx = bool(attrs.get("trans_x", attrs.get("transpose_X", False)))
+    ty = bool(attrs.get("trans_y", attrs.get("transpose_Y", False)))
+
+    def fn(x, y):
+        if tx:
+            x = jnp.swapaxes(x, -1, -2)
+        if ty:
+            y = jnp.swapaxes(y, -1, -2)
+        return jnp.matmul(x, y)
+    return fn
+
+
+_APPEND_OPS["matmul"] = _APPEND_OPS["matmul_v2"]
+_APPEND_OPS["mul"] = _APPEND_OPS["matmul_v2"]
+
+
+@_defop("relu", ("X",))
+def _op_relu(attrs):
+    return lambda x: jnp.maximum(x, 0)
+
+
+@_defop("sigmoid", ("X",))
+def _op_sigmoid(attrs):
+    return jax.nn.sigmoid
+
+
+@_defop("tanh", ("X",))
+def _op_tanh(attrs):
+    return jnp.tanh
+
+
+@_defop("softmax", ("X",))
+def _op_softmax(attrs):
+    axis = int(attrs.get("axis", -1))
+    return lambda x: jax.nn.softmax(x, axis=axis)
+
+
+@_defop("scale", ("X",))
+def _op_scale(attrs):
+    s = float(attrs.get("scale", 1.0))
+    b = float(attrs.get("bias", 0.0))
+    after = bool(attrs.get("bias_after_scale", True))
+    if after:
+        return lambda x: x * s + b
+    return lambda x: (x + b) * s
+
+
+@_defop("reduce_mean", ("X",))
+def _op_reduce_mean(attrs):
+    dim = attrs.get("dim", attrs.get("axis", None))
+    keep = bool(attrs.get("keep_dim", attrs.get("keepdim", False)))
+    axis = tuple(dim) if isinstance(dim, (list, tuple)) else dim
+    return lambda x: jnp.mean(x, axis=axis, keepdims=keep)
+
+
+@_defop("reduce_sum", ("X",))
+def _op_reduce_sum(attrs):
+    dim = attrs.get("dim", attrs.get("axis", None))
+    keep = bool(attrs.get("keep_dim", attrs.get("keepdim", False)))
+    axis = tuple(dim) if isinstance(dim, (list, tuple)) else dim
+    return lambda x: jnp.sum(x, axis=axis, keepdims=keep)
+
+
+@_defop("cast", ("X",))
+def _op_cast(attrs):
+    dt = dtypes.to_jax(attrs["out_dtype"])
+    return lambda x: x.astype(dt)
+
+
+@_defop("reshape2", ("X",))
+def _op_reshape(attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    return lambda x: jnp.reshape(x, shape)
+
+
+_APPEND_OPS["reshape"] = _APPEND_OPS["reshape2"]
+
+
+@_defop("transpose2", ("X",))
+def _op_transpose(attrs):
+    axis = tuple(int(a) for a in attrs["axis"])
+    return lambda x: jnp.transpose(x, axis)
+
+
+_APPEND_OPS["transpose"] = _APPEND_OPS["transpose2"]
+
+
+@_defop("concat", ("X",))
+def _op_concat(attrs):
+    axis = int(attrs.get("axis", 0))
+    return lambda *xs: jnp.concatenate(xs, axis=axis)
+
+
+class Block:
+    """The reference's Block facade over the recorded tape.
+
+    Reference: base/framework.py `Block.append_op` — here ops append
+    OpDescs to the owning Program AND execute eagerly so downstream
+    build-time code sees concrete values.
+    """
+
+    def __init__(self, program):
+        self.program = program
+
+    @property
+    def ops(self):
+        return self.program.ops
+
+    def create_var(self, name=None, shape=None, dtype="float32", **kw):
+        shape = [1 if (s is None or (isinstance(s, int) and s < 0)) else s
+                 for s in (shape or [1])]
+        t = Tensor(jnp.zeros(shape, dtypes.to_jax(dtype)), name=name)
+        if name:
+            tag_tensor(self.program, t, name)
+        return t
+
+    def var(self, name):
+        return self.program.var(name)
+
+    def append_op(self, type=None, inputs=None, outputs=None, attrs=None,
+                  **kw):
+        """Execute + record one curated op (see module docstring)."""
+        type = type or kw.get("op_type")
+        builder = _APPEND_OPS.get(type)
+        if builder is None:
+            raise NotImplementedError(
+                f"Block.append_op: op type '{type}' is outside the "
+                f"curated static append_op set "
+                f"({sorted(_APPEND_OPS)}).  Express the op through the "
+                f"functional API under program_guard (every dispatched "
+                f"op records onto the tape), or use the to_static/jit "
+                f"path; see paddle_tpu/static/__init__.py docstring.")
+        attrs = dict(attrs or {})
+        fn = builder(attrs)
+
+        def _vars(spec):
+            if spec is None:
+                return []
+            vs = spec if isinstance(spec, (list, tuple)) else [spec]
+            out = []
+            for v in vs:
+                if isinstance(v, str):
+                    v = self.program.var(v)
+                elif not isinstance(v, Tensor):
+                    # numpy array / python scalar operand -> constant leaf
+                    v = Tensor(jnp.asarray(np.asarray(v)))
+                out.append(v)
+            return out
+
+        ins = []
+        for key in builder._in_keys:
+            ins.extend(_vars((inputs or {}).get(key)))
+        in_vals = [t._value for t in ins]
+        out = fn(*in_vals)
+        outs_flat = (out,) if not isinstance(out, (tuple, list)) \
+            else tuple(out)
+        out_targets = []
+        for key in builder._out_keys:
+            out_targets.extend(_vars((outputs or {}).get(key)))
+        prog = self.program
+        if not out_targets:
+            out_targets = [Tensor(o) for o in outs_flat]
+        # resolve input vids BEFORE binding outputs: an output var may
+        # alias an input (write-after-read of the same named var)
+        in_vids = []
+        for t in ins:
+            vid = getattr(t, "_static_vid", None)
+            if vid is None or vid not in _prog_mod._known(prog):
+                vid = _prog_mod._leaf_register(prog, t)
+            in_vids.append(vid)
+        out_vids = []
+        for t, o in zip(out_targets, outs_flat):
+            t._value = o
+            # SSA rename: re-writing an already-recorded variable gets a
+            # FRESH vid (earlier readers keep the old value; the name
+            # now maps to the new one), like the reference's var
+            # versioning in ProgramDesc
+            if getattr(t, "_static_vid", None) is not None \
+                    and t._static_vid in _prog_mod._known(prog):
+                _prog_mod.on_inplace_retag(t, t._static_vid)
+                t._static_vid = None
+            out_vids.append(tag_tensor(prog, t, getattr(t, "name", None)))
+        prog.ops.append(OpDesc(type, fn, in_vids, out_vids))
+        return out_targets[0] if len(out_targets) == 1 else out_targets
+
+
+class Program:
+    """A recorded computation: placeholders + an OpDesc tape.
+
+    Ops executed under `program_guard` run eagerly AND append to `ops`;
+    `Executor.run` substitutes feeds and replays under jit.
     """
 
     def __init__(self):
         self.placeholders: Dict[str, _DataPlaceholder] = {}
+        self.ops: List[OpDesc] = []
+        self.var_names: Dict[str, int] = {}
+        self.leaves: Dict[int, tuple] = {}
         self.random_seed = 0
-        self._build_fn = None
-        self._fetch_cache: dict = {}
+        self._block = Block(self)
+        self._exec_cache: dict = {}
 
+    # -- program surface ---------------------------------------------------
     def global_block(self):
-        return self
+        return self._block
+
+    def current_block(self):
+        return self._block
+
+    def block(self, idx=0):
+        return self._block
 
     def clone(self, for_test=False):
         return self
 
     def append_op(self, *a, **k):
-        """Documented hard limit (module docstring): there is no op-list
-        IR to mutate — programs are traced python, the IR is XLA HLO."""
-        raise NotImplementedError(
-            "Program.append_op: paddle_tpu has no mutable ProgramDesc — "
-            "programs are traced python callables and the IR is XLA "
-            "HLO.  Express the op in the python function (or use the "
-            "to_static/jit path); see paddle_tpu/static/__init__.py "
-            "docstring for the supported static surface.")
+        return self._block.append_op(*a, **k)
+
+    def placeholder_vids(self):
+        return [getattr(ph, "_static_vid", None)
+                for ph in self.placeholders.values()
+                if getattr(ph, "_static_vid", None) is not None]
 
     def var(self, name):
-        return self.placeholders.get(name)
+        ph = self.placeholders.get(name)
+        if ph is not None:
+            return ph
+        vid = self.var_names.get(name)
+        return self.find_tensor(vid) if vid is not None else None
 
-    # compatibility no-ops
+    def find_tensor(self, vid):
+        refs = getattr(self, "_var_refs", None)
+        if refs is not None and vid in refs:
+            t = refs[vid]()
+            if t is not None:
+                return t
+        entry = self.leaves.get(vid)
+        if entry is not None and entry[0] is not None:
+            t = entry[0]()
+            if t is not None:
+                return t
+        for ph in self.placeholders.values():
+            if getattr(ph, "_static_vid", None) == vid:
+                return ph
+        return None
+
+    def vids_of(self, targets):
+        out = []
+        for t in targets:
+            if isinstance(t, str):
+                vid = self.var_names.get(t)
+                if vid is None and t in self.placeholders:
+                    vid = getattr(self.placeholders[t], "_static_vid",
+                                  None)
+            else:
+                vid = getattr(t, "_static_vid", None)
+            if vid is None:
+                raise ValueError(
+                    f"fetch target {t!r} is not a recorded variable of "
+                    f"this Program (was it computed under its "
+                    f"program_guard in static mode?)")
+            out.append(vid)
+        return out
+
     def list_vars(self):
         return list(self.placeholders.values())
+
+    # -- replay ------------------------------------------------------------
+    def _leaf_value(self, vid):
+        ref, snapshot = self.leaves[vid]
+        t = ref() if ref is not None else None
+        return t._value if t is not None else snapshot
+
+    def execute(self, feed: Dict[str, Any], fetch_vids: List[int]):
+        """Replay the tape: feeds -> fetch arrays (jitted + cached)."""
+        ph_vids = {name: getattr(ph, "_static_vid", None)
+                   for name, ph in self.placeholders.items()}
+        feed_names = sorted(n for n in feed if ph_vids.get(n) is not None)
+        feed_vals = []
+        for n in feed_names:
+            v = feed[n]
+            v = v.value if isinstance(v, Tensor) else jnp.asarray(
+                np.asarray(v))
+            feed_vals.append(v)
+        stop = set(ph_vids[n] for n in feed_names)
+        ops = needed_ops(self.ops, fetch_vids, stop_vids=stop)
+        # leaves the pruned tape still needs (params/constants + unfed
+        # placeholders — the latter replay with their build-time value,
+        # matching the reference's Scope persistence)
+        produced = set()
+        for op in ops:
+            produced.update(op.out_vids)
+        leaf_vids = []
+        for op in ops:
+            for v in op.in_vids:
+                if v not in produced and v not in stop \
+                        and v not in leaf_vids:
+                    leaf_vids.append(v)
+        for v in fetch_vids:
+            if v not in produced and v not in stop and v not in leaf_vids:
+                leaf_vids.append(v)
+        leaf_vals = []
+        for v in leaf_vids:
+            if v in self.leaves:
+                leaf_vals.append(self._leaf_value(v))
+            else:
+                t = self.find_tensor(v)
+                if t is None:
+                    raise KeyError(
+                        f"static replay: variable {v} has no live value "
+                        f"(placeholder not fed and object released)")
+                leaf_vals.append(t._value)
+
+        key = (tuple(fetch_vids), tuple(feed_names),
+               tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals),
+               len(self.ops))
+        fn = self._exec_cache.get(key)
+        if fn is None:
+            op_slice = list(ops)
+            f_vids = [ph_vids[n] for n in feed_names]
+            l_vids = list(leaf_vids)
+
+            def run_tape(feeds, leaves):
+                env = dict(zip(f_vids, feeds))
+                env.update(zip(l_vids, leaves))
+                return replay(op_slice, env, fetch_vids)
+
+            fn = jax.jit(run_tape)
+            self._exec_cache[key] = fn
+        return fn(feed_vals, leaf_vals)
 
 
 _main_program = Program()
@@ -152,15 +492,22 @@ def program_guard(main_program, startup_program=None):
     _main_program = main_program
     if startup_program is not None:
         _startup_program = startup_program
+    recording = in_static_mode()
+    if recording:
+        _prog_mod.push_program(main_program)
     try:
         yield
     finally:
-        _main_program, _startup_program = prev_m, prev_s
+        if recording:
+            _prog_mod.pop_program(main_program)
+        _main_program = prev_m
+        _startup_program = prev_s
 
 
 def data(name, shape, dtype="float32", lod_level=0):
     ph = _DataPlaceholder(name, shape, dtype)
     _main_program.placeholders[name] = ph
+    tag_tensor(_main_program, ph, name)
     return ph
 
 
@@ -200,27 +547,88 @@ def cpu_places(device_count=None):
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    from ..autograd import grad as _grad
-    return _grad(targets, inputs, target_gradients, allow_unused=True)
+    """d(sum targets)/d(inputs).
+
+    Static-recording mode: records ONE composite grad op on the tape —
+    a jax.grad over the replayed ancestor slice — so gradient fetches
+    re-evaluate against new feeds (reference: append_backward building
+    grad ops into the program).  Inputs may be placeholders or leaves
+    (parameters); gradients w.r.t. interior activations fall back to the
+    eager tape value.  Outside a recording guard: plain eager autograd.
+    """
+    targets_l = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs_l = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    prog = _prog_mod.current_program()
+
+    interior = set()
+    for op in (prog.ops if prog is not None else ()):
+        interior.update(op.out_vids)
+    recordable = (prog is not None and prog.ops
+                  and all(getattr(t, "_static_vid", None) is not None
+                          and t._static_vid not in interior
+                          for t in inputs_l))
+    if not recordable:
+        from ..autograd import grad as _grad
+        return _grad(targets_l, inputs_l, target_gradients,
+                     allow_unused=True)
+
+    tvids = prog.vids_of(targets_l)
+    ivids = prog.vids_of(inputs_l)
+    ops = needed_ops(prog.ops, tvids)
+    produced = set()
+    for op in ops:
+        produced.update(op.out_vids)
+    other_vids = []
+    for op in ops:
+        for v in op.in_vids:
+            if v not in produced and v not in ivids \
+                    and v not in other_vids:
+                other_vids.append(v)
+    op_slice = list(ops)
+    n_in = len(ivids)
+
+    def grad_fn(*vals):
+        diff_vals = vals[:n_in]
+        rest = vals[n_in:]
+
+        def f(diff_vals):
+            env = dict(zip(ivids, diff_vals))
+            env.update(zip(other_vids, rest))
+            outs = replay(op_slice, env, tvids)
+            return sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+
+        return tuple(jax.grad(f)(tuple(diff_vals)))
+
+    def _vid_value(v):
+        if v in prog.leaves:
+            return prog._leaf_value(v)
+        t = prog.find_tensor(v)
+        if t is None:
+            raise KeyError(f"gradients: no live value for var {v}")
+        return t._value
+
+    # evaluate once eagerly (build-time values) so downstream build code
+    # sees concrete grads, and record the composite op for replay
+    vals = [t._value for t in inputs_l] + [_vid_value(v)
+                                           for v in other_vids]
+    g = grad_fn(*vals)
+    outs = [Tensor(gi) for gi in g]
+    in_vids_all = list(ivids) + list(other_vids)
+    out_vids = [tag_tensor(prog, t) for t in outs]
+    prog.ops.append(OpDesc("gradients", grad_fn, in_vids_all, out_vids))
+    return outs
 
 
 class Executor:
-    """Facade over jit compilation (reference: base/executor.py:1234).
+    """Facade over jitted tape replay (reference: base/executor.py:1234).
 
-    run(program, feed, fetch_list): placeholder values are substituted and
-    each fetch target's recorded computation replays.  In this TPU build the
-    "program" was already executed eagerly at build time, so fetches simply
-    re-evaluate with the new feeds via functional substitution — correct for
-    feed-forward graphs built with paddle_tpu.static.data.
-
-    HARD LIMIT (by design, documented): there is no op-level Program IR —
-    workflows that construct programs with raw `append_op` semantics,
-    program transforms/passes, or feed/fetch-driven PARTIAL-graph
-    execution have no path here.  The static surface exists for
-    Model.fit-style usage and API parity; graph-level programming is
-    XLA's job (trace with jit/to_static instead).  See SURVEY §7's
-    design stance — rebuilding the fluid Program machinery would bypass
-    the compiler this framework is built on.
+    run(program, feed, fetch_list): substitutes feed values for the
+    program's placeholders and replays the recorded op tape under jit,
+    pruned to the fetch targets' ancestors (partial-graph execution).
+    The compiled replay is cached per (fetch set, feed shapes) — the
+    `_ExecutorCache` role.  Programs with an empty tape (startup
+    programs; graphs built outside static mode) fall back to returning
+    the fetch targets' live values.
     """
 
     def __init__(self, place=None):
@@ -230,19 +638,34 @@ class Executor:
             return_numpy=True, **kwargs):
         program = program or _main_program
         feed = feed or {}
-        for name, value in feed.items():
-            ph = program.placeholders.get(name)
-            if ph is None:
-                continue
-            v = value.value if isinstance(value, Tensor) else jnp.asarray(
-                np.asarray(value))
-            ph._value = v
-        outs = []
-        for tgt in (fetch_list or []):
-            t = tgt
-            # re-run is only possible when the user builds the graph inside
-            # a callable; for the common hapi/static path the fetch targets
-            # are live Tensors already reflecting the feeds of this step.
-            v = t.value if isinstance(t, Tensor) else t
-            outs.append(np.asarray(v) if return_numpy else v)
-        return outs
+        if not isinstance(program, Program):
+            return []
+        if not program.ops or not fetch_list:
+            # startup / legacy path: bind feeds eagerly, return live values
+            for name, value in feed.items():
+                ph = program.placeholders.get(name)
+                if ph is None:
+                    continue
+                ph._value = value.value if isinstance(value, Tensor) \
+                    else jnp.asarray(np.asarray(value))
+            outs = []
+            for tgt in (fetch_list or []):
+                v = tgt.value if isinstance(tgt, Tensor) else tgt
+                outs.append(np.asarray(v) if return_numpy else v)
+            return outs
+        fetch_vids = program.vids_of(
+            fetch_list if isinstance(fetch_list, (list, tuple))
+            else [fetch_list])
+        vals = program.execute(feed, fetch_vids)
+        return [np.asarray(v) if return_numpy else v for v in vals]
+
+
+# register the dispatch-side recorder (set_static_hook docstring in
+# framework/dispatch.py)
+def _record_hook(name, raw_fn, in_tensors, out_tensors):
+    if _prog_mod.current_program() is None:
+        return
+    _prog_mod.record_op(name, raw_fn, in_tensors, out_tensors)
+
+
+_dispatch.set_static_hook(_record_hook)

@@ -1,0 +1,275 @@
+"""Recorded static Programs — a real op tape behind the static facade.
+
+Reference: `python/paddle/base/framework.py` Program/Block/Operator and
+`base/executor.py:1920` `_run_impl` (feed substitution → pass pipeline →
+StandaloneExecutor over the op list → fetch).
+
+TPU-native redesign: ops still EXECUTE eagerly while the program is being
+built (shapes/values are concrete, exactly like dygraph), but under an
+active ``program_guard`` every dispatch also appends an ``OpDesc`` —
+``(raw jax fn, input var-ids, output var-ids)`` — to the guarded Program.
+``Executor.run(feed, fetch_list)`` then REPLAYS the recorded tape under
+``jax.jit`` with the feed values substituted for placeholders: the tape
+is this framework's ProgramDesc, XLA is its interpreter.  Fetching an
+interior variable runs only its ancestor ops (dead-op elimination — the
+seed of the pass pipeline, see ``apply_pass``).
+
+Variables are identified by a monotonically increasing ``vid`` stamped on
+the Tensor (``_static_vid``); object identity is never reused as a key.
+Inputs with no vid are graph LEAVES (parameters, constants): the replay
+reads their CURRENT value through a weakref (so optimizer updates between
+two ``Executor.run`` calls are visible, matching the reference's Scope
+lookup) and falls back to a build-time snapshot if the object is gone.
+"""
+from __future__ import annotations
+
+import itertools
+import weakref
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["OpDesc", "record_op", "push_program", "pop_program",
+           "current_program", "apply_pass", "REGISTERED_PASSES"]
+
+_vid_counter = itertools.count(1)
+
+# stack of Programs currently recording (innermost last)
+_recording: List[object] = []
+
+
+class OpDesc:
+    """One recorded op: a pure jax callable over its inputs' arrays.
+
+    Mirrors the reference OpDesc (type + input/output var names +
+    attrs); here the "attrs" are already baked into the closure.
+    """
+
+    __slots__ = ("type", "fn", "in_vids", "out_vids")
+
+    def __init__(self, type_, fn, in_vids, out_vids):
+        self.type = type_
+        self.fn = fn
+        self.in_vids = tuple(in_vids)
+        self.out_vids = tuple(out_vids)
+
+    def __repr__(self):
+        return (f"OpDesc({self.type}, in={self.in_vids}, "
+                f"out={self.out_vids})")
+
+
+def push_program(prog):
+    _recording.append(prog)
+
+
+def pop_program(prog):
+    if _recording and _recording[-1] is prog:
+        _recording.pop()
+
+
+def current_program():
+    return _recording[-1] if _recording else None
+
+
+def _new_vid() -> int:
+    return next(_vid_counter)
+
+
+def _known(prog) -> set:
+    """vids this program can resolve (placeholder/produced/leaf)."""
+    s = getattr(prog, "_known_vids", None)
+    if s is None:
+        s = set()
+        prog._known_vids = s
+    return s
+
+
+def tag_tensor(prog, tensor, name: Optional[str] = None) -> int:
+    """Stamp `tensor` as a program variable; returns its vid."""
+    vid = getattr(tensor, "_static_vid", None)
+    if vid is None:
+        vid = _new_vid()
+        tensor._static_vid = vid
+    _known(prog).add(vid)
+    refs = getattr(prog, "_var_refs", None)
+    if refs is None:
+        refs = {}
+        prog._var_refs = refs
+    try:
+        refs[vid] = weakref.ref(tensor)
+    except TypeError:  # pragma: no cover
+        pass
+    if name:
+        prog.var_names[name] = vid
+    return vid
+
+
+def _leaf_register(prog, tensor) -> int:
+    """Register an input as a leaf (parameter / constant) of `prog`."""
+    vid = getattr(tensor, "_static_vid", None)
+    if vid is None:
+        vid = _new_vid()
+        tensor._static_vid = vid
+    try:
+        ref = weakref.ref(tensor)
+    except TypeError:  # pragma: no cover - Tensors are weakref-able
+        ref = None
+    # snapshot covers constants whose Tensor dies before replay; live
+    # weakref covers parameters whose value changes between runs
+    prog.leaves[vid] = (ref, tensor._value)
+    _known(prog).add(vid)
+    return vid
+
+
+def on_inplace_retag(tensor, old_vid):
+    """A tensor object is abandoning `old_vid` (in-place op adopted a new
+    vid).  Freeze every recording program's view of the old variable to
+    its registration-time snapshot: the live object's value now belongs
+    to the NEW vid, and replaying the recorded mutation over the live
+    value would apply it twice."""
+    for prog in _recording:
+        entry = prog.leaves.get(old_vid)
+        if entry is not None and entry[0] is not None \
+                and entry[0]() is tensor:
+            prog.leaves[old_vid] = (None, entry[1])
+        refs = getattr(prog, "_var_refs", None)
+        if refs is not None:
+            ref = refs.get(old_vid)
+            if ref is not None and ref() is tensor:
+                del refs[old_vid]
+
+
+def record_op(name, raw_fn, in_tensors, out_tensors):
+    """dispatch.run hook — append the executed op to the guarded Program."""
+    prog = current_program()
+    if prog is None:
+        return
+    known = _known(prog)
+    in_vids = []
+    for t in in_tensors:
+        vid = getattr(t, "_static_vid", None)
+        if vid is None or vid not in known:
+            # untagged, or tagged by ANOTHER program (nested/previous
+            # guard): a leaf of this one
+            vid = _leaf_register(prog, t)
+        in_vids.append(vid)
+    out_vids = [tag_tensor(prog, t) for t in out_tensors]
+    prog.ops.append(OpDesc(name or getattr(raw_fn, "__name__", "op"),
+                           raw_fn, in_vids, out_vids))
+
+
+def needed_ops(ops: Sequence[OpDesc], target_vids, stop_vids=frozenset()):
+    """Ancestor slice of the tape for `target_vids` (dead-op elimination).
+
+    stop_vids: vars whose value will be supplied externally — ops that
+    only feed those are not needed.
+    """
+    produced = {}
+    for op in ops:
+        for v in op.out_vids:
+            produced[v] = op
+    need_vars = set(target_vids) - set(stop_vids)
+    need: List[OpDesc] = []
+    seen = set()
+    stack = list(need_vars)
+    while stack:
+        v = stack.pop()
+        op = produced.get(v)
+        if op is None or id(op) in seen:
+            continue
+        seen.add(id(op))
+        need.append(op)
+        for iv in op.in_vids:
+            if iv not in stop_vids:
+                stack.append(iv)
+    order = {id(op): i for i, op in enumerate(ops)}
+    need.sort(key=lambda op: order[id(op)])
+    return need
+
+
+def replay(ops: Sequence[OpDesc], env: Dict[int, jax.Array],
+           target_vids) -> List[jax.Array]:
+    """Execute the (pruned) tape over `env` (vid -> array)."""
+    for op in ops:
+        ins = []
+        for v in op.in_vids:
+            if v not in env:
+                raise KeyError(
+                    f"static replay: var {v} needed by op "
+                    f"'{op.type}' has no value — missing feed?")
+            ins.append(env[v])
+        out = op.fn(*ins)
+        outs = (out,) if not isinstance(out, (tuple, list)) else tuple(out)
+        for vid, o in zip(op.out_vids, outs):
+            env[vid] = o
+    return [env[v] for v in target_vids]
+
+
+# ---------------------------------------------------------------------------
+# pass pipeline (reference: base/executor.py applies Plan passes before
+# building the StandaloneExecutor; here passes rewrite the recorded tape)
+
+REGISTERED_PASSES = {}
+
+
+def _register_pass(name):
+    def deco(fn):
+        REGISTERED_PASSES[name] = fn
+        return fn
+    return deco
+
+
+@_register_pass("dead_code_elimination")
+def _dce_pass(program, targets=None):
+    """Drop ops not reachable from `targets` (required — the pass has no
+    way to know which variables the caller will fetch)."""
+    if not targets:
+        raise ValueError(
+            "dead_code_elimination requires targets= (the variables "
+            "that must remain computable); without them every op would "
+            "be dead")
+    tvids = set(program.vids_of(targets))
+    program.ops = needed_ops(program.ops, tvids)
+    return program
+
+
+@_register_pass("constant_folding")
+def _constant_fold_pass(program, targets=None):
+    """Fold ops with no placeholder ancestor into leaf snapshots.
+
+    Build-time execution already computed every op's concrete value, so
+    folding = dropping the op and pinning its outputs as constants.
+    """
+    ph = set(program.placeholder_vids())
+    dynamic = set(ph)
+    kept = []
+    for op in program.ops:
+        if any(v in dynamic for v in op.in_vids):
+            dynamic.update(op.out_vids)
+            kept.append(op)
+            continue
+        outs = [program.find_tensor(vid) for vid in op.out_vids]
+        if any(t is None for t in outs):
+            # an output Tensor was released — its build-time value is
+            # gone, so the op cannot fold; keep executing it, and treat
+            # its outputs as dynamic so consumers don't fold either
+            kept.append(op)
+            dynamic.update(op.out_vids)
+            continue
+        for vid, t in zip(op.out_vids, outs):
+            program.leaves[vid] = (weakref.ref(t), t._value)
+    program.ops = kept
+    return program
+
+
+def apply_pass(program, name: str, targets=None):
+    """Run a registered tape pass over `program` in place."""
+    try:
+        fn = REGISTERED_PASSES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown pass '{name}'; registered: "
+            f"{sorted(REGISTERED_PASSES)}") from None
+    return fn(program, targets=targets)

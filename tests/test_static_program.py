@@ -1,0 +1,256 @@
+"""Static Program tape: feed/fetch replay, partial-graph fetch, append_op.
+
+Reference behavior being matched: `test/legacy_test/test_executor_*`-style
+Executor.run semantics — build a program once, run it repeatedly with new
+feeds, fetch any variable (including gradients) — and raw
+`Block.append_op` program construction (base/framework.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    static.enable_static()
+    yield
+    static.disable_static()
+
+
+def _mlp_program():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 8], "float32")
+        paddle.seed(3)
+        fc1 = nn.Linear(8, 16)
+        fc2 = nn.Linear(16, 2)
+        h = paddle.nn.functional.relu(fc1(x))
+        out = fc2(h)
+        loss = (out * out).mean()
+    return main, startup, x, fc1, fc2, h, out, loss
+
+
+def _np_forward(fc1, fc2, xv):
+    w1 = np.asarray(fc1.weight.value)
+    b1 = np.asarray(fc1.bias.value)
+    w2 = np.asarray(fc2.weight.value)
+    b2 = np.asarray(fc2.bias.value)
+    h = np.maximum(xv @ w1 + b1, 0)
+    return h, h @ w2 + b2
+
+
+class TestFeedFetchReplay:
+    def test_rerun_with_new_feeds_recomputes(self):
+        main, startup, x, fc1, fc2, h, out, loss = _mlp_program()
+        exe = static.Executor()
+        exe.run(startup)
+        for seed in (0, 1):
+            xv = np.random.RandomState(seed).randn(4, 8).astype(np.float32)
+            (got,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+            _, want = _np_forward(fc1, fc2, xv)
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_partial_graph_fetch_interior_var(self):
+        main, startup, x, fc1, fc2, h, out, loss = _mlp_program()
+        exe = static.Executor()
+        xv = np.random.RandomState(7).randn(4, 8).astype(np.float32)
+        (got_h,) = exe.run(main, feed={"x": xv}, fetch_list=[h])
+        want_h, _ = _np_forward(fc1, fc2, xv)
+        np.testing.assert_allclose(got_h, want_h, rtol=1e-5, atol=1e-5)
+
+    def test_multiple_fetches_and_scalar_loss(self):
+        main, startup, x, fc1, fc2, h, out, loss = _mlp_program()
+        exe = static.Executor()
+        xv = np.random.RandomState(11).randn(4, 8).astype(np.float32)
+        got_out, got_loss = exe.run(main, feed={"x": xv},
+                                    fetch_list=[out, loss])
+        _, want = _np_forward(fc1, fc2, xv)
+        np.testing.assert_allclose(got_out, want, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(got_loss, (want * want).mean(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_param_update_visible_on_next_run(self):
+        """Replay reads parameters' CURRENT values (reference: Scope
+        persistence between Executor.run calls)."""
+        main, startup, x, fc1, fc2, h, out, loss = _mlp_program()
+        exe = static.Executor()
+        xv = np.random.RandomState(2).randn(4, 8).astype(np.float32)
+        (before,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        fc2.bias.set_value(np.asarray(fc2.bias.value) + 1.0)
+        (after,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(after, before + 1.0, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_fetch_unrecorded_var_rejected(self):
+        main, startup, *_ = _mlp_program()
+        exe = static.Executor()
+        stray = paddle.to_tensor(np.zeros((2, 2), np.float32))
+        with pytest.raises(ValueError, match="not a recorded variable"):
+            exe.run(main, feed={"x": np.zeros((4, 8), np.float32)},
+                    fetch_list=[stray])
+
+
+class TestGradients:
+    def test_gradient_fetch_replays_with_new_feed(self):
+        main, startup, x, fc1, fc2, h, out, loss = _mlp_program()
+        with static.program_guard(main, startup):
+            (dW,) = static.gradients(loss, [fc1.weight])
+        exe = static.Executor()
+        for seed in (5, 6):
+            xv = np.random.RandomState(seed).randn(4, 8).astype(np.float32)
+            (got,) = exe.run(main, feed={"x": xv}, fetch_list=[dW])
+            # reference value via finite jax grad on the same math
+            import jax
+            import jax.numpy as jnp
+
+            def f(w1):
+                hh = jnp.maximum(jnp.asarray(xv) @ w1
+                                 + fc1.bias.value, 0)
+                o = hh @ fc2.weight.value + fc2.bias.value
+                return (o * o).mean()
+
+            want = jax.grad(f)(fc1.weight.value)
+            np.testing.assert_allclose(got, np.asarray(want),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_gradient_wrt_placeholder(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [3], "float32")
+            y = (x * x).sum()
+            (dx,) = static.gradients(y, [x])
+        exe = static.Executor()
+        xv = np.array([1.0, -2.0, 3.0], np.float32)
+        (got,) = exe.run(main, feed={"x": xv}, fetch_list=[dx])
+        np.testing.assert_allclose(got, 2 * xv, rtol=1e-6)
+
+
+class TestAppendOp:
+    def test_program_built_from_append_ops(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 3], "float32")
+            w = static.data("w", [3, 4], "float32")
+        blk = main.global_block()
+        mm = blk.append_op("matmul_v2", inputs={"X": x, "Y": w})
+        act = blk.append_op("relu", inputs={"X": mm})
+        out = blk.append_op("scale", inputs={"X": act},
+                            attrs={"scale": 2.0, "bias": 1.0})
+        exe = static.Executor()
+        xv = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+        wv = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        (got,) = exe.run(main, feed={"x": xv, "w": wv}, fetch_list=[out])
+        np.testing.assert_allclose(got, np.maximum(xv @ wv, 0) * 2 + 1,
+                                    rtol=1e-5, atol=1e-6)
+
+    def test_append_op_attrs_and_named_output(self):
+        main = static.Program()
+        blk = main.global_block()
+        with static.program_guard(main):
+            x = static.data("x", [4, 4], "float32")
+        y = blk.create_var(name="y", shape=[4, 4])
+        blk.append_op("softmax", inputs={"X": x}, outputs={"Out": y},
+                      attrs={"axis": -1})
+        exe = static.Executor()
+        xv = np.random.RandomState(3).randn(4, 4).astype(np.float32)
+        (got,) = exe.run(main, feed={"x": xv}, fetch_list=["y"])
+        e = np.exp(xv - xv.max(-1, keepdims=True))
+        np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                                    rtol=1e-5, atol=1e-6)
+
+    def test_unsupported_append_op_refuses_with_guidance(self):
+        main = static.Program()
+        with pytest.raises(NotImplementedError, match="to_static"):
+            main.append_op("fancy_custom_op")
+
+
+class TestReviewRegressions:
+    def test_inplace_op_not_double_applied_on_replay(self):
+        """An in-place mutation recorded on the tape must replay from
+        the PRE-update snapshot, not re-apply over the live value."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            w = paddle.to_tensor(np.array([1., 2.], np.float32))
+            paddle.increment(w, 10.0)
+            out = x + w
+        exe = static.Executor()
+        (got,) = exe.run(main, feed={"x": np.zeros(2, np.float32)},
+                         fetch_list=[out])
+        np.testing.assert_allclose(got, [11., 12.])
+
+    def test_dce_without_targets_rejected(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            _ = x * 2.0
+        with pytest.raises(ValueError, match="requires targets"):
+            static.apply_pass(main, "dead_code_elimination")
+
+    def test_append_op_numpy_and_scalar_inputs(self):
+        main = static.Program()
+        blk = main.global_block()
+        with static.program_guard(main):
+            x = static.data("x", [2, 3], "float32")
+        out = blk.append_op("elementwise_add",
+                            inputs={"X": x, "Y": np.ones((2, 3),
+                                                         np.float32)})
+        exe = static.Executor()
+        xv = np.random.RandomState(4).randn(2, 3).astype(np.float32)
+        (got,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(got, xv + 1.0, rtol=1e-6)
+
+    def test_append_op_rewrite_named_var_keeps_earlier_readers(self):
+        """Write y, read it, write y again: the first reader must keep
+        the first value (SSA rename), and name-fetch sees the last."""
+        main = static.Program()
+        blk = main.global_block()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+        y = blk.create_var(name="y", shape=[2])
+        blk.append_op("scale", inputs={"X": x}, outputs={"Out": y},
+                      attrs={"scale": 2.0})
+        r = blk.append_op("scale", inputs={"X": y}, attrs={"scale": 10.0})
+        blk.append_op("scale", inputs={"X": x}, outputs={"Out": y},
+                      attrs={"scale": 3.0})
+        exe = static.Executor()
+        xv = np.array([1., 2.], np.float32)
+        got_r, got_y = exe.run(main, feed={"x": xv},
+                               fetch_list=[r, "y"])
+        np.testing.assert_allclose(got_r, xv * 20.0)
+        np.testing.assert_allclose(got_y, xv * 3.0)
+
+
+class TestPasses:
+    def test_dead_code_elimination(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            kept = x * 2.0
+            _dead = (x + 5.0) * 3.0  # unfetched branch
+        n_before = len(main.ops)
+        static.apply_pass(main, "dead_code_elimination", targets=[kept])
+        assert len(main.ops) < n_before
+        exe = static.Executor()
+        (got,) = exe.run(main, feed={"x": np.array([1., 2.], np.float32)},
+                         fetch_list=[kept])
+        np.testing.assert_allclose(got, [2., 4.])
+
+    def test_constant_folding(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            c = paddle.to_tensor(np.array([3., 4.], np.float32))
+            folded = c * 2.0           # placeholder-free -> foldable
+            out = x + folded
+        static.apply_pass(main, "constant_folding")
+        types = [op.type for op in main.ops]
+        assert all("mul" not in t for t in types) or len(main.ops) == 1
+        exe = static.Executor()
+        (got,) = exe.run(main, feed={"x": np.array([1., 1.], np.float32)},
+                         fetch_list=[out])
+        np.testing.assert_allclose(got, [7., 9.])
