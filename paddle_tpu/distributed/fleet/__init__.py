@@ -24,11 +24,21 @@ from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,  # noqa: F4
                             get_rng_state_tracker)
 from .recompute import recompute, recompute_sequential  # noqa: F401
 
-_fleet_state = {"initialized": False, "strategy": None, "hcg": None}
+_fleet_state = {"initialized": False, "strategy": None, "hcg": None,
+                "role_maker": None, "ps_server": None, "ps_client": None}
 
 
 def init(role_maker=None, is_collective=False, strategy=None, log_level=2):
     """Reference: fleet/fleet.py:218."""
+    if role_maker is None:
+        role_maker = PaddleCloudRoleMaker(is_collective=is_collective)
+    _fleet_state["role_maker"] = role_maker
+    if not is_collective and role_maker.is_server():
+        # PS server process: no device mesh, no collective env — the
+        # server's life is init_server() + run_server()
+        _fleet_state.update(initialized=True,
+                            strategy=strategy or DistributedStrategy())
+        return
     from ..env import init_parallel_env
     init_parallel_env()
     strategy = strategy or DistributedStrategy()
@@ -95,6 +105,80 @@ def barrier_worker():
 def save_persistables(executor=None, dirname=None, main_program=None,
                       mode=0):
     pass
+
+
+# ---------------------------------------------------------------------------
+# parameter-server lifecycle (reference: fleet/fleet.py:972 init_worker,
+# :1016 init_server, :1117 run_server, :1142 stop_worker; tables served
+# by distributed/ps)
+
+def is_server():
+    rm = _fleet_state["role_maker"]
+    return rm is not None and rm.is_server()
+
+
+def is_worker():
+    rm = _fleet_state["role_maker"]
+    return rm is None or rm.is_worker()
+
+
+def init_server(*tables, port=None):
+    """Create this process's PSServer and register `tables`
+    (SparseTable/DenseTable instances).  Reference: fleet.init_server
+    loading table configs before run_server."""
+    import os
+    from ..ps import PSServer
+    rm = _fleet_state["role_maker"]
+    if port is None:
+        port = int(os.environ.get("PADDLE_PORT", "0") or 0)
+    srv = PSServer(port=port)
+    for t in tables:
+        srv.register_table(t)
+    _fleet_state["ps_server"] = srv
+    return srv
+
+
+def run_server(block=True):
+    """Serve pull/push until stopped (reference: fleet.run_server)."""
+    srv = _fleet_state["ps_server"]
+    if srv is None:
+        raise RuntimeError("call fleet.init_server first")
+    if block:
+        srv.run()
+    else:
+        srv.start()
+    return srv
+
+
+def init_worker():
+    """Connect this worker to the PS endpoints (reference:
+    fleet.init_worker starting the communicator)."""
+    from ..ps import PSClient
+    rm = _fleet_state["role_maker"]
+    eps = rm.server_endpoints() if rm is not None else []
+    if not eps:
+        raise RuntimeError(
+            "fleet.init_worker: no PS endpoints — set "
+            "PADDLE_PSERVERS_IP_PORT_LIST or pass a role_maker with "
+            "server_endpoints()")
+    client = PSClient(eps)
+    _fleet_state["ps_client"] = client
+    return client
+
+
+def ps_client():
+    return _fleet_state["ps_client"]
+
+
+def stop_worker():
+    _fleet_state["ps_client"] = None
+
+
+def stop_server():
+    srv = _fleet_state["ps_server"]
+    if srv is not None:
+        srv.stop()
+        _fleet_state["ps_server"] = None
 
 
 utils = None

@@ -1,5 +1,5 @@
-"""Role makers (reference: fleet/base/role_maker.py) — env parsing only;
-the TPU runtime has no parameter-server roles in v1."""
+"""Role makers (reference: fleet/base/role_maker.py — PS roles parsed
+from TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST at :858-908)."""
 from __future__ import annotations
 
 import os
@@ -26,9 +26,36 @@ class RoleMakerBase:
     def is_first_worker(self):
         return self.worker_index() == 0
 
+    def server_endpoints(self):
+        return []
+
 
 class PaddleCloudRoleMaker(RoleMakerBase):
-    pass
+    """Reference: fleet/base/role_maker.py:858 — PS-mode env contract:
+    TRAINING_ROLE=PSERVER|TRAINER, PADDLE_PSERVERS_IP_PORT_LIST,
+    PADDLE_PORT (this server's port).  Collective mode (the default)
+    ignores all of these."""
+
+    def _role(self):
+        return os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+
+    def is_server(self):
+        return (not self._is_collective) and self._role() == "PSERVER"
+
+    def is_worker(self):
+        return self._is_collective or self._role() == "TRAINER"
+
+    def server_endpoints(self):
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        return [e for e in eps.split(",") if e]
+
+    def server_index(self):
+        port = os.environ.get("PADDLE_PORT")
+        eps = self.server_endpoints()
+        for i, e in enumerate(eps):
+            if port is not None and e.endswith(":" + port):
+                return i
+        return 0
 
 
 class UserDefinedRoleMaker(RoleMakerBase):

@@ -1,0 +1,376 @@
+"""Parameter server — host-sharded sparse/dense tables with pull/push.
+
+Reference: `paddle/fluid/distributed/ps/` (brpc services + hash tables,
+`ps/service/`, `ps/table/`), the python driver
+`python/paddle/distributed/ps/the_one_ps.py`, and the fleet PS facade
+`python/paddle/distributed/fleet/fleet.py:972-1142`
+(init_worker/init_server/run_server/stop_worker) with role selection via
+`TRAINING_ROLE` / `PADDLE_PSERVERS_IP_PORT_LIST`
+(`fleet/base/role_maker.py:858-908`).
+
+TPU-native redesign: the PS exists for recommender workloads whose
+embedding tables exceed accelerator memory — lookups are sparse and
+bandwidth-light, so the tables belong on HOSTS while the dense tower
+runs on chips.  That split is unchanged on TPU: tables live host-side,
+sharded by `id % num_servers` across PS processes; the worker pulls the
+batch's unique rows, runs the dense model on the chip (the gather is a
+device-side `embedding` op over the pulled block, so it differentiates
+through the eager tape), and pushes the block's gradient back, where the
+SERVER applies the optimizer (SGD/Adagrad, reference: sparse optimizer
+configs in the_one_ps.py `Table._set`).  Transport is the stdlib
+ThreadingHTTPServer + npy payloads — the same tiny-control-plane stance
+as the launcher's KV rendezvous (launch/master.py); the reference's brpc
+exists for datacenter-scale QPS, which is out of scope for v1 parity.
+
+Row initialization is deterministic per (table, id): a RandomState
+seeded by hash(name, id) — every shard, restart, or re-pull of an
+untouched id yields the same vector, so elastic PS restarts don't
+perturb untrained rows.
+"""
+from __future__ import annotations
+
+import io
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["SparseTable", "DenseTable", "PSServer", "PSClient",
+           "DistributedEmbedding"]
+
+
+def _row_init(table_name: str, rid: int, dim: int, scale: float,
+              dtype=np.float32) -> np.ndarray:
+    seed = (hash((table_name, int(rid))) & 0x7FFFFFFF)
+    return np.asarray(
+        np.random.RandomState(seed).uniform(-scale, scale, size=(dim,)),
+        dtype=dtype)
+
+
+class SparseTable:
+    """Host-side hash-map embedding table shard with a server-side
+    optimizer (reference: `ps/table/memory_sparse_table.cc` + sparse
+    SGD/Adagrad rules)."""
+
+    def __init__(self, name: str, dim: int, init_scale: float = 0.05,
+                 optimizer: str = "sgd", lr: float = 0.1,
+                 adagrad_eps: float = 1e-6):
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(f"unsupported sparse optimizer {optimizer!r}")
+        self.name = name
+        self.dim = int(dim)
+        self.init_scale = float(init_scale)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.adagrad_eps = float(adagrad_eps)
+        self._rows: Dict[int, np.ndarray] = {}
+        self._accum: Dict[int, np.ndarray] = {}
+        self._lock = threading.Lock()
+
+    def _row(self, rid: int) -> np.ndarray:
+        row = self._rows.get(rid)
+        if row is None:
+            row = _row_init(self.name, rid, self.dim, self.init_scale)
+            self._rows[rid] = row
+        return row
+
+    def pull(self, ids: Sequence[int]) -> np.ndarray:
+        with self._lock:
+            return np.stack([self._row(int(i)) for i in ids]) \
+                if len(ids) else np.zeros((0, self.dim), np.float32)
+
+    def push(self, ids: Sequence[int], grads: np.ndarray):
+        """Apply grads server-side; duplicate ids ACCUMULATE (matching
+        the reference's sparse-grad merge before the update)."""
+        grads = np.asarray(grads, np.float32)
+        if grads.shape != (len(ids), self.dim):
+            raise ValueError(
+                f"push to {self.name}: grads {grads.shape} != "
+                f"({len(ids)}, {self.dim})")
+        merged: Dict[int, np.ndarray] = {}
+        for i, rid in enumerate(ids):
+            rid = int(rid)
+            if rid in merged:
+                merged[rid] = merged[rid] + grads[i]
+            else:
+                merged[rid] = grads[i]
+        with self._lock:
+            for rid, g in merged.items():
+                row = self._row(rid)
+                if self.optimizer == "adagrad":
+                    acc = self._accum.get(rid)
+                    if acc is None:
+                        acc = np.zeros(self.dim, np.float32)
+                    acc = acc + g * g
+                    self._accum[rid] = acc
+                    row = row - self.lr * g / (np.sqrt(acc)
+                                               + self.adagrad_eps)
+                else:
+                    row = row - self.lr * g
+                self._rows[rid] = row
+
+    def __len__(self):
+        return len(self._rows)
+
+
+class DenseTable:
+    """Replicated dense parameter block (reference:
+    `ps/table/memory_dense_table.cc`)."""
+
+    def __init__(self, name: str, shape, lr: float = 0.1):
+        self.name = name
+        self.shape = tuple(int(s) for s in shape)
+        self.lr = float(lr)
+        self._value = np.zeros(self.shape, np.float32)
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self._value.copy()
+
+    def push(self, grad: np.ndarray):
+        grad = np.asarray(grad, np.float32)
+        if grad.shape != self.shape:
+            raise ValueError(
+                f"push to {self.name}: grad {grad.shape} != {self.shape}")
+        with self._lock:
+            self._value = self._value - self.lr * grad
+
+    def set(self, value: np.ndarray):
+        with self._lock:
+            self._value = np.asarray(value, np.float32).reshape(self.shape)
+
+
+def _npy_bytes(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _npy_load(data: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+class _PSHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code, body=b"", ctype="application/octet-stream"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _table(self, name):
+        t = self.server._tables.get(name)
+        if t is None:
+            self._send(404, f"no table {name!r}".encode(), "text/plain")
+        return t
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        try:
+            if self.path == "/pull_sparse":
+                head, _, ids_raw = body.partition(b"\n")
+                meta = json.loads(head)
+                t = self._table(meta["table"])
+                if t is None:
+                    return
+                ids = np.frombuffer(ids_raw, np.int64)
+                self._send(200, _npy_bytes(t.pull(ids)))
+            elif self.path == "/push_sparse":
+                head, _, rest = body.partition(b"\n")
+                meta = json.loads(head)
+                t = self._table(meta["table"])
+                if t is None:
+                    return
+                ids = np.frombuffer(rest[:8 * meta["n"]], np.int64)
+                grads = _npy_load(rest[8 * meta["n"]:])
+                t.push(ids, grads)
+                self._send(200)
+            elif self.path == "/pull_dense":
+                meta = json.loads(body)
+                t = self._table(meta["table"])
+                if t is None:
+                    return
+                self._send(200, _npy_bytes(t.pull()))
+            elif self.path == "/push_dense":
+                head, _, rest = body.partition(b"\n")
+                meta = json.loads(head)
+                t = self._table(meta["table"])
+                if t is None:
+                    return
+                t.push(_npy_load(rest))
+                self._send(200)
+            elif self.path == "/stats":
+                out = {name: len(t) if isinstance(t, SparseTable) else -1
+                       for name, t in self.server._tables.items()}
+                self._send(200, json.dumps(out).encode(),
+                           "application/json")
+            else:
+                self._send(404)
+        except Exception as e:  # surface table errors to the client
+            self._send(400, repr(e).encode(), "text/plain")
+
+
+class PSServer:
+    """One PS process: serves its shard of every registered table.
+
+    Reference: `ps/service/brpc_ps_server.cc` (pull/push RPC services);
+    here one HTTP endpoint per server, `id % num_servers` sharding is
+    the CLIENT's job (reference: `ps/service/ps_client.cc` shard calc).
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _PSHandler)
+        self._httpd._tables = {}
+        self._thread: Optional[threading.Thread] = None
+        self.host = host
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def register_table(self, table):
+        self._httpd._tables[table.name] = table
+
+    def table(self, name):
+        return self._httpd._tables.get(name)
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="ps-server")
+        self._thread.start()
+        return self
+
+    def run(self):
+        """Blocking serve (reference: fleet.run_server)."""
+        self._httpd.serve_forever()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class PSClient:
+    """Worker-side client: shards ids over servers, merges results.
+
+    Reference: `ps/service/ps_client.h` + `communicator`; sharding is
+    `id % num_servers` (reference `ps/table/` shard semantics).
+    """
+
+    def __init__(self, endpoints: Sequence[str]):
+        if not endpoints:
+            raise ValueError("PSClient needs at least one endpoint")
+        self.endpoints = list(endpoints)
+
+    def _post(self, server: int, path: str, body: bytes) -> bytes:
+        url = f"http://{self.endpoints[server]}{path}"
+        req = urllib.request.Request(url, data=body, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.read()
+
+    def pull_sparse(self, table: str, ids) -> np.ndarray:
+        """Rows for `ids` (order-preserving, duplicates allowed)."""
+        ids = np.asarray(ids, np.int64).ravel()
+        n_srv = len(self.endpoints)
+        out: Optional[np.ndarray] = None
+        for s in range(n_srv):
+            mask = (ids % n_srv) == s
+            if not mask.any():
+                continue
+            sub = ids[mask]
+            head = json.dumps({"table": table}).encode() + b"\n"
+            rows = _npy_load(self._post(s, "/pull_sparse",
+                                        head + sub.tobytes()))
+            if out is None:
+                out = np.zeros((len(ids), rows.shape[1] if rows.size
+                                else 0), np.float32)
+            out[mask] = rows
+        if out is None:
+            raise ValueError("pull_sparse with empty ids")
+        return out
+
+    def push_sparse(self, table: str, ids, grads: np.ndarray):
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32)
+        n_srv = len(self.endpoints)
+        for s in range(n_srv):
+            mask = (ids % n_srv) == s
+            if not mask.any():
+                continue
+            sub = ids[mask]
+            head = json.dumps({"table": table,
+                               "n": int(mask.sum())}).encode() + b"\n"
+            self._post(s, "/push_sparse",
+                       head + sub.tobytes() + _npy_bytes(grads[mask]))
+
+    def pull_dense(self, table: str) -> np.ndarray:
+        body = json.dumps({"table": table}).encode()
+        return _npy_load(self._post(0, "/pull_dense", body))
+
+    def push_dense(self, table: str, grad: np.ndarray):
+        head = json.dumps({"table": table}).encode() + b"\n"
+        self._post(0, "/push_dense", head + _npy_bytes(np.asarray(grad)))
+
+    def stats(self) -> List[dict]:
+        return [json.loads(self._post(s, "/stats", b""))
+                for s in range(len(self.endpoints))]
+
+
+class DistributedEmbedding:
+    """Worker-side sparse embedding over a PS table.
+
+    Reference: `paddle.static.nn.sparse_embedding` backed by PS
+    pull/push (the_one_ps.py distributed lookup tables).  TPU-native:
+    the batch's UNIQUE rows are pulled into a device Tensor block, the
+    lookup is a device-side `embedding` gather over that block (so it
+    rides the eager tape / jit like any op), and `push_grad()` sends the
+    block gradient back after `loss.backward()`.
+    """
+
+    def __init__(self, client: PSClient, table: str, dim: int):
+        self.client = client
+        self.table = table
+        self.dim = int(dim)
+        self._last = None  # (unique ids, block Tensor)
+
+    def __call__(self, ids):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        ids_np = np.asarray(
+            ids.value if hasattr(ids, "value") else ids, np.int64)
+        uniq, inverse = np.unique(ids_np, return_inverse=True)
+        block = paddle.to_tensor(
+            self.client.pull_sparse(self.table, uniq))
+        block.stop_gradient = False
+        self._last = (uniq, block)
+        local_ids = paddle.to_tensor(
+            inverse.reshape(ids_np.shape).astype(np.int64))
+        return F.embedding(local_ids, block)
+
+    def push_grad(self):
+        """Push d(loss)/d(block) for the LAST forward to the servers."""
+        if self._last is None:
+            raise RuntimeError("push_grad before any forward")
+        uniq, block = self._last
+        g = block.grad
+        if g is None:
+            raise RuntimeError(
+                "embedding block has no grad — did loss.backward() run?")
+        self.client.push_sparse(self.table, uniq, np.asarray(
+            g.value if hasattr(g, "value") else g))
+        self._last = None

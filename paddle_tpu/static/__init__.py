@@ -320,7 +320,7 @@ class Block:
             # versioning in ProgramDesc
             if getattr(t, "_static_vid", None) is not None \
                     and t._static_vid in _prog_mod._known(prog):
-                _prog_mod.on_inplace_retag(t, t._static_vid)
+                _prog_mod.on_inplace_retag(t, t._static_vid, prog=prog)
                 t._static_vid = None
             out_vids.append(tag_tensor(prog, t, getattr(t, "name", None)))
         prog.ops.append(OpDesc(type, fn, in_vids, out_vids))
@@ -418,7 +418,13 @@ class Program:
         """Replay the tape: feeds -> fetch arrays (jitted + cached)."""
         ph_vids = {name: getattr(ph, "_static_vid", None)
                    for name, ph in self.placeholders.items()}
-        feed_names = sorted(n for n in feed if ph_vids.get(n) is not None)
+        unknown = [n for n in feed if ph_vids.get(n) is None]
+        if unknown:
+            raise KeyError(
+                f"feed keys {unknown!r} are not data() placeholders of "
+                f"this Program (placeholders: "
+                f"{sorted(self.placeholders)})")
+        feed_names = sorted(feed)
         feed_vals = []
         for n in feed_names:
             v = feed[n]
@@ -586,6 +592,15 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
                 other_vids.append(v)
     op_slice = list(ops)
     n_in = len(ivids)
+    # cotangents: d(sum_i <targets_i, tg_i>)/d(inputs); default ones
+    # (reference: append_backward's fill_constant initial grads)
+    tgs = None
+    if target_gradients is not None:
+        tg_l = target_gradients if isinstance(
+            target_gradients, (list, tuple)) else [target_gradients]
+        tgs = [None if t is None else jnp.asarray(
+            t.value if isinstance(t, Tensor) else np.asarray(t))
+            for t in tg_l]
 
     def grad_fn(*vals):
         diff_vals = vals[:n_in]
@@ -595,7 +610,14 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
             env = dict(zip(ivids, diff_vals))
             env.update(zip(other_vids, rest))
             outs = replay(op_slice, env, tvids)
-            return sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+            total = jnp.float32(0)
+            for i, o in enumerate(outs):
+                o = o.astype(jnp.float32)
+                if tgs is not None and i < len(tgs) \
+                        and tgs[i] is not None:
+                    o = o * tgs[i].astype(jnp.float32)
+                total = total + jnp.sum(o)
+            return total
 
         return tuple(jax.grad(f)(tuple(diff_vals)))
 

@@ -123,13 +123,17 @@ def _leaf_register(prog, tensor) -> int:
     return vid
 
 
-def on_inplace_retag(tensor, old_vid):
+def on_inplace_retag(tensor, old_vid, prog=None):
     """A tensor object is abandoning `old_vid` (in-place op adopted a new
-    vid).  Freeze every recording program's view of the old variable to
+    vid).  Freeze every affected program's view of the old variable to
     its registration-time snapshot: the live object's value now belongs
     to the NEW vid, and replaying the recorded mutation over the live
-    value would apply it twice."""
-    for prog in _recording:
+    value would apply it twice.  `prog`: a program to freeze in addition
+    to the recording stack (Block.append_op runs outside guards)."""
+    progs = list(_recording)
+    if prog is not None and prog not in progs:
+        progs.append(prog)
+    for prog in progs:
         entry = prog.leaves.get(old_vid)
         if entry is not None and entry[0] is not None \
                 and entry[0]() is tensor:
@@ -237,13 +241,21 @@ def _dce_pass(program, targets=None):
 
 @_register_pass("constant_folding")
 def _constant_fold_pass(program, targets=None):
-    """Fold ops with no placeholder ancestor into leaf snapshots.
+    """Fold ops with no placeholder or MUTABLE ancestor into snapshots.
 
     Build-time execution already computed every op's concrete value, so
     folding = dropping the op and pinning its outputs as constants.
+    Parameters (trainable / persistable leaves) are dynamic — their
+    values change between Executor.run calls, and folding them would
+    break the replay-reads-current-values invariant.
     """
     ph = set(program.placeholder_vids())
     dynamic = set(ph)
+    for vid, (ref, _snap) in program.leaves.items():
+        t = ref() if ref is not None else None
+        if t is not None and (getattr(t, "persistable", False)
+                              or not getattr(t, "stop_gradient", True)):
+            dynamic.add(vid)
     kept = []
     for op in program.ops:
         if any(v in dynamic for v in op.in_vids):

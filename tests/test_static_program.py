@@ -225,6 +225,61 @@ class TestReviewRegressions:
         np.testing.assert_allclose(got_y, xv * 3.0)
 
 
+class TestReviewRegressions2:
+    def test_append_op_rewrite_outside_guard_freezes_leaf(self):
+        """SSA rename must freeze the old vid's leaf even when append_op
+        runs OUTSIDE a program_guard (no recording stack)."""
+        main = static.Program()
+        blk = main.global_block()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+        y = paddle.to_tensor(np.array([1., 1.], np.float32))
+        r = blk.append_op("scale", inputs={"X": y}, attrs={"scale": 5.0})
+        blk.append_op("elementwise_add", inputs={"X": x, "Y": x},
+                      outputs={"Out": y})
+        exe = static.Executor()
+        (got,) = exe.run(main, feed={"x": np.zeros(2, np.float32)},
+                         fetch_list=[r])
+        np.testing.assert_allclose(got, [5., 5.])
+
+    def test_constant_folding_keeps_parameters_dynamic(self):
+        """Folding must not freeze trainable/persistable leaves — their
+        updates between runs stay visible."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            net = nn.Linear(2, 2)
+            out = net(x)
+        static.apply_pass(main, "constant_folding")
+        exe = static.Executor()
+        xv = np.ones(2, np.float32)
+        (before,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        net.bias.set_value(np.asarray(net.bias.value) + 7.0)
+        (after,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(after, before + 7.0, rtol=1e-5)
+
+    def test_gradients_honors_target_gradients(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [3], "float32")
+            y = x * x
+            (dx,) = static.gradients(
+                y, [x],
+                target_gradients=[np.array([1., 0., 2.], np.float32)])
+        exe = static.Executor()
+        xv = np.array([1., 2., 3.], np.float32)
+        (got,) = exe.run(main, feed={"x": xv}, fetch_list=[dx])
+        np.testing.assert_allclose(got, 2 * xv * [1., 0., 2.],
+                                   rtol=1e-6)
+
+    def test_unknown_feed_key_rejected(self):
+        main, startup, x, fc1, fc2, h, out, loss = _mlp_program()
+        exe = static.Executor()
+        with pytest.raises(KeyError, match="not data"):
+            exe.run(main, feed={"X": np.zeros((4, 8), np.float32)},
+                    fetch_list=[out])
+
+
 class TestPasses:
     def test_dead_code_elimination(self):
         main = static.Program()
