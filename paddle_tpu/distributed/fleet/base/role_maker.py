@@ -50,8 +50,19 @@ class PaddleCloudRoleMaker(RoleMakerBase):
         return [e for e in eps.split(",") if e]
 
     def server_index(self):
+        """This server's rank in the endpoint list.  Matches host:port
+        when POD_IP is set (the reference's multi-host contract,
+        role_maker.py:908); with only PADDLE_PORT the first port match
+        wins — unambiguous on single-host, documented limitation
+        otherwise."""
         port = os.environ.get("PADDLE_PORT")
+        ip = os.environ.get("POD_IP")
         eps = self.server_endpoints()
+        if ip is not None and port is not None:
+            target = f"{ip}:{port}"
+            for i, e in enumerate(eps):
+                if e == target:
+                    return i
         for i, e in enumerate(eps):
             if port is not None and e.endswith(":" + port):
                 return i

@@ -33,6 +33,7 @@ import io
 import json
 import threading
 import urllib.request
+import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence
 
@@ -44,7 +45,10 @@ __all__ = ["SparseTable", "DenseTable", "PSServer", "PSClient",
 
 def _row_init(table_name: str, rid: int, dim: int, scale: float,
               dtype=np.float32) -> np.ndarray:
-    seed = (hash((table_name, int(rid))) & 0x7FFFFFFF)
+    # crc32, NOT hash(): python's str hashing is PYTHONHASHSEED-salted
+    # per process, which would break the documented invariant that a
+    # restarted PS regenerates identical untrained rows
+    seed = zlib.crc32(f"{table_name}:{int(rid)}".encode()) & 0x7FFFFFFF
     return np.asarray(
         np.random.RandomState(seed).uniform(-scale, scale, size=(dim,)),
         dtype=dtype)

@@ -142,6 +142,177 @@ def executed_yaml_names():
 
 
 # ---------------------------------------------------------------------------
+# generic gradient verification (reference: op_test.py:3129 check_grad —
+# numeric-vs-analytic per op).  TPU-native: the analytic gradient is
+# jax.grad THROUGH the public api; the numeric side is a directional
+# derivative (dot-product test): perturb every float input along a fixed
+# random direction v, compare (f(x+εv) − f(x−εv)) / 2ε against ⟨∇f, v⟩.
+# One scalar per spec — cheap, and catches any wrong VJP that projects
+# onto a random direction (i.e. almost any wrong VJP).
+# ---------------------------------------------------------------------------
+
+# ops whose outputs are piecewise-constant in their float inputs or
+# selection-indexed (derivative a.e. zero / FD ill-defined at scale):
+# the dot-product test is vacuous or noisy there, so they are skipped
+# and stay accounted as forward-only
+GRAD_CHECK_SKIP = {
+    # integer-valued / index outputs
+    "argmax", "argmin", "argsort", "searchsorted", "bucketize",
+    "nonzero", "unique", "unique_consecutive", "mode", "kthvalue",
+    "topk", "sort", "median", "nanmedian",
+    # piecewise-constant
+    "floor", "ceil", "round", "trunc", "sign", "equal", "not_equal",
+    "greater_than", "greater_equal", "less_than", "less_equal",
+    "isnan", "isinf", "isfinite", "isclose", "allclose",
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "heaviside", "histogram", "bincount",
+    # discontinuous selection / counting semantics
+    "nms", "viterbi_decode", "edit_distance", "accuracy", "auc",
+    "matrix_rank", "clip_by_norm", "box_coder", "prior_box",
+    "yolo_box", "generate_proposals",
+    # stochastic or property-checked only
+    "bernoulli", "multinomial", "randint", "randperm", "uniform",
+    "gaussian", "poisson", "exponential", "dropout", "rrelu",
+    "class_center_sample", "gumbel_softmax", "standard_gamma",
+    # spec sample sits at a non-differentiable point (dist: x == y so
+    # ||x-y|| is at the norm's kink) or an eps-sized FD step crosses an
+    # argmax selection boundary (reduce max/min with close value pairs)
+    "dist", "max", "min", "amax", "amin",
+    # API mutates Tensor state in place (raw-array call unsupported)
+    "increment", "batch_norm", "sync_batch_norm_",
+    # host-side graph message passing (converts to numpy internally)
+    "send_ue_recv",
+}
+
+
+# eligible-by-input specs whose outputs carry no real float Tensor to
+# project (complex-valued: eig/fft_r2c/as_complex; integer/bool: shape,
+# numel, cast-to-int, is_empty, binomial; rank outputs) — the real
+# dot-product test is undefined there, so they stay forward-only
+NO_FLOAT_OUTPUT = {
+    "as_complex", "binomial", "cast", "complex", "eig", "eigvals",
+    "fft_r2c", "is_empty", "matrix_rank_atol_rtol", "matrix_rank_tol",
+    "numel", "shape", "view_dtype",
+}
+
+
+def _float_leaves(args):
+    """Paths of perturbable float arrays in the positional args: (i,
+    None) for a top-level ndarray, (i, j) for an element of a
+    list/tuple arg (concat/stack/multi_dot-style multi-tensor ops)."""
+    paths = []
+    for i, a in enumerate(args):
+        if isinstance(a, np.ndarray) \
+                and np.issubdtype(a.dtype, np.floating):
+            paths.append((i, None))
+        elif isinstance(a, (list, tuple)):
+            for j, e in enumerate(a):
+                if isinstance(e, np.ndarray) \
+                        and np.issubdtype(e.dtype, np.floating):
+                    paths.append((i, j))
+    return paths
+
+
+def _leaf_get(args, path):
+    i, j = path
+    return args[i] if j is None else args[i][j]
+
+
+def _leaf_set(args, path, val):
+    i, j = path
+    if j is None:
+        args[i] = val
+    else:
+        sub = list(args[i])
+        sub[j] = val
+        args[i] = sub
+
+
+def check_grad_spec(spec: ExecSpec, eps: float = 1e-2,
+                    tol: float = 3e-2):
+    """Dot-product grad test for one spec.  Returns True when the check
+    RAN, False when the spec is ineligible (custom body, no float
+    inputs, skip-listed op, or non-scalar-projectable outputs)."""
+    if spec.custom is not None or spec.sample is None \
+            or spec.op in GRAD_CHECK_SKIP:
+        return False
+    import jax
+    import jax.numpy as jnp
+    fn = _resolve(spec.api)
+    args, kwargs = spec.sample()
+    paths = _float_leaves(args)
+    if not paths:
+        return False
+    rs = _rs(_seed_of("gradchk", spec.op))
+    dirs = [rs.randn(*_leaf_get(args, p).shape).astype(np.float64)
+            for p in paths]
+    proj = {}
+
+    def scalar(*fvals):
+        new_args = list(args)
+        for p, v in zip(paths, fvals):
+            _leaf_set(new_args, p, v)
+        out = fn(*_to_tensors(new_args), **_to_tensors(dict(kwargs)))
+        from ..framework.tensor import Tensor
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        total = None
+        for k, o in enumerate(outs):
+            if not isinstance(o, Tensor):
+                continue
+            v = jnp.asarray(o.value)
+            if not jnp.issubdtype(v.dtype, jnp.floating):
+                continue
+            if k not in proj:
+                proj[k] = np.asarray(
+                    _rs(_seed_of("gradw", spec.op, k)).randn(*v.shape),
+                    np.float32)
+            term = jnp.sum(v.astype(jnp.float32) * proj[k])
+            total = term if total is None else total + term
+        return total
+
+    vals32 = [jnp.asarray(_leaf_get(args, p), jnp.float32)
+              for p in paths]
+    probe = scalar(*vals32)
+    if probe is None:
+        return False
+    g = jax.grad(scalar, argnums=tuple(range(len(vals32))))(*vals32)
+    ad = float(sum(np.sum(np.asarray(gi, np.float64) * d)
+                   for gi, d in zip(g, dirs)))
+    # numeric side via two forward evals
+    def at(t):
+        shifted = [jnp.asarray(_leaf_get(args, p) + t * eps * d,
+                               jnp.float32)
+                   for p, d in zip(paths, dirs)]
+        return float(np.asarray(scalar(*shifted)))
+    fd = (at(+1.0) - at(-1.0)) / (2.0 * eps)
+    scale = max(1.0, abs(fd), abs(ad))
+    assert abs(fd - ad) <= tol * scale, \
+        (spec.op, fd, ad, abs(fd - ad) / scale)
+    return True
+
+
+def grad_checked_yaml_names():
+    """Yaml names whose derived gradient the dot-product test verifies
+    (used by tools/op_audit.py's backward.yaml accounting).  Mirrors
+    check_grad_spec's eligibility including the float-INPUT probe
+    (sample() is cheap); specs that would still skip at run time for
+    having no float OUTPUT are excluded via NO_FLOAT_OUTPUT."""
+    out = set()
+    for s in EXEC_SPECS:
+        if s.custom is not None or s.sample is None \
+                or s.op in GRAD_CHECK_SKIP \
+                or s.op in NO_FLOAT_OUTPUT:
+            continue
+        try:
+            args, _ = s.sample()
+        except Exception:
+            continue
+        if _float_leaves(args):
+            out.add(s.op)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # samples shared below
 # ---------------------------------------------------------------------------
 def _s(*shape):

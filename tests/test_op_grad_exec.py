@@ -1,0 +1,31 @@
+"""Generated gradient verification over the exec-spec table.
+
+Reference: `test/legacy_test/op_test.py:3129 check_grad` — every op's
+analytic gradient is checked against a numeric one.  Here the analytic
+side is jax autodiff THROUGH the public api and the numeric side is a
+directional (dot-product) derivative; see
+`paddle_tpu.ops.exec_specs.check_grad_spec`.  Ops in GRAD_CHECK_SKIP
+(non-smooth, stochastic, in-place, index-valued) are excluded and remain
+forward-only in the audit's backward.yaml accounting.
+"""
+import pytest
+
+from paddle_tpu.ops.exec_specs import (EXEC_SPECS, GRAD_CHECK_SKIP,
+                                       check_grad_spec)
+
+_ELIGIBLE = [s for s in EXEC_SPECS
+             if s.custom is None and s.sample is not None
+             and s.op not in GRAD_CHECK_SKIP]
+
+
+@pytest.mark.parametrize("spec", _ELIGIBLE, ids=lambda s: s.op)
+def test_grad_matches_directional_derivative(spec):
+    ran = check_grad_spec(spec)
+    if not ran:
+        pytest.skip("no float inputs / no float outputs")
+
+
+def test_eligible_count_does_not_regress():
+    """The grad-checked surface only grows: 190 specs ran the check at
+    round 5 (audit backward.yaml 'numerically executed' relies on it)."""
+    assert len(_ELIGIBLE) >= 190
