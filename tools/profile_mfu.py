@@ -218,14 +218,17 @@ def render(rows):
         " (optimizer/jit_update.py use_fused_adamw).",
         "",
         "Multi-tensor follow-up (measured): flattening the small params"
-        " (norm scales/biases, `FLAGS_multi_tensor_adamw`, on by"
-        " default) into one fused call is numerically identical and"
-        " perf-NEUTRAL — 17,582 tok/s with grouping vs 17,559 without"
-        " (inside the 0.2% rep spread), and re-measuring the XLA path"
-        " with grouping still loses (16,616 tok/s, MFU 0.509)."
-        "  Conclusion: per-param launch overhead is ~free on this chip;"
-        " the optimizer phase is bandwidth-bound, so the remaining"
-        " ~0.09 MFU of optimizer time could only shrink by cutting"
+        " (norm scales/biases, `FLAGS_multi_tensor_adamw`) into one"
+        " fused call is numerically identical and perf-NEUTRAL on"
+        " llama-1B — 17,582 tok/s with grouping vs 17,559 without"
+        " (inside the 0.2% rep spread) — and re-measuring the XLA path"
+        " with grouping still loses (16,616 tok/s, MFU 0.509).  But on"
+        " bert-base it costs 4.3% (137,151 vs 143,389 tok/s): at 110M"
+        " params the small-param fraction is large enough that the"
+        " concat/split traffic outweighs the saved launches.  The flag"
+        " therefore defaults OFF.  Conclusion: per-param launch"
+        " overhead is ~free on this chip; the optimizer phase is"
+        " bandwidth-bound, so optimizer time only shrinks by cutting"
         " state traffic (e.g. opt-in bf16 moments), not by batching"
         " launches.",
     ]
