@@ -8,7 +8,7 @@ the driver-captured BENCH file records the full matrix, not just llama
 relative spread (max-min)/median reported alongside; compilation happens
 once per config, outside the reps.
 
-BENCH_CONFIG=llama|offload|bert|resnet|unet|decode runs one config.
+BENCH_CONFIG=llama|offload|bert|resnet|unet|decode|longctx runs one config.
 Reference throughput instrumentation analog:
 python/paddle/profiler/timer.py:351 (ips Benchmark).
 """
@@ -164,21 +164,9 @@ def bench_llama(offload=False):
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     x = paddle.to_tensor(ids)
-
-    # warmup / compile (host transfer forces completion: the axon relay's
-    # block_until_ready does not synchronize remote execution).
-    loss = step(x, x)
-    _ = float(np.asarray(loss.value))
-    final_loss = [0.0]
-
-    def rep():
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = step(x, x)
-        final_loss[0] = float(np.asarray(loss.value))
-        return batch * seq * steps / (time.perf_counter() - t0)
-
-    tokens_per_sec, spread, vals = _measure(rep)
+    tokens_per_sec, spread, vals, floss = _timed_train_tokens(
+        step, x, batch, seq, steps)
+    final_loss = [floss]
     model_flops = 6.0 * n_params * tokens_per_sec
     peak = chip_peak_flops()
     mfu = model_flops / peak
@@ -195,6 +183,93 @@ def bench_llama(offload=False):
     _emit(name, tokens_per_sec,
           f"tokens/s/chip (mfu={mfu:.3f}, hw_util={hw_util:.3f}, "
           f"params={n_params/1e6:.0f}M, loss={final_loss[0]:.3f})",
+          mfu / 0.40, spread, vals)
+
+
+def _timed_train_tokens(step, x, batch, seq, steps):
+    """Shared train-bench timing harness: warmup/compile, then timed
+    reps.  The host transfer (`float(np.asarray(...))`) forces
+    completion — the axon relay's block_until_ready does not
+    synchronize remote execution."""
+    loss = step(x, x)
+    _ = float(np.asarray(loss.value))
+    final_loss = [0.0]
+
+    def rep():
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(x, x)
+        final_loss[0] = float(np.asarray(loss.value))
+        return batch * seq * steps / (time.perf_counter() - t0)
+
+    tokens_per_sec, spread, vals = _measure(rep)
+    return tokens_per_sec, spread, vals, final_loss[0]
+
+
+def bench_longctx():
+    """Long-context training (SURVEY §5.7): the same 1.0B llama at
+    seq 16384 (8x the headline config), batch 1, through the Pallas
+    flash-attention path — flash's O(seq) memory is what makes a 16k
+    context FIT next to 8G of resident fp32+moment state on the 16G
+    chip.  MFU here uses attention-INCLUSIVE model FLOPs per token:
+    6N dense + 6·L·h·seq attention (PaLM's 12·L·h·seq causal-halved);
+    at 16k the attention matmuls are 37% of the work, so the
+    dense-only 6N basis would overstate utilization."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM, LlamaConfig
+    from paddle_tpu.parallel import ShardedTrainStep
+    from paddle_tpu.distributed.topology import build_mesh
+
+    if on_tpu:
+        seq = int(os.environ.get("BENCH_LONGCTX_SEQ", "16384"))
+        remat = os.environ.get("BENCH_LONGCTX_REMAT", "full")
+        cfg = LlamaConfig(vocab_size=8192, hidden_size=2560,
+                          intermediate_size=6912, num_hidden_layers=14,
+                          num_attention_heads=20, num_key_value_heads=4,
+                          max_position_embeddings=seq,
+                          dtype="bfloat16", param_dtype="float32",
+                          recompute=remat != "none",
+                          recompute_layers=None,
+                          recompute_granularity=remat
+                          if remat != "none" else "full")
+        batch, steps = 1, 4
+    else:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=128,
+                          intermediate_size=384, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=4,
+                          max_position_embeddings=512, dtype="float32")
+        batch, seq, steps = 1, 512, 2
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    n_params = sum(int(np.prod(p.value.shape))
+                   for p in model.parameters())
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters(),
+                                 weight_decay=0.1,
+                                 moment_dtype="bfloat16" if on_tpu
+                                 else None)
+    mesh = build_mesh(devices=jax.devices()[:1])
+    step = ShardedTrainStep(model, opt, mesh, sharding_stage=3,
+                            rematerialize=False)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    tokens_per_sec, spread, vals, floss = _timed_train_tokens(
+        step, x, batch, seq, steps)
+    # attention-inclusive train FLOPs/token: 6N dense + 6·L·h·seq
+    # attention — PaLM's 12·L·h·seq (fwd 2 + bwd 4 passes over the
+    # 2·seq·h QK^T/AV matmul pair per layer) halved for causal masking
+    attn_per_tok = 6.0 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    model_flops = (6.0 * n_params + attn_per_tok) * tokens_per_sec
+    mfu = model_flops / chip_peak_flops()
+    _emit("llama_longctx_train_tokens_per_sec_per_chip",
+          tokens_per_sec,
+          f"tokens/s/chip (seq={seq}, b={batch}, mfu={mfu:.3f} "
+          f"attention-inclusive, params={n_params/1e6:.0f}M, "
+          f"attn_share={attn_per_tok/(6.0*n_params+attn_per_tok):.2f}, "
+          f"loss={floss:.3f})",
           mfu / 0.40, spread, vals)
 
 
@@ -483,6 +558,7 @@ CONFIGS = {
     "resnet": bench_resnet,
     "unet": bench_unet,
     "decode": bench_llama_decode,
+    "longctx": bench_longctx,
 }
 
 
@@ -511,7 +587,6 @@ def main():
     # otherwise stay resident in this process's jax client and OOM the
     # 16G chip for every config after the first.
     import subprocess
-    import time as _time
     here = os.path.abspath(__file__)
     budget = float(os.environ.get("BENCH_CONFIG_TIMEOUT", "1500"))
     for name in CONFIGS:
@@ -542,7 +617,7 @@ def main():
             # distinct failure shouldn't burn the re-run budget)
             if attempt == 0 and proc.returncode != 0 \
                     and "RESOURCE_EXHAUSTED" in (proc.stderr or "")[-2000:]:
-                _time.sleep(60)
+                time.sleep(60)
                 continue
             tail = (proc.stderr or proc.stdout or "")[-200:]
             print(json.dumps({"metric": f"{name}_bench_error",
