@@ -383,8 +383,18 @@ class Tensor:
         return self.to_device(place)
 
     def fill_(self, v):
-        self._value = jnp.full_like(self._value, v)
-        return self
+        # routed through dispatch so the tape sees the overwrite: the
+        # output no longer depends on the previous value, so the
+        # recorded op's gradient to it is exact ZEROS (reference
+        # fill_grad).  A raw _value overwrite would leave the old
+        # autograd ref attached and backprop stale gradients.
+        from .dispatch import run_inplace
+        import jax
+
+        def _fill(x):
+            return jax.lax.stop_gradient(jnp.full_like(x, v))
+
+        return run_inplace(self, _fill, name="fill_")
 
     def block_until_ready(self):
         self._value.block_until_ready()

@@ -291,12 +291,29 @@ def check_grad_spec(spec: ExecSpec, eps: float = 1e-2,
     return True
 
 
+# ops whose gradients are pinned by TARGETED tests at constructed safe
+# points instead of the generic sweep (tests/test_op_grad_exec.py
+# TestSkipListedGradsAtSafePoints): selection scatters at distinct
+# values, zero-grads of piecewise-constant ops, reinterpret
+# pass-throughs, RNN/FFT directional derivatives, dropout's scaled-mask
+# relation.  Consumed by tools/op_audit.py's backward accounting.
+GRAD_CHECKED_TARGETED = {
+    "max", "min", "dist", "ceil", "floor", "round", "sign", "cast",
+    "complex", "real", "imag", "as_complex", "as_real",
+    "topk", "kthvalue", "mode", "nanmedian", "argsort",
+    "dropout", "lstm", "gru", "rnn", "fill", "view_dtype",
+    "fft_c2c", "fft_r2c", "fft_c2r",
+}
+
+
 def grad_checked_yaml_names():
-    """Yaml names whose derived gradient the dot-product test verifies
-    (used by tools/op_audit.py's backward.yaml accounting).  Mirrors
-    check_grad_spec's eligibility including the float-INPUT probe
-    (sample() is cheap); specs that would still skip at run time for
-    having no float OUTPUT are excluded via NO_FLOAT_OUTPUT."""
+    """Yaml names whose derived gradient is numerically verified (used
+    by tools/op_audit.py's backward.yaml accounting): the dot-product
+    sweep's eligible set — check_grad_spec's eligibility including the
+    float-INPUT probe (sample() is cheap), minus NO_FLOAT_OUTPUT —
+    UNION the GRAD_CHECKED_TARGETED ops pinned by safe-point tests in
+    tests/test_op_grad_exec.py (those are in GRAD_CHECK_SKIP and never
+    run through the sweep)."""
     out = set()
     for s in EXEC_SPECS:
         if s.custom is not None or s.sample is None \
@@ -309,6 +326,7 @@ def grad_checked_yaml_names():
             continue
         if _float_leaves(args):
             out.add(s.op)
+    out |= GRAD_CHECKED_TARGETED
     return out
 
 

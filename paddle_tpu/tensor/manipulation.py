@@ -45,7 +45,25 @@ def view(x, shape_or_dtype, name=None):
         return reshape(x, shape_or_dtype)
     (x,) = to_tensor_args(x)
     jd = dtypes.to_jax(shape_or_dtype)
-    return Tensor(x.value.view(jd))
+    orig = x.value.dtype
+
+    # jax defines the bitcast's gradient as ZERO; the reference's
+    # view_dtype_grad reinterprets the cotangent back instead
+    # (paddle/phi/kernels view_grad) — a custom_vjp restores that
+    import jax
+
+    @jax.custom_vjp
+    def _bitcast(v):
+        return v.view(jd)
+
+    def _fwd(v):
+        return _bitcast(v), v.shape
+
+    def _bwd(shape, g):
+        return (g.view(orig).reshape(shape),)
+
+    _bitcast.defvjp(_fwd, _bwd)
+    return run(_bitcast, x, name="view_dtype")
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
@@ -486,7 +504,8 @@ def cast_(x, dtype):
 
 def as_real(x, name=None):
     (x,) = to_tensor_args(x)
-    return Tensor(jnp.stack([jnp.real(x.value), jnp.imag(x.value)], axis=-1))
+    return run(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+               x, name="as_real")
 
 
 def as_complex(x, name=None):

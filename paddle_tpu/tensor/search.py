@@ -137,19 +137,20 @@ def mode(x, axis=-1, keepdim=False, name=None):
     arr = np.asarray(x.value)
     arr_m = np.moveaxis(arr, axis, -1)
     flat = arr_m.reshape(-1, arr_m.shape[-1])
-    vals = np.empty(flat.shape[0], arr.dtype)
     idxs = np.empty(flat.shape[0], np.int64)
     for i, row in enumerate(flat):
         uq, counts = np.unique(row, return_counts=True)
-        v = uq[np.argmax(counts[::-1].cumsum()[::-1] * 0 + counts)]
         # paddle picks the largest value among modes' last occurrence
         best = uq[counts == counts.max()].max()
-        vals[i] = best
         idxs[i] = np.where(row == best)[0][-1]
-    shp = arr_m.shape[:-1]
-    vals = vals.reshape(shp)
-    idxs = idxs.reshape(shp)
+    idxs = idxs.reshape(arr_m.shape[:-1])
+    # indices are a host-side decision; the VALUES are re-gathered on
+    # device via take_along_axis so gradient scatters to the selected
+    # elements (reference: mode_grad kernel's index scatter)
+    from .manipulation import take_along_axis
+    idx_k = np.expand_dims(idxs, axis)
+    vals_t = take_along_axis(x, Tensor(jnp.asarray(idx_k)), axis)
     if keepdim:
-        vals = np.expand_dims(vals, axis)
-        idxs = np.expand_dims(idxs, axis)
-    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))
+        return vals_t, Tensor(jnp.asarray(idx_k))
+    from .manipulation import squeeze
+    return squeeze(vals_t, axis), Tensor(jnp.asarray(idxs))

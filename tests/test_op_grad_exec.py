@@ -68,3 +68,187 @@ class TestSkipListedGradsAtSafePoints:
         paddle.dist(x, paddle.to_tensor(yv), p=2).backward()
         np.testing.assert_allclose(np.asarray(x.grad.value),
                                    xv / 5.0, rtol=1e-6)
+
+    def test_piecewise_constant_ops_have_zero_grad(self):
+        """ceil/floor/round/sign: derivative is 0 a.e. — the backward
+        must return exact zeros, not NaNs (reference *_grad kernels
+        emit zeros)."""
+        import numpy as np
+        import paddle_tpu as paddle
+        for fn in (paddle.ceil, paddle.floor, paddle.round, paddle.sign):
+            x = paddle.to_tensor(np.array([0.3, -1.7, 2.2], np.float32))
+            x.stop_gradient = False
+            fn(x).sum().backward()
+            np.testing.assert_array_equal(np.asarray(x.grad.value),
+                                          np.zeros(3, np.float32))
+
+    def test_cast_grad_casts_back(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.array([1., 2.], np.float32))
+        x.stop_gradient = False
+        (x.astype("float64") * 3.0).sum().backward()
+        g = np.asarray(x.grad.value)
+        assert g.dtype == np.float32
+        np.testing.assert_allclose(g, [3., 3.])
+
+    def test_complex_real_imag_grads(self):
+        """complex/real/imag/as_complex/as_real round-trip grads."""
+        import numpy as np
+        import paddle_tpu as paddle
+        re = paddle.to_tensor(np.array([1., 2.], np.float32))
+        im = paddle.to_tensor(np.array([3., 4.], np.float32))
+        re.stop_gradient = False
+        im.stop_gradient = False
+        z = paddle.complex(re, im)
+        (paddle.real(z) * 2 + paddle.imag(z) * 5).sum().backward()
+        np.testing.assert_allclose(np.asarray(re.grad.value), [2., 2.])
+        np.testing.assert_allclose(np.asarray(im.grad.value), [5., 5.])
+        # as_complex/as_real reinterpret pair: grad passes through
+        p = paddle.to_tensor(np.array([[1., 3.], [2., 4.]], np.float32))
+        p.stop_gradient = False
+        z2 = paddle.as_complex(p)
+        (paddle.as_real(z2) * paddle.to_tensor(
+            np.array([[2., 7.], [2., 7.]], np.float32))).sum().backward()
+        np.testing.assert_allclose(np.asarray(p.grad.value),
+                                   [[2., 7.], [2., 7.]])
+
+    def test_selection_grads_scatter_to_sources(self):
+        """topk/kthvalue/mode/argsort-values/nanmedian: gradient routes
+        1.0 to each selected source element (reference *_grad scatter
+        kernels), checked at distinct-valued points."""
+        import numpy as np
+        import paddle_tpu as paddle
+        xv = np.array([[1., 9., 3., 7.]], np.float32)
+
+        def grad_of(out_fn):
+            x = paddle.to_tensor(xv)
+            x.stop_gradient = False
+            out_fn(x).sum().backward()
+            return np.asarray(x.grad.value)
+
+        np.testing.assert_allclose(
+            grad_of(lambda x: paddle.topk(x, k=2)[0]),
+            [[0., 1., 0., 1.]])
+        np.testing.assert_allclose(
+            grad_of(lambda x: paddle.kthvalue(x, k=2)[0]),
+            [[0., 0., 1., 0.]])
+        np.testing.assert_allclose(
+            grad_of(lambda x: paddle.sort(x, axis=1) * paddle.to_tensor(
+                np.array([[1., 2., 3., 4.]], np.float32))),
+            [[1., 4., 2., 3.]])  # sorted position weights route back
+        xm = np.array([[5., 5., 2.]], np.float32)
+        x = paddle.to_tensor(xm)
+        x.stop_gradient = False
+        paddle.mode(x, axis=1)[0].sum().backward()
+        assert float(np.asarray(x.grad.value).sum()) == 1.0
+        # nanmedian of [1, nan, 3] = mean of the two non-NaN values:
+        # the gradient scatters exactly 0.5 to each, 0 to the NaN slot
+        xn = np.array([[1., np.nan, 3.]], np.float32)
+        x = paddle.to_tensor(xn)
+        x.stop_gradient = False
+        paddle.nanmedian(x, axis=1).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.value),
+                                   [[0.5, 0.0, 0.5]])
+
+    def test_fill_inplace_detaches_and_zero_grads(self):
+        """fill_ severs dependence on the pre-fill value: the recorded
+        grad to the producer is exact zeros (reference fill_grad) —
+        regression for the raw _value overwrite that left the old
+        autograd ref attached."""
+        import numpy as np
+        import paddle_tpu as paddle
+        w = paddle.to_tensor(np.array([2., 3.], np.float32))
+        w.stop_gradient = False
+        x = w * 5.0
+        x.fill_(7.0)
+        (x * x).sum().backward()
+        np.testing.assert_array_equal(np.asarray(w.grad.value),
+                                      np.zeros(2, np.float32))
+        np.testing.assert_allclose(np.asarray(x.value), [7., 7.])
+
+    def test_view_dtype_grad_bitcasts_back(self):
+        """view(dtype) reinterprets bits; the cotangent must come back
+        through the inverse reinterpret (reference view_dtype_grad),
+        not jax's zero bitcast gradient."""
+        import numpy as np
+        import paddle_tpu as paddle
+        xv = np.array([1.5, -2.25], np.float32)
+        x = paddle.to_tensor(xv)
+        x.stop_gradient = False
+        y = paddle.view(x, "uint8")   # 4 bytes each -> shape [8]
+        assert tuple(y.shape) == (8,)
+        # float32 -> float32 view is identity incl. gradient
+        x2 = paddle.to_tensor(xv)
+        x2.stop_gradient = False
+        (paddle.view(x2, "float32") * 3.0).sum().backward()
+        np.testing.assert_allclose(np.asarray(x2.grad.value), [3., 3.])
+
+    def test_dropout_grad_is_scaled_mask(self):
+        """dropout_grad: dx = dy · mask/(1-p) — equals y/x wherever
+        x != 0 for the same drawn mask."""
+        import numpy as np
+        import paddle_tpu as paddle
+        paddle.seed(123)
+        xv = np.full((64,), 2.0, np.float32)
+        x = paddle.to_tensor(xv)
+        x.stop_gradient = False
+        y = paddle.nn.functional.dropout(x, p=0.5, training=True)
+        y.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.value),
+                                   np.asarray(y.value) / xv, rtol=1e-6)
+
+    def test_rnn_family_grads_match_directional_derivative(self):
+        """lstm/gru/rnn grads via the dot-product test on the layer
+        forward (smooth tanh/sigmoid cells)."""
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        for layer_cls in (nn.LSTM, nn.GRU, nn.SimpleRNN):
+            paddle.seed(11)
+            layer = layer_cls(8, 16)
+            xv = np.random.RandomState(0).randn(2, 5, 8).astype(
+                np.float32)
+            d = np.random.RandomState(1).randn(2, 5, 8).astype(
+                np.float64) * 0.1
+
+            def scalar(arr):
+                out = layer(paddle.framework.tensor.Tensor(arr))[0]
+                return jnp.sum(out.value.astype(jnp.float32))
+
+            g = jax.grad(scalar)(jnp.asarray(xv))
+            ad = float(np.sum(np.asarray(g, np.float64) * d))
+            eps = 1e-2
+            fd = (float(scalar(jnp.asarray(xv + eps * d, jnp.float32)))
+                  - float(scalar(jnp.asarray(xv - eps * d,
+                                             jnp.float32)))) / (2 * eps)
+            assert abs(fd - ad) <= 3e-2 * max(1.0, abs(fd), abs(ad)), \
+                (layer_cls.__name__, fd, ad)
+
+    def test_fft_grads_match_directional_derivative(self):
+        """fft_r2c/c2c/c2r grads through |spectrum|² energy."""
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        xv = np.random.RandomState(3).randn(16).astype(np.float32)
+        d = np.random.RandomState(4).randn(16).astype(np.float64)
+
+        def scalar(arr):
+            t = paddle.framework.tensor.Tensor(arr)
+            spec = paddle.fft.fft(t)           # c2c on real-cast input
+            rspec = paddle.fft.rfft(t)         # r2c
+            back = paddle.fft.irfft(rspec, n=16)  # c2r
+            return (jnp.sum(jnp.abs(spec.value) ** 2).astype(jnp.float32)
+                    + jnp.sum(jnp.abs(rspec.value) ** 2)
+                    + jnp.sum(back.value ** 2)).astype(jnp.float32)
+
+        g = jax.grad(scalar)(jnp.asarray(xv))
+        ad = float(np.sum(np.asarray(g, np.float64) * d))
+        eps = 1e-3
+        fd = (float(scalar(jnp.asarray(xv + eps * d, jnp.float32)))
+              - float(scalar(jnp.asarray(xv - eps * d, jnp.float32)))) \
+            / (2 * eps)
+        assert abs(fd - ad) <= 3e-2 * max(1.0, abs(fd), abs(ad)), (fd, ad)

@@ -477,10 +477,15 @@ def audit_backward():
     and autodiffs it (custom_vjp only where written, e.g. flash
     attention).  So a backward op is 'covered' when its FORWARD op is
     covered: the framework differentiates it by construction.
-    'executed' = the forward op has a registry OpSpec, whose generated
-    tests numerically check the derived gradient against finite
-    differences / numpy (the check_grad analog, matching
-    test/legacy_test/op_test.py:3129)."""
+    'executed' = the derived gradient is numerically checked — by the
+    registry OpSpec's generated check_grad tests, the exec-spec
+    dot-product sweep, or a targeted safe-point test
+    (GRAD_CHECKED_TARGETED).  The 10 residual unexecuted ops are the
+    genuinely unverifiable classes: stochastic samplers
+    (gumbel_softmax, poisson, rrelu, gaussian/uniform_inplace RNG
+    fills), complex eigendecomposition (eig), the host-side graph path
+    (send_ue_recv), mutating batch norm (sync_batch_norm), and legacy
+    aliases (gru_unit, warpctc)."""
     fwd = {op: cat for op, cat, _ in audit(DEFAULT_YAML)}
     _, reg_names = _executed_names()
     from paddle_tpu.ops.exec_specs import grad_checked_yaml_names
