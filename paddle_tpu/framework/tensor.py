@@ -383,18 +383,16 @@ class Tensor:
         return self.to_device(place)
 
     def fill_(self, v):
-        # routed through dispatch so the tape sees the overwrite: the
-        # output no longer depends on the previous value, so the
-        # recorded op's gradient to it is exact ZEROS (reference
-        # fill_grad).  A raw _value overwrite would leave the old
-        # autograd ref attached and backprop stale gradients.
-        from .dispatch import run_inplace
-        import jax
-
-        def _fill(x):
-            return jax.lax.stop_gradient(jnp.full_like(x, v))
-
-        return run_inplace(self, _fill, name="fill_")
+        # the filled value no longer depends on ANYTHING (reference
+        # fill_grad emits zeros), so the correct tape action is to
+        # SEVER: overwrite the value and reset to a fresh leaf VarRef.
+        # Recording a node instead would stop the tensor being a leaf
+        # (grad accumulation breaks for filled parameters) and pin the
+        # pre-fill array; keeping the old ref would backprop stale
+        # gradients through the pre-fill producer.
+        self._value = jnp.full_like(self._value, v)
+        self._set_ref(VarRef())
+        return self
 
     def block_until_ready(self):
         self._value.block_until_ready()

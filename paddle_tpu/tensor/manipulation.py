@@ -443,9 +443,13 @@ def masked_fill(x, mask, value, name=None):
 def masked_scatter(x, mask, value, name=None):
     x, mask, value = to_tensor_args(x, mask, value)
     m = np.asarray(mask.value)
-    out = np.asarray(x.value).copy()
-    out[m] = np.asarray(value.value).reshape(-1)[: int(m.sum())]
-    return Tensor(jnp.asarray(out))
+    idx = tuple(jnp.asarray(i) for i in np.nonzero(m))
+    k = int(m.sum())
+    # mask is a host-side decision; the scatter itself runs through
+    # dispatch so gradients flow — zeros into x at masked positions,
+    # gathered cotangents into value (reference masked_scatter_grad)
+    return run(lambda v, val: v.at[idx].set(val.reshape(-1)[:k]),
+               x, value, name="masked_scatter")
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False,
@@ -482,8 +486,18 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
 def repeat_interleave(x, repeats, axis=None, name=None):
     (x,) = to_tensor_args(x)
     if isinstance(repeats, Tensor):
+        # per-element counts are a host-side decision (dynamic output
+        # shape); the repeat itself dispatches so gradients accumulate
+        # back per source element (reference repeat_interleave_grad)
         reps = np.asarray(repeats.value)
-        return Tensor(jnp.repeat(x.value, jnp.asarray(reps), axis=axis))
+        n_src = x.size if axis is None else x.shape[axis]
+        # a single repeat count (0-d OR size-1) broadcasts over all
+        # source elements; per-element counts sum
+        total = int(reps.reshape(-1)[0]) * n_src if reps.size == 1 \
+            else int(reps.sum())
+        return run(lambda v: jnp.repeat(v, jnp.asarray(reps), axis=axis,
+                                        total_repeat_length=total),
+                   x, name="repeat_interleave")
     return run(lambda v: jnp.repeat(v, repeats, axis=axis), x,
                name="repeat_interleave")
 
@@ -568,10 +582,26 @@ def _has_bool_mask(idx):
 def _getitem(x, idx):
     nidx = _norm_index(idx)
     if _has_bool_mask(nidx):
-        # dynamic result shape → host-side gather (dygraph-only, like reference)
-        return Tensor(jnp.asarray(np.asarray(x.value)[
-            jax.tree_util.tree_map(lambda a: np.asarray(a)
-                                   if hasattr(a, "dtype") else a, nidx)]))
+        # dynamic result shape → the mask resolves to concrete indices
+        # host-side (dygraph-only, like reference), but the gather
+        # itself dispatches so the tape scatters gradients back
+        t_idx = nidx if isinstance(nidx, tuple) else (nidx,)
+        np_idx = jax.tree_util.tree_map(
+            lambda a: np.asarray(a) if hasattr(a, "dtype") else a,
+            t_idx)
+        if len(np_idx) == 1 \
+                and getattr(np_idx[0], "dtype", None) is not None \
+                and np_idx[0].dtype == bool:
+            gidx = tuple(jnp.asarray(i) for i in np.nonzero(np_idx[0]))
+            return run(lambda v: v[gidx], x, name="getitem")
+        # mixed advanced indexing: resolve fully host-side, then a
+        # dispatched identity gather over the flat positions
+        flat_pos = np.arange(int(np.prod(x.shape))).reshape(x.shape)
+        selected = flat_pos[np_idx]
+        sel = jnp.asarray(selected.ravel())
+        shape = selected.shape
+        return run(lambda v: v.ravel()[sel].reshape(shape), x,
+                   name="getitem")
     return run(lambda v: v[nidx], x, name="getitem")
 
 

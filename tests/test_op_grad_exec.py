@@ -163,9 +163,27 @@ class TestSkipListedGradsAtSafePoints:
         x = w * 5.0
         x.fill_(7.0)
         (x * x).sum().backward()
-        np.testing.assert_array_equal(np.asarray(w.grad.value),
-                                      np.zeros(2, np.float32))
+        assert w.grad is None or not np.asarray(w.grad.value).any()
         np.testing.assert_allclose(np.asarray(x.value), [7., 7.])
+        # a filled requires-grad tensor STAYS a trainable leaf: grads
+        # accumulate on it and a second backward works
+        p = paddle.to_tensor(np.array([9., 9.], np.float32))
+        p.stop_gradient = False
+        p.fill_(1.0)
+        (p * 2.0).sum().backward()
+        np.testing.assert_allclose(np.asarray(p.grad.value), [2., 2.])
+        p.clear_grad()
+        (p * 3.0).sum().backward()
+        np.testing.assert_allclose(np.asarray(p.grad.value), [3., 3.])
+
+    def test_repeat_interleave_size1_tensor_reps_broadcasts(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.array([1., 2., 3.], np.float32))
+        out = paddle.repeat_interleave(x, paddle.to_tensor(
+            np.array([2], np.int64)))
+        np.testing.assert_allclose(np.asarray(out.value),
+                                   [1., 1., 2., 2., 3., 3.])
 
     def test_view_dtype_grad_bitcasts_back(self):
         """view(dtype) reinterprets bits; the cotangent must come back
@@ -183,6 +201,44 @@ class TestSkipListedGradsAtSafePoints:
         x2.stop_gradient = False
         (paddle.view(x2, "float32") * 3.0).sum().backward()
         np.testing.assert_allclose(np.asarray(x2.grad.value), [3., 3.])
+
+    def test_masked_scatter_grads_to_both_operands(self):
+        """masked_scatter_grad: x gets zeros at masked slots, value
+        gets the masked cotangents (reference masked_scatter_grad)."""
+        import numpy as np
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.array([1., 2., 3.], np.float32))
+        v = paddle.to_tensor(np.array([10., 20., 30.], np.float32))
+        x.stop_gradient = False
+        v.stop_gradient = False
+        mask = paddle.to_tensor(np.array([True, False, True]))
+        out = paddle.masked_scatter(x, mask, v)
+        (out * paddle.to_tensor(
+            np.array([2., 5., 7.], np.float32))).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.value),
+                                   [0., 5., 0.])
+        np.testing.assert_allclose(np.asarray(v.grad.value),
+                                   [2., 7., 0.])
+
+    def test_repeat_interleave_tensor_reps_grad_accumulates(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.array([1., 2.], np.float32))
+        x.stop_gradient = False
+        reps = paddle.to_tensor(np.array([2, 3], np.int64))
+        paddle.repeat_interleave(x, reps).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.value), [2., 3.])
+
+    def test_bool_mask_getitem_grad_scatters(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.array([1., -2., 3., -4.], np.float32))
+        x.stop_gradient = False
+        mask = paddle.to_tensor(np.array([True, False, False, True]))
+        (x[mask] * paddle.to_tensor(
+            np.array([3., 9.], np.float32))).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.value),
+                                   [3., 0., 0., 9.])
 
     def test_dropout_grad_is_scaled_mask(self):
         """dropout_grad: dx = dy · mask/(1-p) — equals y/x wherever
