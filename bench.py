@@ -8,9 +8,11 @@ the driver-captured BENCH file records the full matrix, not just llama
 relative spread (max-min)/median reported alongside; compilation happens
 once per config, outside the reps.
 
-BENCH_CONFIG=llama|offload|bert|resnet|unet|decode|longctx runs one config.
-Reference throughput instrumentation analog:
-python/paddle/profiler/timer.py:351 (ips Benchmark).
+BENCH_CONFIG=llama|offload|bert|resnet|unet|decode|serve|longctx runs
+one config; `python bench.py --only llama_serve_mixed` (metric OR
+config name) re-measures a single metric in isolation with the same
+reps>=3 + spread discipline.  Reference throughput instrumentation
+analog: python/paddle/profiler/timer.py:351 (ips Benchmark).
 """
 from __future__ import annotations
 
@@ -463,10 +465,9 @@ def bench_unet():
           f"loss={final_loss[0]:.3f})", 1.0, spread, vals)
 
 
-def bench_llama_decode():
-    """Serving decode: KV-cached generate() on the 1B llama — whole
-    generation is one jitted lax.scan program (inference/generation.py).
-    Reports decode tokens/s/chip."""
+def _serving_model():
+    """The shared serving llama (1B GQA bf16 on TPU; tiny on CPU).
+    Returns (model, cfg, batch, n_params, roofline_tok_s)."""
     import jax
     on_tpu = jax.default_backend() == "tpu"
     import paddle_tpu as paddle
@@ -482,17 +483,30 @@ def bench_llama_decode():
                           max_position_embeddings=2048,
                           dtype="bfloat16")
         batch = int(os.environ.get("BENCH_BATCH", "8"))
-        prompt_len, new_tokens = 128, 512
     else:
         cfg = LlamaConfig(vocab_size=256, hidden_size=128,
                           intermediate_size=384, num_hidden_layers=2,
                           num_attention_heads=4, num_key_value_heads=4,
                           max_position_embeddings=256, dtype="float32")
-        batch, prompt_len, new_tokens = 2, 8, 16
-
+        batch = 2
     model = LlamaForCausalLM(cfg)
     n_params = sum(int(np.prod(p.value.shape))
                    for p in model.parameters())
+    # decode roofline: every token reads all params once (bf16 stream)
+    roofline = batch * 0.82e12 / (2.0 * n_params)
+    return model, cfg, batch, n_params, roofline
+
+
+def bench_llama_decode():
+    """Serving decode: KV-cached generate() on the 1B llama — whole
+    generation is one jitted lax.scan program (inference/generation.py).
+    Reports decode tokens/s/chip."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    import paddle_tpu as paddle
+
+    model, cfg, batch, n_params, roofline = _serving_model()
+    prompt_len, new_tokens = (128, 512) if on_tpu else (8, 16)
     rng = np.random.RandomState(0)
     prompt = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size,
@@ -508,47 +522,67 @@ def bench_llama_decode():
         return batch * new_tokens / (time.perf_counter() - t0)
 
     tok_s, spread, vals = _measure(rep)
-    # decode roofline: every token reads all params once (bf16 stream)
-    roofline = batch * 0.82e12 / (2.0 * n_params)
     _emit("llama_decode_tokens_per_sec_per_chip", tok_s,
           f"tokens/s/chip (b={batch}, new={new_tokens}, "
           f"params={n_params/1e6:.0f}M, "
           f"hbm_roofline={roofline:.0f} tok/s)",
           tok_s / max(roofline, 1e-9), spread, vals)
 
-    # continuous batching at MIXED prompt lengths (round-5 verdict
-    # item 8): staggered requests through one ContinuousBatcher,
-    # aggregate generated tokens / wall time
+
+def bench_llama_serve():
+    """Continuous batching at MIXED prompt lengths: 16 staggered
+    requests through one ContinuousBatcher with CHUNKED PREFILL —
+    admission consumes prompts in decode-shaped chunks through the
+    same compiled scan as live decode (inference/serving.py), so the
+    workload compiles exactly two programs and prefill never stalls
+    the batch.  Median-of-reps aggregate tokens/s + spread, like every
+    other metric; each rep replays the same staggered 16-request
+    workload through a fresh batcher (programs cached on the model)."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
     from paddle_tpu.inference import ContinuousBatcher
+
+    model, cfg, batch, n_params, roofline = _serving_model()
     rngm = np.random.RandomState(1)
     if on_tpu:
         lens = [64, 128, 256, 192] * 4      # 16 requests over 8 slots
-        n_new, chunk, max_len = 128, 64, 640
+        n_new, chunk, max_len, pchunk = 128, 64, 640, 32
     else:
         lens = [4, 8, 6, 10]
-        n_new, chunk, max_len = 8, 4, 32
+        n_new, chunk, max_len, pchunk = 8, 4, 32, 4
     prompts = [rngm.randint(0, cfg.vocab_size, L).astype(np.int32)
                for L in lens]
-    bat = ContinuousBatcher(model, max_batch_size=batch,
-                            max_len=max_len, chunk=chunk)
-    for p_ in prompts[:batch]:
-        bat.submit(p_, n_new)
-    bat.step()                              # compile prefills + decode
-    # tokens already produced during the untimed warmup round must not
-    # count toward the timed throughput (raw counter on the batcher —
-    # consistent units either side of t0)
-    warm = bat.tokens_produced
-    t0 = time.perf_counter()
-    for p_ in prompts[batch:]:
-        bat.submit(p_, n_new)
-    bat.run()
-    dt = time.perf_counter() - t0
-    total = bat.tokens_produced - warm
-    _emit("llama_serve_mixed_tokens_per_sec", total / dt,
+    last_stats = {}
+
+    def serve_once():
+        bat = ContinuousBatcher(model, max_batch_size=batch,
+                                max_len=max_len, chunk=chunk,
+                                prefill_chunk=pchunk)
+        for p_ in prompts[:batch]:
+            bat.submit(p_, n_new)
+        t0 = time.perf_counter()
+        bat.step()
+        # remaining requests arrive while the batch is running
+        for p_ in prompts[batch:]:
+            bat.submit(p_, n_new)
+        bat.run()
+        dt = time.perf_counter() - t0
+        last_stats.clear()
+        last_stats.update(bat.stats())
+        return bat.tokens_produced / dt
+
+    serve_once()                            # compile (2 programs)
+    tok_s, spread, vals = _measure(serve_once)
+    st = last_stats
+    _emit("llama_serve_mixed_tokens_per_sec", tok_s,
           f"aggregate tok/s, {len(prompts)} staggered reqs, prompt "
-          f"lens {sorted(set(lens))}, b={batch} slots, chunk={chunk}; "
-          "one-shot aggregate (not a median-of-reps metric)",
-          (total / dt) / max(roofline, 1e-9), 0.0, [total / dt])
+          f"lens {sorted(set(lens))}, b={batch} slots, chunk={chunk}, "
+          f"prefill_chunk={pchunk}; occupancy="
+          f"{st.get('avg_occupancy', 0):.2f}, "
+          f"prefill/decode tokens={st.get('prefill_tokens', 0)}/"
+          f"{st.get('decode_tokens', 0)}, "
+          f"programs={st.get('compiled_programs', 0)}",
+          tok_s / max(roofline, 1e-9), spread, vals)
 
 
 CONFIGS = {
@@ -558,16 +592,41 @@ CONFIGS = {
     "resnet": bench_resnet,
     "unet": bench_unet,
     "decode": bench_llama_decode,
+    "serve": bench_llama_serve,
     "longctx": bench_longctx,
+}
+
+# one table resolves config aliases AND emitted metric names, for both
+# BENCH_CONFIG= and `bench.py --only <metric-or-config>` (the
+# in-isolation re-measure interface — reps + spread like a full run)
+_ALIASES = {
+    "resnet50": "resnet", "cifar": "resnet", "sd": "unet",
+    "diffusion": "unet", "generate": "decode", "serving": "serve",
+    "llama_serve_mixed": "serve",
+    "llama_serve_mixed_tokens_per_sec": "serve",
+    "llama_decode": "decode",
+    "llama_decode_tokens_per_sec_per_chip": "decode",
+    "llama_train_tokens_per_sec_per_chip": "llama",
+    "llama_offload_train_tokens_per_sec_per_chip": "offload",
+    "bert_base_train_tokens_per_sec_per_chip": "bert",
+    "resnet50_cifar_images_per_sec": "resnet",
+    "sd_unet_train_samples_per_sec": "unet",
+    "llama_longctx_train_tokens_per_sec_per_chip": "longctx",
 }
 
 
 def main():
     which = os.environ.get("BENCH_CONFIG", "all").lower()
-    aliases = {"resnet50": "resnet", "cifar": "resnet", "sd": "unet",
-               "diffusion": "unet", "llama_decode": "decode",
-               "generate": "decode"}
-    which = aliases.get(which, which)
+    if "--only" in sys.argv:
+        i = sys.argv.index("--only")
+        if i + 1 >= len(sys.argv):
+            print(json.dumps({"metric": "bench_config_error", "value": 0,
+                              "unit": "--only requires a metric/config "
+                                      "name", "vs_baseline": 0.0}),
+                  flush=True)
+            return 2
+        which = sys.argv[i + 1].lower()
+    which = _ALIASES.get(which, which)
     # legacy interface: BENCH_OFFLOAD=1 turns the llama config into the
     # offload config (r4 drivers invoke it this way)
     if os.environ.get("BENCH_OFFLOAD", "") not in ("", "0") \

@@ -145,7 +145,20 @@ def run(raw_fn, *tensors: Tensor, name: str = "", n_outs: Optional[int] = None):
             r.index = i
 
     if _static_hook is not None and not has_tracer:
-        _static_hook(name, raw_fn, tensors, out_tensors)
+        rec_fn = raw_fn
+        if _amp_hook is not None and any(
+                v is not t._value for v, t in zip(vals, tensors)):
+            # AMP rewrote the executed inputs (O1 auto_cast): recording
+            # raw_fn would replay WITHOUT the casts, so Executor.run
+            # results could diverge in dtype/numerics from the eager
+            # build-time values.  Record a wrapper that reapplies the
+            # exact input dtypes that executed (static module docstring
+            # notes the snapshot semantics).
+            cast_dts = tuple(v.dtype for v in vals)
+
+            def rec_fn(*vs, _fn=raw_fn, _dts=cast_dts):
+                return _fn(*(v.astype(d) for v, d in zip(vs, _dts)))
+        _static_hook(name, rec_fn, tensors, out_tensors)
 
     return out_tensors[0] if single else tuple(out_tensors)
 

@@ -51,53 +51,70 @@ def _sample(logits, key, temperature, top_p, top_k):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _model_program_cache(model, key, build, cap=16):
+    """Compiled-program cache living ON the model object, so its
+    lifetime (and the closed-over weights) ends with the model —
+    a global registry would pin every served model's HBM forever.
+    Shared by generate() and the serving ContinuousBatcher (whose two
+    step programs thereby survive across batcher instances).  Capped
+    LRU (hits refresh recency): the batcher's step programs run every
+    chunk, so generate() shape churn evicts cold generate entries
+    rather than the serving hot path — FIFO would evict the
+    earliest-inserted (hottest) programs first."""
+    store = model.__dict__.setdefault("_gen_compiled", {})
+    fn = store.pop(key, None)
+    if fn is None:
+        fn = build()
+        if len(store) >= cap:
+            store.pop(next(iter(store)))
+    store[key] = fn                    # (re)insert at the recent end
+    return fn
+
+
 def _compiled_gen(model, b, s_prompt, max_new, temperature, top_p,
                   top_k, eos_token_id, max_len):
-    """Compiled-generation cache lives ON the model object, so its
-    lifetime (and the closed-over weights) ends with the model —
-    a global registry would pin every served model's HBM forever."""
     cache_key = (b, s_prompt, max_new, temperature, top_p, top_k,
                  eos_token_id, max_len)
-    store = model.__dict__.setdefault("_gen_compiled", {})
-    if cache_key in store:
-        return store[cache_key]
-    from ..jit import _swapped_state
-    sd = model.state_dict()
-    names = list(sd.keys())
 
-    def gen(param_vals, ids, key):
-        with _swapped_state(model, names, list(param_vals)):
-            cache = model.init_cache(b, max_len)
-            logits, cache = model.forward_cached(
-                ids, cache, jnp.asarray(0, jnp.int32))
-            key, sub = jax.random.split(key)
-            first = _sample(logits[:, -1], sub, temperature, top_p,
-                            top_k)
-            done0 = jnp.zeros((b,), bool) if eos_token_id is None \
-                else (first == eos_token_id)
+    def build():
+        # closure construction (state_dict walk included) only happens
+        # on a cache MISS — the warm-path cost is the dict lookup
+        from ..jit import _swapped_state
+        sd = model.state_dict()
+        names = list(sd.keys())
 
-            def body(carry, _):
-                cache, tok, pos, key, done = carry
-                lg, cache = model.forward_cached(tok[:, None], cache,
-                                                 pos)
+        def gen(param_vals, ids, key):
+            with _swapped_state(model, names, list(param_vals)):
+                cache = model.init_cache(b, max_len)
+                logits, cache = model.forward_cached(
+                    ids, cache, jnp.asarray(0, jnp.int32))
                 key, sub = jax.random.split(key)
-                nxt = _sample(lg[:, 0], sub, temperature, top_p, top_k)
-                if eos_token_id is not None:
-                    nxt = jnp.where(done, eos_token_id, nxt)
-                    done = done | (nxt == eos_token_id)
-                return (cache, nxt, pos + 1, key, done), nxt
+                first = _sample(logits[:, -1], sub, temperature, top_p,
+                                top_k)
+                done0 = jnp.zeros((b,), bool) if eos_token_id is None \
+                    else (first == eos_token_id)
 
-            init = (cache, first, jnp.asarray(s_prompt, jnp.int32),
-                    key, done0)
-            _, rest = jax.lax.scan(body, init, None,
-                                   length=max_new - 1)
-        return jnp.concatenate([first[:, None], rest.T], axis=1)
+                def body(carry, _):
+                    cache, tok, pos, key, done = carry
+                    lg, cache = model.forward_cached(tok[:, None],
+                                                     cache, pos)
+                    key, sub = jax.random.split(key)
+                    nxt = _sample(lg[:, 0], sub, temperature, top_p,
+                                  top_k)
+                    if eos_token_id is not None:
+                        nxt = jnp.where(done, eos_token_id, nxt)
+                        done = done | (nxt == eos_token_id)
+                    return (cache, nxt, pos + 1, key, done), nxt
 
-    fn = jax.jit(gen)
-    if len(store) >= 16:
-        store.pop(next(iter(store)))
-    store[cache_key] = fn
-    return fn
+                init = (cache, first, jnp.asarray(s_prompt, jnp.int32),
+                        key, done0)
+                _, rest = jax.lax.scan(body, init, None,
+                                       length=max_new - 1)
+            return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+        return jax.jit(gen)
+
+    return _model_program_cache(model, cache_key, build)
 
 
 def generate(model, input_ids, max_new_tokens: int = 32,

@@ -103,8 +103,18 @@ def cached_attention(q, k_cache, v_cache, q_pos0, scale=None):
 
     q: [b, s_new, h, d] (queries for the tokens being appended);
     k_cache/v_cache: [b, S_max, h_kv, d] with positions < q_pos0 + s_new
-    valid; q_pos0: int32 scalar — global position of q's first token.
-    Query i attends cache slots j <= q_pos0 + i.
+    valid; q_pos0: int32 scalar — global position of q's first token —
+    or a PER-SLOT [b] vector (continuous batching: each sequence sits
+    at its own depth).  Query i of slot b attends cache slots
+    j <= q_pos0[b] + i.
+
+    The vector form with s_new > 1 is the CHUNKED-PREFILL contract
+    (inference/serving.py): a mixed batch where some slots decode one
+    token while others consume a multi-token prompt chunk shares this
+    one call — each slot's causal frontier is its own pos[b]+lane.
+    Lanes past a slot's valid count rely on the caller masking/
+    overwriting their KV before any later query can attend them (the
+    serving scan's pad-lane discipline).
 
     Reference: `python/paddle/incubate/nn/functional/
     block_multihead_attention.py` (paged-KV decode).  TPU-native

@@ -31,6 +31,12 @@ tests/test_static_program.py):
   * ``paddle_tpu.hapi.Model`` static-mode fit/evaluate/predict, and
     ``jit.save / jit.load`` StableHLO serialization.
 
+AMP interaction: ops recorded under ``amp.auto_cast`` are taped as a
+wrapper that re-applies the input dtypes that actually EXECUTED (the
+O1 cast decisions are snapshotted at record time), so ``Executor.run``
+replays match the eager build-time numerics; replay does not re-consult
+live AMP state — re-record under a fresh guard to change precision.
+
 Out of scope BY DESIGN:
   * append_op types outside the curated set (the YAML-wide op surface is
     the functional API's job — wrap the python call in a program_guard
@@ -276,14 +282,29 @@ class Block:
         attrs = dict(attrs or {})
         fn = builder(attrs)
 
-        def _vars(spec):
+        def _vars(spec, role="input"):
             if spec is None:
                 return []
             vs = spec if isinstance(spec, (list, tuple)) else [spec]
             out = []
             for v in vs:
                 if isinstance(v, str):
-                    v = self.program.var(v)
+                    name = v
+                    v = self.program.var(name)
+                    if v is None:
+                        if role == "output":
+                            # reference append_op auto-creates output
+                            # vars by name (base/framework.py); the
+                            # placeholder value is replaced by the
+                            # computed output below
+                            v = Tensor(jnp.zeros((), jnp.float32),
+                                       name=name)
+                        else:
+                            raise ValueError(
+                                f"Block.append_op('{type}'): input "
+                                f"variable {name!r} does not exist in "
+                                f"this Program — create it with "
+                                f"create_var()/data() or pass a Tensor")
                 elif not isinstance(v, Tensor):
                     # numpy array / python scalar operand -> constant leaf
                     v = Tensor(jnp.asarray(np.asarray(v)))
@@ -299,7 +320,8 @@ class Block:
             else tuple(out)
         out_targets = []
         for key in builder._out_keys:
-            out_targets.extend(_vars((outputs or {}).get(key)))
+            out_targets.extend(_vars((outputs or {}).get(key),
+                                     role="output"))
         prog = self.program
         if not out_targets:
             out_targets = [Tensor(o) for o in outs_flat]
@@ -324,6 +346,7 @@ class Block:
                 t._static_vid = None
             out_vids.append(tag_tensor(prog, t, getattr(t, "name", None)))
         prog.ops.append(OpDesc(type, fn, in_vids, out_vids))
+        _prog_mod.bump_version(prog)
         return out_targets[0] if len(out_targets) == 1 else out_targets
 
 
@@ -342,6 +365,9 @@ class Program:
         self.random_seed = 0
         self._block = Block(self)
         self._exec_cache: dict = {}
+        # monotonic tape version (program.bump_version): every ops
+        # append / pass rewrite bumps it; the replay cache keys on it
+        self._version = 0
 
     # -- program surface ---------------------------------------------------
     def global_block(self):
@@ -460,9 +486,12 @@ class Program:
                         f"(placeholder not fed and object released)")
                 leaf_vals.append(t._value)
 
+        # keyed on the tape VERSION, not just len(ops): a pass followed
+        # by more recording can restore the same op count over a
+        # different op slice (stale-replay hazard, r5 advisor item 1)
         key = (tuple(fetch_vids), tuple(feed_names),
                tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals),
-               len(self.ops))
+               len(self.ops), getattr(self, "_version", 0))
         fn = self._exec_cache.get(key)
         if fn is None:
             op_slice = list(ops)
@@ -638,6 +667,7 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     in_vids_all = list(ivids) + list(other_vids)
     out_vids = [tag_tensor(prog, t) for t in outs]
     prog.ops.append(OpDesc("gradients", grad_fn, in_vids_all, out_vids))
+    _prog_mod.bump_version(prog)
     return outs
 
 

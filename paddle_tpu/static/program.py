@@ -32,7 +32,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["OpDesc", "record_op", "push_program", "pop_program",
-           "current_program", "apply_pass", "REGISTERED_PASSES"]
+           "current_program", "apply_pass", "REGISTERED_PASSES",
+           "bump_version"]
 
 _vid_counter = itertools.count(1)
 
@@ -75,6 +76,16 @@ def current_program():
 
 def _new_vid() -> int:
     return next(_vid_counter)
+
+
+def bump_version(prog):
+    """Monotonic tape-version counter, bumped on EVERY mutation of the
+    op list (append or pass rewrite) and folded into the Executor's
+    replay-cache key — a pass that restores the same op COUNT can never
+    hit a stale compiled replay closed over the old op slice (r5
+    advisor item 1; the reference invalidates its _ExecutorCache by
+    program identity + desc version the same way)."""
+    prog._version = getattr(prog, "_version", 0) + 1
 
 
 def _known(prog) -> set:
@@ -162,6 +173,7 @@ def record_op(name, raw_fn, in_tensors, out_tensors):
     out_vids = [tag_tensor(prog, t) for t in out_tensors]
     prog.ops.append(OpDesc(name or getattr(raw_fn, "__name__", "op"),
                            raw_fn, in_vids, out_vids))
+    bump_version(prog)
 
 
 def needed_ops(ops: Sequence[OpDesc], target_vids, stop_vids=frozenset()):
@@ -284,4 +296,6 @@ def apply_pass(program, name: str, targets=None):
         raise ValueError(
             f"unknown pass '{name}'; registered: "
             f"{sorted(REGISTERED_PASSES)}") from None
-    return fn(program, targets=targets)
+    out = fn(program, targets=targets)
+    bump_version(program)
+    return out

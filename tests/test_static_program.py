@@ -309,3 +309,73 @@ class TestPasses:
         (got,) = exe.run(main, feed={"x": np.array([1., 1.], np.float32)},
                          fetch_list=[out])
         np.testing.assert_allclose(got, [7., 9.])
+
+
+class TestAdvisorRegressionsR6:
+    """r5 advisor items 1/3/4: replay-cache staleness after passes, AMP
+    cast fidelity on the recorded tape, append_op missing-var UX."""
+
+    def test_pass_then_rerecord_invalidates_replay_cache(self):
+        """A pass followed by recording more ops can restore the same
+        op COUNT over a different op slice; the replay cache must key
+        on the tape version, not just len(ops) (stale hit would replay
+        the pre-pass slice with the post-pass leaf values)."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            w = paddle.to_tensor(np.array([1., 2.], np.float32))
+            y = w * 2.0            # placeholder-free -> foldable
+            out = x + y
+        exe = static.Executor()
+        feed = {"x": np.zeros(2, np.float32)}
+        (r1,) = exe.run(main, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(r1, [2., 4.])
+        n_before = len(main.ops)
+        static.apply_pass(main, "constant_folding")
+        with static.program_guard(main):
+            _ = out * 1.0          # restore the pre-pass op count
+        assert len(main.ops) == n_before
+        (r2,) = exe.run(main, feed=feed, fetch_list=[out])
+        # a stale cache hit replays y = w*2 over y's folded value
+        # (giving [4., 8.]); the version-keyed cache recompiles
+        np.testing.assert_allclose(r2, [2., 4.])
+
+    def test_amp_recorded_tape_replays_with_casts(self):
+        """Ops taped under amp.auto_cast must replay WITH the input
+        casts that actually executed (dispatch records a cast-
+        reapplying wrapper), so Executor.run matches the eager
+        build-time dtype/numerics."""
+        import jax.numpy as jnp
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2], "float32")
+            w = paddle.to_tensor(np.eye(2, dtype=np.float32) * 3.0)
+            with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+                out = paddle.matmul(x, w)   # whitelisted -> bf16
+        assert out.value.dtype == jnp.bfloat16
+        exe = static.Executor()
+        xv = np.array([[1., 2.], [3., 4.]], np.float32)
+        (got,) = exe.run(main, feed={"x": xv}, fetch_list=[out],
+                         return_numpy=False)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(got.astype(jnp.float32)),
+                                   xv * 3.0, rtol=1e-2)
+
+    def test_append_op_auto_creates_named_output(self):
+        """A string output name with no pre-created var auto-creates it
+        (reference base/framework.py append_op) instead of crashing in
+        np.asarray(None)."""
+        main = static.Program()
+        blk = main.global_block()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2], "float32")
+        blk.append_op("relu", inputs={"X": x}, outputs={"Out": "y"})
+        exe = static.Executor()
+        xv = np.array([[-1., 2.], [3., -4.]], np.float32)
+        (got,) = exe.run(main, feed={"x": xv}, fetch_list=["y"])
+        np.testing.assert_allclose(got, np.maximum(xv, 0))
+
+    def test_append_op_missing_input_raises_clear_error(self):
+        main = static.Program()
+        with pytest.raises(ValueError, match="nope"):
+            main.append_op("relu", inputs={"X": "nope"})
