@@ -22,8 +22,18 @@ class DistributedStrategy:
         self.recompute = False
         self.recompute_configs = {"checkpoints": []}
         self.sharding = False
+        # offload: False | True (opt state host-parked) | "params"
+        # (params too, scheduler-overlapped) | "stream" (explicit
+        # double-buffered per-layer pipeline — parallel/
+        # offload_pipeline.py).  offload_prefetch_depth: device-side
+        # parameter window depth of the stream pipeline (HBM holds at
+        # most depth+1 layers' params).  offload_cast_dtype: wire dtype
+        # for host→HBM parameter transfers (None = storage dtype).
+        # Plumbed by ShardedTrainStep.from_strategy.
         self.sharding_configs = {"sharding_degree": 1, "stage": 1,
-                                 "offload": False}
+                                 "offload": False,
+                                 "offload_prefetch_depth": 1,
+                                 "offload_cast_dtype": "bfloat16"}
         self.pipeline = False
         self.pipeline_configs = {"accumulate_steps": 1,
                                  "micro_batch_size": 1,

@@ -121,8 +121,17 @@ class SparseTable:
 
 
 class DenseTable:
-    """Replicated dense parameter block (reference:
-    `ps/table/memory_dense_table.cc`)."""
+    """Dense parameter block, SINGLE-HOMED on one PS process
+    (reference: `ps/table/memory_dense_table.cc`).
+
+    The home server is `crc32(name) % num_servers` — the client routes
+    every pull/push there (see `PSClient.pull_dense`), so distinct
+    dense tables spread across the server fleet by name hash.  A dense
+    table is NOT replicated: registering the same table on several
+    servers leaves the non-home copies cold (they receive no traffic).
+    Register each dense table at least on its home server
+    (registering everywhere is harmless and keeps registration
+    topology-independent)."""
 
     def __init__(self, name: str, shape, lr: float = 0.1):
         self.name = name
@@ -272,8 +281,13 @@ class PSServer:
 class PSClient:
     """Worker-side client: shards ids over servers, merges results.
 
-    Reference: `ps/service/ps_client.h` + `communicator`; sharding is
-    `id % num_servers` (reference `ps/table/` shard semantics).
+    Reference: `ps/service/ps_client.h` + `communicator`.  Sparse
+    tables shard ROWS by `id % num_servers` (reference `ps/table/`
+    shard semantics); dense tables are single-homed WHOLE on
+    `crc32(name) % num_servers`, so many dense tables balance across
+    the fleet while each individual pull/push stays one round trip
+    (previously every dense call targeted endpoint 0 regardless of
+    fleet size, concentrating all dense traffic and state there).
     """
 
     def __init__(self, endpoints: Sequence[str]):
@@ -322,13 +336,20 @@ class PSClient:
             self._post(s, "/push_sparse",
                        head + sub.tobytes() + _npy_bytes(grads[mask]))
 
+    def _dense_home(self, table: str) -> int:
+        """Home server of a dense table: crc32 of the NAME (stable
+        across processes/restarts, unlike salted hash())."""
+        return zlib.crc32(table.encode()) % len(self.endpoints)
+
     def pull_dense(self, table: str) -> np.ndarray:
         body = json.dumps({"table": table}).encode()
-        return _npy_load(self._post(0, "/pull_dense", body))
+        return _npy_load(self._post(self._dense_home(table),
+                                    "/pull_dense", body))
 
     def push_dense(self, table: str, grad: np.ndarray):
         head = json.dumps({"table": table}).encode() + b"\n"
-        self._post(0, "/push_dense", head + _npy_bytes(np.asarray(grad)))
+        self._post(self._dense_home(table), "/push_dense",
+                   head + _npy_bytes(np.asarray(grad)))
 
     def stats(self) -> List[dict]:
         return [json.loads(self._post(s, "/stats", b""))

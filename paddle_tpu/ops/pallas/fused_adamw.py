@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["fused_adamw"]
+__all__ = ["fused_adamw", "adamw_hostside"]
 
 # elements per grid step: in+out blocks (up to 4 f32 + 2 bf16 each way)
 # double-buffered must fit the ~16 MiB scoped VMEM
@@ -200,6 +200,35 @@ def fused_adamw(grad, m, v, master, lr, step, *, b1=0.9, b2=0.999,
         p1, m1, v1, mst1 = (a[:n] for a in (p1, m1, v1, mst1))
     return (p1.reshape(shape), m1.reshape(shape), v1.reshape(shape),
             mst1.reshape(shape))
+
+
+def adamw_hostside(grad, m, v, master, lr, step, *, b1=0.9, b2=0.999,
+                   eps=1e-8, wd=0.0, decoupled=True,
+                   out_dtype=jnp.bfloat16):
+    """Host-side twin of the fused kernel: the same single-pass AdamW
+    math as `_step_math`, expressed in plain jnp so it can run where a
+    Pallas launch cannot — off-TPU backends, and inside host-offload
+    pipelines that apply each layer's update the moment its gradient
+    lands (parallel/offload_pipeline.py backward scan).  Same signature
+    and return convention as `fused_adamw`; numerics match the kernel
+    (and the optimizer's pure `_update` rule) — fp32 update math, any
+    grad/moment storage dtype.  When out_dtype is fp32 the param IS the
+    master (the returned master is the new param)."""
+    lrf = jnp.asarray(lr, jnp.float32)
+    g = grad.astype(jnp.float32)
+    mst = master.astype(jnp.float32)
+    if wd and not decoupled:
+        g = g + wd * mst
+    mn = b1 * m.astype(jnp.float32) + (1 - b1) * g
+    vn = b2 * v.astype(jnp.float32) + (1 - b2) * g * g
+    mhat = mn / (1 - b1 ** step)
+    vhat = vn / (1 - b2 ** step)
+    upd = mhat / (jnp.sqrt(vhat) + eps)
+    if wd and decoupled:
+        upd = upd + wd * mst
+    new_mst = mst - lrf * upd
+    return (new_mst.astype(out_dtype), mn.astype(m.dtype),
+            vn.astype(v.dtype), new_mst)
 
 
 def np_prod(shape):

@@ -1,0 +1,861 @@
+"""Explicit double-buffered ZeRO-3 host-offload streaming pipeline.
+
+Reference: `group_sharded_stage3.py` prefetch (CUDA-stream double
+buffering of parameter slices) and ZeRO-Offload's design point: the win
+over "park everything on host and hope" comes from (a) an explicit
+two-deep device-side parameter window so layer i+1's host→HBM DMA rides
+under layer i's compute, in the forward AND the backward, and (b)
+applying each layer's optimizer update the moment its gradient lands,
+overlapping the optimizer with the rest of the backward instead of
+running it as a serial epilogue.
+
+The previous offload path (param_stream.py) placed a `device_put` inside
+each block's remat region and relied on XLA's latency-hiding scheduler;
+the backward *replayed* every region and re-streamed params serially —
+host-bandwidth-bound with near-zero overlap (BENCH_r05: 0.188× baseline,
+MFU 0.075).  This module replaces scheduler luck with structure:
+
+  forward   h_{i+1} = block(w_i, h_i) as ONE `lax.scan` over layers.
+            The carry holds a (prefetch_depth+1)-deep window of
+            device-resident layer params; each step consumes window[0]
+            and fetches layer i+depth+1 from the host-parked stack —
+            the DMA is data-independent of the compute, so the
+            scheduler can only overlap it (it has nothing else to do
+            with it).  Params cross the wire in `cast_dtype` (bf16 by
+            default — half the DMA bytes; fp32 masters never leave the
+            host).  Layer-input residuals are the only activations
+            saved (full-remat memory profile).
+  backward  a second `lax.scan`, reverse order, with the SAME window
+            discipline: while layer i's vjp recomputes and
+            differentiates, layer i-depth-1's (param, moments[,
+            master]) bundle is already streaming in.  There is no
+            `jax.checkpoint` replay — the reverse-order prefetch IS the
+            rematerialization, minus the serial re-stream.
+  optimizer inside the backward scan body: as soon as layer i's grad
+            exists, `apply_update` runs on the streamed slice (the
+            fused Pallas AdamW on TPU, the optimizer's pure rule
+            elsewhere — ops/pallas/fused_adamw.py `adamw_hostside` is
+            the jnp twin of the kernel for host-side application) and
+            the new param/state are dynamic-update-sliced straight back
+            into the host-parked stacks.  Gradients therefore never
+            materialize as an all-layers buffer anywhere.
+
+HBM residency for block parameters is bounded by construction:
+(prefetch_depth+1) forward windows or backward bundles — never the full
+model.  Exactly ONE program is compiled regardless of layer count (both
+loops are `lax.scan`), which `compiled_hlo` lets tests assert.
+
+CPU fallback: backends without `pinned_host`/`device` memory kinds (the
+CPU runtime exposes only `unpinned_host`) run the identical scanned
+program minus the memory-space annotations — placement degenerates to
+ordinary device memory but the math, the window structure, and the
+program count are unchanged, which is what makes offload parity testable
+off-TPU.
+
+Restrictions (documented AND checked): the model must have a single
+stack of identically-structured blocks (`.layers.N.` / `.blocks.N.` /
+`.h.N.` / `.stages.N.` naming) whose hidden state is the first
+POSITIONAL call argument.  Remaining positional/keyword inputs are
+captured and replayed — float-dtype ones are differentiated (a learned
+pre-stack quantity fed to the blocks gets its gradient), and
+layer-VARYING arguments are detected at trace time and rejected.
+In-block randomness (dropout) is supported: each block call runs under
+a per-(step, layer) key scope so the backward recompute draws identical
+masks.  Models with buffers (BN running stats) or MoE aux-loss side
+channels are not supported (rejected / documented respectively).
+"""
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..framework.tensor import Tensor
+from ..framework import random as prandom
+
+__all__ = ["OffloadPipelineStep", "supports_memory_kinds",
+           "BLOCK_STACK_PAT"]
+
+# THE block-stack name pattern for parallel/ (also used by
+# sharded_trainer's per-block param_stream filter — one definition so
+# the two paths cannot drift on what counts as a stacked layer).
+# Matches '<path>.layers.<i>.<leaf>' with layers|blocks|h|stages as the
+# container, including top-level stacks ('layers.0.w').
+BLOCK_STACK_PAT = re.compile(
+    r"^(?P<prefix>(?:.*\.)?(?:layers|blocks|h|stages))\.(?P<idx>\d+)"
+    r"\.(?P<leaf>.+)$")
+_BLOCK_PAT = BLOCK_STACK_PAT
+
+
+def supports_memory_kinds() -> bool:
+    """True when the backend exposes the pinned_host/device memory kinds
+    in-step streaming targets (TPU).  The CPU runtime exposes only
+    unpinned_host — there the pipeline runs without placement
+    annotations (same program, device-resident stacks)."""
+    try:
+        kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
+    except Exception:
+        return False
+    return "pinned_host" in kinds and "device" in kinds
+
+
+class _CaptureStop(Exception):
+    """Ends the pre-segment trace at the last block: by then every
+    block's call arguments have been recorded (the values are tracers
+    of the ENCLOSING trace, so using them from the catching frame is
+    legal)."""
+
+
+def _value(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+class OffloadPipelineStep:
+    """Streamed host-offload train step for block-stacked models.
+
+    Drop-in alternative to `ShardedTrainStep(offload="params")` for the
+    beyond-HBM regime; see the module docstring for the design.  The
+    mesh's batch axes shard the batch; block parameter stacks are
+    replicated per host (host DRAM is the capacity lever here, not
+    cross-chip sharding).
+
+    prefetch_depth: how many layers ahead the window streams (>=1;
+        HBM holds at most prefetch_depth+1 layers' params).
+    cast_dtype: wire dtype for parameters crossing host→HBM in the
+        forward (default bfloat16 when params are stored wider; None =
+        no cast, exact parity with the in-HBM trainer).
+    """
+
+    def __init__(self, model, optimizer, mesh: Mesh, loss_fn=None,
+                 prefetch_depth: int = 1,
+                 cast_dtype: Optional[str] = "bfloat16",
+                 batch_axes=("dp", "sharding"), donate: bool = True,
+                 seq_axis: Optional[str] = None, seq_dim: int = 1):
+        if prefetch_depth < 1:
+            raise ValueError("prefetch_depth must be >= 1")
+        self.model = model
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.loss_fn = loss_fn
+        self.prefetch_depth = int(prefetch_depth)
+        self.batch_axes = batch_axes
+        self.seq_axis = seq_axis
+        self.seq_dim = seq_dim
+        self._donate = donate
+        self._offload = supports_memory_kinds()
+        self._compiled = None
+        self._stacks_ready = False
+
+        sd = model.state_dict()
+        names = [n for n, _ in model.named_parameters()]
+        if len(sd) != len(names):
+            extra = [n for n in sd if n not in set(names)]
+            raise ValueError(
+                "OffloadPipelineStep does not support models with "
+                f"buffers (found {extra[:4]}...); the streamed scan "
+                "cannot thread buffer mutations")
+        self._split_names(names, sd)
+        self._resolve_blocks()
+
+        # wire dtype: cast only when it actually narrows the storage
+        store_dt = sd[self._block_names[0][self._leaves[0]]].value.dtype
+        wire = jnp.dtype(cast_dtype) if cast_dtype is not None \
+            else jnp.dtype(store_dt)
+        self._store_dtype = jnp.dtype(store_dt)
+        self._wire_dtype = wire
+        self._casts = wire != self._store_dtype
+
+        self._setup_shardings()
+
+    # -- structure discovery ----------------------------------------------
+    def _split_names(self, names, sd):
+        by_prefix: dict = {}
+        tail = []
+        for n in names:
+            m = _BLOCK_PAT.match(n)
+            if m:
+                by_prefix.setdefault(m.group("prefix"), {}).setdefault(
+                    int(m.group("idx")), {})[m.group("leaf")] = n
+            else:
+                tail.append(n)
+        if not by_prefix:
+            raise ValueError(
+                "OffloadPipelineStep: no block stack found — expected "
+                "parameters named like '<path>.layers.<i>.<leaf>' "
+                "(or .blocks./.h./.stages.)")
+        if len(by_prefix) > 1:
+            raise ValueError(
+                "OffloadPipelineStep supports exactly one block stack, "
+                f"found {sorted(by_prefix)}")
+        (self._prefix, layers), = by_prefix.items()
+        self._num_layers = max(layers) + 1
+        leaves = sorted(layers[0])
+        for i in range(self._num_layers):
+            if i not in layers or sorted(layers[i]) != leaves:
+                raise ValueError(
+                    f"block {self._prefix}.{i} does not match block 0's "
+                    "parameter structure — layers must be homogeneous")
+        self._leaves = leaves
+        # _block_names[i][leaf] -> global param name
+        self._block_names = [layers[i] for i in range(self._num_layers)]
+        self._tail_names = tail
+
+    def _resolve_blocks(self):
+        obj = self.model
+        for part in self._prefix.split("."):
+            obj = getattr(obj, part)
+        self._blocks = list(obj)
+        self._block0 = self._blocks[0]
+        local = {n for n, _ in self._block0.named_parameters()}
+        missing = [s for s in self._leaves if s not in local]
+        if missing:
+            raise ValueError(
+                f"block 0 has no local parameters {missing} — stacked "
+                "leaf names must resolve inside one block")
+
+    # -- placement ---------------------------------------------------------
+    def _setup_shardings(self):
+        mesh = self.mesh
+        rep = P()
+        if self._offload:
+            self._host_sh = lambda ndim: NamedSharding(
+                mesh, rep, memory_kind="pinned_host")
+            self._dev_sh = lambda ndim: NamedSharding(
+                mesh, rep, memory_kind="device")
+        else:
+            self._host_sh = lambda ndim: None
+            self._dev_sh = lambda ndim: None
+
+    def _to_host(self, arr):
+        sh = self._host_sh(arr.ndim)
+        return jax.device_put(arr, sh) if sh is not None else arr
+
+    def _to_device_in_step(self, tree):
+        """In-graph host→HBM transfer of a fetched slice (the H2D DMA on
+        TPU; identity off-TPU).  The barrier forces a materialized HBM
+        copy — an unbarriered transfer fuses into the consumer as an
+        unimplemented host→vmem DMA — and keeps the fetch a single
+        schedulable unit the latency-hider can slide under compute."""
+        if self._offload:
+            dev = NamedSharding(self.mesh, P(), memory_kind="device")
+            tree = jax.tree.map(lambda a: jax.device_put(a, dev), tree)
+        leaves, treedef = jax.tree.flatten(tree)
+        leaves = jax.lax.optimization_barrier(tuple(leaves))
+        return jax.tree.unflatten(treedef, leaves)
+
+    # -- state init --------------------------------------------------------
+    def _init_stacks(self):
+        """Build the host-parked stacks: per leaf a [L, ...] param stack
+        (storage dtype), optionally a [L, ...] wire-cast stack for the
+        forward, and the stacked optimizer state.  State is initialized
+        PER LAYER through the optimizer's own `_init_state` (+ master),
+        so nonzero initial states (e.g. Adagrad's
+        initial_accumulator_value) match the in-HBM trainer exactly."""
+        from ..optimizer.jit_update import maybe_master_state
+        sd = self.model.state_dict()
+        opt = self.optimizer
+        self._stk_param = {}
+        self._stk_wire = {}
+        self._stk_state = {}
+        for s in self._leaves:
+            vals = [np.asarray(sd[self._block_names[i][s]].value)
+                    for i in range(self._num_layers)]
+            stack = np.stack(vals)
+            self._stk_param[s] = self._to_host(jnp.asarray(stack))
+            if self._casts:
+                self._stk_wire[s] = self._to_host(
+                    jnp.asarray(stack).astype(self._wire_dtype))
+            sts = []
+            for i in range(self._num_layers):
+                p_i = sd[self._block_names[i][s]]
+                sts.append(maybe_master_state(opt, p_i,
+                                              opt._init_state(p_i)))
+            self._stk_state[s] = {
+                k: self._to_host(jnp.asarray(
+                    np.stack([np.asarray(st[k]) for st in sts])))
+                for k in sts[0]}
+            # park the per-layer originals host-side: the stacks are now
+            # authoritative, the originals would otherwise pin HBM
+            if self._offload:
+                for i in range(self._num_layers):
+                    t = sd[self._block_names[i][s]]
+                    t._value = jax.device_put(t._value,
+                                              self._host_sh(t._value.ndim))
+        self._tail_states = []
+        for n in self._tail_names:
+            p = sd[n]
+            st = maybe_master_state(opt, p, opt._init_state(p))
+            self._tail_states.append(st)
+        self._stacks_ready = True
+
+    # -- per-parameter decay/lr policy (mirror ShardedTrainStep._build) ----
+    def _wd_scale(self, name, sd):
+        opt = self.optimizer
+        p = sd[name]
+        wd = opt._wd_value(p)
+        decay_fn = getattr(opt, "_apply_decay_param_fun", None)
+        if decay_fn is not None and not decay_fn(p.name or name):
+            wd = 0.0
+        exclude_fn = getattr(opt, "_exclude_fn", None)
+        if exclude_fn is not None and exclude_fn(p.name or name):
+            wd = 0.0
+        lr_ratio = getattr(opt, "_lr_ratio", None)
+        ls = float(lr_ratio(p)) if lr_ratio is not None else 1.0
+        return wd, ls
+
+    def _leaf_policies(self, sd):
+        """Per-leaf (wd, lr_scale), asserted layer-uniform (the scan
+        body is one traced program — a policy that differs by layer
+        index cannot be expressed)."""
+        out = {}
+        for s in self._leaves:
+            pols = {self._wd_scale(self._block_names[i][s], sd)
+                    for i in range(self._num_layers)}
+            if len(pols) != 1:
+                raise ValueError(
+                    f"weight-decay/lr policy for leaf {s!r} differs "
+                    f"across layers ({pols}) — the scanned update needs "
+                    "a layer-uniform policy")
+            out[s] = next(iter(pols))
+        return out
+
+    # -- traced model segments --------------------------------------------
+    def _model_inputs(self, batch):
+        return [Tensor(b) for b in batch[:-1]], batch[-1]
+
+    def _pre_fn(self, tail_vals, batch):
+        """Model forward up to (not including) block 0.
+
+        Captures block 0's call arguments — positional AND keyword (by
+        patching `forward`; pre-hooks only see positionals) — while the
+        OTHER blocks run as identity pass-throughs that record their
+        own arguments, so layer-varying block inputs (per-layer slopes,
+        a block reading its own index) are DETECTED and rejected rather
+        than silently replaced by layer 0's values.
+
+        Returns ((h0, diff_extras), int_extras) for vjp(has_aux=True):
+        float-dtype extras are REAL differentiated outputs — a learned
+        pre-stack quantity fed to every block (e.g. a projected gate)
+        gets its parameter gradients through the accumulated per-layer
+        cotangents, not silently zeroed; integer extras (position ids)
+        ride as aux."""
+        from ..jit import _swapped_state
+        inputs, _ = self._model_inputs(batch)
+        records = []
+        L = self._num_layers
+
+        def recorder(i):
+            def fwd(*args, **kwargs):
+                records.append((i, args, kwargs))
+                if i == L - 1:
+                    raise _CaptureStop()
+                return args[0] if isinstance(args[0], Tensor) \
+                    else Tensor(args[0])
+            return fwd
+
+        for i, b in enumerate(self._blocks):
+            b.forward = recorder(i)
+        stopped = False
+        try:
+            with _swapped_state(self.model, self._tail_names, tail_vals):
+                try:
+                    self.model(*inputs)
+                except _CaptureStop:
+                    stopped = True
+        finally:
+            for b in self._blocks:
+                b.__dict__.pop("forward", None)
+        if not stopped or [r[0] for r in records] != list(range(L)):
+            raise RuntimeError(
+                "offload pipeline: the model must call every block "
+                "exactly once, in order, each step (saw call sequence "
+                f"{[r[0] for r in records]} of {L} blocks)")
+        _, args, kwargs = records[0]
+        if not args:
+            raise ValueError(
+                "offload pipeline: blocks must take the hidden state "
+                "as their first POSITIONAL argument (block 0 was "
+                f"called with only keyword args {sorted(kwargs)})")
+        # the scan body replays ONE argument set for every layer — a
+        # per-layer argument cannot be expressed and must be rejected.
+        # Array-valued args must be the SAME objects across layers
+        # (value equality on tracers is not decidable at trace time);
+        # python-valued ones compare by ==.
+        def _same_arg(x, y):
+            if x is y or _value(x) is _value(y):
+                return True
+            if hasattr(_value(x), "shape") or hasattr(_value(y),
+                                                      "shape"):
+                return False
+            return x == y
+
+        for i, a_i, kw_i in records[1:]:
+            same = (len(a_i) == len(args)
+                    and sorted(kw_i) == sorted(kwargs)
+                    and all(_same_arg(x, y)
+                            for x, y in zip(a_i[1:], args[1:]))
+                    and all(_same_arg(kw_i[k], kwargs[k])
+                            for k in kwargs))
+            if not same:
+                raise ValueError(
+                    f"offload pipeline: block {i} was called with "
+                    "different non-hidden arguments than block 0 — "
+                    "layer-varying block inputs are not supported by "
+                    "the scanned step (fold them into the block's "
+                    "parameters instead)")
+        flat = tuple(args[1:]) + tuple(kwargs[k] for k in sorted(kwargs))
+        self._extras_n_pos = len(args) - 1
+        self._extras_kw_keys = sorted(kwargs)
+        spec, diff, ints = [], [], []
+        for a in flat:
+            v = _value(a)
+            if isinstance(v, (jax.Array, np.ndarray)) \
+                    or hasattr(v, "shape") and hasattr(v, "dtype"):
+                v = jnp.asarray(v)
+                if jnp.issubdtype(v.dtype, jnp.inexact):
+                    spec.append(("diff", isinstance(a, Tensor)))
+                    diff.append(v)
+                else:
+                    spec.append(("int", isinstance(a, Tensor)))
+                    ints.append(jax.lax.stop_gradient(v))
+            else:
+                # python-valued (None, flags): replay by value
+                spec.append(("static", a))
+        self._extras_spec = spec
+        h0 = _value(args[0])
+        return (h0, tuple(diff)), tuple(ints)
+
+    def _block_apply(self, leaf_vals, h, diff_extras, int_extras):
+        """One block, functionally: block 0's module with `leaf_vals`
+        swapped in and the captured positional/keyword extras replayed.
+        leaf_vals: dict leaf-suffix -> array (wire dtype)."""
+        from ..jit import _swapped_state
+        names = self._leaves
+        vals = [leaf_vals[s] for s in names]
+        wrapped, d_it, i_it = [], iter(diff_extras), iter(int_extras)
+        for kind, meta in self._extras_spec:
+            if kind == "static":
+                wrapped.append(meta)
+            else:
+                e = next(d_it if kind == "diff" else i_it)
+                wrapped.append(Tensor(e) if meta else e)
+        pos = wrapped[:self._extras_n_pos]
+        kw = dict(zip(self._extras_kw_keys,
+                      wrapped[self._extras_n_pos:]))
+        with _swapped_state(self._block0, names, vals):
+            out = self._block0(Tensor(h), *pos, **kw)
+        return _value(out)
+
+    def _post_fn(self, tail_vals, h_last, batch):
+        """Model forward from above the block stack: every block's
+        `forward` is replaced for the trace — block 0 returns `h_last`,
+        the rest pass their input through — so the head/norm/loss trace
+        against the scanned stack's output and NO block body is traced
+        here (program size stays independent of layer count; the dead
+        pre-segment recomputation is DCE'd)."""
+        from ..jit import _swapped_state
+        inputs, labels = self._model_inputs(batch)
+
+        def inject(*a, **k):
+            return Tensor(h_last)
+
+        def passthrough(x, *a, **k):
+            return x if isinstance(x, Tensor) else Tensor(x)
+
+        self._blocks[0].forward = inject
+        for b in self._blocks[1:]:
+            b.forward = passthrough
+        try:
+            with _swapped_state(self.model, self._tail_names, tail_vals):
+                out = self.model(*inputs)
+                if self.loss_fn is not None:
+                    loss = self.loss_fn(out, Tensor(labels))
+                else:
+                    loss = self.model.compute_loss(out, Tensor(labels))
+        finally:
+            for b in self._blocks:
+                b.__dict__.pop("forward", None)
+        return _value(loss)
+
+    # -- build -------------------------------------------------------------
+    def _build(self):
+        from ..optimizer.jit_update import (apply_update, _fusable,
+                                           _is_adam_hp)
+        opt = self.optimizer
+        hp = opt._hyper()
+        upd = type(opt)._update
+        L = self._num_layers
+        W = min(self.prefetch_depth + 1, L)
+        leaves = self._leaves
+        casts = self._casts
+        wire_dt = self._wire_dtype
+        sd = self.model.state_dict()
+        policies = self._leaf_policies(sd)
+        tail_pol = [self._wd_scale(n, sd) for n in self._tail_names]
+        fused_ok = self.mesh.size == 1
+        mesh = self.mesh if self.mesh.size > 1 else None
+        adam_shaped = _is_adam_hp(hp)
+        from .sharded_trainer import activation_sharding_scope
+
+        def leaf_update(p, g, s, lr_, wd, step_i):
+            """One streamed slice's update, as its gradient lands: the
+            fused Pallas kernel when available (TPU), else the kernel's
+            jnp twin `adamw_hostside` (same single-pass math), else the
+            optimizer's pure rule."""
+            if _fusable(hp, s, jnp.dtype(p.dtype)):
+                return apply_update(upd, p, g, s, lr_, wd, step_i, hp,
+                                    fused_ok=fused_ok, mesh=mesh,
+                                    spec=P())
+            if adam_shaped and set(s) <= {"moment1", "moment2",
+                                          "master"}:
+                from ..ops.pallas.fused_adamw import adamw_hostside
+                master = s.get("master", p)
+                new_p, m, v, mst = adamw_hostside(
+                    g, s["moment1"], s["moment2"], master, lr_, step_i,
+                    b1=hp["b1"], b2=hp["b2"], eps=hp["eps"], wd=wd,
+                    decoupled=hp["decoupled"], out_dtype=p.dtype)
+                ns = {"moment1": m, "moment2": v}
+                if "master" in s:
+                    ns["master"] = mst
+                return new_p, ns
+            return apply_update(upd, p, g, s, lr_, wd, step_i, hp,
+                                fused_ok=fused_ok, mesh=mesh, spec=P())
+
+        def fetch_fwd(stk_wire, i):
+            sl = {s: jax.lax.dynamic_index_in_dim(stk_wire[s], i, 0,
+                                                  keepdims=False)
+                  for s in leaves}
+            return self._to_device_in_step(sl)
+
+        def fetch_bwd(stk_param, stk_state, i):
+            bundle = {
+                s: (jax.lax.dynamic_index_in_dim(stk_param[s], i, 0,
+                                                 keepdims=False),
+                    {k: jax.lax.dynamic_index_in_dim(v, i, 0,
+                                                     keepdims=False)
+                     for k, v in stk_state[s].items()})
+                for s in leaves}
+            return self._to_device_in_step(bundle)
+
+        def _dus(stack, val, idx):
+            return jax.lax.dynamic_update_index_in_dim(
+                stack, val.astype(stack.dtype), idx, 0)
+
+        def step(tail_vals, tail_states, stk_param, stk_wire, stk_state,
+                 lr, step_i, key, batch):
+            with prandom.key_scope(key), \
+                 activation_sharding_scope(self.mesh, self.batch_axes,
+                                           self.seq_axis, self.seq_dim):
+                # ---- pre segment (embeddings etc.); float extras are
+                # REAL differentiated outputs (their per-layer
+                # cotangents flow back to the tail params that produced
+                # them), integer extras ride as aux
+                (h0, dex), pre_vjp, iex = jax.vjp(
+                    lambda tv: self._pre_fn(tv, batch), list(tail_vals),
+                    has_aux=True)
+
+                # ---- forward: scanned blocks, W-deep prefetch window
+                fwd_src = stk_wire if casts else stk_param
+                window0 = tuple(fetch_fwd(fwd_src, min(i, L - 1))
+                                for i in range(W))
+
+                # per-layer PRNG: each block call (forward AND its
+                # backward recompute) runs under a key derived from
+                # (step key, layer index) with a FRESH counter — the
+                # recompute consumes the same key sequence the forward
+                # did, so in-block randomness (dropout) produces
+                # identical masks in both scans.  Sharing the outer
+                # scope instead would bake trace-order counters and
+                # silently differentiate a different function.
+                blk_key = jax.random.fold_in(key, 1)
+
+                def fbody(carry, i):
+                    h, window = carry
+                    cur = window[0]
+                    nxt = fetch_fwd(fwd_src, jnp.minimum(i + W, L - 1))
+                    with prandom.key_scope(jax.random.fold_in(blk_key, i)):
+                        h_out = self._block_apply(cur, h, dex, iex)
+                    return (h_out, window[1:] + (nxt,)), h
+
+                (h_last, _), resid = jax.lax.scan(
+                    fbody, (h0, window0), jnp.arange(L))
+
+                # ---- head + loss
+                loss, post_vjp = jax.vjp(
+                    lambda tv, h: self._post_fn(tv, h, batch),
+                    list(tail_vals), h_last)
+                d_tail_post, dh = post_vjp(
+                    jnp.ones_like(loss))
+
+                # ---- backward: reverse scan, same window discipline,
+                # optimizer applied per layer as the gradient lands
+                bwindow0 = tuple(
+                    fetch_bwd(stk_param, stk_state, max(L - 1 - k, 0))
+                    for k in range(W))
+
+                def bbody(carry, xs):
+                    dh, d_acc, bwindow, stk_p, stk_w, stk_s = carry
+                    h_in, idx = xs
+                    param_i, state_i = {}, {}
+                    for s in leaves:
+                        param_i[s], state_i[s] = bwindow[0][s]
+                    # prefetch from the CARRIED stacks (not the pre-scan
+                    # inputs): layer idx-W updates W reverse-iterations
+                    # after this read, so the value is identical, and
+                    # keeping one consumer lets XLA alias the donated
+                    # host buffers instead of holding a second full
+                    # copy of every stack through the loop
+                    pre = fetch_bwd(stk_p, stk_s,
+                                    jnp.maximum(idx - W, 0))
+                    wire_i = {s: param_i[s].astype(wire_dt)
+                              for s in leaves} if casts else param_i
+
+                    def replay(w, h, dx):
+                        # same (blk_key, layer) scope as the forward —
+                        # the recompute's randomness matches exactly
+                        with prandom.key_scope(
+                                jax.random.fold_in(blk_key, idx)):
+                            return self._block_apply(w, h, dx, iex)
+
+                    _, blk_vjp = jax.vjp(replay, wire_i, h_in, dex)
+                    dws, dh_prev, d_dex = blk_vjp(dh)
+                    d_acc = jax.tree.map(jnp.add, d_acc, d_dex)
+                    for s in leaves:
+                        wd, ls = policies[s]
+                        g = dws[s]
+                        if not casts:
+                            g = g.astype(param_i[s].dtype)
+                        new_p, new_st = leaf_update(
+                            param_i[s], g, state_i[s],
+                            lr if ls == 1.0 else lr * ls, wd, step_i)
+                        stk_p = dict(stk_p)
+                        stk_p[s] = _dus(stk_p[s], new_p, idx)
+                        if casts:
+                            stk_w = dict(stk_w)
+                            stk_w[s] = _dus(stk_w[s],
+                                            new_p.astype(wire_dt), idx)
+                        stk_s = dict(stk_s)
+                        stk_s[s] = {
+                            k: _dus(stk_s[s][k],
+                                    new_st[k].astype(stk_s[s][k].dtype),
+                                    idx)
+                            for k in stk_s[s]}
+                    return (dh_prev, d_acc, bwindow[1:] + (pre,),
+                            stk_p, stk_w, stk_s), None
+
+                d_acc0 = jax.tree.map(jnp.zeros_like, dex)
+                (dh0, d_dex_sum, _, new_stk_p, new_stk_w,
+                 new_stk_s), _ = jax.lax.scan(
+                    bbody,
+                    (dh, d_acc0, bwindow0, stk_param, stk_wire,
+                     stk_state),
+                    (resid, jnp.arange(L)), reverse=True)
+
+                # ---- tail grads (pre + post contributions) and update
+                (d_tail_pre,) = pre_vjp((dh0, d_dex_sum))
+                new_tail, new_tstates = [], []
+                for i, (p, st) in enumerate(zip(tail_vals, tail_states)):
+                    g = d_tail_post[i] + d_tail_pre[i]
+                    wd, ls = tail_pol[i]
+                    np_, ns = leaf_update(
+                        p, g, st, lr if ls == 1.0 else lr * ls, wd,
+                        step_i)
+                    new_tail.append(np_)
+                    new_tstates.append(ns)
+            return (loss, new_tail, new_tstates, new_stk_p, new_stk_w,
+                    new_stk_s)
+
+        host = self._host_sh(1)
+        stk_sh = jax.tree.map(lambda _: host, self._stk_param)
+        stkw_sh = jax.tree.map(lambda _: host, self._stk_wire)
+        stks_sh = jax.tree.map(lambda _: host, self._stk_state)
+        out_sh = (None, None, None, stk_sh, stkw_sh, stks_sh)
+        donate = (0, 1, 2, 3, 4) if self._donate else ()
+        self._step_fn = step
+        with self.mesh:
+            self._compiled = jax.jit(step, donate_argnums=donate,
+                                     out_shardings=out_sh)
+
+    # -- run ---------------------------------------------------------------
+    def _shard_batch(self, arr):
+        from .sharded_trainer import shard_batch
+        return shard_batch(self.mesh, arr, self.batch_axes,
+                           self.seq_axis, self.seq_dim)
+
+    def _prepare(self, batch):
+        sd = self._sd = self.model.state_dict()
+        if not self._stacks_ready:
+            self._init_stacks()
+        if self._compiled is None:
+            self._build()
+        tail_vals = [sd[n]._value for n in self._tail_names]
+        batch_vals = tuple(
+            self._shard_batch(b.value if isinstance(b, Tensor)
+                              else jnp.asarray(b)) for b in batch)
+        return tail_vals, batch_vals
+
+    def __call__(self, *batch):
+        return self._run_one(batch, None)
+
+    def _run_one(self, batch, lr_override):
+        from ..distributed.watchdog import watched
+        tail_vals, batch_vals = self._prepare(batch)
+        self.optimizer._step_count += 1
+        lr = self.optimizer.get_lr() if lr_override is None \
+            else lr_override
+        key = prandom.next_key()
+        with watched("offload pipeline step"):
+            (loss, new_tail, new_tstates, self._stk_param,
+             self._stk_wire, self._stk_state) = self._compiled(
+                tail_vals, self._tail_states, self._stk_param,
+                self._stk_wire, self._stk_state,
+                jnp.asarray(lr, jnp.float32),
+                jnp.asarray(self.optimizer._step_count, jnp.int32),
+                key, batch_vals)
+        sd = self._sd
+        for n, v in zip(self._tail_names, new_tail):
+            sd[n]._value = v
+        self._tail_states = new_tstates
+        return Tensor(loss)
+
+    def run_steps(self, *stacked_batch, advance_lr_scheduler=True):
+        """K steps over [K, batch, ...] stacks.  The streamed step is
+        deliberately NOT scan-fused across steps (the whole point is
+        that HBM never holds the stacks a fused multi-step carry would
+        need); this is a host loop for API parity with
+        ShardedTrainStep.run_steps — including the per-step LRScheduler
+        advance contract (see jit.per_step_lrs).  Returns the [K] loss
+        Tensor."""
+        from ..jit import per_step_lrs
+        vals = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                for b in stacked_batch]
+        k = int(vals[0].shape[0])
+        lrs, commit_lr = per_step_lrs(self.optimizer, k,
+                                      advance=advance_lr_scheduler)
+        losses = []
+        for i in range(k):
+            losses.append(self._run_one(
+                tuple(v[i] for v in vals), float(lrs[i]))._value)
+        commit_lr()
+        return Tensor(jnp.stack(losses))
+
+    def sync_to_model(self):
+        """Write the stacked host params back into the model's per-layer
+        Tensors (the stacks are authoritative between steps; the model's
+        block tensors go stale after the first step — call this before
+        checkpointing or eval through the module API)."""
+        if not self._stacks_ready:
+            return
+        sd = self.model.state_dict()
+        for s in self._leaves:
+            host = np.asarray(self._stk_param[s])
+            for i in range(self._num_layers):
+                t = sd[self._block_names[i][s]]
+                v = jnp.asarray(host[i], dtype=t.value.dtype)
+                t._value = self._to_host(v) if self._offload else v
+
+    # -- introspection / instrumentation ----------------------------------
+    @property
+    def window_size(self) -> int:
+        return min(self.prefetch_depth + 1, self._num_layers)
+
+    def layer_param_bytes(self) -> int:
+        """Wire bytes of ONE layer's parameters (what a forward-window
+        slot occupies in HBM)."""
+        if not self._stacks_ready:
+            self._init_stacks()
+        return sum(int(np.prod(a.shape[1:])) * self._wire_dtype.itemsize
+                   for a in self._stk_param.values())
+
+    def hbm_param_bytes(self) -> int:
+        """Upper bound of block-parameter bytes resident in HBM at any
+        point: the (prefetch_depth+1)-deep window (backward bundles
+        additionally hold the layer's moments/master, accounted by
+        `layer_state_bytes`)."""
+        return self.window_size * self.layer_param_bytes()
+
+    def layer_state_bytes(self) -> int:
+        if not self._stacks_ready:
+            self._init_stacks()
+        return sum(int(np.prod(v.shape[1:])) * v.dtype.itemsize
+                   for st in self._stk_state.values()
+                   for v in st.values())
+
+    def stream_bytes_per_step(self) -> dict:
+        """Analytic DMA bytes for one step: forward H2D (wire params),
+        backward H2D (storage params + moments/master), D2H write-back
+        (new params [+ wire copy] + state).  Counts FETCH EVENTS, which
+        include the window-size extra fetches each scan issues at its
+        boundary (W at init plus W clamped re-fetches of the edge
+        layer) — the bytes actually crossing the wire, so bench's
+        dma_share denominator doesn't under-report by ~W/L."""
+        if not self._stacks_ready:
+            self._init_stacks()
+        L = self._num_layers
+        W = self.window_size
+        store = sum(int(np.prod(a.shape[1:])) * a.dtype.itemsize
+                    for a in self._stk_param.values())
+        wire = self.layer_param_bytes()
+        state = self.layer_state_bytes()
+        h2d = (L + W) * wire + (L + W) * (store + state)
+        d2h = L * (store + state + (wire if self._casts else 0))
+        return {"h2d_bytes": int(h2d), "d2h_bytes": int(d2h),
+                "prefetch_depth": self.prefetch_depth}
+
+    def dma_probe(self, reps: int = 3) -> float:
+        """Seconds to stream one step's host→HBM bytes with NO compute:
+        a jitted scan that fetches every forward window and backward
+        bundle and reduces each to a scalar.  Compared against the real
+        step time this separates bandwidth-bound (ratio→1) from
+        schedule-bound (ratio≪1 with low MFU) rounds."""
+        import time
+        if not self._stacks_ready:
+            self._init_stacks()
+        L = self._num_layers
+        leaves = self._leaves
+        fwd_src = self._stk_wire if self._casts else self._stk_param
+
+        def drain(stk_wire, stk_param, stk_state):
+            def body(acc, i):
+                sl = {s: jax.lax.dynamic_index_in_dim(stk_wire[s], i, 0)
+                      for s in leaves}
+                sl2 = {s: jax.lax.dynamic_index_in_dim(stk_param[s], i, 0)
+                       for s in leaves}
+                sl3 = {s: {k: jax.lax.dynamic_index_in_dim(v, i, 0)
+                           for k, v in stk_state[s].items()}
+                       for s in leaves}
+                tree = self._to_device_in_step((sl, sl2, sl3))
+                # a real reduction of every fetched byte — `x*0+1`-style
+                # counters would let XLA DCE the loads under the probe
+                tot = sum(jnp.sum(x.astype(jnp.float32))
+                          for x in jax.tree.leaves(tree))
+                return acc + tot, None
+            acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(L))
+            return acc
+
+        with self.mesh:
+            fn = jax.jit(drain)
+        out = fn(fwd_src, self._stk_param, self._stk_state)
+        float(np.asarray(out))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(fwd_src, self._stk_param, self._stk_state)
+        float(np.asarray(out))
+        return (time.perf_counter() - t0) / reps
+
+    def compiled_hlo(self, *batch, optimized: bool = False) -> str:
+        """Compile (without executing) and return the HLO — lets tests
+        assert the one-program/window structure (e.g. `dot_general`
+        count independent of layer count; exactly two scan loops)."""
+        tail_vals, batch_vals = self._prepare(batch)
+        lowered = self._compiled.lower(
+            tail_vals, self._tail_states, self._stk_param,
+            self._stk_wire, self._stk_state,
+            jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.int32),
+            jax.random.key(0), batch_vals)
+        return lowered.compile().as_text() if optimized \
+            else lowered.as_text()

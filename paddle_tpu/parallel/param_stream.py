@@ -20,9 +20,19 @@ the transfer sits INSIDE the rematerialized region:
     the current block's compute (the double-buffered prefetch the
     reference implements by hand with CUDA streams).
 
+NOTE: the scheduler-dependent overlap above measured poorly (BENCH_r05
+offload at 0.188× baseline) — `parallel/offload_pipeline.py` is the
+explicit double-buffered replacement for block-stacked models; this
+scope remains the mechanism for irregular models.
+
 The scope maps parameter-Tensor OBJECT ids to their device shardings —
 object identity is stable across `_swapped_state` value swaps, which is
 what makes the trainer↔recompute handshake work without name plumbing.
+
+Every table entry must be consulted by the traced step: a parameter
+that is never visited would silently train against a stale HBM copy
+(or not stream at all), so `param_stream_scope` raises on clean exit
+when entries go unvisited.
 """
 from __future__ import annotations
 
@@ -34,19 +44,42 @@ _ACTIVE: list = []
 
 
 @contextmanager
-def param_stream_scope(table):
+def param_stream_scope(table, names=None):
     """table: {id(param_tensor): NamedSharding(..., memory_kind="device")}
-    — active while TRACING the train step's forward."""
-    _ACTIVE.append(table)
+    — active while TRACING the train step's forward.
+
+    names: optional {id(param_tensor): name} used to report unvisited
+    entries.  On clean exit, any table entry the traced step never
+    looked up via `stream_sharding_for` raises a RuntimeError — the
+    previous behavior was a silent no-op (the param simply never
+    streamed), which surfaced as wrong placement only under a profiler.
+    """
+    visited: set = set()
+    _ACTIVE.append((table, visited))
     try:
         yield
     finally:
         _ACTIVE.pop()
+    missing = set(table) - visited
+    if missing:
+        labels = sorted(
+            (names or {}).get(i, f"<param id {i}>") for i in missing)
+        raise RuntimeError(
+            "param_stream_scope: {} streamed parameter(s) were never "
+            "visited by the traced step: {} — every parameter in the "
+            "stream table must be consumed inside the traced forward "
+            "(is the block skipped, or the tensor replaced rather than "
+            "value-swapped?)".format(len(missing), labels))
 
 
 def stream_sharding_for(tensor_obj):
     """Device sharding for this parameter if the active scope streams
-    it, else None."""
+    it, else None.  Marks the entry visited (see the scope's exit
+    check)."""
     if not _ACTIVE:
         return None
-    return _ACTIVE[-1].get(id(tensor_obj))
+    table, visited = _ACTIVE[-1]
+    sh = table.get(id(tensor_obj))
+    if sh is not None:
+        visited.add(id(tensor_obj))
+    return sh

@@ -67,6 +67,21 @@ def constrain_activation(v):
         v, NamedSharding(mesh, P(*spec)))
 
 
+def shard_batch(mesh: Mesh, arr, batch_axes=("dp", "sharding"),
+                seq_axis=None, seq_dim=1):
+    """Place one batch array: batch dim over the data axes, seq dim
+    over `seq_axis` when present AND divisible (same guard as
+    `constrain_activation` — a ragged seq stays replicated rather than
+    erroring).  Shared by ShardedTrainStep and OffloadPipelineStep."""
+    from ..distributed.topology import batch_partition_spec
+    spec = batch_partition_spec(mesh, arr.shape, batch_axes)
+    if seq_axis and seq_axis in mesh.axis_names \
+            and mesh.shape[seq_axis] > 1 and arr.ndim > seq_dim \
+            and arr.shape[seq_dim] % mesh.shape[seq_axis] == 0:
+        spec[seq_dim] = seq_axis
+    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+
+
 def make_batch_sharding(mesh: Mesh, ndim: int, batch_axes=("dp", "sharding")):
     axes = tuple(a for a in batch_axes if a in mesh.axis_names
                  and mesh.shape[a] > 1)
@@ -120,13 +135,36 @@ class ShardedTrainStep:
                  sharding_stage: int = 0, rematerialize: bool = False,
                  batch_axes=("dp", "sharding"), donate: bool = True,
                  seq_axis: Optional[str] = None, seq_dim: int = 1,
-                 offload=False):
+                 offload=False, offload_prefetch_depth: int = 1,
+                 offload_cast_dtype="bfloat16"):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.stage = sharding_stage
         self.remat = rematerialize
+        # offload="stream": the explicit double-buffered per-layer
+        # streaming pipeline (offload_pipeline.py) — forward/backward
+        # prefetch windows + in-backward optimizer, replacing the
+        # scheduler-dependent param_stream path for block-stacked
+        # models.  The pipeline's host stacks are authoritative between
+        # steps: call sync_to_model() before checkpointing or running
+        # eval through the module API.
+        self.batch_axes = batch_axes
+        self.seq_axis = seq_axis
+        self.seq_dim = seq_dim
+        self._donate = donate
+        self._pipeline = None
+        if offload == "stream":
+            from .offload_pipeline import OffloadPipelineStep
+            self._pipeline = OffloadPipelineStep(
+                model, optimizer, mesh, loss_fn=loss_fn,
+                prefetch_depth=offload_prefetch_depth,
+                cast_dtype=offload_cast_dtype, batch_axes=batch_axes,
+                donate=donate, seq_axis=seq_axis, seq_dim=seq_dim)
+            self.offload = True
+            self.offload_params = True
+            return
         # host offload (reference: group_sharded_stage3.py `offload` —
         # fp32 master + moments, and with offload=True also the
         # PARAMETER slices, parked on CPU).  TPU-native:
@@ -147,10 +185,6 @@ class ShardedTrainStep:
         self.offload_params = offload in ("params", "all")
         self._stream_offload = bool(offload) and \
             jax.default_backend() == "tpu"
-        self.batch_axes = batch_axes
-        self.seq_axis = seq_axis
-        self.seq_dim = seq_dim
-        self._donate = donate
         self._names = [n for n, _ in model.named_parameters()]
         all_names = list(model.state_dict().keys())
         self._buf_names = [n for n in all_names if n not in self._names]
@@ -158,11 +192,46 @@ class ShardedTrainStep:
         self._opt_states = None
         self._setup_shardings()
 
+    @classmethod
+    def from_strategy(cls, model, optimizer, mesh, strategy, **kw):
+        """Build from a fleet DistributedStrategy: when the
+        `strategy.sharding` master switch is on, sharding_configs
+        supplies {stage, offload, offload_prefetch_depth,
+        offload_cast_dtype} (reference: sharding_configs in
+        distributed_strategy.proto only drive GroupSharded when
+        strategy.sharding is enabled)."""
+        sc = dict(getattr(strategy, "sharding_configs", {}) or {}) \
+            if getattr(strategy, "sharding", False) else {}
+        kw.setdefault("sharding_stage", sc.get("stage", 0 if not sc
+                                               else 1))
+        kw.setdefault("offload", sc.get("offload", False))
+        kw.setdefault("offload_prefetch_depth",
+                      sc.get("offload_prefetch_depth", 1))
+        kw.setdefault("offload_cast_dtype",
+                      sc.get("offload_cast_dtype", "bfloat16"))
+        return cls(model, optimizer, mesh, **kw)
+
     # -- sharding policy ---------------------------------------------------
     def _setup_shardings(self):
         mesh = self.mesh
         sd = self.model.state_dict()
         shard_n = mesh.shape.get("sharding", 1)
+        # backends without the pinned_host/device memory kinds (the CPU
+        # runtime exposes only unpinned_host) fall back to plain
+        # shardings: placement degenerates to device memory but every
+        # numerical path is unchanged — what keeps offload parity
+        # testable off-TPU
+        from .offload_pipeline import supports_memory_kinds
+        mk = supports_memory_kinds()
+
+        def _host(ns):
+            return NamedSharding(mesh, ns.spec,
+                                 memory_kind="pinned_host") if mk else ns
+
+        def _dev(ns):
+            return NamedSharding(mesh, ns.spec,
+                                 memory_kind="device") if mk else ns
+
         self._param_shardings = {}
         self._param_store_shardings = {}
         self._dev_param_shardings = {}
@@ -179,11 +248,9 @@ class ShardedTrainStep:
                                          p.value.shape, shard_n, mesh)
             ns = NamedSharding(mesh, P(*spec))
             self._param_shardings[n] = ns
-            self._param_store_shardings[n] = NamedSharding(
-                mesh, ns.spec, memory_kind="pinned_host") \
+            self._param_store_shardings[n] = _host(ns) \
                 if self.offload_params else ns
-            self._dev_param_shardings[n] = NamedSharding(
-                mesh, ns.spec, memory_kind="device")
+            self._dev_param_shardings[n] = _dev(ns)
             p._value = jax.device_put(p.value,
                                       self._param_store_shardings[n])
         self._opt_shardings = {}
@@ -205,11 +272,9 @@ class ShardedTrainStep:
             # streaming transfers target — the transfer custom call must
             # carry BOTH placement and sharding or the SPMD partitioner
             # rejects it.
-            self._opt_store_shardings[n] = NamedSharding(
-                mesh, ns.spec, memory_kind="pinned_host") \
+            self._opt_store_shardings[n] = _host(ns) \
                 if self.offload else ns
-            self._dev_opt_shardings[n] = NamedSharding(
-                mesh, ns.spec, memory_kind="device")
+            self._dev_opt_shardings[n] = _dev(ns)
 
     def _states_for_call(self):
         """Opt states as the compiled step expects them: host-resident
@@ -246,14 +311,8 @@ class ShardedTrainStep:
         return new_states
 
     def _shard_batch(self, arr):
-        from ..distributed.topology import batch_partition_spec
-        spec = batch_partition_spec(self.mesh, arr.shape,
-                                    self.batch_axes)
-        if self.seq_axis and self.seq_axis in self.mesh.axis_names \
-                and self.mesh.shape[self.seq_axis] > 1 \
-                and arr.ndim > self.seq_dim:
-            spec[self.seq_dim] = self.seq_axis
-        return jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
+        return shard_batch(self.mesh, arr, self.batch_axes,
+                           self.seq_axis, self.seq_dim)
 
     # -- build -------------------------------------------------------------
     def _init_opt_states(self):
@@ -310,7 +369,6 @@ class ShardedTrainStep:
         # the scope; the long tail (embeddings, lm_head, final norm)
         # transfers up-front in the forward
         import os
-        import re
         stream_params = self.offload_params and self._stream_offload
         # PDTPU_PARAM_STREAM=1 opts into PER-BLOCK in-remat streaming
         # (HBM holds ~one block's params; see param_stream.py).  The
@@ -321,7 +379,7 @@ class ShardedTrainStep:
         # "Unimplemented DMA from host to vmem"); measured 4.49B trains
         # at 550 tok/s on 16G in boundary mode (15.79G peak)
         per_block = os.environ.get("PDTPU_PARAM_STREAM", "0") == "1"
-        block_pat = re.compile(r"\.(layers|blocks|h|stages)\.\d+\.")
+        from .offload_pipeline import BLOCK_STACK_PAT as block_pat
         # only matrix params stream: small 1-D scales would be DMA'd
         # host->vmem directly (unimplemented on the TPU runtime) and
         # cost nothing to keep device-resident
@@ -332,6 +390,8 @@ class ShardedTrainStep:
         dev_param_sh = [self._dev_param_shardings[n] for n in names]
         from .param_stream import param_stream_scope
         stream_table = {id(sd[n]): dev_param_sh[i]
+                        for i, n in enumerate(names) if streamed[i]}
+        stream_names = {id(sd[n]): n
                         for i, n in enumerate(names) if streamed[i]}
 
         def loss_of(param_vals, buf_vals, key, batch):
@@ -346,7 +406,7 @@ class ShardedTrainStep:
                 with _swapped_state(model, names + buf_names,
                                     list(param_vals) + list(buf_vals)):
                     with prandom.key_scope(key), \
-                         param_stream_scope(stream_table), \
+                         param_stream_scope(stream_table, stream_names), \
                          activation_sharding_scope(self.mesh,
                                                    self.batch_axes,
                                                    self.seq_axis,
@@ -476,6 +536,8 @@ class ShardedTrainStep:
         sharding stage implies.  optimized=False returns the pre-SPMD
         StableHLO, where explicit sharding constraints (e.g. stage-2 grad
         shardings) are still visible as @Sharding custom calls."""
+        if self._pipeline is not None:
+            return self._pipeline.compiled_hlo(*batch, optimized=optimized)
         param_vals, buf_vals, batch_vals = self._prepare(batch)
         lowered = self._compiled.lower(
             param_vals, self._states_for_call(), buf_vals,
@@ -543,6 +605,9 @@ class ShardedTrainStep:
         A per-step LRScheduler is advanced inside the window (see
         jit.per_step_lrs); epoch-granular schedulers pass
         advance_lr_scheduler=False."""
+        if self._pipeline is not None:
+            return self._pipeline.run_steps(
+                *stacked_batch, advance_lr_scheduler=advance_lr_scheduler)
         param_vals, buf_vals, _ = self._prepare(
             tuple(Tensor(b.value[0] if isinstance(b, Tensor)
                          else jnp.asarray(b)[0])
@@ -584,9 +649,20 @@ class ShardedTrainStep:
         return jax.device_put(
             arr, NamedSharding(self.mesh, P(None, *spec)))
 
+    def sync_to_model(self):
+        """Streamed-pipeline mode: write the authoritative host stacks
+        back into the model's per-layer Tensors (do this before
+        checkpointing or eval through the module API).  No-op for the
+        non-stream paths, whose __call__ already keeps the model
+        current."""
+        if self._pipeline is not None:
+            self._pipeline.sync_to_model()
+
     # -- run ---------------------------------------------------------------
     def __call__(self, *batch):
         from ..distributed.watchdog import watched
+        if self._pipeline is not None:
+            return self._pipeline(*batch)
         param_vals, buf_vals, batch_vals = self._prepare(batch)
         sd = self._sd
         self.optimizer._step_count += 1

@@ -621,19 +621,41 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
                 other_vids.append(v)
     op_slice = list(ops)
     n_in = len(ivids)
+    n_other = len(other_vids)
     # cotangents: d(sum_i <targets_i, tg_i>)/d(inputs); default ones
-    # (reference: append_backward's fill_constant initial grads)
-    tgs = None
+    # (reference: append_backward's fill_constant initial grads).
+    # target_gradients are recorded as EXTRA OP INPUTS (in_vids), not
+    # closure constants: a replay with new feeds substitutes fresh
+    # cotangents exactly like the reference's initial-grad program
+    # variables (previously the record-time values were baked in and
+    # every Executor.run replayed with them).
+    tg_slots = []        # per-target: position among the tg inputs
+    tg_vids = []
+    tg_tensors = []
     if target_gradients is not None:
         tg_l = target_gradients if isinstance(
             target_gradients, (list, tuple)) else [target_gradients]
-        tgs = [None if t is None else jnp.asarray(
-            t.value if isinstance(t, Tensor) else np.asarray(t))
-            for t in tg_l]
+        for t in tg_l:
+            if t is None:
+                tg_slots.append(None)
+                continue
+            tt = t if isinstance(t, Tensor) else Tensor(
+                jnp.asarray(np.asarray(t)))
+            vid = getattr(tt, "_static_vid", None)
+            if vid is not None and vid in _prog_mod._known(prog):
+                vid = tag_tensor(prog, tt)
+            else:
+                # raw arrays / foreign tensors become program leaves
+                # (snapshot + live weakref, like any recorded constant)
+                vid = _prog_mod._leaf_register(prog, tt)
+            tg_slots.append(len(tg_vids))
+            tg_vids.append(vid)
+            tg_tensors.append(tt)
 
     def grad_fn(*vals):
         diff_vals = vals[:n_in]
-        rest = vals[n_in:]
+        rest = vals[n_in:n_in + n_other]
+        tg_vals = vals[n_in + n_other:]
 
         def f(diff_vals):
             env = dict(zip(ivids, diff_vals))
@@ -642,9 +664,9 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
             total = jnp.float32(0)
             for i, o in enumerate(outs):
                 o = o.astype(jnp.float32)
-                if tgs is not None and i < len(tgs) \
-                        and tgs[i] is not None:
-                    o = o * tgs[i].astype(jnp.float32)
+                slot = tg_slots[i] if i < len(tg_slots) else None
+                if slot is not None:
+                    o = o * tg_vals[slot].astype(jnp.float32)
                 total = total + jnp.sum(o)
             return total
 
@@ -661,10 +683,11 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     # evaluate once eagerly (build-time values) so downstream build code
     # sees concrete grads, and record the composite op for replay
     vals = [t._value for t in inputs_l] + [_vid_value(v)
-                                           for v in other_vids]
+                                           for v in other_vids] \
+        + [tt._value for tt in tg_tensors]
     g = grad_fn(*vals)
     outs = [Tensor(gi) for gi in g]
-    in_vids_all = list(ivids) + list(other_vids)
+    in_vids_all = list(ivids) + list(other_vids) + list(tg_vids)
     out_vids = [tag_tensor(prog, t) for t in outs]
     prog.ops.append(OpDesc("gradients", grad_fn, in_vids_all, out_vids))
     _prog_mod.bump_version(prog)

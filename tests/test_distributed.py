@@ -378,13 +378,19 @@ def test_zero3_host_offload_roundtrip():
            for _ in range(3)]
     np.testing.assert_allclose(off, base, rtol=1e-5, atol=1e-6)
 
-    # placement round-trips: state is pinned_host AFTER the step
-    for st_dict in s2._opt_states:
-        for k, v in st_dict.items():
-            assert v.sharding.memory_kind == "pinned_host", (k, v.sharding)
-    # params stayed in device memory
-    for n, p in m2.named_parameters():
-        assert p.value.sharding.memory_kind == "device"
+    # placement round-trips: state is pinned_host AFTER the step.
+    # Backends without the pinned_host/device memory kinds (this CPU
+    # runtime) run the same math with plain placement — parity above
+    # is the invariant there.
+    from paddle_tpu.parallel.offload_pipeline import supports_memory_kinds
+    if supports_memory_kinds():
+        for st_dict in s2._opt_states:
+            for k, v in st_dict.items():
+                assert v.sharding.memory_kind == "pinned_host", \
+                    (k, v.sharding)
+        # params stayed in device memory
+        for n, p in m2.named_parameters():
+            assert p.value.sharding.memory_kind == "device"
     w1 = np.asarray(m1.state_dict()["0.weight"].value)
     w2 = np.asarray(m2.state_dict()["0.weight"].value)
     np.testing.assert_allclose(w2, w1, rtol=1e-5, atol=1e-6)
@@ -428,12 +434,15 @@ def test_zero3_param_offload_roundtrip():
     np.testing.assert_allclose(off, base, rtol=1e-5, atol=1e-6)
 
     # placement round-trips: params AND opt state pinned_host AFTER the
-    # step; the two runs' final weights agree
-    for n, p in m2.named_parameters():
-        assert p.value.sharding.memory_kind == "pinned_host", n
-    for st_dict in s2._opt_states:
-        for k, v in st_dict.items():
-            assert v.sharding.memory_kind == "pinned_host", k
+    # step; the two runs' final weights agree.  Placement asserts are
+    # TPU-only (no pinned_host memory kind on this CPU runtime).
+    from paddle_tpu.parallel.offload_pipeline import supports_memory_kinds
+    if supports_memory_kinds():
+        for n, p in m2.named_parameters():
+            assert p.value.sharding.memory_kind == "pinned_host", n
+        for st_dict in s2._opt_states:
+            for k, v in st_dict.items():
+                assert v.sharding.memory_kind == "pinned_host", k
     sd1, sd2 = m1.state_dict(), m2.state_dict()
     for n in sd1:
         np.testing.assert_allclose(np.asarray(sd2[n].value),

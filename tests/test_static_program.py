@@ -272,6 +272,25 @@ class TestReviewRegressions2:
         np.testing.assert_allclose(got, 2 * xv * [1., 0., 2.],
                                    rtol=1e-6)
 
+    def test_gradients_target_gradients_replay_fresh(self):
+        """Cotangents are op INPUTS, not record-time closure constants:
+        a placeholder target_gradient must be substituted per feed
+        (advisor r5 item 2 — pre-fix this replayed the build-time
+        zeros for every run)."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [3], "float32")
+            w = static.data("w", [3], "float32")
+            y = x * x
+            (dx,) = static.gradients(y, [x], target_gradients=[w])
+        exe = static.Executor()
+        xv = np.array([1., 2., 3.], np.float32)
+        for wv in ([1., 0., 2.], [0., 1., 5.]):
+            wv = np.array(wv, np.float32)
+            (got,) = exe.run(main, feed={"x": xv, "w": wv},
+                             fetch_list=[dx])
+            np.testing.assert_allclose(got, 2 * xv * wv, rtol=1e-6)
+
     def test_unknown_feed_key_rejected(self):
         main, startup, x, fc1, fc2, h, out, loss = _mlp_program()
         exe = static.Executor()
