@@ -639,7 +639,42 @@ _ALIASES = {
 }
 
 
+def _assert_analysis_zero_overhead():
+    """FLAGS off ⇒ the verifier never touches the replay hot path: the
+    Executor replay-cache key set is identical before/after loading the
+    analysis subsystem AND across repeat runs, and VERIFY_CALLS does not
+    move during flags-off replays (the zero-overhead contract of
+    paddle_tpu/analysis — verification must be free when not asked
+    for).  Cheap (tiny program), runs before every bench config."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu.analysis import verifier
+
+    static.enable_static()
+    try:
+        main_p = static.Program()
+        with static.program_guard(main_p, static.Program()):
+            x = static.data("x", [2, 4], "float32")
+            w = paddle.to_tensor(np.ones((4, 3), np.float32))
+            loss = paddle.matmul(x, w).mean()
+        exe = static.Executor()
+        xv = np.ones((2, 4), np.float32)
+        exe.run(main_p, feed={"x": xv}, fetch_list=[loss])
+        keys = set(main_p._exec_cache)
+        calls = verifier.VERIFY_CALLS
+        for _ in range(3):
+            exe.run(main_p, feed={"x": xv}, fetch_list=[loss])
+        assert verifier.VERIFY_CALLS == calls, \
+            "verifier ran on the replay hot path with FLAGS off"
+        assert set(main_p._exec_cache) == keys, \
+            "flags-off replays changed the replay-cache key set"
+    finally:
+        static.disable_static()
+
+
 def main():
+    _assert_analysis_zero_overhead()
     which = os.environ.get("BENCH_CONFIG", "all").lower()
     if "--only" in sys.argv:
         i = sys.argv.index("--only")

@@ -1,0 +1,51 @@
+"""paddle_tpu.analysis — program verification and jaxpr lint passes.
+
+Reference: the PIR layer's `Operation::Verify` contract — every pass must
+leave the IR verifiable (`paddle/pir/core/operation.cc`, and
+`VerifySig/VerifyType` hooks on each op) — plus the debugging passes
+under `paddle/fluid/framework/ir/` (graph_viz, check ops).  Here the
+same discipline is applied to this framework's two program forms:
+
+  * the recorded **OpDesc tape** (`static/program.py`) — structural
+    invariants: def-before-use, single definition (SSA) per vid,
+    WAR/WAW in-place hazards against the `on_inplace_retag` protocol,
+    leaf liveness, name-table integrity, and (level="full") per-op
+    output arity via abstract evaluation.  `verify_program` runs
+    automatically after every `apply_pass`, and — gated on
+    `FLAGS_check_program` — at `Executor.run` entry, so a buggy tape
+    pass can never ship a structurally broken program;
+
+  * **traced/compiled jax programs** — lint analyses over jaxprs and
+    lowered modules: silent dtype promotion (fp32 upcasts inside
+    bf16/AMP regions, x64 creep), unexpected host<->device transfers
+    inside a jitted step, declared-donated buffers the executable did
+    not actually alias, a `recompile_guard` context manager that
+    bounds compilation count and reports the offending avals, and a
+    cross-rank collective-order checker (`collectives.py`) — the
+    static deadlock detector for the NCCL-hang-equivalent failure
+    mode (a collective misorder across mesh ranks).
+
+CLI: `python tools/verify_program.py` (JSON mode + non-zero exit on
+findings, like tools/op_audit.py).  All checks are cold-path: with the
+flags off the replay hot path pays one dict lookup, and bench.py
+asserts the replay-cache keys are byte-identical with the subsystem
+loaded.
+"""
+from __future__ import annotations
+
+from .base import Finding, ProgramVerifyError, LintError, \
+    CollectiveOrderError, RecompileError
+from .verifier import verify_program, check_program
+from .lints import lint_dtype_promotion, lint_transfers, lint_donation, \
+    recompile_guard, note_program_build
+from .collectives import CollectiveEvent, collective_schedule, \
+    check_collective_order
+
+__all__ = [
+    "Finding", "ProgramVerifyError", "LintError", "CollectiveOrderError",
+    "RecompileError",
+    "verify_program", "check_program",
+    "lint_dtype_promotion", "lint_transfers", "lint_donation",
+    "recompile_guard", "note_program_build",
+    "CollectiveEvent", "collective_schedule", "check_collective_order",
+]

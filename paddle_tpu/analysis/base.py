@@ -1,0 +1,94 @@
+"""Shared finding/error types for the analysis subsystem.
+
+A `Finding` is one verifier/lint diagnostic.  Every check returns a
+list of findings rather than raising on first hit (the PIR verifier
+collects all IrNotMetException sites the same way); `check_program`
+and the CLI turn a non-empty list into an error / non-zero exit.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+__all__ = ["Finding", "ProgramVerifyError", "LintError",
+           "CollectiveOrderError", "RecompileError", "format_findings"]
+
+
+class Finding:
+    """One diagnostic: a stable machine code + a human message.
+
+    code      stable kebab-case id ("use-before-def", "fp32-upcast", ...)
+    message   human-readable description with names/avals
+    op_index  tape index / eqn index the finding anchors to (or None)
+    detail    check-specific payload (vid, dtype pair, aval list, ...)
+    """
+
+    __slots__ = ("code", "message", "op_index", "detail")
+
+    def __init__(self, code: str, message: str,
+                 op_index: Optional[int] = None, detail: Any = None):
+        self.code = code
+        self.message = message
+        self.op_index = op_index
+        self.detail = detail
+
+    def to_dict(self):
+        d = {"code": self.code, "message": self.message}
+        if self.op_index is not None:
+            d["op_index"] = self.op_index
+        if self.detail is not None:
+            d["detail"] = repr(self.detail)
+        return d
+
+    def __repr__(self):
+        loc = f" @op[{self.op_index}]" if self.op_index is not None else ""
+        return f"Finding({self.code}{loc}: {self.message})"
+
+
+def format_findings(findings, title="program verification failed"):
+    lines = [f"{title} ({len(findings)} finding"
+             f"{'s' if len(findings) != 1 else ''}):"]
+    for f in findings:
+        loc = f"  op[{f.op_index}] " if f.op_index is not None else "  "
+        lines.append(f"{loc}[{f.code}] {f.message}")
+    return "\n".join(lines)
+
+
+class ProgramVerifyError(RuntimeError):
+    """Tape verifier found structural invariant violations."""
+
+    def __init__(self, findings, title="program verification failed"):
+        self.findings = list(findings)
+        super().__init__(format_findings(self.findings, title))
+
+
+class LintError(RuntimeError):
+    """A jaxpr lint found violations (when raised rather than returned)."""
+
+    def __init__(self, findings, title="jaxpr lint failed"):
+        self.findings = list(findings)
+        super().__init__(format_findings(self.findings, title))
+
+
+class CollectiveOrderError(RuntimeError):
+    """Cross-rank collective order diverges — the static image of an
+    NCCL-style deadlock (some rank enters collective A while a peer in
+    the same ordering domain enters collective B)."""
+
+    def __init__(self, findings, title="collective order check failed"):
+        self.findings = list(findings)
+        super().__init__(format_findings(self.findings, title))
+
+
+class RecompileError(RuntimeError):
+    """recompile_guard: more programs compiled than the declared budget."""
+
+    def __init__(self, compiles, max_programs, label=""):
+        self.compiles = list(compiles)
+        self.max_programs = max_programs
+        what = f" in {label}" if label else ""
+        lines = [f"recompile_guard{what}: {len(self.compiles)} programs "
+                 f"compiled, max_programs={max_programs}.  Offending "
+                 f"compilations (name + avals):"]
+        for c in self.compiles:
+            lines.append(f"  - {c}")
+        super().__init__("\n".join(lines))

@@ -1,0 +1,160 @@
+"""Cross-rank collective-order checker — the static deadlock detector.
+
+Reference failure mode: NCCL collectives hang the fleet when two ranks
+of one communicator enter DIFFERENT collectives (or the same ones in a
+different order) — `ProcessGroupNCCL` has no ordering protection, the
+reference relies on every rank tracing the same program.  The TPU
+analog is identical: a mis-scheduled psum/ppermute/all_gather across
+mesh ranks, or a pipeline stage consuming micro-batch transfers in an
+order its peer never sends, is a silent whole-mesh hang.
+
+Model: a `CollectiveEvent` is one communication op with
+
+  kind    primitive/channel kind ("psum", "ppermute", "act", "grad"...)
+  key     payload identity that must agree across participants
+          (axis names + perm + shape for jaxpr collectives;
+          (src_chunk, dst_chunk, micro) for pipeline transfers)
+  domain  the ORDERING DOMAIN — the communicator analog.  Events in
+          one domain execute in issue order on every member rank, so
+          all ranks listing events of a domain must list them in the
+          SAME order.  For named-axis collectives the domain is the
+          axis-name tuple; for pipeline point-to-point it is the
+          directed channel (kind, src_stage, dst_stage).
+
+`check_collective_order({rank: [events...]})` proves, per domain, an
+identical total order across every rank that participates — exactly
+the property whose violation deadlocks rendezvous communication.  The
+proof is static: it needs only the schedules, never runs the programs.
+
+`collective_schedule(fn, *args)` extracts the event sequence from a
+traced jax program (recursing through scan/while/pjit bodies in
+program order — one scan iteration represents the per-iteration order,
+which is what rendezvous matching depends on).  SPMD programs yield
+one schedule shared by every rank; per-rank/per-stage host-driven
+systems (PipelineEngine) build their own per-rank event lists.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Sequence, Tuple
+
+from .base import Finding, CollectiveOrderError
+from .lints import as_jaxpr, iter_eqns
+
+__all__ = ["CollectiveEvent", "COLLECTIVE_PRIMS", "collective_schedule",
+           "check_collective_order", "assert_collective_order"]
+
+
+class CollectiveEvent(NamedTuple):
+    kind: str
+    key: tuple
+    domain: tuple
+
+    def describe(self) -> str:
+        return f"{self.kind}{list(self.key)} on domain {self.domain}"
+
+
+# jaxpr primitives that lower to cross-rank communication.  psum2 is
+# jax's current name for the general psum; pbroadcast is shard_map's
+# replication MARKER (device-local), deliberately excluded.
+COLLECTIVE_PRIMS = {
+    "psum": "psum", "psum2": "psum", "pmax": "pmax", "pmin": "pmin",
+    "ppermute": "ppermute", "pgather": "pgather",
+    "all_gather": "all_gather",
+    "all_gather_invariant": "all_gather",
+    "reduce_scatter": "reduce_scatter", "all_to_all": "all_to_all",
+}
+
+
+def _event_of(eqn) -> CollectiveEvent:
+    kind = COLLECTIVE_PRIMS[eqn.primitive.name]
+    axes = eqn.params.get("axis_name",
+                          eqn.params.get("axes", eqn.params.get(
+                              "axis_index_groups")))
+    if not isinstance(axes, tuple):
+        axes = (axes,)
+    shape = None
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "shape"):
+            shape = tuple(aval.shape)
+            break
+    extras: Tuple = ()
+    if "perm" in eqn.params:
+        extras = (tuple(map(tuple, eqn.params["perm"])),)
+    return CollectiveEvent(kind, (axes, shape) + extras, tuple(axes))
+
+
+def collective_schedule(fn_or_jaxpr, *args) -> List[CollectiveEvent]:
+    """The ordered collective-event sequence of a traced program
+    (one shared jaxpr walker — lints.iter_eqns — so the lints and this
+    checker can never disagree on which sub-jaxprs are visited)."""
+    return [_event_of(eqn)
+            for eqn in iter_eqns(as_jaxpr(fn_or_jaxpr, *args))
+            if eqn.primitive.name in COLLECTIVE_PRIMS]
+
+
+def _domain_participants(domain, all_ranks):
+    """Ranks expected to take part in `domain`.  Pipeline channels
+    encode their endpoints as the ints in the domain tuple (("act", 0,
+    1) → stages 0 and 1); axis-name domains have no rank info in the
+    events, so EVERY scheduled rank is presumed a member — the sound
+    default for the one-rank-skips-the-collective hang (a rank that
+    genuinely sits outside the communicator should not be in
+    `schedules`, or pass an explicit `participants=`)."""
+    ints = [x for x in domain if isinstance(x, int)]
+    if ints and len(ints) == len(domain) - 1:
+        return set(ints) & set(all_ranks)
+    return set(all_ranks)
+
+
+def check_collective_order(
+        schedules: Dict[object, Sequence[CollectiveEvent]],
+        participants=None) -> List[Finding]:
+    """Statically prove an identical per-domain total order across all
+    participating ranks.  Returns findings (empty == deadlock-free
+    ordering); each finding names the domain, the diverging ranks, and
+    the first position where their orders disagree.  A participant
+    with ZERO events of a domain its peers use is a divergence too —
+    the classic one-rank-never-enters-the-collective hang.
+
+    participants: optional callable domain -> set(ranks) overriding
+    `_domain_participants`."""
+    findings: List[Finding] = []
+    all_ranks = list(schedules)
+    part = participants or (
+        lambda d: _domain_participants(d, all_ranks))
+    domains = {ev.domain for events in schedules.values()
+               for ev in events}
+    by_domain: Dict[tuple, List] = {}
+    for d in sorted(domains, key=repr):
+        for rank in all_ranks:
+            if rank not in part(d):
+                continue
+            seq = [(ev.kind, ev.key) for ev in schedules[rank]
+                   if ev.domain == d]
+            by_domain.setdefault(d, []).append((rank, seq))
+    for domain, rank_seqs in by_domain.items():
+        ref_rank, ref = rank_seqs[0]
+        for rank, seq in rank_seqs[1:]:
+            if seq == ref:
+                continue
+            pos = next((i for i, (a, b) in enumerate(zip(ref, seq))
+                        if a != b), min(len(ref), len(seq)))
+            a = ref[pos] if pos < len(ref) else "<nothing — sequence ends>"
+            b = seq[pos] if pos < len(seq) else "<nothing — sequence ends>"
+            findings.append(Finding(
+                "collective-order-divergence",
+                f"domain {domain}: rank {ref_rank!r} and rank {rank!r} "
+                f"disagree at position {pos}: {a!r} vs {b!r} — ranks "
+                f"would enter different collectives and hang "
+                f"(lengths {len(ref)} vs {len(seq)})",
+                op_index=pos,
+                detail=(domain, ref_rank, rank, pos)))
+    return findings
+
+
+def assert_collective_order(schedules, title="collective order check "
+                            "failed"):
+    findings = check_collective_order(schedules)
+    if findings:
+        raise CollectiveOrderError(findings, title=title)

@@ -1,0 +1,409 @@
+"""Jaxpr lints — dtype/transfer/donation analyses + recompile_guard.
+
+Reference: the reference framework's AMP debugging tooling
+(`paddle/fluid/eager/amp_auto_cast.h` promotion tables + the
+`FLAGS_low_precision_op_list` audit) and the memory-copy profiler
+(`memcpy_h2d/d2h` op counters).  Here the traced program IS the ground
+truth: every lint walks the jaxpr (recursing into scan/while/pjit
+sub-jaxprs in program order), so what is linted is exactly what XLA
+will compile.
+
+  lint_dtype_promotion   silent fp32 upcasts on bf16/f16 inputs and
+                         64-bit creep (x64 avals appearing from 32-bit
+                         inputs) — the two ways AMP regions silently
+                         lose their precision contract.
+  lint_transfers         device_put eqns inside a jitted step — each is
+                         a host<->device (or cross-memory-kind) copy
+                         the step pays every call.  Intentional
+                         streaming (offload pipeline) passes an allow
+                         predicate.
+  lint_donation          declared-donated buffers the lowered module
+                         did not alias to any output (the executable
+                         will silently keep both copies live).
+  recompile_guard        context manager bounding the number of XLA
+                         compilations in a region; on violation reports
+                         each offending compile WITH its argument avals
+                         (via jax's compile log, which carries them).
+"""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable, List, Optional, Sequence
+
+import jax
+
+from .base import Finding, RecompileError
+
+__all__ = ["iter_eqns", "lint_dtype_promotion", "lint_transfers",
+           "lint_donation", "lint_compiled_step", "recompile_guard",
+           "note_program_build"]
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+
+def _sub_jaxprs(params):
+    for val in params.values():
+        if isinstance(val, (jax.core.Jaxpr, jax.core.ClosedJaxpr)):
+            yield val
+        elif isinstance(val, (tuple, list)):
+            for v in val:
+                if isinstance(v, (jax.core.Jaxpr, jax.core.ClosedJaxpr)):
+                    yield v
+
+
+def iter_eqns(jaxpr):
+    """Yield every eqn of `jaxpr` (Jaxpr or ClosedJaxpr) depth-first in
+    program order, recursing into scan/while/cond/pjit sub-jaxprs."""
+    if isinstance(jaxpr, jax.core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def as_jaxpr(fn_or_jaxpr, *args, **kw):
+    """Accept a ClosedJaxpr as-is, or trace a callable over `args`."""
+    if isinstance(fn_or_jaxpr, (jax.core.ClosedJaxpr, jax.core.Jaxpr)):
+        return fn_or_jaxpr
+    return jax.make_jaxpr(fn_or_jaxpr)(*args, **kw)
+
+
+def _avals(atoms):
+    out = []
+    for a in atoms:
+        aval = getattr(a, "aval", None)
+        if aval is not None and hasattr(aval, "dtype"):
+            out.append(aval)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dtype promotion lint
+
+_LOW = ("bfloat16", "float16")
+_X64 = ("float64", "int64", "uint64", "complex128")
+
+
+def lint_dtype_promotion(fn_or_jaxpr, *args,
+                         check_upcast: bool = True,
+                         check_x64: bool = True,
+                         ignore_prims: Sequence[str] = ()) -> List[Finding]:
+    """Findings for silent precision changes inside a traced program.
+
+      fp32-upcast  an eqn consumes a bf16/f16 array and produces f32 —
+                   inside an AMP/bf16 region that is a silent promotion
+                   (deliberate loss-scale casts can be skipped via
+                   ignore_prims=("convert_element_type",)).
+      x64-creep    an eqn produces a 64-bit array from non-64-bit
+                   inputs, or the program takes 64-bit inputs — on TPU
+                   this de-optimizes every downstream op.
+    """
+    jaxpr = as_jaxpr(fn_or_jaxpr, *args)
+    findings: List[Finding] = []
+    closed = jaxpr if isinstance(jaxpr, jax.core.ClosedJaxpr) else None
+    if check_x64 and closed is not None:
+        for v in closed.jaxpr.invars:
+            aval = getattr(v, "aval", None)
+            if aval is not None and str(getattr(aval, "dtype", "")) in _X64:
+                findings.append(Finding(
+                    "x64-input",
+                    f"program input has 64-bit aval {aval} — x64 creep "
+                    f"starts at the feed",
+                    detail=str(aval)))
+    ignore = set(ignore_prims)
+    for i, eqn in enumerate(iter_eqns(jaxpr)):
+        if eqn.primitive.name in ignore:
+            continue
+        in_avals = _avals(eqn.invars)
+        out_avals = _avals(eqn.outvars)
+        in_dts = [str(a.dtype) for a in in_avals]
+        out_dts = [str(a.dtype) for a in out_avals]
+        if check_upcast and any(d in _LOW for d in in_dts) \
+                and any(d == "float32" for d in out_dts):
+            findings.append(Finding(
+                "fp32-upcast",
+                f"eqn '{eqn.primitive.name}' promotes "
+                f"{[str(a) for a in in_avals]} -> "
+                f"{[str(a) for a in out_avals]}: silent fp32 upcast "
+                f"inside a low-precision region",
+                op_index=i,
+                detail=(eqn.primitive.name, in_dts, out_dts)))
+        if check_x64 and any(d in _X64 for d in out_dts) \
+                and not any(d in _X64 for d in in_dts):
+            findings.append(Finding(
+                "x64-creep",
+                f"eqn '{eqn.primitive.name}' introduces 64-bit avals "
+                f"{[str(a) for a in out_avals]} from 32-bit inputs",
+                op_index=i,
+                detail=(eqn.primitive.name, in_dts, out_dts)))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# transfer lint
+
+def _transfer_dst(eqn):
+    """Summarize a device_put eqn's destination (memory kind when
+    annotated, else the device/sharding repr)."""
+    dsts = eqn.params.get("devices") or eqn.params.get("device") or []
+    if not isinstance(dsts, (tuple, list)):
+        dsts = [dsts]
+    out = []
+    for d in dsts:
+        mk = getattr(d, "memory_kind", None)
+        out.append(str(mk) if mk is not None else repr(d))
+    return ", ".join(out) or "<unspecified>"
+
+
+def lint_transfers(fn_or_jaxpr, *args,
+                   allow: Optional[Callable] = None) -> List[Finding]:
+    """Findings for every `device_put` eqn inside the traced program —
+    each is a host<->device or cross-memory-space copy paid on every
+    call of the jitted step.  `allow(eqn) -> bool` whitelists expected
+    transfers (e.g. the offload pipeline's parameter streaming)."""
+    jaxpr = as_jaxpr(fn_or_jaxpr, *args)
+    findings: List[Finding] = []
+    for i, eqn in enumerate(iter_eqns(jaxpr)):
+        if eqn.primitive.name != "device_put":
+            continue
+        if allow is not None and allow(eqn):
+            continue
+        shapes = [str(a) for a in _avals(eqn.invars)]
+        findings.append(Finding(
+            "in-step-transfer",
+            f"device_put of {shapes} to [{_transfer_dst(eqn)}] inside "
+            f"the jitted program — a copy on every step",
+            op_index=i,
+            detail=(shapes, _transfer_dst(eqn))))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# donation lint
+
+_MLIR_DT = {
+    "float32": "f32", "float16": "f16", "bfloat16": "bf16",
+    "float64": "f64", "int32": "i32", "int64": "i64", "int16": "i16",
+    "int8": "i8", "uint8": "ui8", "uint32": "ui32", "uint64": "ui64",
+    "bool": "i1",
+}
+
+
+def _mlir_type(aval) -> str:
+    dt = _MLIR_DT.get(str(aval.dtype), str(aval.dtype))
+    dims = "x".join(str(d) for d in aval.shape)
+    return f"tensor<{dims}{'x' if dims else ''}{dt}>"
+
+
+_ARG_SPLIT = re.compile(r"(?=%arg\d+:)")
+_TENSOR_PAT = re.compile(r"tensor<[^>]*>")
+
+
+def lint_donation(lowered_or_fn, *args,
+                  donate_argnums: Sequence[int] = ()) -> List[Finding]:
+    """Findings for declared-donated buffers the lowered module did not
+    alias to any output (`tf.aliasing_output`) — the executable keeps
+    both copies live, silently doubling that buffer's footprint.
+
+    Accepts a `jax.stages.Lowered` (donation read off its
+    `donate_argnums`) or a callable + args + donate_argnums.
+    """
+    if hasattr(lowered_or_fn, "as_text") \
+            and hasattr(lowered_or_fn, "donate_argnums"):
+        lowered = lowered_or_fn
+    else:
+        lowered = jax.jit(lowered_or_fn,
+                          donate_argnums=tuple(donate_argnums)) \
+            .lower(*args)
+    # Lowered.donate_argnums indexes the FLATTENED argument leaves
+    # (pytree args expand), matching tree_leaves(in_avals) order
+    flat_avals = jax.tree_util.tree_leaves(lowered.in_avals)
+    donated = [(i, flat_avals[i]) for i in lowered.donate_argnums
+               if i < len(flat_avals)]
+    if not donated:
+        return []
+    text = lowered.as_text()
+    main = text[text.index("@main"):] if "@main" in text else text
+    sig = main[:main.index("{\n")] if "{\n" in main else main
+    # chunk per %argN: the chunk carries that arg's full attribute dict
+    # (attr values may nest braces — "{replicated}" — so a flat regex
+    # over the dict would truncate)
+    chunks = [c for c in _ARG_SPLIT.split(sig) if c.startswith("%arg")]
+
+    def _is_aliased(chunk):
+        return ("tf.aliasing_output" in chunk
+                or "jax.buffer_donor" in chunk)
+
+    findings: List[Finding] = []
+    # exact path: kept_var_idx maps flat arg indices to MLIR arg
+    # positions (unused args are dropped from @main), so each donated
+    # leaf is checked against ITS OWN chunk — two donated args sharing
+    # an aval cannot be confused
+    kept = None
+    try:
+        kept = sorted(lowered._lowering.compile_args["kept_var_idx"])
+    except Exception:
+        pass
+    if kept is not None and len(kept) == len(chunks):
+        pos = {flat_i: j for j, flat_i in enumerate(kept)}
+        for argnum, aval in donated:
+            j = pos.get(argnum)
+            if j is not None and _is_aliased(chunks[j]):
+                continue
+            dropped = " (dropped: unused by the computation)" \
+                if j is None else ""
+            findings.append(Finding(
+                "donation-unaliased",
+                f"donated buffer {aval} (argnum {argnum}) was not "
+                f"aliased to any output by the lowered "
+                f"module{dropped} — donation is a no-op for it and "
+                f"both copies stay live",
+                detail=(argnum, str(aval))))
+        return findings
+    # fallback (no kept_var_idx): multiset-match by tensor type — may
+    # attribute a finding to the wrong argnum among same-aval args
+    pool = [_TENSOR_PAT.search(c).group(0) for c in chunks
+            if _is_aliased(c) and _TENSOR_PAT.search(c)]
+    for argnum, aval in donated:
+        ty = _mlir_type(aval)
+        if ty in pool:
+            pool.remove(ty)
+        else:
+            findings.append(Finding(
+                "donation-unaliased",
+                f"donated buffer {aval} (argnum {argnum}) was not "
+                f"aliased to any output by the lowered module — "
+                f"donation is a no-op for it and both copies stay "
+                f"live",
+                detail=(argnum, str(aval))))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# combined dispatch for compiled train steps
+
+def lint_compiled_step(compiled, args, *, mesh=None, dtype=False,
+                       transfers=False, donation=False):
+    """Shared body of ShardedTrainStep.lint / OffloadPipelineStep.lint:
+    trace the jitted `compiled` ONCE for the jaxpr-walking lints, lower
+    separately for the donation check, all under the mesh context.
+    Returns {category: [Finding, ...]} for the enabled categories."""
+    import contextlib
+    out = {}
+    with (mesh if mesh is not None else contextlib.nullcontext()):
+        if dtype or transfers:
+            jaxpr = jax.make_jaxpr(compiled)(*args)
+            if dtype:
+                out["dtype"] = lint_dtype_promotion(jaxpr)
+            if transfers:
+                out["transfers"] = lint_transfers(jaxpr)
+        if donation:
+            out["donation"] = lint_donation(compiled.lower(*args))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# recompile_guard
+
+# model-level program-cache builds (inference.generation
+# _model_program_cache) are announced here so a guard can also bound
+# cache growth, not just raw XLA compiles
+_BUILD_LISTENERS: List[Callable] = []
+
+
+def note_program_build(key):
+    """Called by program caches on a build miss (cold compile ahead)."""
+    for cb in list(_BUILD_LISTENERS):
+        cb(key)
+
+
+_COMPILE_LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch")
+_COMPILE_PAT = re.compile(r"Compiling ([\w<>\-.]+) (?:with|for)")
+
+
+class _CompileLogHandler(logging.Handler):
+    def __init__(self, sink):
+        super().__init__(level=logging.DEBUG)
+        self._sink = sink
+
+    def emit(self, record):
+        msg = record.getMessage()
+        if msg.startswith("Compiling "):
+            self._sink(msg)
+
+
+class recompile_guard:
+    """Bound the number of XLA compilations inside a `with` block.
+
+        with recompile_guard(max_programs=2, match="serve_step") as g:
+            batcher.run()
+        assert g.count <= 2
+
+    Replaces hand-rolled "exactly N compiled programs" counting: on
+    exit, if more than `max_programs` compilations matched, raises
+    RecompileError listing each offending compile — jax's compile log
+    line carries the jitted function's name AND the argument avals, so
+    the report names the shapes that caused the recompile.
+
+    match    substring the compiled function's name must contain
+             (None = count every compile, including jax-internal
+             helper jits like convert_element_type)
+    The guard also records model-level program-cache builds
+    (`note_program_build`) in `.cache_builds` — the serving batcher and
+    generate() announce their cache misses there.
+    """
+
+    def __init__(self, max_programs: int, match: Optional[str] = None,
+                 label: str = ""):
+        self.max_programs = int(max_programs)
+        self.match = match
+        self.label = label
+        self.compiles: List[str] = []
+        self.cache_builds: List = []
+
+    # -- sinks -------------------------------------------------------------
+    def _on_compile(self, msg):
+        name_m = _COMPILE_PAT.match(msg)
+        name = name_m.group(1) if name_m else "<unknown>"
+        if self.match is None or self.match in name:
+            self.compiles.append(msg)
+
+    def _on_build(self, key):
+        self.cache_builds.append(key)
+
+    @property
+    def count(self) -> int:
+        return len(self.compiles)
+
+    # -- context -----------------------------------------------------------
+    def __enter__(self):
+        self._handler = _CompileLogHandler(self._on_compile)
+        self._prev_log = jax.config.jax_log_compiles
+        jax.config.update("jax_log_compiles", True)
+        self._loggers = []
+        for name in _COMPILE_LOGGERS:
+            lg = logging.getLogger(name)
+            self._loggers.append((lg, lg.level, lg.propagate))
+            if lg.level > logging.WARNING:
+                lg.setLevel(logging.WARNING)
+            # the records exist only because the guard turned the
+            # compile log on — keep them out of the user's terminal
+            lg.propagate = False
+            lg.addHandler(self._handler)
+        _BUILD_LISTENERS.append(self._on_build)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        jax.config.update("jax_log_compiles", self._prev_log)
+        for lg, lvl, prop in self._loggers:
+            lg.removeHandler(self._handler)
+            lg.setLevel(lvl)
+            lg.propagate = prop
+        _BUILD_LISTENERS.remove(self._on_build)
+        if exc_type is None and self.count > self.max_programs:
+            raise RecompileError(self.compiles, self.max_programs,
+                                 label=self.label or (self.match or ""))
+        return False

@@ -88,3 +88,11 @@ define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf")
 define_flag("check_nan_inf_level", 0, "0: error on nan/inf")
 define_flag("use_bf16_default", True, "prefer bfloat16 as AMP dtype on TPU")
 define_flag("benchmark", False, "sync after each op for timing")
+# analysis subsystem (paddle_tpu/analysis): all off by default — the
+# replay/train hot paths must pay nothing beyond the flag lookup
+define_flag("check_program", False,
+            "verify the static Program tape at Executor.run entry "
+            "(apply_pass always verifies, independent of this flag)")
+define_flag("check_collective_order", False,
+            "statically verify the cross-stage collective order "
+            "(deadlock detector) before pipeline train_batch")

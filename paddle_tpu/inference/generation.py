@@ -64,6 +64,11 @@ def _model_program_cache(model, key, build, cap=16):
     store = model.__dict__.setdefault("_gen_compiled", {})
     fn = store.pop(key, None)
     if fn is None:
+        # announce the cache miss to the analysis layer: an active
+        # recompile_guard records it in .cache_builds, so tests bound
+        # program-cache growth the same way they bound XLA compiles
+        from ..analysis.lints import note_program_build
+        note_program_build(key)
         fn = build()
         if len(store) >= cap:
             store.pop(next(iter(store)))

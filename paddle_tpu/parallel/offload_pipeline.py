@@ -851,11 +851,37 @@ class OffloadPipelineStep:
         """Compile (without executing) and return the HLO — lets tests
         assert the one-program/window structure (e.g. `dot_general`
         count independent of layer count; exactly two scan loops)."""
-        tail_vals, batch_vals = self._prepare(batch)
-        lowered = self._compiled.lower(
-            tail_vals, self._tail_states, self._stk_param,
-            self._stk_wire, self._stk_state,
-            jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.int32),
-            jax.random.key(0), batch_vals)
+        args = self._trace_args(batch)   # builds self._compiled lazily
+        lowered = self._compiled.lower(*args)
         return lowered.compile().as_text() if optimized \
             else lowered.as_text()
+
+    def _trace_args(self, batch):
+        """The one argument tuple every analysis entry point traces
+        with (compiled_hlo / collective_schedule / lint)."""
+        tail_vals, batch_vals = self._prepare(batch)
+        return (tail_vals, self._tail_states, self._stk_param,
+                self._stk_wire, self._stk_state,
+                jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.int32),
+                jax.random.key(0), batch_vals)
+
+    def collective_schedule(self, *batch):
+        """Collective eqns of the streamed step in program order
+        (analysis.collectives) — one SPMD program, so the schedule is
+        shared by every mesh rank by construction."""
+        from ..analysis.collectives import collective_schedule
+        args = self._trace_args(batch)
+        with self.mesh:
+            return collective_schedule(self._compiled, *args)
+
+    def lint(self, *batch, dtype: bool = False, transfers: bool = False,
+             donation: bool = True):
+        """Analysis lints over the streamed step.  transfers defaults
+        OFF here: the per-layer host<->HBM device_puts are this
+        pipeline's design, not a defect — enable to AUDIT the streaming
+        structure (each finding is one window transfer)."""
+        from ..analysis.lints import lint_compiled_step
+        args = self._trace_args(batch)
+        return lint_compiled_step(
+            self._compiled, args, mesh=self.mesh, dtype=dtype,
+            transfers=transfers, donation=donation and self._donate)

@@ -347,6 +347,13 @@ class PipelineEngine:
         self._split_bwd = sched in ("ZB", "ZBH1", "ZEROBUBBLE",
                                     "ZBVPP", "ZBV", "ZEROBUBBLEVPP")
         from ..distributed.watchdog import watched
+        from ..framework.flags import get_flag
+        order = self._orders(m, schedule)
+        if get_flag("check_collective_order"):
+            # static deadlock detector (FLAGS-gated: costs nothing when
+            # off) — prove the cross-stage transfer order consistent
+            # BEFORE dispatching any device work
+            self.verify_schedule(m, schedule, orders=order)
         self._sync_shared_values()
         micro_x = jnp.split(xv, m)
         micro_y = jnp.split(yv, m)
@@ -358,24 +365,14 @@ class PipelineEngine:
             chunks[0].inbox[i] = chunks[0].place_activation(micro_x[i])
         labels = [chunks[-1].place_activation(lb) for lb in micro_y]
 
-        order = self._orders(m, schedule)
-        done = set()
-        idx = [0] * pp
         with watched(f"pipeline train_batch ({schedule}, m={m})"):
-            while any(idx[s] < len(order[s]) for s in range(pp)):
-                progress = False
-                for s in range(pp):
-                    while idx[s] < len(order[s]):
-                        kind, v, i = order[s][idx[s]]
-                        if not self._ready(kind, v, i, done):
-                            break
-                        self._exec(kind, v, i, labels)
-                        done.add((kind, v, i))
-                        idx[s] += 1
-                        progress = True
-                if not progress:
-                    raise RuntimeError(
-                        f"pipeline schedule deadlock at {done}")
+            stuck = self._dispatch(
+                order,
+                execute=lambda k, v, i: self._exec(k, v, i, labels))
+            if stuck:
+                raise RuntimeError(
+                    f"pipeline schedule deadlock: stuck ops {stuck} "
+                    f"(each is (stage, kind, chunk, micro))")
 
         # write back grads (avg over micro-batches); a tied param seen in
         # several chunks gets the SUM of its per-chunk grads, placed like
@@ -534,6 +531,109 @@ class PipelineEngine:
                     order.append(pending_w.popleft())
         order.extend(pending_w)
         return order
+
+    # -- static schedule verification (analysis.collectives) ---------------
+    def collective_events(self, num_micro, schedule="1F1B", orders=None):
+        """Per-physical-stage communication event lists derived from the
+        schedule — the pipeline's answer to "extract the collective eqn
+        sequence per rank".  Each cross-stage activation/grad transfer
+        becomes a CollectiveEvent on the directed channel (kind, src
+        stage, dst stage): the ordering domain in which a rendezvous
+        backend (NCCL send/recv semantics) executes strictly in issue
+        order.  Appears once in the sender's list (at its producing op)
+        and once in the receiver's (at its consuming op)."""
+        from ..analysis.collectives import CollectiveEvent
+        orders = orders if orders is not None \
+            else self._orders(num_micro, schedule)
+        last = self.num_chunks - 1
+        stage_of = lambda v: v % self.pp  # noqa: E731
+        events = {s: [] for s in range(self.pp)}
+        for s, order in enumerate(orders):
+            for kind, v, i in order:
+                if kind == "f":
+                    if v > 0 and stage_of(v - 1) != s:
+                        src = stage_of(v - 1)
+                        events[s].append(CollectiveEvent(
+                            "act", (v - 1, v, i), ("act", src, s)))
+                    if v < last and stage_of(v + 1) != s:
+                        dst = stage_of(v + 1)
+                        events[s].append(CollectiveEvent(
+                            "act", (v, v + 1, i), ("act", s, dst)))
+                elif kind == "b":
+                    if v < last and stage_of(v + 1) != s:
+                        src = stage_of(v + 1)
+                        events[s].append(CollectiveEvent(
+                            "grad", (v + 1, v, i), ("grad", src, s)))
+                    if v > 0 and stage_of(v - 1) != s:
+                        dst = stage_of(v - 1)
+                        events[s].append(CollectiveEvent(
+                            "grad", (v, v - 1, i), ("grad", s, dst)))
+                # "w" (deferred weight grad) has no cross-stage traffic
+        return events
+
+    def _dispatch(self, orders, execute=None):
+        """THE dependency dispatcher: walk the per-stage op lists,
+        running each op once its dependencies are done.  With
+        `execute` it is train_batch's runtime loop; with execute=None
+        it is the static dry run — one driver, so the checker can
+        never validate a different dispatcher than the one that runs.
+        Returns the stuck ops ([] == the schedule drains)."""
+        done = set()
+        idx = [0] * self.pp
+        while any(idx[s] < len(orders[s]) for s in range(self.pp)):
+            progress = False
+            for s in range(self.pp):
+                while idx[s] < len(orders[s]):
+                    kind, v, i = orders[s][idx[s]]
+                    if not self._ready(kind, v, i, done):
+                        break
+                    if execute is not None:
+                        execute(kind, v, i)
+                    done.add((kind, v, i))
+                    idx[s] += 1
+                    progress = True
+            if not progress:
+                return [(s,) + tuple(orders[s][idx[s]])
+                        for s in range(self.pp)
+                        if idx[s] < len(orders[s])]
+        return []
+
+    def simulate_schedule(self, orders):
+        """Dry-run the dependency dispatcher over `orders` WITHOUT
+        executing device work: the same stall train_batch would hit at
+        runtime, caught before any compute."""
+        return self._dispatch(orders)
+
+    def verify_schedule(self, num_micro, schedule="1F1B", orders=None):
+        """Statically prove the schedule deadlock-free: (1) every
+        directed cross-stage channel carries its transfers in the SAME
+        order on sender and receiver (check_collective_order — the
+        NCCL-hang-equivalent property: a rendezvous backend blocks on
+        the first divergent transfer), and (2) the dependency
+        dispatcher drains (no stuck ops).  Raises
+        CollectiveOrderError with the divergence/stall, else returns
+        self."""
+        from ..analysis.base import Finding, CollectiveOrderError
+        from ..analysis.collectives import check_collective_order
+        orders = orders if orders is not None \
+            else self._orders(num_micro, schedule)
+        findings = check_collective_order(
+            self.collective_events(num_micro, schedule, orders=orders))
+        stuck = self.simulate_schedule(orders)
+        if stuck:
+            findings.append(Finding(
+                "schedule-stall",
+                f"dependency dispatcher cannot drain the schedule: "
+                f"stuck at {stuck} (each is (stage, kind, chunk, "
+                f"micro) whose dependencies never complete)",
+                detail=stuck))
+        if findings:
+            raise CollectiveOrderError(
+                findings,
+                title=f"pipeline schedule '{schedule}' "
+                      f"(m={num_micro}) fails the static collective-"
+                      f"order check")
+        return self
 
     # -- dependency + execution -------------------------------------------
     def _ready(self, kind, v, i, done):

@@ -538,13 +538,59 @@ class ShardedTrainStep:
         shardings) are still visible as @Sharding custom calls."""
         if self._pipeline is not None:
             return self._pipeline.compiled_hlo(*batch, optimized=optimized)
-        param_vals, buf_vals, batch_vals = self._prepare(batch)
-        lowered = self._compiled.lower(
-            param_vals, self._states_for_call(), buf_vals,
-            jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.int32),
-            jax.random.key(0), batch_vals)
+        args = self._trace_args(batch)   # builds self._compiled lazily
+        lowered = self._compiled.lower(*args)
         return lowered.compile().as_text() if optimized \
             else lowered.as_text()
+
+    def _trace_args(self, batch):
+        """The one argument tuple every analysis entry point traces
+        with (compiled_hlo / collective_schedule / lint) — a signature
+        change to the step lands in all of them at once."""
+        param_vals, buf_vals, batch_vals = self._prepare(batch)
+        return (param_vals, self._states_for_call(), buf_vals,
+                jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.int32),
+                jax.random.key(0), batch_vals)
+
+    def collective_schedule(self, *batch):
+        """Ordered collective-event sequence of the traced train step
+        (analysis.collectives) — psum/ppermute/all_gather/
+        reduce_scatter eqns in program order.  SPMD traces one program
+        for the whole mesh, so every rank shares this schedule; pass
+        `{rank: step.collective_schedule(*batch) for rank in ...}` to
+        `check_collective_order` when composing with per-rank host
+        logic (the PipelineEngine builds its own per-stage lists)."""
+        if self._pipeline is not None:
+            return self._pipeline.collective_schedule(*batch)
+        from ..analysis.collectives import collective_schedule
+        args = self._trace_args(batch)
+        with self.mesh:
+            return collective_schedule(self._compiled, *args)
+
+    def lint(self, *batch, dtype: bool = False,
+             transfers: Optional[bool] = None, donation: bool = True):
+        """Run the analysis lints over the traced+lowered train step.
+        Returns {category: [Finding, ...]}.
+
+        transfers: device_put eqns inside the step — a silent per-step
+          copy.  Default (None) = on for plain steps, off when offload
+          streaming is the design; pass an explicit bool to override
+          (True audits the streaming structure itself).  donation:
+          donated buffers the lowered module did not alias.  dtype:
+          off by default — AMP loss upcasts are intentional fp32; turn
+          on to audit a step that should be uniformly low-precision."""
+        if self._pipeline is not None:
+            kw = {"dtype": dtype, "donation": donation}
+            if transfers is not None:    # explicit override passes down
+                kw["transfers"] = transfers
+            return self._pipeline.lint(*batch, **kw)
+        from ..analysis.lints import lint_compiled_step
+        if transfers is None:
+            transfers = not (self.offload or self.offload_params)
+        args = self._trace_args(batch)
+        return lint_compiled_step(
+            self._compiled, args, mesh=self.mesh, dtype=dtype,
+            transfers=transfers, donation=donation and self._donate)
 
     def _prepare(self, batch):
         """Shared prologue of __call__ and compiled_hlo: gather current

@@ -59,6 +59,7 @@ import jax.numpy as jnp
 from ..framework.tensor import Tensor
 from ..framework import dtypes
 from ..framework import dispatch as _dispatch
+from ..framework.flags import get_flag as _get_flag
 from .state import enable_static, disable_static, in_dynamic_mode, \
     in_static_mode
 from . import program as _prog_mod
@@ -438,7 +439,17 @@ class Program:
     def _leaf_value(self, vid):
         ref, snapshot = self.leaves[vid]
         t = ref() if ref is not None else None
-        return t._value if t is not None else snapshot
+        if t is not None:
+            return t._value
+        if snapshot is None:
+            # dangling leaf: verifier finding "dangling-leaf" — raise
+            # here rather than feeding None into the replayed op
+            raise KeyError(
+                f"static replay: leaf var {vid} is dangling (object "
+                f"released and no build-time snapshot); "
+                f"FLAGS_check_program / verify_program flags this "
+                f"before replay")
+        return snapshot
 
     def execute(self, feed: Dict[str, Any], fetch_vids: List[int]):
         """Replay the tape: feeds -> fetch arrays (jitted + cached)."""
@@ -497,11 +508,19 @@ class Program:
             op_slice = list(ops)
             f_vids = [ph_vids[n] for n in feed_names]
             l_vids = list(leaf_vids)
+            # vid -> name, for replay error messages only (built on the
+            # compile path — cache hits never pay for it)
+            rev_names = {vid: n for n, vid in self.var_names.items()}
+            for n, ph in self.placeholders.items():
+                v = getattr(ph, "_static_vid", None)
+                if v is not None:
+                    rev_names.setdefault(v, n)
 
             def run_tape(feeds, leaves):
                 env = dict(zip(f_vids, feeds))
                 env.update(zip(l_vids, leaves))
-                return replay(op_slice, env, fetch_vids)
+                return replay(op_slice, env, fetch_vids,
+                              var_names=rev_names)
 
             fn = jax.jit(run_tape)
             self._exec_cache[key] = fn
@@ -715,6 +734,15 @@ class Executor:
         feed = feed or {}
         if not isinstance(program, Program):
             return []
+        # FLAGS_check_program: verify the tape before replay (the
+        # MLIR-style --verify-each entry point).  Off by default — the
+        # hot path pays exactly this one dict lookup.
+        if _get_flag("check_program"):
+            from ..analysis.verifier import check_program
+            check_program(
+                program,
+                title="Executor.run: FLAGS_check_program verification "
+                      "failed")
         if not program.ops or not fetch_list:
             # startup / legacy path: bind feeds eagerly, return live values
             for name, value in feed.items():

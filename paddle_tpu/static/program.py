@@ -66,8 +66,15 @@ def push_program(prog):
 
 
 def pop_program(prog):
-    if _recording and _recording[-1] is prog:
-        _recording.pop()
+    if not _recording or _recording[-1] is not prog:
+        top = _recording[-1] if _recording else None
+        raise RuntimeError(
+            f"pop_program: unbalanced program guards — asked to pop "
+            f"{prog!r} but the innermost recording program is "
+            f"{top!r}.  program_guard blocks must nest strictly "
+            f"(a silent no-op here would leave the stack recording "
+            f"every later op onto the wrong Program)")
+    _recording.pop()
 
 
 def current_program():
@@ -205,21 +212,61 @@ def needed_ops(ops: Sequence[OpDesc], target_vids, stop_vids=frozenset()):
     return need
 
 
+def _describe_missing_var(ops, missing, op, target_vids, var_names):
+    """Error text for a replay miss: names the missing var, the op that
+    needed it, the consumer chain down to the fetch target it feeds,
+    and which fetch target that is (vids mapped through `var_names`
+    when the caller knows them)."""
+    names = var_names or {}
+
+    def _v(v):
+        n = names.get(v)
+        return f"var {v} ({n!r})" if n else f"var {v}"
+
+    msg = (f"static replay: {_v(missing)} needed by op '{op.type}' has "
+           f"no value (not a feed, leaf, or earlier op output — "
+           f"missing feed, or a pass removed/reordered its producer?)")
+    tset = set(target_vids)
+    if missing in tset:
+        return msg + f"; it IS fetch target {_v(missing)}"
+    # walk consumers from the missing var toward a fetch target
+    chain, frontier, hit = [], {missing}, None
+    for o in ops:
+        if frontier & set(o.in_vids):
+            chain.append(o.type)
+            frontier.update(o.out_vids)
+            hit = next((v for v in o.out_vids if v in tset), None)
+            if hit is not None:
+                break
+    if chain:
+        msg += ("; it feeds "
+                + " -> ".join(chain)
+                + (f" -> fetch target {_v(hit)}" if hit is not None
+                   else ""))
+    return msg
+
+
 def replay(ops: Sequence[OpDesc], env: Dict[int, jax.Array],
-           target_vids) -> List[jax.Array]:
-    """Execute the (pruned) tape over `env` (vid -> array)."""
+           target_vids, var_names: Optional[Dict[int, str]] = None
+           ) -> List[jax.Array]:
+    """Execute the (pruned) tape over `env` (vid -> array).
+    var_names: optional vid -> name map used only for error messages."""
     for op in ops:
         ins = []
         for v in op.in_vids:
             if v not in env:
-                raise KeyError(
-                    f"static replay: var {v} needed by op "
-                    f"'{op.type}' has no value — missing feed?")
+                raise KeyError(_describe_missing_var(
+                    ops, v, op, target_vids, var_names))
             ins.append(env[v])
         out = op.fn(*ins)
         outs = (out,) if not isinstance(out, (tuple, list)) else tuple(out)
         for vid, o in zip(op.out_vids, outs):
             env[vid] = o
+    for v in target_vids:
+        if v not in env:
+            raise KeyError(_describe_missing_var(
+                ops, v, OpDesc("<fetch>", None, (), ()), target_vids,
+                var_names))
     return [env[v] for v in target_vids]
 
 
@@ -289,7 +336,13 @@ def _constant_fold_pass(program, targets=None):
 
 
 def apply_pass(program, name: str, targets=None):
-    """Run a registered tape pass over `program` in place."""
+    """Run a registered tape pass over `program` in place.
+
+    Every pass must leave the tape verifiable (the PIR
+    `Operation::Verify` contract): the structural verifier runs
+    unconditionally after the rewrite, so a buggy pass fails HERE with
+    named findings instead of shipping a tape that replays wrong or
+    KeyErrors at Executor.run."""
     try:
         fn = REGISTERED_PASSES[name]
     except KeyError:
@@ -298,4 +351,7 @@ def apply_pass(program, name: str, targets=None):
             f"{sorted(REGISTERED_PASSES)}") from None
     out = fn(program, targets=targets)
     bump_version(program)
+    from ..analysis.verifier import check_program
+    check_program(out if out is not None else program,
+                  title=f"pass '{name}' left the tape unverifiable")
     return out

@@ -135,7 +135,11 @@ def test_chunked_admission_overlaps_decode(model):
 def test_admission_no_recompile_per_prompt_length(model):
     """Prompt length never reaches a program shape: a workload of many
     DISTINCT lengths runs through exactly two compiled scans (the C=1
-    decode program + the C=prefill_chunk admission program)."""
+    decode program + the C=prefill_chunk admission program).  The
+    budget is enforced by analysis.recompile_guard — on violation it
+    raises with the offending avals instead of a bare count — which
+    also records the model-level program-cache misses."""
+    from paddle_tpu.analysis import recompile_guard
     bat = ContinuousBatcher(model, max_batch_size=2, max_len=64,
                             chunk=4, prefill_chunk=4)
     rng = np.random.RandomState(13)
@@ -143,18 +147,21 @@ def test_admission_no_recompile_per_prompt_length(model):
     for L in (3, 5, 7, 9, 11, 14, 17, 21):   # 8 distinct lengths
         ids.append(bat.submit(rng.randint(1, 128, L).astype(np.int32),
                               4))
-    outs = bat.run()
+    with recompile_guard(max_programs=2, match="serve_step") as g:
+        outs = bat.run()
     assert sorted(outs) == sorted(ids)
     assert bat.compiled_programs == 2
+    assert len([k for k in g.cache_builds
+                if isinstance(k, tuple) and k
+                and k[0] == "serve_step"]) <= 2
     # and the programs live on the MODEL: a second batcher of the same
-    # shape reuses them instead of compiling its own
-    store = model.__dict__.get("_gen_compiled", {})
-    serve_keys = [k for k in store if isinstance(k, tuple)
-                  and k and k[0] == "serve_step"]
+    # shape reuses them — ZERO compiles and ZERO cache misses allowed
     bat2 = ContinuousBatcher(model, max_batch_size=2, max_len=64,
                              chunk=4, prefill_chunk=4)
     bat2.submit(rng.randint(1, 128, 6).astype(np.int32), 4)
-    bat2.run()
-    serve_keys2 = [k for k in store if isinstance(k, tuple)
-                   and k and k[0] == "serve_step"]
-    assert len(serve_keys2) == len(serve_keys)
+    with recompile_guard(max_programs=0, match="serve_step") as g2:
+        bat2.run()
+    assert g2.count == 0
+    assert [k for k in g2.cache_builds
+            if isinstance(k, tuple) and k
+            and k[0] == "serve_step"] == []

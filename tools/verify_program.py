@@ -1,0 +1,227 @@
+"""Program verifier / lint CLI — the command-line face of
+paddle_tpu.analysis (JSON output + non-zero exit on findings, like
+tools/op_audit.py).
+
+Two modes:
+
+  python tools/verify_program.py pkg.module:factory [--level full]
+      Import `factory`, call it, verify every Program it returns (a
+      single Program, a (main, startup) tuple, or any iterable of
+      Programs).  Exit 1 if ANY finding.
+
+  python tools/verify_program.py --selftest
+      CI canary: builds one verifier-clean program plus a planted
+      defect per verifier/lint check (use-before-def, SSA double-def,
+      leaf overwrite, dangling leaf, bad name table, fp32 upcast,
+      in-step transfer, unaliased donation, misordered cross-rank
+      collective schedule) and asserts each is CAUGHT and the clean
+      program stays clean.  Exit 1 if any check failed to fire — a
+      silently broken verifier is exactly the failure mode this guards.
+
+  --json     one machine-readable JSON document on stdout
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_programs(target: str):
+    mod_name, _, attr = target.partition(":")
+    if not attr:
+        raise SystemExit(f"target must be 'module:callable', got "
+                         f"{target!r}")
+    sys.path.insert(0, os.getcwd())
+    obj = getattr(importlib.import_module(mod_name), attr)
+    result = obj() if callable(obj) else obj
+    from paddle_tpu.static import Program
+    if isinstance(result, Program):
+        return [("program", result)]
+    out = []
+    for i, p in enumerate(result):
+        if isinstance(p, Program):
+            out.append((f"program[{i}]", p))
+    if not out:
+        raise SystemExit(f"{target} produced no static Programs")
+    return out
+
+
+def _verify_targets(target: str, level: str):
+    from paddle_tpu.analysis import verify_program
+    report = []
+    for name, prog in _load_programs(target):
+        findings = verify_program(prog, level=level)
+        report.append({
+            "program": name,
+            "ops": len(prog.ops),
+            "findings": [f.to_dict() for f in findings],
+        })
+    return report
+
+
+# ---------------------------------------------------------------------------
+# selftest: one planted defect per check
+
+def _clean_program():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    static.enable_static()
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 4], "float32")
+        w = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 3).astype("float32"))
+        y = paddle.matmul(x, w)
+        (y * y).mean()
+    static.disable_static()
+    return main
+
+
+def _selftest():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.analysis import (
+        verify_program, lint_dtype_promotion, lint_transfers,
+        lint_donation, check_collective_order, CollectiveEvent)
+    from paddle_tpu.static.program import OpDesc
+
+    checks = []
+
+    def expect(name, findings, code):
+        hit = any(f.code == code for f in findings)
+        checks.append({"check": name, "expected": code, "caught": hit,
+                       "findings": [f.to_dict() for f in findings]})
+
+    def _defective():
+        # deliberately broken below — opt out of the test suite's
+        # autouse verify-every-Program fixture (conftest.py)
+        p = _clean_program()
+        p._no_autoverify = True
+        return p
+
+    clean = _clean_program()
+    base = verify_program(clean, level="full")
+    checks.append({"check": "clean-program", "expected": None,
+                   "caught": not base,
+                   "findings": [f.to_dict() for f in base]})
+
+    # use-before-def: reverse the tape
+    p = _defective()
+    p.ops = list(reversed(p.ops))
+    expect("reversed-tape", verify_program(p), "use-before-def")
+
+    # SSA double definition
+    p = _defective()
+    dup = p.ops[-1]
+    p.ops.append(OpDesc(dup.type, dup.fn, dup.in_vids, dup.out_vids))
+    expect("double-def", verify_program(p), "ssa-double-def")
+
+    # leaf overwrite (in-place retag protocol violation) — planted on
+    # the LAST op, whose inputs never include the first op's weight
+    # leaf (writing a vid the op also READS fires inplace-self-alias
+    # instead, a different hazard)
+    p = _defective()
+    op = p.ops[-1]
+    leaf_vid = next(v for v in p.leaves if v not in op.in_vids)
+    p.ops[-1] = OpDesc(op.type, op.fn, op.in_vids, (leaf_vid,))
+    expect("leaf-overwrite", verify_program(p), "leaf-overwrite")
+
+    # dangling leaf
+    p = _defective()
+    p.leaves[next(iter(p.leaves))] = (None, None)
+    expect("dangling-leaf", verify_program(p), "dangling-leaf")
+
+    # name table pointing nowhere
+    p = _defective()
+    p.var_names["ghost"] = 10 ** 9
+    expect("ghost-name", verify_program(p), "unknown-named-var")
+
+    # arity mismatch (full level)
+    p = _defective()
+    op = p.ops[0]
+    p.ops[0] = OpDesc(op.type, op.fn, op.in_vids,
+                      tuple(op.out_vids) + (10 ** 9 + 1,))
+    expect("arity", verify_program(p, level="full"), "arity-mismatch")
+
+    # lints
+    expect("fp32-upcast",
+           lint_dtype_promotion(lambda x: x * np.float32(2.0),
+                                jnp.ones((4,), jnp.bfloat16)),
+           "fp32-upcast")
+    expect("in-step-transfer",
+           lint_transfers(lambda x: jax.device_put(
+               x, jax.devices()[0]) + 1, jnp.ones((2,), jnp.float32)),
+           "in-step-transfer")
+    expect("donation-unaliased",
+           lint_donation(lambda x, y: (y.sum(),),
+                         jnp.ones((4,), jnp.float32),
+                         jnp.ones((3,), jnp.float32),
+                         donate_argnums=(0,)),
+           "donation-unaliased")
+
+    # cross-rank collective misorder
+    a = [CollectiveEvent("psum", ("g", 1), ("dp",)),
+         CollectiveEvent("all_gather", ("g", 2), ("dp",))]
+    expect("collective-misorder",
+           check_collective_order({0: a, 1: list(reversed(a))}),
+           "collective-order-divergence")
+
+    return checks
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="verify static Program tapes / self-check the "
+                    "analysis subsystem")
+    ap.add_argument("target", nargs="?",
+                    help="module:callable returning Program(s)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="plant one defect per check; exit 1 unless "
+                         "every one is caught")
+    ap.add_argument("--level", default="full",
+                    choices=("structural", "full"))
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        checks = _selftest()
+        bad = [c for c in checks if not c["caught"]]
+        if args.json:
+            print(json.dumps({"mode": "selftest", "checks": checks,
+                              "failed": len(bad)}, indent=1))
+        else:
+            for c in checks:
+                mark = "ok  " if c["caught"] else "FAIL"
+                want = c["expected"] or "no findings"
+                print(f"  {mark} {c['check']:<22} ({want})")
+            print(f"selftest: {len(checks) - len(bad)}/{len(checks)} "
+                  f"checks fired")
+        return 1 if bad else 0
+
+    if not args.target:
+        ap.error("provide a module:callable target or --selftest")
+    report = _verify_targets(args.target, args.level)
+    n = sum(len(r["findings"]) for r in report)
+    if args.json:
+        print(json.dumps({"mode": "verify", "programs": report,
+                          "findings": n}, indent=1))
+    else:
+        for r in report:
+            print(f"{r['program']}: {r['ops']} ops, "
+                  f"{len(r['findings'])} finding(s)")
+            for f in r["findings"]:
+                loc = f" @op[{f['op_index']}]" if "op_index" in f else ""
+                print(f"  [{f['code']}]{loc} {f['message']}")
+    return 1 if n else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
