@@ -673,8 +673,54 @@ def _assert_analysis_zero_overhead():
         static.disable_static()
 
 
+def _assert_fault_tolerance_zero_overhead():
+    """FLAGS off ⇒ the fault-tolerant runtime costs the step path
+    nothing: no guard ops compiled into the train step (no is_finite /
+    old-vs-new selects), no checkpoint IO, and the fault registry never
+    counts a hit (its unset fast path is one cached string compare).
+    Cheap (tiny MLP), runs before every bench config."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fault
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.distributed.topology import build_mesh
+    from paddle_tpu.parallel import ShardedTrainStep
+
+    assert not fault.is_active(), \
+        "FLAGS_fault_injection armed during a bench run"
+
+    class _MLP(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(8, 8)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    paddle.seed(0)
+    m = _MLP()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    step = ShardedTrainStep(
+        m, opt, build_mesh(devices=jax.devices()[:1]),
+        loss_fn=lambda o, y: paddle.nn.functional.mse_loss(o, y))
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    y = paddle.to_tensor(np.ones((4, 8), np.float32))
+    hlo = step.compiled_hlo(x, y, optimized=False)
+    assert "is_finite" not in hlo and "is-finite" not in hlo, \
+        "guard ops compiled into the flags-off train step"
+    writes, hits = ckpt.WRITE_CALLS, fault.hit_counts()
+    for _ in range(2):
+        step(x, y)
+    assert ckpt.WRITE_CALLS == writes, \
+        "flags-off train steps performed checkpoint IO"
+    assert fault.hit_counts() == hits, \
+        "flags-off train steps consulted the fault registry"
+
+
 def main():
     _assert_analysis_zero_overhead()
+    _assert_fault_tolerance_zero_overhead()
     which = os.environ.get("BENCH_CONFIG", "all").lower()
     if "--only" in sys.argv:
         i = sys.argv.index("--only")

@@ -209,6 +209,19 @@ class GradScaler:
                 self._good_steps = 0
         self._found_inf = False
 
+    def backoff(self):
+        """Nonfinite-step loss-scale backoff — the StepAnomalyGuard
+        hook (distributed/guard.py).  One call = one bad step observed
+        by the compiled skip-step path: the scale decreases by
+        decr_ratio (floored at 1.0) so the NEXT step's scaled loss has
+        headroom, and the good-step streak resets.  A no-op for the
+        bf16 default (scale already 1.0)."""
+        if not self._enable:
+            return
+        self._scale = max(self._scale * self._decr_ratio, 1.0)
+        self._good_steps = 0
+        self._bad_steps = 0
+
     def is_enable(self):
         return self._enable
 

@@ -1,0 +1,146 @@
+"""Runtime step guards: nonfinite skip-step budget + SIGTERM drain.
+
+Reference failure taxonomy (MegaScale §5 / OPT logbook): loss spikes
+and NaN steps are routine at scale — production loops skip the bad
+step (keeping params/optimizer untouched), back the AMP loss scale
+off, and only abort after a bounded run of consecutive bad steps; and
+preemption arrives as SIGTERM with a grace window — the loop finishes
+the in-flight step, writes an emergency checkpoint and exits
+``ELASTIC_EXIT_CODE`` so the gang relaunch auto-resumes from it.
+
+Two pieces live here:
+
+* :class:`StepAnomalyGuard` — the HOST half of the skip-step path.
+  The compiled half (trainers select old-vs-new params on a
+  ``isfinite(loss) & isfinite(grad_norm²)`` predicate) only exists
+  when ``FLAGS_skip_nonfinite_steps`` is on — flags off, the compiled
+  step is bit-identical to the unguarded one (bench-asserted).  The
+  guard tracks consecutive nonfinite losses, calls the attached
+  ``GradScaler.backoff()`` per bad step, and raises with a diagnostic
+  report once ``FLAGS_max_consecutive_bad_steps`` is exhausted.
+
+* :func:`install_sigterm_drain` / :func:`drain_requested` — the
+  train-loop half of the preemption protocol.  The launch controller
+  forwards SIGTERM to its children and waits; the loop polls
+  ``drain_requested()`` at step boundaries and runs its emergency
+  checkpoint + ``sys.exit(ELASTIC_EXIT_CODE)`` epilogue.
+"""
+from __future__ import annotations
+
+import math
+import signal
+import threading
+from typing import Optional
+
+from ..framework.flags import get_flag  # the two guard flags live in
+# framework/flags.py (core set): FLAGS_skip_nonfinite_steps,
+# FLAGS_max_consecutive_bad_steps
+
+__all__ = ["StepAnomalyGuard", "BadStepBudgetExceeded",
+           "install_sigterm_drain", "drain_requested", "clear_drain"]
+
+
+class BadStepBudgetExceeded(RuntimeError):
+    """Raised by StepAnomalyGuard when the consecutive-bad-step budget
+    is exhausted; carries a diagnostic report."""
+
+
+class StepAnomalyGuard:
+    """Consecutive nonfinite-step budget with AMP loss-scale backoff.
+
+        guard = StepAnomalyGuard(scaler=scaler, name="sharded step")
+        loss = step(batch)
+        guard.record(float(loss), step=opt._step_count)
+
+    `record` returns True when the step was bad (the compiled guard
+    already refused its update); after `budget` consecutive bad steps
+    it raises BadStepBudgetExceeded with the recent loss history."""
+
+    def __init__(self, budget: Optional[int] = None, scaler=None,
+                 name: str = "train step"):
+        self.budget = int(budget if budget is not None
+                          else get_flag("max_consecutive_bad_steps") or 8)
+        self.scaler = scaler
+        self.name = name
+        self.consecutive_bad = 0
+        self.total_bad = 0
+        self.total_steps = 0
+        self._recent = []           # (step, loss) of recent bad steps
+
+    def record(self, loss: float, step: Optional[int] = None) -> bool:
+        self.total_steps += 1
+        bad = not math.isfinite(loss)
+        if not bad:
+            self.consecutive_bad = 0
+            return False
+        self.consecutive_bad += 1
+        self.total_bad += 1
+        self._recent.append((step, float(loss)))
+        self._recent = self._recent[-16:]
+        if self.scaler is not None and hasattr(self.scaler, "backoff"):
+            self.scaler.backoff()
+        if self.consecutive_bad >= self.budget:
+            raise BadStepBudgetExceeded(self.report())
+        return True
+
+    def report(self) -> str:
+        scale = None
+        if self.scaler is not None:
+            scale = getattr(self.scaler, "_scale", None)
+        return (
+            f"[anomaly-guard] {self.name}: {self.consecutive_bad} "
+            f"consecutive nonfinite steps (budget "
+            f"{self.budget}; {self.total_bad}/{self.total_steps} bad "
+            f"total) — persistent divergence, aborting.\n"
+            f"  recent bad steps (step, loss): {self._recent}\n"
+            f"  loss scale: {scale}\n"
+            "  Skipped steps left params and optimizer state untouched; "
+            "resume from the last checkpoint with a lower LR or loss "
+            "scale.")
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM drain protocol (train-loop side)
+# ---------------------------------------------------------------------------
+_drain = threading.Event()
+_prev_handler = None
+_installed = False
+
+
+def _on_sigterm(signum, frame):
+    _drain.set()
+    # chain a previously installed python-level handler (e.g. a user's
+    # own logger) — but never re-raise the default action, the whole
+    # point is to NOT die mid-step
+    if callable(_prev_handler):
+        try:
+            _prev_handler(signum, frame)
+        except Exception:
+            pass
+
+
+def install_sigterm_drain() -> bool:
+    """Install the SIGTERM → drain-flag handler (idempotent).  Returns
+    False when not on the main thread (signal.signal would raise) —
+    callers treat that as 'no drain protocol available'."""
+    global _prev_handler, _installed
+    if _installed:
+        return True
+    try:
+        prev = signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:          # not the main thread
+        return False
+    if prev not in (signal.SIG_DFL, signal.SIG_IGN, None):
+        _prev_handler = prev
+    _installed = True
+    return True
+
+
+def drain_requested() -> bool:
+    """True once SIGTERM arrived — finish the in-flight step, write an
+    emergency checkpoint, exit ELASTIC_EXIT_CODE."""
+    return _drain.is_set()
+
+
+def clear_drain():
+    _drain.clear()

@@ -42,6 +42,10 @@ ELASTIC_SETTLE = 2.0   # absorb late joiners up to nnodes_max for this long
 # asks the launcher to re-form the gang instead of counting a failure
 ELASTIC_EXIT_CODE = 101
 SCALE_CHECK_INTERVAL = 5.0
+# SIGTERM drain window: how long children get to finish the in-flight
+# step and write their emergency checkpoint before being terminated
+# (preemption notices are typically 30-120s; tests tighten via env)
+DRAIN_GRACE = float(os.environ.get("PADDLE_DRAIN_GRACE", "60"))
 
 
 class ProcEntry:
@@ -61,6 +65,14 @@ class ProcEntry:
 
     def poll(self):
         return self.proc.poll() if self.proc else None
+
+    def signal(self, sig):
+        """Forward a signal without waiting (the SIGTERM drain path)."""
+        if self.proc is not None and self.proc.poll() is None:
+            try:
+                self.proc.send_signal(sig)
+            except OSError:
+                pass
 
     def terminate(self, grace=3.0):
         if self.proc is not None and self.proc.poll() is None:
@@ -104,6 +116,7 @@ class CollectiveController:
         self.kv = None             # KVClient if multi-node
         self._hb_stop = threading.Event()
         self._hb_thread = None
+        self._drain_deadline = None   # set when SIGTERM starts a drain
         os.makedirs(args.log_dir, exist_ok=True)
 
     # ---------------- rendezvous ----------------
@@ -451,7 +464,19 @@ class CollectiveController:
     # ---------------- heartbeat / elastic ----------------
 
     def _heartbeat_loop(self):
+        from .. import fault
         while not self._hb_stop.wait(HEARTBEAT_INTERVAL):
+            # injection point: mode=skip drops beats (a stalled
+            # launcher) so lease-lapse recovery is testable without
+            # SIGKILLing a process; mode=error is swallowed like any
+            # heartbeat hiccup (the lease TTL absorbs it)
+            try:
+                if fault.is_active():
+                    f = fault.hit("launch.heartbeat", key=self.pod_id)
+                    if f is not None and f.mode == "skip":
+                        continue
+            except fault.FaultError:
+                continue
             # stamped with the MASTER's clock so freshness comparisons are
             # immune to cross-host skew
             self.kv.stamp(f"{self.job_id}/heartbeat/{self.pod_id}")
@@ -498,6 +523,11 @@ class CollectiveController:
         while True:
             time.sleep(0.5)
             codes = [p.poll() for p in self.procs]
+            if self._drain_deadline is not None:
+                rc = self._watch_drain(codes)
+                if rc is not None:
+                    return rc
+                continue
             if all(c == 0 for c in codes):
                 return 0
             bad = [c for c in codes if c not in (None, 0)]
@@ -559,6 +589,47 @@ class CollectiveController:
                                 f"new pod(s) joined: {sorted(extra)}"):
                             return 1
 
+    # ---------------- SIGTERM drain ----------------
+
+    def begin_drain(self):
+        """Preemption notice: forward SIGTERM to the children so they
+        finish the in-flight step and write an emergency checkpoint
+        (guard.install_sigterm_drain on the train side), then exit
+        ELASTIC_EXIT_CODE.  The watch loop supervises the grace window;
+        heartbeats keep flowing so peers don't reap this pod early."""
+        if self._drain_deadline is not None:
+            return
+        self._drain_deadline = time.time() + DRAIN_GRACE
+        print(f"[launch] SIGTERM: draining {len(self.procs)} worker(s), "
+              f"grace {DRAIN_GRACE:.0f}s", file=sys.stderr)
+        for p in self.procs:
+            p.signal(signal.SIGTERM)
+
+    def _watch_drain(self, codes):
+        """One watch-loop tick during a drain.  Returns the controller
+        exit code once settled, else None.  No relaunch/re-form happens
+        here — the node is going away; the surviving gang re-forms
+        around the lease lapse after exit."""
+        if any(c is None for c in codes):
+            if time.time() <= self._drain_deadline:
+                return None
+            print("[launch] drain grace expired; terminating workers",
+                  file=sys.stderr)
+            for p in self.procs:
+                p.terminate()
+            return 128 + signal.SIGTERM
+        # every child exited within the grace window: a child that
+        # drained via the protocol exits ELASTIC_EXIT_CODE (its
+        # emergency checkpoint is committed) — propagate it so the
+        # supervisor relaunches this pod and training auto-resumes
+        if any(c == ELASTIC_EXIT_CODE for c in codes) \
+                and all(c in (0, ELASTIC_EXIT_CODE) for c in codes):
+            print("[launch] drain complete: workers checkpointed "
+                  f"(exit {ELASTIC_EXIT_CODE})", file=sys.stderr)
+            return ELASTIC_EXIT_CODE
+        bad = [c for c in codes if c != 0]
+        return 0 if not bad else (128 - bad[0] if bad[0] < 0 else bad[0])
+
     def stop(self):
         self._hb_stop.set()
         for p in self.procs:
@@ -584,8 +655,20 @@ class CollectiveController:
         def _sig(signum, frame):
             self.stop()
             sys.exit(128 + signum)
+
+        def _sigterm(signum, frame):
+            # preemption protocol: first SIGTERM starts the drain
+            # (children finish the in-flight step + emergency
+            # checkpoint, watch() propagates ELASTIC_EXIT_CODE); a
+            # second SIGTERM — or one before any child runs — keeps the
+            # old immediate-exit behavior
+            if self._drain_deadline is None and any(
+                    p.poll() is None for p in self.procs):
+                self.begin_drain()
+                return
+            _sig(signum, frame)
         try:
-            signal.signal(signal.SIGTERM, _sig)
+            signal.signal(signal.SIGTERM, _sigterm)
             signal.signal(signal.SIGINT, _sig)
         except ValueError:
             pass  # not main thread (tests)

@@ -116,23 +116,47 @@ class KVServer:
 
 
 class KVClient:
-    """Client side of the rendezvous store."""
+    """Client side of the rendezvous store.
+
+    Transient connection errors (a master restarting, a dropped TCP
+    handshake, an injected `kv.request` fault) are retried with bounded
+    exponential backoff instead of failing the pod on the first blip —
+    a heartbeat or rendezvous PUT that dies to one connection reset
+    would otherwise tear a healthy gang down.  After the attempts are
+    exhausted the old contract holds: (0, b"") — callers' own
+    deadline/poll loops decide what unreachable means."""
+
+    #: attempts per request; env-tunable (PADDLE_KV_RETRIES) so
+    #: latency-sensitive poll loops can tighten it
+    RETRIES = None          # resolved lazily from the env, default 3
+    BACKOFF = 0.05          # base seconds, doubles per attempt
 
     def __init__(self, endpoint: str):
         if not endpoint.startswith("http"):
             endpoint = "http://" + endpoint
         self.endpoint = endpoint.rstrip("/")
 
-    def _req(self, method, path, body=None, timeout=5):
+    def _req(self, method, path, body=None, timeout=5, attempts=None):
+        import os as _os
+        from .. import fault
+        if attempts is None:
+            attempts = self.RETRIES if self.RETRIES is not None \
+                else int(_os.environ.get("PADDLE_KV_RETRIES", "3"))
+        attempts = max(1, int(attempts))
         req = urllib.request.Request(
             f"{self.endpoint}/{path}", data=body, method=method)
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as r:
-                return r.status, r.read()
-        except urllib.error.HTTPError as e:
-            return e.code, b""
-        except (urllib.error.URLError, ConnectionError, OSError):
-            return 0, b""
+        for i in range(attempts):
+            try:
+                fault.hit("kv.request", key=path)  # mode=error raises
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return r.status, r.read()
+            except urllib.error.HTTPError as e:
+                return e.code, b""
+            except (urllib.error.URLError, ConnectionError, OSError):
+                if i == attempts - 1:
+                    return 0, b""
+                time.sleep(self.BACKOFF * (2 ** i))
+        return 0, b""
 
     def put(self, key: str, value: str) -> bool:
         code, _ = self._req("PUT", f"kv/{key}", value.encode())
@@ -178,5 +202,7 @@ class KVClient:
             f"'{prefix}/', have {len(self.prefix(prefix))}")
 
     def alive(self) -> bool:
-        code, _ = self._req("GET", "kv/__ping__")
+        # single attempt: alive() is itself called from retrying poll
+        # loops — stacking backoff under them only stretches deadlines
+        code, _ = self._req("GET", "kv/__ping__", attempts=1)
         return code in (200, 404)

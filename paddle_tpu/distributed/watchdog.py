@@ -46,6 +46,8 @@ class CommTask:
         self._manager = manager
 
     def done(self):
+        # idempotent: a task finished from both an exception path and a
+        # finally block deregisters once (set.discard)
         self._manager._finish(self)
 
     def __enter__(self):
@@ -88,15 +90,25 @@ class CommTaskManager:
             else float(get_flag("stop_check_timeout") or 0)
         if t <= 0:
             return None
+        # arm the monitor BEFORE registering: if thread creation fails
+        # (interpreter shutdown, resource limits) no ghost task is left
+        # registered to age toward a spurious report/abort
+        self._ensure_thread()
         task = CommTask(name, t, self)
         with self._lock:
             self._tasks.add(task)
-        self._ensure_thread()
         return task
 
     def _finish(self, task):
         with self._lock:
             self._tasks.discard(task)
+
+    def active_tasks(self):
+        """Names of currently registered (in-flight) tasks — leak
+        introspection for tests: after a watched block exits (normally
+        OR by raising) its task must not appear here."""
+        with self._lock:
+            return [t.name for t in self._tasks]
 
     # -- monitor -----------------------------------------------------------
     def _loop(self):
@@ -107,22 +119,36 @@ class CommTaskManager:
                            if now > t.deadline and not t.reported]
             for t in expired:
                 t.reported = True
-                self._report(t, now - t.started)
+                try:
+                    self._report(t, now - t.started)
+                except Exception:
+                    # a failing report (stderr gone, handler bug) must
+                    # not kill the monitor thread — every other watched
+                    # task would silently lose its watchdog
+                    pass
 
     def _report(self, task, age):
-        report = self._build_report(task, age)
-        self.timeout_log.append((task.name, age, report))
-        sys.stderr.write(report)
-        sys.stderr.flush()
-        if self.on_timeout is not None:
-            try:
-                self.on_timeout(task, report)
-            except Exception:
-                pass
-        if get_flag("comm_watchdog_abort"):
-            faulthandler.dump_traceback()
-            import os
-            os.abort()
+        try:
+            report = self._build_report(task, age)
+            self.timeout_log.append((task.name, age, report))
+            sys.stderr.write(report)
+            sys.stderr.flush()
+            if self.on_timeout is not None:
+                try:
+                    self.on_timeout(task, report)
+                except Exception:
+                    pass
+        finally:
+            # the hard abort must fire even when emitting the report
+            # failed (stderr gone) — a hung collective staying alive
+            # because a write raised would defeat the flag entirely
+            if get_flag("comm_watchdog_abort"):
+                try:
+                    faulthandler.dump_traceback()
+                except Exception:
+                    pass
+                import os
+                os.abort()
 
     @staticmethod
     def _build_report(task, age) -> str:
@@ -160,19 +186,25 @@ class watched:
         with watched("pp train_batch"):
             engine.train_batch(...)
 
-    No-op unless FLAGS_stop_check_timeout > 0 or timeout given."""
+    No-op unless FLAGS_stop_check_timeout > 0 or timeout given.
+
+    Exception-safe: a body that raises mid-flight still deregisters its
+    task (no ghost tasks aging toward a spurious report/abort), and a
+    `watched` instance is reentrant — nested/reused entries keep a
+    stack of tasks instead of clobbering the outer one."""
 
     def __init__(self, name: str, timeout: Optional[float] = None):
         self.name = name
         self.timeout = timeout
-        self._task = None
+        self._stack = []
 
     def __enter__(self):
-        self._task = get_comm_task_manager().start_task(self.name,
-                                                        self.timeout)
+        self._stack.append(
+            get_comm_task_manager().start_task(self.name, self.timeout))
         return self
 
     def __exit__(self, *exc):
-        if self._task is not None:
-            self._task.done()
+        task = self._stack.pop() if self._stack else None
+        if task is not None:
+            task.done()
         return False

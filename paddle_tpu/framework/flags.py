@@ -96,3 +96,21 @@ define_flag("check_program", False,
 define_flag("check_collective_order", False,
             "statically verify the cross-stage collective order "
             "(deadlock detector) before pipeline train_batch")
+# fault-tolerant runtime (distributed/{fault,guard}): cross-layer
+# switches defined HERE so env pickup happens at interpreter start —
+# a relaunched worker arms FLAGS_fault_injection before any subsystem
+# imports.  All off by default: the train/replay hot paths must pay
+# nothing beyond the flag lookup (bench-asserted).
+define_flag("fault_injection", "",
+            "deterministic fault-injection spec(s), e.g. "
+            "\"ckpt.write:step=3:mode=truncate\" — see "
+            "paddle_tpu/distributed/fault.py for the grammar; empty "
+            "disables injection entirely")
+define_flag("skip_nonfinite_steps", False,
+            "compile the nonfinite-step guard into train steps: a step "
+            "whose loss or grad-norm is nonfinite leaves params and "
+            "optimizer state untouched (skip-step), bounded by "
+            "FLAGS_max_consecutive_bad_steps")
+define_flag("max_consecutive_bad_steps", 8,
+            "abort training after this many CONSECUTIVE nonfinite "
+            "steps (a persistent divergence, not a transient spike)")

@@ -11,7 +11,8 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
-           "LRScheduler", "EarlyStopping", "config_callbacks"]
+           "LRScheduler", "EarlyStopping", "FaultTolerantCheckpoint",
+           "config_callbacks"]
 
 
 class Callback:
@@ -158,6 +159,86 @@ class ModelCheckpoint(Callback):
         if self.save_dir and self.model:
             import os
             self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class FaultTolerantCheckpoint(Callback):
+    """Step-granular preemption-safe checkpointing for `Model.fit`.
+
+    * every `every_steps` train batches: commit a full TrainState
+      checkpoint (params, optimizer state, LR scheduler, global step,
+      RNG) plus the data cursor (epoch, step) under `root` via
+      `distributed.checkpoint.save_train_checkpoint` — atomic shard
+      writes, `latest` committed only after verification, `keep` old
+      steps retained;
+    * on_train_begin: restore from the newest complete checkpoint (torn
+      ones are skipped) and hand `fit` the cursor so it fast-forwards
+      the data iterator — the resumed run is bit-exact with an
+      uninterrupted one;
+    * SIGTERM (preemption notice, forwarded by the launch controller's
+      drain): finish the in-flight step, write an emergency checkpoint
+      SYNCHRONOUSLY, exit ELASTIC_EXIT_CODE so the gang relaunch
+      auto-resumes from it.
+    """
+
+    def __init__(self, root, every_steps=1, keep=3, async_save=False,
+                 resume=True, drain_exit=True):
+        super().__init__()
+        self.root = root
+        self.every_steps = max(1, int(every_steps))
+        self.keep = keep
+        self.async_save = async_save
+        self.resume = resume
+        self.drain_exit = drain_exit
+        self._epoch = 0
+        self._seen = 0
+
+    def on_train_begin(self, logs=None):
+        from ..distributed import guard
+        from ..distributed.checkpoint import restore_train_checkpoint
+        guard.install_sigterm_drain()
+        # the drain event is a sticky process-global: a SIGTERM that
+        # landed after a PREVIOUS fit's last batch (or during eval)
+        # must not make this fresh run self-terminate at its first
+        # batch — anything set at install time predates this training
+        guard.clear_drain()
+        if not self.resume:
+            return
+        meta = restore_train_checkpoint(self.model, self.root)
+        if meta and meta.get("cursor"):
+            self.model._resume_cursor = dict(meta["cursor"])
+            print(f"[ckpt] resumed from step {meta.get('step_count')} "
+                  f"(cursor {meta['cursor']})", flush=True)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def _save(self, cursor, sync=False):
+        from ..distributed.checkpoint import (save_train_checkpoint,
+                                              synchronize_async_saves)
+        save_train_checkpoint(
+            self.model, self.root, keep=self.keep,
+            async_save=self.async_save and not sync,
+            extra_meta={"cursor": cursor})
+        if sync:
+            synchronize_async_saves()
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..distributed import guard
+        cursor = {"epoch": self._epoch, "step": step}
+        if self.drain_exit and guard.drain_requested():
+            import sys
+            from ..distributed.launch.controller import ELASTIC_EXIT_CODE
+            self._save(cursor, sync=True)
+            print("[ckpt] SIGTERM drain: emergency checkpoint committed, "
+                  f"exiting {ELASTIC_EXIT_CODE}", flush=True)
+            sys.exit(ELASTIC_EXIT_CODE)
+        self._seen += 1
+        if self._seen % self.every_steps == 0:
+            self._save(cursor)
+
+    def on_train_end(self, logs=None):
+        from ..distributed.checkpoint import synchronize_async_saves
+        synchronize_async_saves()
 
 
 class LRScheduler(Callback):

@@ -49,16 +49,19 @@ class Model:
         self._amp_configs = amp_configs
 
     # -- single-batch entry points (reference: train_batch :759) ------------
+    def _get_train_step(self):
+        if self._train_step is None:
+            from ..jit import TrainStep
+            self._train_step = TrainStep(self.network, self._loss,
+                                         self._optimizer)
+        return self._train_step
+
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = self._to_list(inputs)
         labels = self._to_list(labels)
         if self._use_jit and update and len(labels) == 1:
-            if self._train_step is None:
-                from ..jit import TrainStep
-                self._train_step = TrainStep(self.network, self._loss,
-                                             self._optimizer)
-            loss = self._train_step(*inputs, labels[0])
+            loss = self._get_train_step()(*inputs, labels[0])
             metrics = self._compute_metrics(None, labels)
             return self._loss_and_metrics(loss, metrics)
         outputs = self.network(*inputs)
@@ -66,6 +69,23 @@ class Model:
         loss = losses if isinstance(losses, Tensor) else losses[0]
         loss.backward()
         if update:
+            if self._train_step is not None:
+                # jitted steps already ran: optimizer state is now
+                # SPLIT between TrainStep._opt_states and the eager
+                # accumulators — checkpoints keep capturing the jit
+                # side (the bulk), but the run is no longer bit-exact
+                import warnings
+                warnings.warn(
+                    "train_batch fell back to the eager path after "
+                    "jitted TrainStep steps; optimizer state is split "
+                    "across both paths and checkpoints capture only "
+                    "the jit side", RuntimeWarning)
+            else:
+                # optimizer state lives in the eager accumulators, not
+                # a TrainStep — train_state must capture THIS path
+                # even when _use_jit is set (multi-label losses fall
+                # through here)
+                self._stepped_eager = True
             self._optimizer.step()
             self._optimizer.clear_grad()
         metrics = self._compute_metrics(outputs, labels)
@@ -142,10 +162,22 @@ class Model:
                                 verbose=verbose,
                                 metrics=self._metrics_name())
         cbks.on_begin("train")
-        for epoch in range(epochs):
+        # resume cursor (set by FaultTolerantCheckpoint.on_train_begin
+        # after restoring a checkpoint): fast-forward to the epoch and
+        # skip the batches the restored step count already consumed, so
+        # the data iterator lines up with the optimizer state
+        start_epoch, skip_steps = 0, 0
+        cursor = getattr(self, "_resume_cursor", None)
+        if cursor:
+            start_epoch = int(cursor.get("epoch", 0))
+            skip_steps = int(cursor.get("step", -1)) + 1
+            self._resume_cursor = None
+        logs = {}
+        for epoch in range(start_epoch, epochs):
             cbks.on_epoch_begin(epoch)
-            logs = self._run_one_epoch(train_loader, cbks, "train",
-                                       num_iters=num_iters)
+            logs = self._run_one_epoch(
+                train_loader, cbks, "train", num_iters=num_iters,
+                skip_steps=skip_steps if epoch == start_epoch else 0)
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
@@ -201,7 +233,8 @@ class Model:
                     for i in range(n_out)]
         return outputs
 
-    def _run_one_epoch(self, loader, cbks, mode, num_iters=None):
+    def _run_one_epoch(self, loader, cbks, mode, num_iters=None,
+                       skip_steps=0):
         logs = {}
         for m in self._metrics:
             if mode == "train":
@@ -209,6 +242,8 @@ class Model:
         for step, batch in enumerate(loader):
             if num_iters is not None and step >= num_iters:
                 break
+            if step < skip_steps:
+                continue    # resume fast-forward: batch already trained
             cbks.on_batch_begin(mode, step, logs)
             batch = self._to_list(batch)
             inputs, labels = batch[:-1], batch[-1:]
@@ -240,6 +275,90 @@ class Model:
             n = m.name()
             names += n if isinstance(n, list) else [n]
         return names
+
+    # -- fault tolerance ---------------------------------------------------
+    def train_state(self):
+        """(arrays, meta) of the full training state — the
+        save_train_checkpoint/restore_train_checkpoint contract shared
+        with ShardedTrainStep/OffloadPipelineStep.  The jit path
+        captures the compiled TrainStep's donated opt-state buffers;
+        the eager path captures optimizer accumulators.  The branch
+        follows what train_batch ACTUALLY ran (a multi-label loss falls
+        through to eager even under jit=True), and the choice is
+        recorded in the meta so restore takes the same one."""
+        if self._jit_path_active():
+            arrays, meta = self._get_train_step().train_state()
+            meta["hapi_path"] = "jit"
+            return arrays, meta
+        from ..distributed.checkpoint import optimizer_meta
+        sd = self.network.state_dict()
+        arrays = {f"model.{n}": sd[n]._value for n in sd}
+        if self._optimizer is not None:
+            opt = self._optimizer
+            # structural param names (same `opt.<param>.<key>` scheme as
+            # TrainStep.train_state) — `p.name` counters aren't stable
+            # across model instances; _state_for materializes zero
+            # accumulators for never-stepped params so the restore
+            # skeleton always carries every opt-state key
+            import jax.numpy as jnp
+            for n, p in self.network.named_parameters():
+                for k, v in opt._state_for(p).items():
+                    arrays[f"opt.{n}.{k}"] = v
+                mw = opt._master_weights.get(id(p))
+                if mw is None and getattr(opt, "_multi_precision",
+                                          False) \
+                        and p.value.dtype in (jnp.float16, jnp.bfloat16):
+                    # materialize the lazy fp32 master (same init as
+                    # optimizer.step would) so a fresh trainer's restore
+                    # skeleton carries the __master__ keys
+                    mw = p.value.astype(jnp.float32)
+                    opt._master_weights[id(p)] = mw
+                if mw is not None:
+                    arrays[f"opt.{n}.__master__"] = mw
+            meta = optimizer_meta(self._optimizer)
+        else:
+            meta = {"step_count": 0, "lr_sched": None, "rng": None}
+        meta["hapi_path"] = "eager"
+        return arrays, meta
+
+    def _jit_path_active(self):
+        """Whether checkpoint state lives in the jitted TrainStep (vs
+        the eager optimizer accumulators)."""
+        return getattr(self, "_use_jit", True) \
+            and self._loss is not None \
+            and not getattr(self, "_stepped_eager", False)
+
+    def prepare_restore(self, meta):
+        """restore_train_checkpoint hook: shape the train_state
+        skeleton to the checkpoint's recorded capture branch before the
+        restore reads it."""
+        path = meta.get("hapi_path")
+        if path is not None:
+            self._stepped_eager = (path == "eager")
+
+    def load_train_state(self, arrays, meta):
+        saved_path = (meta or {}).get("hapi_path")
+        use_jit = self._jit_path_active() if saved_path is None \
+            else saved_path == "jit"
+        if use_jit:
+            return self._get_train_step().load_train_state(arrays, meta)
+        self._stepped_eager = True   # keep later saves on this branch
+        from ..distributed.checkpoint import apply_optimizer_meta
+        sd = self.network.state_dict()
+        for n in sd:
+            if f"model.{n}" in arrays:
+                sd[n]._value = arrays[f"model.{n}"]
+        if self._optimizer is not None:
+            opt = self._optimizer
+            for n, p in self.network.named_parameters():
+                st = opt._state_for(p)
+                for k in st:
+                    if f"opt.{n}.{k}" in arrays:
+                        st[k] = arrays[f"opt.{n}.{k}"]
+                if f"opt.{n}.__master__" in arrays:
+                    opt._master_weights[id(p)] = \
+                        arrays[f"opt.{n}.__master__"]
+            apply_optimizer_meta(self._optimizer, meta)
 
     # -- persistence -------------------------------------------------------
     def save(self, path, training=True):

@@ -240,6 +240,25 @@ def per_step_lrs(optimizer, k: int, advance: bool = True):
     return jnp.asarray(lrs, jnp.float32), commit
 
 
+def _step_faults(batch_vals, where):
+    """Train-step fault-injection boundary (distributed.fault):
+    `step.begin` handles kill/error/delay itself; `step.data` mode=nan
+    poisons the first float batch array so THIS step's loss and grads
+    go genuinely nonfinite (the deterministic NaN-step harness)."""
+    from ..distributed import fault
+    if not fault.is_active():
+        return batch_vals
+    fault.hit("step.begin", key=where)
+    f = fault.hit("step.data", key=where)
+    if f is not None and f.mode == "nan":
+        batch_vals = list(batch_vals)
+        for i, b in enumerate(batch_vals):
+            if jnp.issubdtype(b.dtype, jnp.inexact):
+                batch_vals[i] = jnp.full_like(b, jnp.nan)
+                break
+    return batch_vals
+
+
 class TrainStep:
     """Fused forward+backward+update as ONE jitted function with donated
     param/opt-state buffers.
@@ -363,6 +382,7 @@ class TrainStep:
         buf_vals = [sd[n]._value for n in self._buf_names]
         batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                       for b in stacked_batch]
+        batch_vals = _step_faults(batch_vals, "jit-multi")
         if self._opt_states is None:
             self._opt_states = self._init_opt_states(param_vals)
         if self._compiled is None:
@@ -386,6 +406,37 @@ class TrainStep:
         self._opt_states = new_states
         return Tensor(losses)
 
+    def train_state(self):
+        """(arrays, meta) of the full training state — params, buffers,
+        optimizer state, global step, LR scheduler, RNG — for
+        `distributed.checkpoint.save_train_checkpoint` (same contract
+        as ShardedTrainStep.train_state; the resume is bit-exact)."""
+        from ..distributed.checkpoint import optimizer_meta
+        sd = self.model.state_dict()
+        if self._opt_states is None:
+            self._opt_states = self._init_opt_states(
+                [sd[n]._value for n in self._names])
+        arrays = {f"model.{n}": sd[n]._value for n in sd}
+        for n, st in zip(self._names, self._opt_states):
+            for k, v in st.items():
+                arrays[f"opt.{n}.{k}"] = v
+        return arrays, optimizer_meta(self.optimizer)
+
+    def load_train_state(self, arrays, meta):
+        from ..distributed.checkpoint import apply_optimizer_meta
+        sd = self.model.state_dict()
+        for n in sd:
+            if f"model.{n}" in arrays:
+                sd[n]._value = arrays[f"model.{n}"]
+        if self._opt_states is None:
+            self._opt_states = self._init_opt_states(
+                [sd[n]._value for n in self._names])
+        for n, st in zip(self._names, self._opt_states):
+            for k in st:
+                if f"opt.{n}.{k}" in arrays:
+                    st[k] = arrays[f"opt.{n}.{k}"]
+        apply_optimizer_meta(self.optimizer, meta)
+
     def __call__(self, *batch):
         """batch: (*inputs, label) Tensors; returns loss Tensor."""
         model = self.model
@@ -396,11 +447,15 @@ class TrainStep:
             self._opt_states = self._init_opt_states(param_vals)
         if self._compiled is None:
             self._build(batch)
+        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                      for b in batch]
+        # inject BEFORE the step counter advances or an RNG key is
+        # drawn (same order as the sharded trainers): a caught injected
+        # crash must not leave a phantom step behind
+        batch_vals = _step_faults(batch_vals, "jit")
         self.optimizer._step_count += 1
         lr = self.optimizer.get_lr()
         key = prandom.next_key()
-        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
-                      for b in batch]
         loss, new_params, new_states, new_bufs = self._compiled(
             param_vals, self._opt_states, buf_vals,
             jnp.asarray(lr, jnp.float32),

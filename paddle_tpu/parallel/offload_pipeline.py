@@ -134,13 +134,16 @@ class OffloadPipelineStep:
                  prefetch_depth: int = 1,
                  cast_dtype: Optional[str] = "bfloat16",
                  batch_axes=("dp", "sharding"), donate: bool = True,
-                 seq_axis: Optional[str] = None, seq_dim: int = 1):
+                 seq_axis: Optional[str] = None, seq_dim: int = 1,
+                 grad_scaler=None):
         if prefetch_depth < 1:
             raise ValueError("prefetch_depth must be >= 1")
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.loss_fn = loss_fn
+        self._guard = None
+        self._scaler = grad_scaler
         self.prefetch_depth = int(prefetch_depth)
         self.batch_axes = batch_axes
         self.seq_axis = seq_axis
@@ -500,6 +503,15 @@ class OffloadPipelineStep:
         mesh = self.mesh if self.mesh.size > 1 else None
         adam_shaped = _is_adam_hp(hp)
         from .sharded_trainer import activation_sharding_scope
+        # nonfinite skip-step guard: compiled in only when the flag is
+        # on at build time.  The per-layer updates are applied INSIDE
+        # the backward scan, so the guard carries a grad-norm² accum
+        # through it and selects old-vs-new stacks at the end — which
+        # keeps the pre-step stacks live past the scan (the donated
+        # host buffers can't alias; roughly double stack residency is
+        # the documented cost of the opt-in guard).
+        from ..framework.flags import get_flag
+        guard_on = bool(get_flag("skip_nonfinite_steps"))
 
         def leaf_update(p, g, s, lr_, wd, step_i):
             """One streamed slice's update, as its gradient lands: the
@@ -598,7 +610,11 @@ class OffloadPipelineStep:
                     for k in range(W))
 
                 def bbody(carry, xs):
-                    dh, d_acc, bwindow, stk_p, stk_w, stk_s = carry
+                    if guard_on:
+                        (dh, d_acc, bwindow, stk_p, stk_w, stk_s,
+                         gsq) = carry
+                    else:
+                        dh, d_acc, bwindow, stk_p, stk_w, stk_s = carry
                     h_in, idx = xs
                     param_i, state_i = {}, {}
                     for s in leaves:
@@ -644,28 +660,55 @@ class OffloadPipelineStep:
                                     new_st[k].astype(stk_s[s][k].dtype),
                                     idx)
                             for k in stk_s[s]}
-                    return (dh_prev, d_acc, bwindow[1:] + (pre,),
-                            stk_p, stk_w, stk_s), None
+                    out_carry = (dh_prev, d_acc, bwindow[1:] + (pre,),
+                                 stk_p, stk_w, stk_s)
+                    if guard_on:
+                        lg = sum(jnp.sum(jnp.square(
+                            dws[s].astype(jnp.float32))) for s in leaves)
+                        out_carry = out_carry + (gsq + lg,)
+                    return out_carry, None
 
                 d_acc0 = jax.tree.map(jnp.zeros_like, dex)
-                (dh0, d_dex_sum, _, new_stk_p, new_stk_w,
-                 new_stk_s), _ = jax.lax.scan(
-                    bbody,
-                    (dh, d_acc0, bwindow0, stk_param, stk_wire,
-                     stk_state),
-                    (resid, jnp.arange(L)), reverse=True)
+                carry0 = (dh, d_acc0, bwindow0, stk_param, stk_wire,
+                          stk_state)
+                if guard_on:
+                    carry0 = carry0 + (jnp.float32(0),)
+                out_carry, _ = jax.lax.scan(
+                    bbody, carry0, (resid, jnp.arange(L)), reverse=True)
+                if guard_on:
+                    (dh0, d_dex_sum, _, new_stk_p, new_stk_w,
+                     new_stk_s, gsq_total) = out_carry
+                else:
+                    (dh0, d_dex_sum, _, new_stk_p, new_stk_w,
+                     new_stk_s) = out_carry
+                    gsq_total = None
 
                 # ---- tail grads (pre + post contributions) and update
                 (d_tail_pre,) = pre_vjp((dh0, d_dex_sum))
                 new_tail, new_tstates = [], []
                 for i, (p, st) in enumerate(zip(tail_vals, tail_states)):
                     g = d_tail_post[i] + d_tail_pre[i]
+                    if guard_on:
+                        gsq_total = gsq_total + jnp.sum(
+                            jnp.square(g.astype(jnp.float32)))
                     wd, ls = tail_pol[i]
                     np_, ns = leaf_update(
                         p, g, st, lr if ls == 1.0 else lr * ls, wd,
                         step_i)
                     new_tail.append(np_)
                     new_tstates.append(ns)
+                if guard_on:
+                    ok = (jnp.isfinite(loss.astype(jnp.float32))
+                          & jnp.isfinite(gsq_total))
+
+                    def sel(n, o):
+                        return jax.tree.map(
+                            lambda a, b: jnp.where(ok, a, b), n, o)
+                    new_tail = sel(new_tail, list(tail_vals))
+                    new_tstates = sel(new_tstates, list(tail_states))
+                    new_stk_p = sel(new_stk_p, stk_param)
+                    new_stk_w = sel(new_stk_w, stk_wire)
+                    new_stk_s = sel(new_stk_s, stk_state)
             return (loss, new_tail, new_tstates, new_stk_p, new_stk_w,
                     new_stk_s)
 
@@ -704,6 +747,7 @@ class OffloadPipelineStep:
     def _run_one(self, batch, lr_override):
         from ..distributed.watchdog import watched
         tail_vals, batch_vals = self._prepare(batch)
+        batch_vals = self._step_faults(batch_vals)
         self.optimizer._step_count += 1
         lr = self.optimizer.get_lr() if lr_override is None \
             else lr_override
@@ -720,6 +764,7 @@ class OffloadPipelineStep:
         for n, v in zip(self._tail_names, new_tail):
             sd[n]._value = v
         self._tail_states = new_tstates
+        self._guard_record(loss)
         return Tensor(loss)
 
     def run_steps(self, *stacked_batch, advance_lr_scheduler=True):
@@ -742,6 +787,75 @@ class OffloadPipelineStep:
                 tuple(v[i] for v in vals), float(lrs[i]))._value)
         commit_lr()
         return Tensor(jnp.stack(losses))
+
+    # -- fault tolerance ---------------------------------------------------
+    def _step_faults(self, batch_vals):
+        """`step.begin` (kill/error/delay) and `step.data` (mode=nan
+        poisons the first float batch array) injection points — same
+        contract as ShardedTrainStep._step_faults."""
+        from ..jit import _step_faults
+        return tuple(_step_faults(batch_vals, "offload"))
+
+    def _guard_record(self, loss):
+        from ..framework.flags import get_flag
+        if not get_flag("skip_nonfinite_steps"):
+            return
+        if self._guard is None:
+            from ..distributed.guard import StepAnomalyGuard
+            self._guard = StepAnomalyGuard(scaler=self._scaler,
+                                           name="offload pipeline step")
+        self._guard.record(float(np.asarray(loss)),
+                           step=self.optimizer._step_count)
+
+    def train_state(self):
+        """(arrays, meta) of the full streamed-pipeline training state:
+        tail params + their optimizer state, the host-parked per-leaf
+        param/state STACKS (authoritative between steps — no
+        sync_to_model detour, so the capture is exact), global step, LR
+        scheduler and RNG."""
+        from ..distributed.checkpoint import optimizer_meta
+        if not self._stacks_ready:
+            self._init_stacks()
+        sd = self.model.state_dict()
+        arrays = {f"model.{n}": sd[n]._value for n in self._tail_names}
+        for n, st in zip(self._tail_names, self._tail_states):
+            for k, v in st.items():
+                arrays[f"opt.{n}.{k}"] = v
+        for s in self._leaves:
+            arrays[f"stack.{s}"] = self._stk_param[s]
+            for k, v in self._stk_state[s].items():
+                arrays[f"stack_state.{s}.{k}"] = v
+        return arrays, optimizer_meta(self.optimizer)
+
+    def load_train_state(self, arrays, meta):
+        from ..distributed.checkpoint import apply_optimizer_meta
+        if not self._stacks_ready:
+            self._init_stacks()
+        sd = self.model.state_dict()
+        for n in self._tail_names:
+            if f"model.{n}" in arrays:
+                sd[n]._value = arrays[f"model.{n}"]
+        for n, st in zip(self._tail_names, self._tail_states):
+            for k in st:
+                if f"opt.{n}.{k}" in arrays:
+                    st[k] = arrays[f"opt.{n}.{k}"]
+        for s in self._leaves:
+            if f"stack.{s}" in arrays:
+                self._stk_param[s] = arrays[f"stack.{s}"]
+                if self._casts:
+                    # rebuild the wire-dtype twin from the restored
+                    # storage stack (np round-trip: astype on a
+                    # pinned_host array would run through the device)
+                    self._stk_wire[s] = self._to_host(jnp.asarray(
+                        np.asarray(arrays[f"stack.{s}"]).astype(
+                            np.dtype(self._wire_dtype))))
+            for k in self._stk_state[s]:
+                if f"stack_state.{s}.{k}" in arrays:
+                    self._stk_state[s][k] = \
+                        arrays[f"stack_state.{s}.{k}"]
+        apply_optimizer_meta(self.optimizer, meta)
+        # keep the module-API view consistent with the restored stacks
+        self.sync_to_model()
 
     def sync_to_model(self):
         """Write the stacked host params back into the model's per-layer

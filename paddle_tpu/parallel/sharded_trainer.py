@@ -136,13 +136,17 @@ class ShardedTrainStep:
                  batch_axes=("dp", "sharding"), donate: bool = True,
                  seq_axis: Optional[str] = None, seq_dim: int = 1,
                  offload=False, offload_prefetch_depth: int = 1,
-                 offload_cast_dtype="bfloat16"):
+                 offload_cast_dtype="bfloat16", grad_scaler=None):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.stage = sharding_stage
         self.remat = rematerialize
+        # nonfinite-step guard (FLAGS_skip_nonfinite_steps): lazily
+        # built; the optional GradScaler gets backoff() on bad steps
+        self._guard = None
+        self._scaler = grad_scaler
         # offload="stream": the explicit double-buffered per-layer
         # streaming pipeline (offload_pipeline.py) — forward/backward
         # prefetch windows + in-backward optimizer, replacing the
@@ -161,7 +165,8 @@ class ShardedTrainStep:
                 model, optimizer, mesh, loss_fn=loss_fn,
                 prefetch_depth=offload_prefetch_depth,
                 cast_dtype=offload_cast_dtype, batch_axes=batch_axes,
-                donate=donate, seq_axis=seq_axis, seq_dim=seq_dim)
+                donate=donate, seq_axis=seq_axis, seq_dim=seq_dim,
+                grad_scaler=grad_scaler)
             self.offload = True
             self.offload_params = True
             return
@@ -459,6 +464,25 @@ class ShardedTrainStep:
         chain_every = max(1, int(os.environ.get(
             "PDTPU_OFFLOAD_CHAIN_EVERY", "1")))
 
+        # nonfinite skip-step guard, compiled in ONLY when the flag is
+        # on at build time — flags off, the step program is
+        # bit-identical to the unguarded one (bench-asserted).  A bad
+        # step (nonfinite loss OR grad-norm) keeps params, optimizer
+        # state and buffers untouched; the host-side StepAnomalyGuard
+        # bounds how many may run consecutively.
+        from ..framework.flags import get_flag
+        guard_on = bool(get_flag("skip_nonfinite_steps"))
+
+        def _finite_pred(loss, grads):
+            gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                      for g in grads)
+            return (jnp.isfinite(loss.astype(jnp.float32))
+                    & jnp.isfinite(gsq))
+
+        def _guarded(finite, new_tree, old_tree):
+            return jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new_tree, old_tree)
+
         def step(param_vals, opt_states, buf_vals, lr, step_i, key, batch):
             (loss, new_bufs), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(param_vals, buf_vals, key, batch)
@@ -471,6 +495,11 @@ class ShardedTrainStep:
                 new_params, new_states = apply_updates(
                     upd, param_vals, grads, opt_states, lr, wds, step_i,
                     hp, lr_scales=lr_scales)
+                if guard_on:
+                    ok = _finite_pred(loss, grads)
+                    new_params = _guarded(ok, new_params, param_vals)
+                    new_states = _guarded(ok, new_states, opt_states)
+                    new_bufs = _guarded(ok, new_bufs, buf_vals)
                 return loss, new_params, new_states, new_bufs
             new_params, new_states = [], []
             token = None
@@ -510,6 +539,11 @@ class ShardedTrainStep:
                 new_states.append(ns)
                 if chain_updates and (i + 1) % chain_every == 0:
                     token = np_
+            if guard_on:
+                ok = _finite_pred(loss, grads)
+                new_params = _guarded(ok, new_params, param_vals)
+                new_states = _guarded(ok, new_states, opt_states)
+                new_bufs = _guarded(ok, new_bufs, buf_vals)
             return loss, new_params, new_states, new_bufs
 
         param_sh = [self._param_store_shardings[n] if stream_params
@@ -660,10 +694,10 @@ class ShardedTrainStep:
                   for b in stacked_batch))
         if getattr(self, "_compiled_multi", None) is None:
             self._build_multi()
-        stacked = tuple(
+        stacked = self._step_faults(tuple(
             self._stack_shard(b.value if isinstance(b, Tensor)
                               else jnp.asarray(b))
-            for b in stacked_batch)
+            for b in stacked_batch))
         k = int(stacked[0].shape[0])
         from ..jit import per_step_lrs
         lrs, commit_lr = per_step_lrs(self.optimizer, k,
@@ -684,6 +718,7 @@ class ShardedTrainStep:
         for n, v in zip(self._buf_names, new_bufs):
             sd[n]._value = v
         self._opt_states = self._park_states(new_states)
+        self._guard_record(losses)
         return Tensor(losses)
 
     def _stack_shard(self, arr):
@@ -704,12 +739,71 @@ class ShardedTrainStep:
         if self._pipeline is not None:
             self._pipeline.sync_to_model()
 
+    # -- fault tolerance ---------------------------------------------------
+    def train_state(self):
+        """(arrays, meta) of the FULL training state: model params and
+        buffers, per-param optimizer state, global step, LR scheduler
+        and process RNG — everything a bit-exact resume needs (N steps
+        ≡ N/2 + save + restore-into-fresh-state + N/2).  Feed to
+        `distributed.checkpoint.save_train_checkpoint`."""
+        if self._pipeline is not None:
+            return self._pipeline.train_state()
+        from ..distributed.checkpoint import optimizer_meta
+        sd = self.model.state_dict()
+        if self._opt_states is None:
+            self._opt_states = self._init_opt_states()
+        arrays = {f"model.{n}": sd[n]._value for n in sd}
+        for n, st in zip(self._names, self._opt_states):
+            for k, v in st.items():
+                arrays[f"opt.{n}.{k}"] = v
+        return arrays, optimizer_meta(self.optimizer)
+
+    def load_train_state(self, arrays, meta):
+        if self._pipeline is not None:
+            return self._pipeline.load_train_state(arrays, meta)
+        from ..distributed.checkpoint import apply_optimizer_meta
+        sd = self.model.state_dict()
+        for n in sd:
+            if f"model.{n}" in arrays:
+                sd[n]._value = arrays[f"model.{n}"]
+        if self._opt_states is None:
+            self._opt_states = self._init_opt_states()
+        for n, st in zip(self._names, self._opt_states):
+            for k in st:
+                if f"opt.{n}.{k}" in arrays:
+                    st[k] = arrays[f"opt.{n}.{k}"]
+        apply_optimizer_meta(self.optimizer, meta)
+
+    def _step_faults(self, batch_vals):
+        """Thread the train-step injection points: `step.begin`
+        (kill/error/delay) and `step.data` (mode=nan poisons the first
+        float batch array — the deterministic way to make THIS step's
+        loss and grads genuinely nonfinite for guard tests)."""
+        from ..jit import _step_faults
+        return tuple(_step_faults(batch_vals, "sharded"))
+
+    def _guard_record(self, loss):
+        """Host half of the skip-step path: budget consecutive bad
+        steps, back off the attached GradScaler.  Only consulted when
+        FLAGS_skip_nonfinite_steps is on (it forces a host sync on the
+        loss — never on the flags-off hot path)."""
+        from ..framework.flags import get_flag
+        if not get_flag("skip_nonfinite_steps"):
+            return
+        if self._guard is None:
+            from ..distributed.guard import StepAnomalyGuard
+            self._guard = StepAnomalyGuard(scaler=self._scaler,
+                                           name="sharded train step")
+        for v in np.atleast_1d(np.asarray(loss)):
+            self._guard.record(float(v), step=self.optimizer._step_count)
+
     # -- run ---------------------------------------------------------------
     def __call__(self, *batch):
         from ..distributed.watchdog import watched
         if self._pipeline is not None:
             return self._pipeline(*batch)
         param_vals, buf_vals, batch_vals = self._prepare(batch)
+        batch_vals = self._step_faults(batch_vals)
         sd = self._sd
         self.optimizer._step_count += 1
         lr = self.optimizer.get_lr()
@@ -725,4 +819,5 @@ class ShardedTrainStep:
         for n, v in zip(self._buf_names, new_bufs):
             sd[n]._value = v
         self._opt_states = self._park_states(new_states)
+        self._guard_record(loss)
         return Tensor(loss)

@@ -79,7 +79,9 @@ def _verify_built_programs():
 # ---------------------------------------------------------------------------
 _SLOW_MODULES = {
     # multi-process launch/elastic walls (heartbeat TTL waits)
-    "test_elastic", "test_launch", "test_rpc",
+    "test_elastic", "test_launch", "test_rpc", "test_elastic_resume",
+    # trainer-compile zoo (checkpoint/guard planted-fault coverage)
+    "test_fault_tolerance",
     # XLA CPU compile walls (model zoo, UNet, scanned pipelines)
     "test_vision_models", "test_unet", "test_gpt", "test_moe",
     "test_pipeline", "test_recompute", "test_long_context",
@@ -90,6 +92,7 @@ _SLOW_MODULES = {
 # one representative per slow module keeps every subsystem in the tier
 _FAST_PICKS = {
     "test_elastic": "test_elastic_exit_code_triggers_reform",
+    "test_fault_tolerance": "test_sharded_trainer_resume_parity",
     "test_launch": "test_two_procs_env_wiring",
     "test_rpc": "test_rpc_two_workers",
     "test_vision_models": "test_forward_shape[squeezenet1_1]",
@@ -112,6 +115,10 @@ _FAST_PICKS = {
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "fast: <5-minute CPU subset covering every subsystem")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (-m 'not slow') "
+        "— heavy multi-process end-to-end walls; covered in tier-1 by "
+        "fast in-process twins")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,287 @@
+"""Chaos / recovery CLI — the command-line face of
+paddle_tpu.distributed.fault (JSON output + non-zero exit on failure,
+like tools/verify_program.py).
+
+Two modes:
+
+  python tools/chaos_check.py --spec "ckpt.write:step=2:mode=truncate"
+      Run a short checkpointed train loop with the spec ARMED: any
+      injected crash is treated as a process death and "relaunched"
+      (fresh model/optimizer/trainer restored from the newest complete
+      checkpoint).  The run passes iff (a) the spec actually FIRED,
+      (b) the loop reached its target step count, and (c) the final
+      loss sequence is BIT-EXACT equal to an uninterrupted fault-free
+      run — recovery, not just survival.
+
+  python tools/chaos_check.py --selftest
+      CI canary: one spec per injection point (torn shard, corrupt
+      shard, writer IO error, missing manifest, missing `latest`
+      commit, KV connection blips, heartbeat skip, step kill→resume,
+      NaN step under the skip-step guard) — asserts each fault fires
+      AND its recovery machinery recovers.  Exit 1 if any check fails —
+      a silently dead injection point is exactly the failure mode this
+      guards.
+
+  --json     one machine-readable JSON document on stdout
+  --steps N  target train steps for --spec runs (default 8)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# the short train loop: tiny MLP + ShardedTrainStep + per-step commits
+# ---------------------------------------------------------------------------
+
+def _make_trainer(seed=7):
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.topology import build_mesh
+    from paddle_tpu.parallel import ShardedTrainStep
+
+    class MLP(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = paddle.nn.Linear(8, 16)
+            self.fc2 = paddle.nn.Linear(16, 1)
+
+        def forward(self, x):
+            return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+    paddle.seed(seed)
+    m = MLP()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters(),
+                                 weight_decay=0.1)
+    mesh = build_mesh(devices=jax.devices()[:1])
+    return ShardedTrainStep(
+        m, opt, mesh,
+        loss_fn=lambda o, y: paddle.nn.functional.mse_loss(o, y))
+
+
+def _batch(i):
+    import numpy as np
+    import paddle_tpu as paddle
+    rng = np.random.RandomState(100 + i)
+    return (paddle.to_tensor(rng.randn(4, 8).astype(np.float32)),
+            paddle.to_tensor(rng.randn(4, 1).astype(np.float32)))
+
+
+def _loss_of(step, i):
+    import numpy as np
+    x, y = _batch(i)
+    return float(np.asarray(step(x, y).value))
+
+
+def run_loop(spec, steps=8, ckpt_every=1):
+    """Train `steps` steps with `spec` armed, checkpointing every
+    `ckpt_every` steps; recover from injected crashes by rebuilding the
+    trainer from the newest complete checkpoint.  Returns a report
+    dict; report["ok"] is the pass verdict."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fault
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    # the fault-free reference (spec disarmed)
+    paddle.set_flags({"FLAGS_fault_injection": ""})
+    fault.reset()
+    ref_step = _make_trainer()
+    ref = [_loss_of(ref_step, i) for i in range(steps)]
+
+    root = tempfile.mkdtemp(prefix="chaos_ckpt_")
+    paddle.set_flags({"FLAGS_fault_injection": spec})
+    fault.reset()
+    trainer = _make_trainer()
+    losses, crashes, relaunches = {}, [], 0
+    try:
+        i = 0
+        guard_budget = steps + 4   # bound injected-NaN skip loops
+        while i < steps and guard_budget > 0:
+            guard_budget -= 1
+            try:
+                loss = _loss_of(trainer, i)
+                losses[i] = loss
+                if (i + 1) % ckpt_every == 0:
+                    ckpt.save_train_checkpoint(trainer, root,
+                                               extra_meta={"cursor": i})
+                i += 1
+            except (IOError, OSError) as e:   # injected crash analog
+                crashes.append(f"step {i}: {type(e).__name__}: {e}")
+                relaunches += 1
+                if relaunches > steps:
+                    break
+                paddle.seed(31337 + relaunches)   # fresh-process analog
+                trainer = _make_trainer(seed=31337)
+                meta = ckpt.restore_train_checkpoint(trainer, root)
+                i = (int(meta["cursor"]) + 1) if meta else 0
+        fired = dict(fault.fired_counts())
+    finally:
+        paddle.set_flags({"FLAGS_fault_injection": ""})
+        fault.reset()
+    # the torn dirs the spec left behind must not poison recovery: a
+    # fresh trainer restores from the newest COMPLETE checkpoint
+    fresh = _make_trainer(seed=1)
+    resumable = ckpt.restore_train_checkpoint(fresh, root) is not None
+    got = [losses.get(i) for i in range(steps)]
+    bit_exact = got == ref
+    fired = {k: v for k, v in fired.items() if v}
+    ok = (bool(fired) and len(losses) == steps and bit_exact
+          and resumable)
+    return {"spec": spec, "steps": steps, "fired": fired,
+            "crashes": crashes, "relaunches": relaunches,
+            "bit_exact": bit_exact, "completed": len(losses),
+            "resumable": resumable, "ok": ok}
+
+
+# ---------------------------------------------------------------------------
+# selftest: one fault per injection point
+# ---------------------------------------------------------------------------
+
+def _selftest():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fault
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    checks = []
+
+    def record(name, fired, recovered, detail=""):
+        checks.append({"check": name, "fired": bool(fired),
+                       "recovered": bool(recovered), "detail": detail})
+
+    # -- checkpoint recovery paths: loop-level specs --------------------
+    for name, spec in [
+            ("ckpt.write-truncate", "ckpt.write:step=3:mode=truncate"),
+            ("ckpt.write-corrupt", "ckpt.write:step=3:mode=corrupt"),
+            ("ckpt.write-io-error", "ckpt.write:after=1:times=2:mode=error"),
+            ("ckpt.manifest-skip", "ckpt.manifest:step=3:mode=skip"),
+            ("ckpt.latest-skip", "ckpt.latest:step=3:mode=skip")]:
+        rep = run_loop(spec, steps=6)
+        record(name, rep["fired"], rep["ok"], json.dumps(rep["crashes"]))
+
+    # -- kv.request: blips under a live KV server ----------------------
+    from paddle_tpu.distributed.launch.master import KVServer, KVClient
+    srv = KVServer(0).start()
+    try:
+        kv = KVClient(f"127.0.0.1:{srv.port}")
+        with fault.scope("kv.request:times=2:mode=error"):
+            put_ok = kv.put("chaos/x", "1")
+            fired = fault.fired_counts().get("kv.request", 0)
+        record("kv.request-retry", fired >= 2,
+               put_ok and kv.get("chaos/x") == "1")
+    finally:
+        srv.stop()
+
+    # -- launch.heartbeat: skipped beats leave the stamp stale ---------
+    srv = KVServer(0).start()
+    try:
+        kv = KVClient(f"127.0.0.1:{srv.port}")
+
+        class _C:  # minimal controller stand-in for the heartbeat loop
+            pod_id, job_id = "chaos-pod", "chaos"
+        import threading
+        import time as _t
+        from paddle_tpu.distributed.launch import controller as lctl
+        c = _C()
+        c.kv = kv
+        c._hb_stop = threading.Event()
+        old_interval = lctl.HEARTBEAT_INTERVAL
+        lctl.HEARTBEAT_INTERVAL = 0.01
+        try:
+            with fault.scope("launch.heartbeat:times=*:mode=skip"):
+                t = threading.Thread(
+                    target=lctl.CollectiveController._heartbeat_loop,
+                    args=(c,), daemon=True)
+                t.start()
+                _t.sleep(0.2)
+                c._hb_stop.set()
+                t.join(timeout=10)
+                fired = fault.fired_counts().get("launch.heartbeat", 0)
+        finally:
+            lctl.HEARTBEAT_INTERVAL = old_interval
+        stale = kv.get(f"chaos/heartbeat/{c.pod_id}") is None
+        record("launch.heartbeat-skip", fired > 0, stale,
+               f"fired={fired}")
+    finally:
+        srv.stop()
+
+    # -- step.begin: injected crash mid-loop, resume from checkpoint ---
+    rep = run_loop("step.begin:step=4:mode=error", steps=6)
+    record("step.begin-crash-resume", rep["fired"], rep["ok"],
+           json.dumps(rep["crashes"]))
+
+    # -- step.data: NaN step under the skip-step guard ------------------
+    paddle.set_flags({"FLAGS_skip_nonfinite_steps": True})
+    try:
+        with fault.scope("step.data:step=2:mode=nan"):
+            trainer = _make_trainer()
+            l1 = _loss_of(trainer, 0)
+            snap = {n: np.asarray(t.value).copy()
+                    for n, t in trainer.model.state_dict().items()}
+            l2 = _loss_of(trainer, 1)      # poisoned
+            untouched = all(
+                np.array_equal(np.asarray(t.value), snap[n])
+                for n, t in trainer.model.state_dict().items())
+            l3 = _loss_of(trainer, 2)
+            fired = fault.fired_counts().get("step.data", 0)
+        record("step.data-nan-guard", fired == 1,
+               (not np.isfinite(l2)) and np.isfinite(l1)
+               and np.isfinite(l3) and untouched)
+    finally:
+        paddle.set_flags({"FLAGS_skip_nonfinite_steps": False})
+    return checks
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="run a short train loop under a fault-injection "
+                    "spec and verify recovery")
+    ap.add_argument("--spec", help="FLAGS_fault_injection spec to arm")
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--selftest", action="store_true",
+                    help="plant one fault per injection point and "
+                         "assert each fires and recovers")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        checks = _selftest()
+        bad = [c for c in checks
+               if not (c["fired"] and c["recovered"])]
+        if args.as_json:
+            print(json.dumps({"mode": "selftest", "checks": checks,
+                              "ok": not bad}, indent=2))
+        else:
+            for c in checks:
+                mark = "ok " if c["fired"] and c["recovered"] else "FAIL"
+                print(f"  [{mark}] {c['check']} "
+                      f"(fired={c['fired']}, recovered={c['recovered']})")
+            print(f"selftest: {len(checks) - len(bad)}/{len(checks)} "
+                  "checks passed")
+        return 1 if bad else 0
+    if not args.spec:
+        ap.error("provide --spec or --selftest")
+    rep = run_loop(args.spec, steps=args.steps)
+    if args.as_json:
+        print(json.dumps(rep, indent=2))
+    else:
+        verdict = "RECOVERED" if rep["ok"] else "FAILED"
+        print(f"{verdict}: spec {rep['spec']!r} fired {rep['fired']}, "
+              f"{rep['completed']}/{rep['steps']} steps, "
+              f"bit_exact={rep['bit_exact']}, "
+              f"relaunches={rep['relaunches']}")
+    return 0 if rep["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
