@@ -67,15 +67,47 @@ def _measure(rep_fn):
     return med, spread, vals
 
 
-def _emit(metric, value, unit, vs_baseline, spread, vals):
-    print(json.dumps({
+def _emit(metric, value, unit, vs_baseline, spread, vals, extra=None):
+    rec = {
         "metric": metric,
         "value": round(value, 1) if value >= 10 else round(value, 3),
         "unit": unit,
         "vs_baseline": round(vs_baseline, 3),
         "reps": len(vals),
         "spread": round(spread, 3),
-    }), flush=True)
+    }
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def _phase_fields(model, step, batch, seq, n_params, label,
+                  remat_flops=0.0):
+    """fwd/bwd/opt phase decomposition (the PROFILE_r05 method, shared
+    with tools/profile_mfu.py) as JSON-ready fields, so BENCH_r* tracks
+    the gap items the kernel fusions target — not just tokens/s.
+    BENCH_PHASES=0 skips the extra phase compiles."""
+    if os.environ.get("BENCH_PHASES", "1") == "0":
+        return None
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    try:
+        from tools.profile_mfu import _profile
+        r = _profile(model, step, batch, seq, n_params, label,
+                     remat_flops)
+    except Exception as e:  # phases are telemetry, never a bench failure
+        return {"phases_error": str(e)[:120]}
+    return {"phases": {
+        "fwd_ms": round(r["t_fwd_ms"], 1),
+        "bwd_ms": round(r["t_bwd_ms"], 1),
+        "opt_ms": round(r["t_opt_ms"], 1),
+        "full_ms": round(r["t_full_ms"], 1),
+        "fwd_util": round(r["fwd_util"], 3),
+        "bwd_util": round(r["bwd_util"], 3),
+        "bwd_util_hw": round(r["bwd_util_hw"], 3),
+        "step_mfu": round(r["mfu_full"], 3),
+    }}
 
 
 def bench_llama(offload=False):
@@ -209,7 +241,12 @@ def bench_llama(offload=False):
                  f"d2h={sb['d2h_bytes'] / 1e9:.2f}G/step, "
                  f"dma_share={min(dma_s / step_wall, 9.99):.2f}, "
                  f"prefetch_depth={sb['prefetch_depth']}")
-    _emit(name, tokens_per_sec, unit + ")", mfu / 0.40, spread, vals)
+    extra = None
+    if not requested_offload:
+        extra = _phase_fields(model, step, batch, seq, n_params,
+                              "llama", recompute_per_tok)
+    _emit(name, tokens_per_sec, unit + ")", mfu / 0.40, spread, vals,
+          extra=extra)
 
 
 def _timed_train_tokens(step, x, batch, seq, steps):
@@ -433,7 +470,8 @@ def bench_bert():
     mfu = 6.0 * n_params * tokens_per_sec / chip_peak_flops()
     _emit("bert_base_train_tokens_per_sec_per_chip", tokens_per_sec,
           f"tokens/s/chip (mfu={mfu:.3f}, params={n_params/1e6:.0f}M, "
-          f"loss={final_loss[0]:.3f})", mfu / 0.40, spread, vals)
+          f"loss={final_loss[0]:.3f})", mfu / 0.40, spread, vals,
+          extra=_phase_fields(model, step, batch, seq, n_params, "bert"))
 
 
 def bench_unet():
@@ -718,9 +756,62 @@ def _assert_fault_tolerance_zero_overhead():
         "flags-off train steps consulted the fault registry"
 
 
+def _assert_mfu_fusion_zero_overhead():
+    """FLAGS_fused_ce / FLAGS_bf16_adamw_moments are toggle-stable:
+    building the same tiny-llama step before, during and after toggling
+    the flags must yield (a) identical flags-off StableHLO text both
+    times — arming and disarming the flags leaves zero residue in the
+    flags-off program — (b) a different program with the flags on (the
+    fusions really engage), and (c) no 'ef' key in the flags-off
+    optimizer state.  (This checks toggle idempotence, not identity
+    with the pre-PR program: the flags-off loss/norm code paths were
+    themselves deduplicated in this PR, value-pinned by regression
+    tests.)
+    Cheap (tiny llama, lowering only — no compile/execute), runs before
+    every bench config."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+    from paddle_tpu.parallel import ShardedTrainStep
+    from paddle_tpu.distributed.topology import build_mesh
+
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 512, (2, 16)).astype(np.int32))
+
+    def build(fused, bf16m):
+        set_flags({"FLAGS_fused_ce": fused,
+                   "FLAGS_bf16_adamw_moments": bf16m})
+        try:
+            paddle.seed(0)
+            m = LlamaForCausalLM(llama_tiny_config())
+            opt = paddle.optimizer.AdamW(
+                1e-3, parameters=m.parameters(), weight_decay=0.1)
+            step = ShardedTrainStep(
+                m, opt, build_mesh(devices=jax.devices()[:1]),
+                sharding_stage=0)
+            hlo = step.compiled_hlo(ids, ids, optimized=False)
+            state_keys = set(step._opt_states[0])
+        finally:
+            set_flags({"FLAGS_fused_ce": False,
+                       "FLAGS_bf16_adamw_moments": False})
+        return hlo, state_keys
+
+    off1, keys_off = build(False, False)
+    on, keys_on = build(True, True)
+    off2, _ = build(False, False)
+    assert off1 == off2, \
+        "flags-off train step is not byte-identical across flag toggles"
+    assert on != off1, "MFU-fusion flags changed nothing in the program"
+    assert "ef" not in keys_off and "ef" in keys_on, \
+        f"optimizer state keys wrong: off={keys_off}, on={keys_on}"
+
+
 def main():
     _assert_analysis_zero_overhead()
     _assert_fault_tolerance_zero_overhead()
+    _assert_mfu_fusion_zero_overhead()
     which = os.environ.get("BENCH_CONFIG", "all").lower()
     if "--only" in sys.argv:
         i = sys.argv.index("--only")

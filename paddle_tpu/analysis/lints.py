@@ -36,7 +36,8 @@ import jax
 from .base import Finding, RecompileError
 
 __all__ = ["iter_eqns", "lint_dtype_promotion", "lint_transfers",
-           "lint_donation", "lint_compiled_step", "recompile_guard",
+           "lint_donation", "lint_materialized_logits",
+           "lint_compiled_step", "recompile_guard",
            "note_program_build"]
 
 
@@ -283,23 +284,80 @@ def lint_donation(lowered_or_fn, *args,
 
 
 # ---------------------------------------------------------------------------
+# materialized-logits lint
+
+def lint_materialized_logits(fn_or_jaxpr, *args, vocab_size: int,
+                             min_rows: Optional[int] = None
+                             ) -> List[Finding]:
+    """Findings for every fp32 intermediate shaped [..., vocab_size]
+    inside the traced program — the full-logits buffer the fused
+    chunked cross-entropy exists to eliminate (at the llama bench shape
+    the [B, S, V] fp32 logits are 256 MB, the largest live allocation
+    in the step; PROFILE_r05's logits/CE gap item).
+
+    Rule: an eqn OUTPUT with dtype float32, last dim == vocab_size and
+    ndim >= 3 (a batched [B, S, V] buffer).  The fused path's per-chunk
+    [chunk, V] slices are 2-D and stay below the radar; so do the [H, V]
+    lm-head weight gradients.  `min_rows` additionally flags 2-D
+    [rows, V] buffers whose leading product reaches it (catches a
+    flattened [B*S, V] materialization when the caller knows the token
+    count).  Recurses into scan/while/pjit sub-jaxprs like every other
+    jaxpr lint.
+    """
+    jaxpr = as_jaxpr(fn_or_jaxpr, *args)
+    findings: List[Finding] = []
+    for i, eqn in enumerate(iter_eqns(jaxpr)):
+        for aval in _avals(eqn.outvars):
+            shape = tuple(getattr(aval, "shape", ()))
+            if len(shape) < 2 or shape[-1] != vocab_size \
+                    or str(aval.dtype) != "float32":
+                continue
+            rows = 1
+            for d in shape[:-1]:
+                rows *= int(d)
+            if len(shape) >= 3 or (min_rows is not None
+                                   and rows >= min_rows):
+                findings.append(Finding(
+                    "materialized-logits",
+                    f"eqn '{eqn.primitive.name}' materializes a "
+                    f"[{', '.join(str(d) for d in shape)}] fp32 buffer "
+                    f"with vocab-sized last dim ({vocab_size}) — "
+                    f"{rows * vocab_size * 4 / 1e6:.1f} MB of full "
+                    f"logits the fused cross-entropy path avoids",
+                    op_index=i,
+                    detail=(eqn.primitive.name, shape)))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # combined dispatch for compiled train steps
 
 def lint_compiled_step(compiled, args, *, mesh=None, dtype=False,
-                       transfers=False, donation=False):
+                       transfers=False, donation=False,
+                       logits_vocab: Optional[int] = None,
+                       logits_min_rows: Optional[int] = None):
     """Shared body of ShardedTrainStep.lint / OffloadPipelineStep.lint:
     trace the jitted `compiled` ONCE for the jaxpr-walking lints, lower
     separately for the donation check, all under the mesh context.
-    Returns {category: [Finding, ...]} for the enabled categories."""
+    Returns {category: [Finding, ...]} for the enabled categories.
+
+    logits_vocab: enable lint_materialized_logits with this vocab size
+    (the fused-CE no-full-logits contract); logits_min_rows additionally
+    flags flattened 2-D [rows>=min_rows, V] fp32 buffers (the [B*S, V]
+    evasion — callers that know the step's token count pass it)."""
     import contextlib
     out = {}
     with (mesh if mesh is not None else contextlib.nullcontext()):
-        if dtype or transfers:
+        if dtype or transfers or logits_vocab is not None:
             jaxpr = jax.make_jaxpr(compiled)(*args)
             if dtype:
                 out["dtype"] = lint_dtype_promotion(jaxpr)
             if transfers:
                 out["transfers"] = lint_transfers(jaxpr)
+            if logits_vocab is not None:
+                out["logits"] = lint_materialized_logits(
+                    jaxpr, vocab_size=int(logits_vocab),
+                    min_rows=logits_min_rows)
         if donation:
             out["donation"] = lint_donation(compiled.lower(*args))
     return out

@@ -194,7 +194,13 @@ class RowParallelLinear(Layer):
 class ParallelCrossEntropy(Layer):
     """Reference: mp_layers.py:742 (c_softmax_with_cross_entropy kernel —
     a hand-written vocab-parallel softmax).  With vocab-sharded logits GSPMD
-    derives the same comm pattern from the plain cross_entropy graph."""
+    derives the same comm pattern from the plain cross_entropy graph.
+
+    shard_map callers that want the hand-written merge (and the fused
+    no-logits loss) use `ops.pallas.fused_cross_entropy.
+    fused_linear_cross_entropy(axis_name=...)` instead: per-shard
+    max/denominator/picked combined with one pmax + psum per row chunk,
+    hidden gradients psum'd across shards."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()

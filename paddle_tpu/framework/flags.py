@@ -114,3 +114,17 @@ define_flag("skip_nonfinite_steps", False,
 define_flag("max_consecutive_bad_steps", 8,
             "abort training after this many CONSECUTIVE nonfinite "
             "steps (a persistent divergence, not a transient spike)")
+# MFU-gap kernel fusions (ISSUE 5): both off by default — the flags-off
+# train step must compile to a byte-identical program (bench-asserted).
+define_flag("fused_ce", False,
+            "causal/masked LM losses compute from the HIDDEN states via "
+            "the chunked fused linear+cross-entropy "
+            "(nn.functional.fused_cross_entropy): the [B, S, vocab] fp32 "
+            "logits tensor is never materialized — the model's training "
+            "forward returns hidden states and compute_loss folds the "
+            "lm-head matmul into the loss")
+define_flag("bf16_adamw_moments", False,
+            "store Adam/AdamW moments in bfloat16 with an error-feedback "
+            "residual for the second moment (state key 'ef'): moment HBM "
+            "traffic halves (8->4 bytes/param) plus a 2-byte residual; "
+            "update math stays fp32 via the v+ef reconstruction")

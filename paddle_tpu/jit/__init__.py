@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 import jax
 import jax.numpy as jnp
+from ..ops.pallas._x64 import x64_off
 
 from ..framework.tensor import Tensor, Parameter
 from ..framework.tape import no_grad
@@ -532,7 +533,7 @@ def save(layer, path, input_spec=None, **configs):
     param_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype)
                    for v in state.values()]
     in_avals = _specs_to_avals(list(input_spec))
-    with jax.enable_x64(False):
+    with x64_off():
         exported = jexport.export(jax.jit(raw))(param_avals, *in_avals)
         blob = exported.serialize()
 
@@ -580,7 +581,7 @@ class TranslatedLayer:
         in_vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
                    for a in args]
         state_vals = [self._state[n]._value for n in self._param_names]
-        with jax.enable_x64(False):
+        with x64_off():
             out = self._exported.call(state_vals, *in_vals)
         return jax.tree_util.tree_map(
             lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out)

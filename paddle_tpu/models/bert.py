@@ -205,6 +205,13 @@ class BertForMaskedLM(nn.Layer):
         x = self.transform_norm(nn.functional.gelu(
             self.transform(seq_out),
             approximate=self.config.hidden_act == "gelu_tanh"))
+        from ..framework.flags import get_flag
+        if get_flag("fused_ce") and self.training:
+            # fused-loss mode: compute_loss folds the tied-embedding
+            # decoder matmul into the chunked cross entropy — the
+            # [tokens, vocab] logits (2 GB of HBM traffic at bench
+            # shapes) never materialize
+            return x
         w = self.bert.embeddings.word_embeddings.weight
         return run(lambda v, e, b: v @ e.T.astype(v.dtype)
                    + b.astype(v.dtype),
@@ -212,24 +219,22 @@ class BertForMaskedLM(nn.Layer):
                    name="mlm_decoder")
 
     def compute_loss(self, logits, labels, ignore_index=-100):
-        """Masked-position cross entropy, fp32 accumulation.
-
-        CE = logsumexp(logits) − logits[target]: only the per-row lse
-        (a reduction XLA fuses over the bf16 logits — the fp32 cast
-        never materializes) and the gathered target logit are needed;
-        materializing the full [tokens, vocab] fp32 log_softmax just to
-        gather one element per row costs 2 GB of HBM traffic at
-        BERT-base bench shapes."""
-        (logits, labels) = to_tensor_args(logits, labels)
-        lbl = labels.value
-
-        def _fn(lg):
-            import jax
-            tgt = jnp.maximum(lbl.astype(jnp.int32), 0)
-            picked = jnp.take_along_axis(lg, tgt[..., None],
-                                         axis=-1)[..., 0]
-            lse = jax.nn.logsumexp(lg.astype(jnp.float32), axis=-1)
-            mask = (lbl != ignore_index).astype(jnp.float32)
-            ce = lse - picked.astype(jnp.float32)
-            return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-        return run(_fn, logits, name="mlm_loss")
+        """Masked-position cross entropy, fp32 accumulation, via the
+        shared nn.functional.fused_cross_entropy (CE = lse − picked;
+        under FLAGS_fused_ce the decoder matmul folds into the chunked
+        fused loss and only [chunk, vocab] logits slices ever exist)."""
+        (out, labels) = to_tensor_args(logits, labels)
+        cfg = self.config
+        # mirrors forward()'s fused gate (flag + training) — see
+        # models/llama.py: shape inference alone mis-dispatches when
+        # hidden_size == vocab_size
+        from ..framework.flags import get_flag
+        if get_flag("fused_ce") and self.training \
+                and out.shape[-1] == cfg.hidden_size:
+            return nn.functional.fused_cross_entropy(
+                out, labels,
+                weight=self.bert.embeddings.word_embeddings.weight,
+                bias=self.decoder_bias, transpose_weight=True,
+                ignore_index=ignore_index, name="mlm_loss_fused")
+        return nn.functional.fused_cross_entropy(
+            out, labels, ignore_index=ignore_index, name="mlm_loss")

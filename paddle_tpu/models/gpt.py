@@ -157,22 +157,32 @@ class GPTForCausalLM(nn.Layer):
 
     def forward(self, input_ids):
         x = self.gpt(input_ids)
+        from ..framework.flags import get_flag
+        if get_flag("fused_ce") and self.training:
+            # fused-loss mode: compute_loss folds the tied-embedding
+            # lm-head matmul into the chunked cross entropy
+            return x
         w = self.gpt.wte
         return run(lambda v, e: v @ e.T.astype(v.dtype), x, w,
                    name="gpt_lm_head")
 
     def compute_loss(self, logits, labels):
-        (logits, labels) = to_tensor_args(logits, labels)
-        lbl = labels.value
-
-        def _fn(lg):
-            lgf = lg[:, :-1].astype(jnp.float32)
-            tgt = lbl[:, 1:].astype(jnp.int32)
-            logp = jax.nn.log_softmax(lgf, axis=-1)
-            picked = jnp.take_along_axis(logp, tgt[..., None],
-                                         axis=-1)[..., 0]
-            return -jnp.mean(picked)
-        return run(_fn, logits, name="gpt_lm_loss")
+        """Next-token cross entropy via the shared
+        nn.functional.fused_cross_entropy (hidden-state fused mode
+        under FLAGS_fused_ce — see models/llama.py)."""
+        (out, labels) = to_tensor_args(logits, labels)
+        cfg = self.config
+        # mirrors forward()'s fused gate (flag + training) — see
+        # models/llama.py: shape inference alone mis-dispatches when
+        # hidden_size == vocab_size
+        from ..framework.flags import get_flag
+        if get_flag("fused_ce") and self.training \
+                and out.shape[-1] == cfg.hidden_size:
+            return nn.functional.fused_cross_entropy(
+                out, labels, weight=self.gpt.wte, transpose_weight=True,
+                shift=True, name="gpt_lm_loss_fused")
+        return nn.functional.fused_cross_entropy(
+            out, labels, shift=True, name="gpt_lm_loss")
 
 
 def shard_gpt_tp(model: GPTForCausalLM, mesh):

@@ -335,7 +335,35 @@ class LlamaDecoderLayer(nn.Layer):
     def _qkv_part(self, x, cos, sin):
         return self.self_attn.qkv_rope(self.input_layernorm(x), cos, sin)
 
+    def _add_norm_mid(self, x, delta):
+        """Fused mid-block residual-add + RMSNorm (ops.fused_add_rms_norm
+        — one Pallas VMEM pass on TPU, the identical unfused ops
+        elsewhere): returns (tagged residual, normed) so the attention
+        output lands in the residual stream and feeds the MLP norm
+        without a second HBM round-trip (PROFILE_r05 norm slice)."""
+        from jax.ad_checkpoint import checkpoint_name
+        from ..parallel.sharded_trainer import constrain_activation
+        norm = self.post_attention_layernorm
+        (x, delta) = to_tensor_args(x, delta)
+
+        def _fn(xv, dv, w):
+            resid, normed = tpu_ops.fused_add_rms_norm(
+                xv, dv, w.astype(xv.dtype), norm.eps)
+            resid = checkpoint_name(constrain_activation(resid),
+                                    "resid_mid")
+            return resid, normed
+        return run(_fn, x, delta, norm.weight, name="fused_add_rms_norm")
+
     def _post_attention(self, x, attn):
+        """Selective-remat region B body.  Deliberately UNFUSED: the
+        save_only_these_names('resid_mid') policy replays everything
+        downstream of the tag, so the norm must CONSUME the tagged
+        residual — the backward then rebuilds only norm+MLP from the
+        saved tag.  Routing through the fused add+norm kernel here
+        would put the MLP's input upstream of the tag and make the
+        replay re-run output_proj per layer (an extra [T,H]x[H,H]
+        matmul in every backward).  The fused kernel serves _block
+        (full-/no-remat), where no such replay split exists."""
         from jax.ad_checkpoint import checkpoint_name
         from ..parallel.sharded_trainer import constrain_activation
         x = x + self.self_attn.output_proj(attn)
@@ -346,13 +374,10 @@ class LlamaDecoderLayer(nn.Layer):
         return run(constrain_activation, x, name="constrain_resid")
 
     def _block(self, x, cos, sin):
-        from jax.ad_checkpoint import checkpoint_name
         from ..parallel.sharded_trainer import constrain_activation
-        x = x + self.self_attn(self.input_layernorm(x), cos, sin)
-        x = run(lambda v: checkpoint_name(constrain_activation(v),
-                                          "resid_mid"), x,
-                name="tag_resid")
-        x = x + self.mlp(self.post_attention_layernorm(x))
+        a = self.self_attn(self.input_layernorm(x), cos, sin)
+        x, h = self._add_norm_mid(x, a)
+        x = x + self.mlp(h)
         return run(constrain_activation, x, name="constrain_resid")
 
     def forward_cached(self, x, cos, sin, k_cache, v_cache, pos):
@@ -453,6 +478,12 @@ class LlamaForCausalLM(nn.Layer):
 
     def forward(self, input_ids):
         x = self.llama(input_ids)
+        from ..framework.flags import get_flag
+        if get_flag("fused_ce") and self.training:
+            # fused-loss mode: compute_loss folds the lm-head matmul
+            # into the chunked cross entropy — the [B, S, V] fp32
+            # logits (the step's largest live buffer) never materialize
+            return x
         if self.config.tie_word_embeddings:
             w = self.llama.embed_tokens
             return run(lambda v, e: v @ e.T.astype(v.dtype), x, w,
@@ -479,20 +510,30 @@ class LlamaForCausalLM(nn.Layer):
 
     def compute_loss(self, logits, labels):
         """Next-token cross entropy in fp32 (reference:
-        ParallelCrossEntropy over vocab-sharded logits)."""
-        (logits,) = to_tensor_args(logits)
+        ParallelCrossEntropy over vocab-sharded logits), via the shared
+        nn.functional.fused_cross_entropy.  Under FLAGS_fused_ce the
+        forward hands HIDDEN states here and the lm-head matmul folds
+        into the chunked fused loss (no [B, S, V] fp32 logits)."""
+        (out,) = to_tensor_args(logits)
         (labels,) = to_tensor_args(labels)
-        lbl = labels.value
-
-        def _fn(lg):
-            import jax
-            lgf = lg[:, :-1].astype(jnp.float32)
-            tgt = lbl[:, 1:].astype(jnp.int32)
-            logp = jax.nn.log_softmax(lgf, axis=-1)
-            picked = jnp.take_along_axis(logp, tgt[..., None],
-                                         axis=-1)[..., 0]
-            return -jnp.mean(picked)
-        loss = run(_fn, logits, name="causal_lm_loss")
+        cfg = self.config
+        # fused-mode detection mirrors forward()'s gate (flag + training)
+        # rather than inferring from shapes — a shape heuristic silently
+        # mis-dispatches when hidden_size == vocab_size.  The shape check
+        # only guards against logits computed OUTSIDE fused mode.
+        from ..framework.flags import get_flag
+        if get_flag("fused_ce") and self.training \
+                and out.shape[-1] == cfg.hidden_size:
+            if cfg.tie_word_embeddings:
+                w, tw = self.llama.embed_tokens, True
+            else:
+                w, tw = self.lm_head, False
+            loss = F.fused_cross_entropy(out, labels, weight=w,
+                                         transpose_weight=tw, shift=True,
+                                         name="causal_lm_loss_fused")
+        else:
+            loss = F.fused_cross_entropy(out, labels, shift=True,
+                                         name="causal_lm_loss")
         if self.config.moe_num_experts > 0 \
                 and self.config.moe_aux_weight:
             # load-balance auxiliary loss from each MoE block's last

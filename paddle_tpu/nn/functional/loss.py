@@ -92,6 +92,87 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     return run(_fn, *args, name="cross_entropy")
 
 
+def fused_cross_entropy(input, label, weight=None, bias=None, *,
+                        transpose_weight=False, ignore_index=None,
+                        shift=False, chunk_rows=None, vocab_chunk=None,
+                        axis_name=None, use_pallas=None, name=None):
+    """Token-level LM cross entropy — the ONE implementation of the loss
+    math llama, gpt and bert's MLM head used to hand-roll (PROFILE_r05:
+    the fp32 logits/CE slice of the non-matmul MFU gap).
+
+    Two modes:
+
+      weight is None — `input` IS the logits [..., V].  Reference path:
+        fp32 `logsumexp − picked logit`, masked mean over labels that
+        are non-negative and != ignore_index.  Same values as the old
+        per-model implementations (regression-pinned).
+
+      weight given — `input` is the HIDDEN states [..., H] and the
+        lm-head matmul folds INTO the loss: the chunked fused
+        linear+cross-entropy (ops/pallas/fused_cross_entropy.py,
+        Liger-style) computes per-row-chunk logits, loss and gradients
+        in one sweep, so the [B, S, V] fp32 logits tensor — the single
+        largest live buffer in the llama train step — never exists.
+        `weight` is [H, V], or [V, H] with transpose_weight (the
+        tied-embedding layout); optional `bias` [V].  axis_name: the
+        vocab-sharded (ParallelCrossEntropy) mode for shard_map callers
+        — per-shard max/denominator merged with one pmax + psum.
+
+    shift=True drops the last input position and the first label column
+    (next-token prediction) — kept here so both modes shift
+    identically.  Models enable the fused mode via FLAGS_fused_ce (the
+    training forward then returns hidden states).
+    """
+    (input,) = to_tensor_args(input)
+    (label,) = to_tensor_args(label)
+    lbl = label.value
+
+    def _prep_labels(lg_or_h):
+        tgt = lbl[:, 1:] if shift else lbl
+        return lg_or_h[:, :-1] if shift else lg_or_h, tgt
+
+    if weight is None:
+        def _fn(lg):
+            lgv, tgt = _prep_labels(lg)
+            tgt = tgt.astype(jnp.int32)
+            if ignore_index is not None:
+                tgt = jnp.where(tgt == ignore_index, -1, tgt)
+            safe = jnp.maximum(tgt, 0)
+            # gather from the COMPUTE-dtype logits and upcast only the
+            # picked column; the fp32 cast feeds just the logsumexp
+            # reduction (XLA fuses it) — a full fp32 [tokens, vocab]
+            # buffer never needs to materialize on this flags-off path
+            picked = jnp.take_along_axis(lgv, safe[..., None],
+                                         axis=-1)[..., 0] \
+                .astype(jnp.float32)
+            lse = jax.nn.logsumexp(lgv.astype(jnp.float32), axis=-1)
+            mask = (tgt >= 0).astype(jnp.float32)
+            return jnp.sum((lse - picked) * mask) \
+                / jnp.maximum(jnp.sum(mask), 1.0)
+        return run(_fn, input, name=name or "fused_cross_entropy")
+
+    from ...ops.pallas.fused_cross_entropy import \
+        fused_linear_cross_entropy
+    (weight,) = to_tensor_args(weight)
+    has_b = bias is not None
+    if has_b:
+        (bias,) = to_tensor_args(bias)
+
+    def _fused(h, w, *b):
+        hv, tgt = _prep_labels(h)
+        # the matmul runs in the hidden states' compute dtype (what the
+        # unfused lm-head did) with fp32 accumulation inside the kernel
+        return fused_linear_cross_entropy(
+            hv, w.astype(hv.dtype), tgt,
+            bias=b[0].astype(jnp.float32) if b else None,
+            transpose_weight=transpose_weight, ignore_index=ignore_index,
+            chunk_rows=chunk_rows, vocab_chunk=vocab_chunk,
+            axis_name=axis_name, use_pallas=use_pallas)
+
+    args = (input, weight) + ((bias,) if has_b else ())
+    return run(_fused, *args, name=name or "fused_linear_cross_entropy")
+
+
 def softmax_with_cross_entropy(logits, label, soft_label=False,
                                ignore_index=-100, numeric_stable_mode=True,
                                return_softmax=False, axis=-1):

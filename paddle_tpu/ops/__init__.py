@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["attention", "cached_attention", "rms_norm", "layer_norm",
+           "fused_add_rms_norm", "xla_fused_add_rms_norm",
            "rope", "apply_rope",
            "swiglu", "get_attention_backend", "set_attention_backend",
            "gqa_scores", "gqa_weighted_v"]
@@ -183,6 +184,29 @@ def rms_norm(x, weight=None, epsilon=1e-6):
     return xla_rms_norm(x, weight, epsilon)
 
 
+def xla_fused_add_rms_norm(x, y, weight, epsilon=1e-6):
+    """jnp twin of pallas.rms_norm.fused_add_rms_norm — the EXACT ops
+    of the unfused path (add in the compute dtype, then xla_rms_norm),
+    so threading the fused entry into a model changes nothing
+    numerically off-TPU."""
+    resid = x + y
+    return resid, xla_rms_norm(resid, weight, epsilon)
+
+
+def fused_add_rms_norm(x, y, weight, epsilon=1e-6):
+    """Fused residual-add + RMSNorm: (x + y, rms_norm(x + y) * weight)
+    in one Pallas VMEM pass on TPU (the residual sum is written once and
+    never re-read — one fewer [tokens, H] HBM round-trip per transformer
+    block, a PROFILE_r05 non-matmul gap item).  XLA twin elsewhere."""
+    if _on_tpu() and weight is not None and x.ndim >= 2:
+        from .pallas.rms_norm import fused_add_rms_norm as _parn
+        try:
+            return _parn(x, y, weight, epsilon)
+        except ValueError:
+            pass  # tiling-incompatible shape → XLA path
+    return xla_fused_add_rms_norm(x, y, weight, epsilon)
+
+
 def layer_norm(x, weight=None, bias=None, epsilon=1e-5):
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=-1, keepdims=True)
@@ -218,7 +242,19 @@ def _rotate_half(x):
 def apply_rope(q, k, cos, sin):
     """Reference: incubate fused_rotary_position_embedding (NeoX-style
     rotate-half, matching paddle's use_neox_rotary_style=True).
-    q/k: [b, s, h, d]; cos/sin: [s, d] or [b, s, d]."""
+    q/k: [b, s, h, d]; cos/sin: [s, d] or [b, s, d].
+
+    On TPU the q/k rotation runs as ONE Pallas pass per row block
+    (pallas/rope.py — each operand read once, written once; the XLA
+    path's concat/slice rotate-half shuffles are a PROFILE_r05
+    non-matmul gap item); shapes its tiling cannot serve (e.g. the
+    batch·seq < 8 decode case) fall back to XLA here."""
+    if _on_tpu() and q.ndim == 4 and k.ndim == 4:
+        from .pallas.rope import rope_apply as _prope
+        try:
+            return _prope(q, k, cos, sin)
+        except ValueError:
+            pass  # tiling-incompatible shape → XLA path
     if cos.ndim == 2:      # [s, d] → [1, s, 1, d]
         cos, sin = cos[None, :, None, :], sin[None, :, None, :]
     elif cos.ndim == 3:    # [b, s, d] → [b, s, 1, d]

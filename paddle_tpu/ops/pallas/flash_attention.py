@@ -30,6 +30,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from ._x64 import x64_off
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -133,7 +134,7 @@ def _fwd_small(q3, k2, v2, scale, causal, block_q, block_k, h, hk):
     kvm = _kv_head_map(h, hk)
     kv_spec = lambda b, i: (kvm(b), 0, 0)
     grid = (bh, sq // block_q)
-    with jax.enable_x64(False):
+    with x64_off():
         out, lse = pl.pallas_call(
             functools.partial(_fwd_small_kernel, scale=scale, causal=causal,
                               block_q=block_q, block_k=block_k, seq_k=sk),
@@ -277,7 +278,7 @@ def _bwd_small(scale, causal, block_q, block_k, h, hk, res, do3):
     kvm = _kv_head_map(h, hk)
     kv_spec = lambda b, i: (kvm(b), 0, 0)
 
-    with jax.enable_x64(False):
+    with x64_off():
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_small_kernel, scale=scale,
                               causal=causal, block_q=block_q,
@@ -367,7 +368,7 @@ def _fwd_1b(q3, k2, v2, scale, causal, gh):
     bh, sq, d = q3.shape
     sk = k2.shape[1]
     spec = lambda b: (b, 0, 0)
-    with jax.enable_x64(False):
+    with x64_off():
         out, lse = pl.pallas_call(
             functools.partial(_fwd_1b_kernel, scale=scale, causal=causal,
                               gh=gh),
@@ -428,7 +429,7 @@ def _bwd_1b(scale, causal, gh, res, do3):
     delta = jnp.sum(do3.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
     spec = lambda b: (b, 0, 0)
-    with jax.enable_x64(False):
+    with x64_off():
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_1b_kernel, scale=scale, causal=causal,
                               gh=gh),
@@ -549,7 +550,7 @@ def _fwd(q3, k2, v2, scale, causal, block_q, block_k, h, hk):
     grid = (bh, sq // block_q, num_kb)
     # mosaic rejects the i64/f64 weak constants x64 mode produces; trace the
     # kernel with x64 off (all operands are explicitly typed anyway)
-    with jax.enable_x64(False):
+    with x64_off():
         out, lse = pl.pallas_call(
             functools.partial(_fwd_kernel, scale=scale, causal=causal,
                               block_q=block_q, block_k=block_k,
@@ -692,7 +693,7 @@ def _bwd(scale, causal, block_q, block_k, h, hk, res, do3):
     else:
         kv_spec = lambda b, i, j: (kvm(b), j, 0)
 
-    with jax.enable_x64(False):
+    with x64_off():
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                               block_q=block_q, block_k=block_k,

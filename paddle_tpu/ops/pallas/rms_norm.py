@@ -8,6 +8,15 @@ dw across row blocks with a fp32 accumulator output.
   y   = x * rsqrt(mean(x², -1) + eps) * w
   dx  = r*(g*w) - r³/H * x * Σ(g*w*x)      (r = rsqrt(mean x² + eps))
   dw  = Σ_rows g * x * r
+
+`fused_add_rms_norm` extends the same kernel with the residual add that
+always precedes the norm in pre-LN transformer blocks (PROFILE_r05: the
+add and the norm are separate HBM round-trips at a fusion boundary):
+one pass reads (x, y), writes the residual sum AND its norm — the sum
+is never re-read.  The residual output is rounded to the storage dtype
+BEFORE the statistics, so fused and unfused (`x + y` then `rms_norm`)
+are bit-identical; the backward fuses the residual cotangent add into
+the norm's dx kernel.
 """
 from __future__ import annotations
 
@@ -15,6 +24,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from ._x64 import x64_off
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -69,7 +79,7 @@ def _rms2(x2, w, eps):
     rows, h = x2.shape
     br = _pick_block_rows(rows, h)
     grid = (rows // br,)
-    with jax.enable_x64(False):
+    with x64_off():
         out = pl.pallas_call(
             functools.partial(_fwd_kernel, eps=eps),
             grid=grid,
@@ -97,7 +107,7 @@ def _rms_bwd(eps, res, g2):
     rows, h = x2.shape
     br = _pick_block_rows(rows, h)
     nblocks = rows // br
-    with jax.enable_x64(False):
+    with x64_off():
         dx, dw_part = pl.pallas_call(
             functools.partial(_bwd_kernel, eps=eps),
             grid=(nblocks,),
@@ -124,3 +134,110 @@ def rms_norm(x, weight, epsilon=1e-6):
     x2 = x.reshape(-1, h)
     out = _rms_core(x2, weight, float(epsilon))
     return out.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# fused residual-add + RMSNorm
+
+def _add_fwd_kernel(x_ref, y_ref, w_ref, r_ref, o_ref, *, eps):
+    s = x_ref[:].astype(jnp.float32) + y_ref[:].astype(jnp.float32)
+    # round to the residual storage dtype FIRST: the statistics then see
+    # exactly what the unfused `x + y` produced → bit-identical paths
+    s_low = s.astype(r_ref.dtype)
+    r_ref[:] = s_low
+    sf = s_low.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(sf * sf, axis=-1, keepdims=True)
+                      + jnp.float32(eps))
+    o_ref[:] = (sf * r * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _add_bwd_kernel(x_ref, w_ref, g_ref, gr_ref, dx_ref, dw_ref, *, eps):
+    """Norm backward over the saved residual + fused add of the residual
+    cotangent (gr): d(resid) = rms_dx + gr, and dx == dy == d(resid)."""
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    h = x.shape[-1]
+    r = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True)
+                      + jnp.float32(eps))
+    gw = g * w
+    dot = jnp.mean(gw * x, axis=-1, keepdims=True)
+    dx = r * gw - (r * r * r) * x * dot + gr_ref[:].astype(jnp.float32)
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+    dw_ref[0, 0] = jnp.sum(g * x * r, axis=0)
+
+
+def _add_rms2(x2, y2, w, eps):
+    rows, h = x2.shape
+    br = _pick_block_rows(rows, h)
+    res_dt = jnp.promote_types(x2.dtype, y2.dtype)
+    with x64_off():
+        resid, out = pl.pallas_call(
+            functools.partial(_add_fwd_kernel, eps=eps),
+            grid=(rows // br,),
+            in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                      pl.BlockSpec((br, h), lambda i: (i, 0)),
+                      pl.BlockSpec((h,), lambda i: (0,))],
+            out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                       pl.BlockSpec((br, h), lambda i: (i, 0))],
+            out_shape=[jax.ShapeDtypeStruct((rows, h), res_dt),
+                       jax.ShapeDtypeStruct(
+                           (rows, h), jnp.promote_types(res_dt, w.dtype))],
+            interpret=_interpret(),
+        )(x2, y2, w)
+    return resid, out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _add_rms_core(x2, y2, w, eps):
+    return _add_rms2(x2, y2, w, eps)
+
+
+def _add_rms_fwd(x2, y2, w, eps):
+    resid, out = _add_rms2(x2, y2, w, eps)
+    return (resid, out), (resid, w)
+
+
+def _add_rms_bwd(eps, res, g):
+    resid, w = res
+    g_resid, g_out = g
+    rows, h = resid.shape
+    br = _pick_block_rows(rows, h)
+    nblocks = rows // br
+    with x64_off():
+        dresid, dw_part = pl.pallas_call(
+            functools.partial(_add_bwd_kernel, eps=eps),
+            grid=(nblocks,),
+            in_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                      pl.BlockSpec((h,), lambda i: (0,)),
+                      pl.BlockSpec((br, h), lambda i: (i, 0)),
+                      pl.BlockSpec((br, h), lambda i: (i, 0))],
+            out_specs=[pl.BlockSpec((br, h), lambda i: (i, 0)),
+                       pl.BlockSpec((1, 1, h), lambda i: (i, 0, 0))],
+            out_shape=[jax.ShapeDtypeStruct((rows, h), resid.dtype),
+                       jax.ShapeDtypeStruct((nblocks, 1, h), jnp.float32)],
+            interpret=_interpret(),
+        )(resid, w, g_out, g_resid)
+    dw = jnp.sum(dw_part, axis=(0, 1)).astype(w.dtype)
+    return dresid, dresid, dw
+
+
+_add_rms_core.defvjp(_add_rms_fwd, _add_rms_bwd)
+
+
+def fused_add_rms_norm(x, y, weight, epsilon=1e-6):
+    """(x + y, rms_norm(x + y) * weight) in one VMEM pass.
+    x/y: [..., H]; weight: [H].  Returns (residual, normed), both shaped
+    like x; the residual is in promote_types(x, y) — identical to the
+    unfused `x + y`.  Mixed-dtype operands are cast to the common dtype
+    outside the custom VJP (the cast's own autodiff restores each
+    operand's gradient dtype)."""
+    shape = x.shape
+    h = shape[-1]
+    if y.shape != shape:
+        raise ValueError(f"residual shapes differ: {shape} vs {y.shape}")
+    res_dt = jnp.promote_types(x.dtype, y.dtype)
+    resid, out = _add_rms_core(x.reshape(-1, h).astype(res_dt),
+                               y.reshape(-1, h).astype(res_dt),
+                               weight, float(epsilon))
+    return resid.reshape(shape), out.reshape(shape)

@@ -85,7 +85,7 @@ def _fusable(hp, state, p_dtype):
     if jax.default_backend() != "tpu" \
             and not get_flag("fused_adamw_interpret"):
         return False
-    keys = set(state)
+    keys = set(state) - {"ef"}   # the error-feedback residual rides along
     if "master" in keys:
         return {"moment1", "moment2", "master"} == keys
     return ({"moment1", "moment2"} == keys
@@ -115,28 +115,37 @@ def apply_update(upd, p, g, s, lr, wd, step_i, hp, fused_ok=True,
     if fusable and (fused_ok or (mesh is not None and spec is not None)):
         from ..ops.pallas.fused_adamw import fused_adamw
         master = s.get("master", p)
+        ef = s.get("ef")
         kw = dict(b1=hp["b1"], b2=hp["b2"], eps=hp["eps"], wd=wd,
                   decoupled=hp["decoupled"], out_dtype=p.dtype)
         if fused_ok:
-            new_p, m, v, mst = fused_adamw(g, s["moment1"], s["moment2"],
-                                           master, lr, step_i, **kw)
+            out = fused_adamw(g, s["moment1"], s["moment2"], master,
+                              lr, step_i, ef=ef, **kw)
         else:
             from jax.experimental.shard_map import shard_map
             sp = _pad_spec(spec, g.ndim)
+            n_state = 4 if ef is None else 5
 
-            def local(g_, m_, v_, mst_, lr_, st_):
-                return fused_adamw(g_, m_, v_, mst_, lr_, st_, **kw)
+            def local(g_, m_, v_, mst_, lr_, st_, *ef_):
+                return fused_adamw(g_, m_, v_, mst_, lr_, st_,
+                                   ef=ef_[0] if ef_ else None, **kw)
 
-            new_p, m, v, mst = shard_map(
+            out = shard_map(
                 local, mesh=mesh,
-                in_specs=(sp, sp, sp, sp, P(), P()),
-                out_specs=(sp, sp, sp, sp),
+                in_specs=(sp, sp, sp, sp, P(), P())
+                + ((sp,) if ef is not None else ()),
+                out_specs=(sp,) * n_state,
                 check_rep=False,
             )(g, s["moment1"], s["moment2"], master,
-              jnp.asarray(lr, jnp.float32), jnp.asarray(step_i, jnp.int32))
+              jnp.asarray(lr, jnp.float32), jnp.asarray(step_i, jnp.int32),
+              *(() if ef is None else (ef,)))
+        new_p, m, v, mst = out[:4]
+        ns = {"moment1": m, "moment2": v}
         if "master" in s:
-            return new_p, {"moment1": m, "moment2": v, "master": mst}
-        return new_p, {"moment1": m, "moment2": v}
+            ns["master"] = mst
+        if ef is not None:
+            ns["ef"] = out[4]
+        return new_p, ns
     if "master" in s:
         rest = {k: v for k, v in s.items() if k != "master"}
         new_master, ns = upd(s["master"], g.astype(jnp.float32), rest,
@@ -177,7 +186,9 @@ def apply_updates(upd, params, grads, states, lr, wds, step_i, hp,
 
     groups: dict = {}
     for i, (p, s) in enumerate(zip(params, states)):
-        if (p.size < _MULTI_TENSOR_MAX
+        # ef states stay per-param: grouping is measured perf-neutral at
+        # best, and the residual would need its own concat/split lane
+        if (p.size < _MULTI_TENSOR_MAX and "ef" not in s
                 and _fusable(hp, s, jnp.dtype(p.dtype))):
             key = (float(wds[i]), float(lr_scales[i]),
                    jnp.dtype(p.dtype).name, "master" in s,

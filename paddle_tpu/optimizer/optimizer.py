@@ -249,7 +249,7 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 name=None, moment_dtype=None, **kw):
+                 name=None, moment_dtype=None, moment_ef=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision=multi_precision, **kw)
         self._beta1 = beta1
@@ -257,14 +257,32 @@ class Adam(Optimizer):
         self._epsilon = epsilon
         # storage dtype of the moments (default fp32).  bfloat16 halves
         # the optimizer-state HBM footprint; the update math still runs
-        # in fp32 (moments are cast up, computed, cast back)
+        # in fp32 (moments are cast up, computed, cast back).
+        # FLAGS_bf16_adamw_moments (read at construction): opt-in bf16
+        # moments WITH an error-feedback residual for the second moment
+        # — plain bf16 v stalls because its (1-β₂)·g² increment sits
+        # below bf16 resolution; the 'ef' state buffer carries the
+        # rounding error so v+ef integrates at fp32 fidelity (see
+        # ops/pallas/fused_adamw.py).  moment_ef=True forces the
+        # residual for any sub-fp32 moment_dtype.
+        from ..framework.flags import get_flag
+        flag_on = bool(get_flag("bf16_adamw_moments"))
+        if flag_on and moment_dtype is None:
+            moment_dtype = "bfloat16"
         self._moment_dtype = moment_dtype
+        if moment_ef is None:
+            moment_ef = flag_on
+        self._moment_ef = bool(moment_ef) and moment_dtype is not None \
+            and jnp.dtype(moment_dtype) != jnp.float32
 
     def _init_state(self, p):
         md = jnp.dtype(self._moment_dtype) if self._moment_dtype \
             else jnp.float32
-        return {"moment1": jnp.zeros_like(p.value, md),
-                "moment2": jnp.zeros_like(p.value, md)}
+        st = {"moment1": jnp.zeros_like(p.value, md),
+              "moment2": jnp.zeros_like(p.value, md)}
+        if self._moment_ef:
+            st["ef"] = jnp.zeros_like(p.value, md)
+        return st
 
     def _hyper(self):
         return {"b1": self._beta1, "b2": self._beta2, "eps": self._epsilon,
@@ -279,15 +297,23 @@ class Adam(Optimizer):
         if wd and not decoupled:
             gf = gf + wd * pf
         m = b1 * state["moment1"].astype(jnp.float32) + (1 - b1) * gf
-        v = b2 * state["moment2"].astype(jnp.float32) + (1 - b2) * gf * gf
+        v_prev = state["moment2"].astype(jnp.float32)
+        if "ef" in state:
+            # error feedback: stored moment + residual IS the full-
+            # precision second moment (bf16-moment mode)
+            v_prev = v_prev + state["ef"].astype(jnp.float32)
+        v = b2 * v_prev + (1 - b2) * gf * gf
         mhat = m / (1 - b1 ** step)
         vhat = v / (1 - b2 ** step)
         upd = mhat / (jnp.sqrt(vhat) + eps)
         if wd and decoupled:
             upd = upd + wd * pf
         new_p = pf - lr * upd
-        return new_p.astype(param.dtype), {"moment1": m.astype(md),
-                                           "moment2": v.astype(md)}
+        ns = {"moment1": m.astype(md), "moment2": v.astype(md)}
+        if "ef" in state:
+            ns["ef"] = (v - ns["moment2"].astype(jnp.float32)) \
+                .astype(state["ef"].dtype)
+        return new_p.astype(param.dtype), ns
 
 
 class AdamW(Adam):

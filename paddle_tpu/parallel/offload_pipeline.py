@@ -523,16 +523,20 @@ class OffloadPipelineStep:
                                     fused_ok=fused_ok, mesh=mesh,
                                     spec=P())
             if adam_shaped and set(s) <= {"moment1", "moment2",
-                                          "master"}:
+                                          "master", "ef"}:
                 from ..ops.pallas.fused_adamw import adamw_hostside
                 master = s.get("master", p)
-                new_p, m, v, mst = adamw_hostside(
+                out = adamw_hostside(
                     g, s["moment1"], s["moment2"], master, lr_, step_i,
                     b1=hp["b1"], b2=hp["b2"], eps=hp["eps"], wd=wd,
-                    decoupled=hp["decoupled"], out_dtype=p.dtype)
+                    decoupled=hp["decoupled"], out_dtype=p.dtype,
+                    ef=s.get("ef"))
+                new_p, m, v, mst = out[:4]
                 ns = {"moment1": m, "moment2": v}
                 if "master" in s:
                     ns["master"] = mst
+                if "ef" in s:
+                    ns["ef"] = out[4]
                 return new_p, ns
             return apply_update(upd, p, g, s, lr_, wd, step_i, hp,
                                 fused_ok=fused_ok, mesh=mesh, spec=P())

@@ -602,7 +602,8 @@ class ShardedTrainStep:
             return collective_schedule(self._compiled, *args)
 
     def lint(self, *batch, dtype: bool = False,
-             transfers: Optional[bool] = None, donation: bool = True):
+             transfers: Optional[bool] = None, donation: bool = True,
+             logits: bool = False):
         """Run the analysis lints over the traced+lowered train step.
         Returns {category: [Finding, ...]}.
 
@@ -612,7 +613,10 @@ class ShardedTrainStep:
           (True audits the streaming structure itself).  donation:
           donated buffers the lowered module did not alias.  dtype:
           off by default — AMP loss upcasts are intentional fp32; turn
-          on to audit a step that should be uniformly low-precision."""
+          on to audit a step that should be uniformly low-precision.
+          logits: lint_materialized_logits with the model config's
+          vocab_size — the fused-CE (FLAGS_fused_ce) contract that no
+          [B, S, vocab] fp32 buffer exists anywhere in the step."""
         if self._pipeline is not None:
             kw = {"dtype": dtype, "donation": donation}
             if transfers is not None:    # explicit override passes down
@@ -621,10 +625,33 @@ class ShardedTrainStep:
         from ..analysis.lints import lint_compiled_step
         if transfers is None:
             transfers = not (self.offload or self.offload_params)
+        logits_vocab = None
+        logits_min_rows = None
+        if logits:
+            logits_vocab = int(getattr(
+                getattr(self.model, "config", None), "vocab_size", 0)) \
+                or None
+        if logits_vocab and batch:
+            # also flag FLATTENED [B*S, V] fp32 buffers — but only when
+            # the token count exceeds the fused path's row chunk, below
+            # which a full [tokens, V] chunk slice is legitimate (the
+            # chunking is vacuous at that size)
+            from ..ops.pallas.fused_cross_entropy import _DEFAULT_CHUNK
+            import numpy as _np
+            tokens = int(_np.prod(batch[0].shape)) if batch[0].shape \
+                else 0
+            # gate AND threshold both use the post-shift row count (the
+            # causal loss drops one position per sequence): armed only
+            # when the fused path actually chunks, so its own
+            # [_DEFAULT_CHUNK, V] slice can never reach min_rows
+            shifted = tokens - int(batch[0].shape[0])
+            if shifted > _DEFAULT_CHUNK:
+                logits_min_rows = shifted
         args = self._trace_args(batch)
         return lint_compiled_step(
             self._compiled, args, mesh=self.mesh, dtype=dtype,
-            transfers=transfers, donation=donation and self._donate)
+            transfers=transfers, donation=donation and self._donate,
+            logits_vocab=logits_vocab, logits_min_rows=logits_min_rows)
 
     def _prepare(self, batch):
         """Shared prologue of __call__ and compiled_hlo: gather current

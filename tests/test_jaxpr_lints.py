@@ -16,6 +16,7 @@ import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 from paddle_tpu.analysis import (
     lint_dtype_promotion, lint_transfers, lint_donation,
+    lint_materialized_logits,
     recompile_guard, RecompileError, CollectiveOrderError,
     CollectiveEvent, collective_schedule, check_collective_order)
 
@@ -343,3 +344,91 @@ class TestTrainerIntegration:
             step(x, y)
             step(x, y)
         assert g.count <= 1
+
+
+class TestMaterializedLogitsLint:
+    """lint_materialized_logits: the fused-CE contract checker — any
+    [B, S, vocab] fp32 intermediate in a traced step is a full-logits
+    materialization the chunked loss exists to eliminate."""
+
+    V = 512
+
+    def test_planted_defect_old_compute_loss(self):
+        """The pre-dedup causal-LM loss (fp32 log_softmax over the full
+        [B, S-1, V] logits) MUST trip the lint."""
+        lbl = jnp.zeros((2, 16), jnp.int32)
+
+        def legacy_loss(lg):
+            lgf = lg[:, :-1].astype(jnp.float32)
+            tgt = lbl[:, 1:]
+            logp = jax.nn.log_softmax(lgf, axis=-1)
+            return -jnp.mean(jnp.take_along_axis(
+                logp, tgt[..., None], axis=-1)[..., 0])
+
+        lg = jnp.zeros((2, 16, self.V), jnp.bfloat16)
+        findings = lint_materialized_logits(legacy_loss, lg,
+                                            vocab_size=self.V)
+        assert findings and _codes(findings) == {"materialized-logits"}
+        assert any("(2, 15, 512)" in str(f.detail) for f in findings)
+
+    def test_fused_chunked_loss_is_clean(self):
+        """The chunked fused loss's per-chunk [chunk, V] slices are 2-D
+        and must stay below the radar."""
+        from paddle_tpu.ops.pallas.fused_cross_entropy import (
+            fused_linear_cross_entropy)
+        lbl = jnp.zeros((32,), jnp.int32)
+
+        def fused(h, w):
+            return fused_linear_cross_entropy(h, w, lbl, chunk_rows=8)
+
+        h = jnp.zeros((32, 64), jnp.float32)
+        w = jnp.zeros((64, self.V), jnp.float32)
+        assert lint_materialized_logits(fused, h, w,
+                                        vocab_size=self.V) == []
+        # the gradient pass does its vocab work per chunk too
+        assert lint_materialized_logits(
+            jax.grad(lambda h, w: fused(h, w), argnums=(0, 1)), h, w,
+            vocab_size=self.V) == []
+
+    def test_min_rows_catches_flattened_2d(self):
+        """min_rows flags a flattened [B*S, V] fp32 buffer that the 3-D
+        rule alone would miss, without flagging small chunks."""
+        def flat(lg):
+            return jnp.sum(jax.nn.log_softmax(
+                lg.astype(jnp.float32), axis=-1))
+
+        lg = jnp.zeros((32, self.V), jnp.bfloat16)
+        assert lint_materialized_logits(flat, lg,
+                                        vocab_size=self.V) == []
+        findings = lint_materialized_logits(flat, lg, vocab_size=self.V,
+                                            min_rows=32)
+        assert findings and _codes(findings) == {"materialized-logits"}
+
+    def test_weight_grad_shape_not_flagged(self):
+        # [H, V] fp32 lm-head gradients share the vocab last dim but are
+        # 2-D below min_rows — not a logits materialization
+        def wgrad(h, d):
+            return jnp.dot(h.T, d, preferred_element_type=jnp.float32)
+
+        h = jnp.zeros((32, 64), jnp.bfloat16)
+        d = jnp.zeros((32, self.V), jnp.bfloat16)
+        assert lint_materialized_logits(wgrad, h, d,
+                                        vocab_size=self.V) == []
+
+    def test_recurses_into_scan(self):
+        lbl = jnp.zeros((4, 2, 16), jnp.int32)
+
+        def stepped(lgs):
+            def body(c, xs):
+                lg, tg = xs
+                logp = jax.nn.log_softmax(lg.astype(jnp.float32),
+                                          axis=-1)
+                return c - jnp.mean(jnp.take_along_axis(
+                    logp, tg[..., None], axis=-1)), None
+            out, _ = jax.lax.scan(body, jnp.float32(0), (lgs, lbl))
+            return out
+
+        lgs = jnp.zeros((4, 2, 16, self.V), jnp.bfloat16)
+        findings = lint_materialized_logits(stepped, lgs,
+                                            vocab_size=self.V)
+        assert findings, "per-iteration [B, S, V] fp32 must be flagged"

@@ -9,8 +9,13 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.ops.pallas.flash_attention import flash_attention
-from paddle_tpu.ops.pallas.rms_norm import rms_norm as pallas_rms_norm
-from paddle_tpu.ops import xla_attention, xla_rms_norm
+from paddle_tpu.ops.pallas.rms_norm import (rms_norm as pallas_rms_norm,
+                                            fused_add_rms_norm
+                                            as pallas_add_rms_norm)
+from paddle_tpu.ops.pallas.rope import rope_apply
+from paddle_tpu.ops import (xla_attention, xla_rms_norm,
+                            xla_fused_add_rms_norm, apply_rope,
+                            rope_cos_sin)
 
 
 _rng = np.random.RandomState(0)
@@ -187,3 +192,148 @@ class TestRMSNorm:
         for a, b in zip(gp, gx):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-4, rtol=1e-4)
+
+
+class TestFusedAddRMSNorm:
+    """Residual-add + RMSNorm fused into one pass: the residual output
+    must be BIT-identical to the unfused `x + y` (it feeds the next
+    block), the norm to fp32 tolerance, and the backward must fuse the
+    residual cotangent into dx == dy."""
+
+    def test_forward(self):
+        x, y, w = r(32, 256), r(32, 256), r(256)
+        r1, o1 = pallas_add_rms_norm(x, y, w)
+        r2, o2 = xla_fused_add_rms_norm(x, y, w)
+        assert (np.asarray(r1) == np.asarray(r2)).all()
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_forward_3d(self):
+        x, y, w = r(2, 16, 256), r(2, 16, 256), r(256)
+        r1, o1 = pallas_add_rms_norm(x, y, w)
+        r2, o2 = xla_fused_add_rms_norm(x, y, w)
+        assert (np.asarray(r1) == np.asarray(r2)).all()
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_backward_both_outputs(self):
+        # cotangents flow through BOTH outputs (the residual feeds the
+        # next block, the norm feeds the MLP)
+        x, y, w = r(32, 256), r(32, 256), r(256)
+
+        def lp(x, y, w):
+            res, out = pallas_add_rms_norm(x, y, w)
+            return jnp.sum(out ** 2) + 0.3 * jnp.sum(res)
+
+        def lx(x, y, w):
+            res, out = xla_fused_add_rms_norm(x, y, w)
+            return jnp.sum(out ** 2) + 0.3 * jnp.sum(res)
+
+        gp = jax.grad(lp, argnums=(0, 1, 2))(x, y, w)
+        gx = jax.grad(lx, argnums=(0, 1, 2))(x, y, w)
+        for a, b in zip(gp, gx):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_shape_mismatch_rejected(self):
+        with pytest.raises(ValueError):
+            pallas_add_rms_norm(r(32, 256), r(16, 256), r(256))
+
+
+class TestRope:
+    """Fused rope application: one VMEM pass rotates q AND k; the VJP is
+    the same kernel with sin negated (orthogonal rotation)."""
+
+    def _qk(self, b=2, s=16, h=4, hk=2, d=8):
+        return r(b, s, h, d), r(b, s, hk, d)
+
+    def test_forward_matches_xla(self):
+        q, k = self._qk()
+        cos, sin = rope_cos_sin(16, 8)
+        oq, ok = rope_apply(q, k, cos, sin)
+        # the XLA reference path, explicitly (apply_rope would dispatch
+        # to the kernel on TPU)
+        from paddle_tpu.ops import _rotate_half
+        c4, s4 = cos[None, :, None, :], sin[None, :, None, :]
+        rq = q * c4 + _rotate_half(q) * s4
+        rk = k * c4 + _rotate_half(k) * s4
+        np.testing.assert_allclose(np.asarray(oq), np.asarray(rq),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(ok), np.asarray(rk),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_forward_batched_positions(self):
+        # [b, s, d] cos/sin — the per-slot position form decode uses
+        q, k = self._qk()
+        pos = jnp.asarray(_rng.randint(0, 16, (2, 1)).astype(np.int32)) \
+            + jnp.arange(16, dtype=jnp.int32)[None]
+        cos, sin = rope_cos_sin(16, 8, position_ids=pos)
+        oq, ok = rope_apply(q, k, cos, sin)
+        rq, rk = apply_rope(q, k, cos, sin)
+        np.testing.assert_allclose(np.asarray(oq), np.asarray(rq),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(ok), np.asarray(rk),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_backward_matches_xla(self):
+        q, k = self._qk()
+        cos, sin = rope_cos_sin(16, 8)
+
+        def lp(q, k):
+            oq, ok = rope_apply(q, k, cos, sin)
+            return jnp.sum(oq ** 2) + jnp.sum(ok ** 3)
+
+        def lx(q, k):
+            from paddle_tpu.ops import _rotate_half
+            c4, s4 = cos[None, :, None, :], sin[None, :, None, :]
+            oq = q * c4 + _rotate_half(q) * s4
+            ok = k * c4 + _rotate_half(k) * s4
+            return jnp.sum(oq ** 2) + jnp.sum(ok ** 3)
+
+        gp = jax.grad(lp, argnums=(0, 1))(q, k)
+        gx = jax.grad(lx, argnums=(0, 1))(q, k)
+        for a, b in zip(gp, gx):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_backward_asymmetric_sin_halves(self):
+        # regression: the half-split adjoint swaps which sin half
+        # multiplies which gradient half (dx1 = g1·c1 + g2·s2, dx2 =
+        # g2·c2 − g1·s1) — plain neg_sin alone is only correct when the
+        # cache duplicates sin across halves (the rope_cos_sin layout);
+        # a user-supplied cache with DIFFERING halves must still get
+        # true gradients through ops.apply_rope on TPU
+        q, k = self._qk()
+        cos = jnp.asarray(_rng.randn(16, 8).astype(np.float32))
+        sin = jnp.asarray(_rng.randn(16, 8).astype(np.float32))
+
+        def lp(q, k):
+            oq, ok = rope_apply(q, k, cos, sin)
+            return jnp.sum(oq ** 2) + jnp.sum(ok ** 3)
+
+        def lx(q, k):
+            from paddle_tpu.ops import _rotate_half
+            c4, s4 = cos[None, :, None, :], sin[None, :, None, :]
+            oq = q * c4 + _rotate_half(q) * s4
+            ok = k * c4 + _rotate_half(k) * s4
+            return jnp.sum(oq ** 2) + jnp.sum(ok ** 3)
+
+        gp = jax.grad(lp, argnums=(0, 1))(q, k)
+        gx = jax.grad(lx, argnums=(0, 1))(q, k)
+        for a, b in zip(gp, gx):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_tiny_rows_rejected(self):
+        # batch*seq below the sublane granule → ValueError so the ops
+        # dispatch falls back to XLA (the decode path)
+        q, k = self._qk(b=1, s=4)
+        cos, sin = rope_cos_sin(4, 8)
+        with pytest.raises(ValueError):
+            rope_apply(q, k, cos, sin)
+
+    def test_odd_head_dim_rejected(self):
+        q, k = r(2, 16, 4, 7), r(2, 16, 2, 7)
+        cos = sin = jnp.zeros((16, 7), jnp.float32)
+        with pytest.raises(ValueError):
+            rope_apply(q, k, cos, sin)

@@ -210,6 +210,16 @@ def render(rows):
             f" — itemized to within 3 points of the measurement.")
     lines += [
         "",
+        "> Follow-up (ISSUE 5): bench.py now emits this decomposition"
+        " per run — the llama/bert JSON lines carry a `phases` field"
+        " ({fwd,bwd,opt,full}_ms + per-phase util, produced by the same"
+        " tools/profile_mfu.py `_profile`), so BENCH_r* tracks these"
+        " gap items directly.  The gap items themselves are attacked by"
+        " `FLAGS_fused_ce` (chunked fused linear+CE — no [B, S, V] fp32"
+        " logits), the fused residual+RMSNorm / rope Pallas kernels,"
+        " and `FLAGS_bf16_adamw_moments` (bf16 moments + error"
+        " feedback); see README \"Closing the MFU gap\".",
+        "",
         "Optimizer-phase notes (measured here): the fused Pallas AdamW"
         " runs ~200 GB/s standalone vs XLA's 775 GB/s, yet the FULL"
         " step is 5.4% faster with the Pallas kernel (17,559 vs 16,607"
