@@ -163,6 +163,7 @@ from . import static  # noqa: E402
 from . import vision  # noqa: E402
 from . import distributed  # noqa: E402
 from . import incubate  # noqa: E402
+from . import telemetry  # noqa: E402
 from . import profiler  # noqa: E402
 from . import utils  # noqa: E402
 from . import ops  # noqa: E402

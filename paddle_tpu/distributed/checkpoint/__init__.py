@@ -585,6 +585,7 @@ def _gc_old_steps(root, keep: int, current: str):
          if (s := _step_of(d)) is not None), reverse=True)
     cur_step = _step_of(current) or 0
     kept = 0
+    removed = []
     for s, d in steps:
         p = os.path.join(root, d)
         if d == current:
@@ -601,6 +602,11 @@ def _gc_old_steps(root, keep: int, current: str):
             # than this commit; incomplete dirs NEWER than the commit
             # (another writer in flight) are left alone
             shutil.rmtree(p, ignore_errors=True)
+            removed.append(d)
+    if removed:
+        from ... import telemetry as _tel
+        _tel.counter("ckpt.gc_removed").inc(len(removed))
+        _tel.emit("ckpt.gc", root=root, removed=removed, kept=kept)
 
 
 def _commit_latest(root, dirname, keep, wait_secs=60.0):
@@ -628,6 +634,10 @@ def _commit_latest(root, dirname, keep, wait_secs=60.0):
     if f is not None and f.mode == "skip":
         return path
     _atomic_write_bytes(os.path.join(root, "latest"), dirname.encode())
+    from ... import telemetry as _tel
+    _tel.counter("ckpt.commits").inc()
+    _tel.emit("ckpt.commit", dir=dirname, root=root,
+              step=_step_of(dirname))
     if keep is not None and keep > 0:
         _gc_old_steps(root, keep, dirname)
     return path

@@ -225,6 +225,16 @@ def hit(point: str, key: Optional[str] = None) -> Optional[Fault]:
                 break
     if live is None:
         return None
+    # a FIRED injection is never the hot path — publish it so chaos
+    # runs correlate recovery behavior with the exact planted failure
+    # (the unset fast path returned above untouched)
+    try:
+        from .. import telemetry as _telemetry
+        _telemetry.counter("fault.fired").inc()
+        _telemetry.emit("fault.hit", point=point, mode=live.mode,
+                        hit=n, key=str(key))
+    except Exception:
+        pass
     if live.mode == "error":
         raise FaultError(
             f"injected fault at {point} (hit {n}, key={key!r})")

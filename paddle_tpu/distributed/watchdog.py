@@ -129,6 +129,15 @@ class CommTaskManager:
 
     def _report(self, task, age):
         try:
+            # publish BEFORE the (possibly failing) report/abort so a
+            # fleet log records the hang even when stderr is gone
+            try:
+                from .. import telemetry as _tel
+                _tel.counter("watchdog.timeouts").inc()
+                _tel.emit("watchdog.timeout", task=task.name,
+                          age_s=round(age, 3))
+            except Exception:
+                pass
             report = self._build_report(task, age)
             self.timeout_log.append((task.name, age, report))
             sys.stderr.write(report)

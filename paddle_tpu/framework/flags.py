@@ -128,3 +128,15 @@ define_flag("bf16_adamw_moments", False,
             "residual for the second moment (state key 'ef'): moment HBM "
             "traffic halves (8->4 bytes/param) plus a 2-byte residual; "
             "update math stays fp32 via the v+ef reconstruction")
+# telemetry plane / cold-start killer (paddle_tpu/telemetry): defined
+# HERE so env pickup happens at interpreter start — a relaunched worker
+# sets FLAGS_compile_cache_dir before any trainer compiles.  Unset, the
+# whole cache layer is one flag lookup per trainer build and the
+# compiled programs stay byte-identical (bench-asserted).
+define_flag("compile_cache_dir", "",
+            "directory for the persistent XLA compilation cache AND the "
+            "AOT serialized-executable store (<dir>/aot/): a second "
+            "process pointed at the same dir skips trace+compile on "
+            "every cached program — telemetry.compile_report() records "
+            "per-program trace/compile ms and hit/miss; empty disables "
+            "both layers entirely")

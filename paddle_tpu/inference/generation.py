@@ -69,6 +69,12 @@ def _model_program_cache(model, key, build, cap=16):
         # program-cache growth the same way they bound XLA compiles
         from ..analysis.lints import note_program_build
         note_program_build(key)
+        # a cold compile is ahead: arm jax's persistent compilation
+        # cache if FLAGS_compile_cache_dir asks for it — serving-only
+        # processes (no trainer) reach the cold-start killer through
+        # here (one flag lookup when unset; idempotent when armed)
+        from ..telemetry.compile_cache import maybe_enable_persistent_cache
+        maybe_enable_persistent_cache()
         fn = build()
         if len(store) >= cap:
             store.pop(next(iter(store)))

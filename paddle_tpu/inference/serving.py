@@ -310,6 +310,18 @@ class ContinuousBatcher:
         # warm program keeps its first chunks in the timing window
         self._first_use = key not in self.model.__dict__.get(
             "_gen_compiled", {})
+        if self._first_use and key in self._programs_used:
+            # mid-life re-trace of a program this batcher already ran
+            # (LRU eviction / cleared model cache): snapshot stats()
+            # into the telemetry plane BEFORE the rebuild — the counters
+            # themselves must survive the recompile (regression-pinned),
+            # and the snapshot timestamps exactly which chunks predate
+            # the new program (its timing stats restart via _first_use)
+            from .. import telemetry as _tel
+            if _tel.active():
+                _tel.emit("serve.recompile",
+                          dict(self.stats(), program=str(key)))
+            _tel.counter("serve.recompiles").inc()
         self._programs_used.add(key)
         model = self.model
         names = self._names
@@ -409,6 +421,17 @@ class ContinuousBatcher:
         self._occupancy_total += self.active
         self._prefill_tok_total += int(n_pref)
         self._decode_tok_total += int(n_dec)
+        from .. import telemetry as _tel
+        _tel.counter("serve.chunks").inc()       # sink or not
+        if _tel.active():
+            _tel.emit("serve.chunk",
+                      kind="admit" if mixed else "decode",
+                      wall_ms=round(dt * 1e3, 3),
+                      occupancy=self.active, slots=self.B,
+                      prefill_tokens=int(n_pref),
+                      decode_tokens=int(n_dec),
+                      first_use=self._first_use)
+            _tel.histogram("serve.chunk_ms").observe(dt * 1e3)
         for i, req in enumerate(self._slots):
             if req is None:
                 continue

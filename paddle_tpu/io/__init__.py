@@ -25,7 +25,8 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ChainDataset",
            "ComposeDataset", "ConcatDataset", "Subset", "random_split",
            "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
            "BatchSampler", "DistributedBatchSampler", "DataLoader",
-           "get_worker_info", "default_collate_fn"]
+           "get_worker_info", "default_collate_fn", "prefetch_to_device",
+           "DevicePrefetcher"]
 
 
 class Dataset:
@@ -430,3 +431,191 @@ class DataLoader:
                 and self.batch_sampler is not None:
             return self._iter_multi()
         return self._iter_single()
+
+
+# ---------------------------------------------------------------------------
+# device prefetch (ROADMAP item 5b: steps must never wait on the host)
+
+class DevicePrefetcher:
+    """Double-buffered host→device pipeline over any iterable of
+    batches (a DataLoader, a generator of Tensors/arrays, ...).
+
+    A background thread pulls batches, `jax.device_put`s every array
+    leaf — sharding-aware when a mesh (batch dim over the data axes,
+    via parallel.shard_batch) or an explicit sharding is given — and
+    parks up to `depth` device-resident batches in a queue.  The
+    consumer's `next()` then finds a WARM buffer: the H2D transfer of
+    batch N+1 overlapped with the step on batch N, so the train step
+    never blocks on host input.
+
+    Telemetry: every get publishes `io.step` with host_wait_ms (time
+    the consumer actually blocked) and the buffered depth; `stats()`
+    reports lifetime totals including `cold_gets` — gets (after the
+    first, which legitimately waits for the pipeline to prime) that
+    found the buffer EMPTY.  The never-a-cold-buffer regression test
+    pins cold_gets == 0 for a producer faster than its consumer.
+
+    Exceptions in the source loader re-raise at the consumer's next().
+    The producer is a daemon thread that ends when the loader is
+    exhausted; a consumer that abandons the iterator early must call
+    `close()` (or use the prefetcher as a context manager) — otherwise
+    the thread stays parked on a full queue holding `depth`
+    device-resident batches for the rest of the process.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, loader, depth: int = 2, sharding=None, mesh=None,
+                 batch_axes=("dp", "sharding"), seq_axis=None,
+                 seq_dim: int = 1):
+        if depth < 1:
+            raise ValueError("prefetch depth must be >= 1")
+        self._loader = loader
+        self._depth = int(depth)
+        self._sharding = sharding
+        self._mesh = mesh
+        self._batch_axes = batch_axes
+        self._seq_axis = seq_axis
+        self._seq_dim = seq_dim
+        self._q: queue.Queue = queue.Queue(maxsize=self._depth)
+        self._err = None
+        self._steps = 0
+        self._cold_gets = 0
+        self._host_wait_total_ms = 0.0
+        self._closed = threading.Event()
+        self._done = False
+        self._thread = threading.Thread(target=self._produce,
+                                        name="io-prefetch", daemon=True)
+        self._thread.start()
+
+    # -- placement ---------------------------------------------------------
+    def _place_leaf(self, x):
+        import jax
+        v = x.value if isinstance(x, Tensor) else np.asarray(x)
+        if self._mesh is not None and getattr(v, "ndim", 0) >= 1:
+            from ..parallel.sharded_trainer import shard_batch
+            return Tensor(shard_batch(self._mesh, v, self._batch_axes,
+                                      self._seq_axis, self._seq_dim))
+        if self._sharding is not None:
+            return Tensor(jax.device_put(v, self._sharding))
+        return Tensor(jax.device_put(v))
+
+    def _place(self, batch):
+        if isinstance(batch, tuple) and hasattr(batch, "_fields"):
+            # namedtuple: positional fields, not an iterable-arg ctor
+            return type(batch)(*(self._place(b) for b in batch))
+        if isinstance(batch, (list, tuple)):
+            return type(batch)(self._place(b) for b in batch)
+        if isinstance(batch, dict):
+            return {k: self._place(v) for k, v in batch.items()}
+        return self._place_leaf(batch)
+
+    def _produce(self):
+        try:
+            for batch in self._loader:
+                if self._closed.is_set():
+                    return
+                placed = self._place(batch)
+                # bounded put: a close() while the queue is full must
+                # unblock the thread (its parked batches pin device
+                # memory), not leave it waiting forever
+                while not self._closed.is_set():
+                    try:
+                        self._q.put(placed, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:      # noqa: BLE001 — surfaced at next()
+            self._err = e
+        finally:
+            if not self._closed.is_set():
+                self._q.put(self._SENTINEL)
+
+    # -- consumer ----------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import time
+        if self._closed.is_set() or self._done:
+            # close() drained the queue / the sentinel was already
+            # consumed (exhaustion or a propagated loader error) —
+            # re-iteration must raise, never park on an empty queue
+            # behind a dead producer
+            raise StopIteration
+        cold = self._q.empty() and self._steps > 0
+        t0 = time.perf_counter()
+        item = self._q.get()
+        wait_ms = (time.perf_counter() - t0) * 1e3
+        if item is self._SENTINEL:
+            self._done = True
+            if self._err is not None:
+                err, self._err = self._err, None
+                raise err
+            raise StopIteration
+        self._steps += 1
+        if cold:
+            self._cold_gets += 1
+        self._host_wait_total_ms += wait_ms
+        from .. import telemetry as _tel
+        _tel.counter("io.steps").inc()       # lifetime total, sink or not
+        if _tel.active():
+            # the TIMING histogram is sink-gated like every other
+            # producer's (serve.chunk_ms, train.step_ms); lifetime
+            # wait totals are always in stats()
+            _tel.histogram("io.host_wait_ms").observe(wait_ms)
+            _tel.emit("io.step", host_wait_ms=round(wait_ms, 3),
+                      buffered=self._q.qsize(), cold=cold,
+                      step=self._steps)
+        return item
+
+    def stats(self) -> dict:
+        return {"steps": self._steps,
+                "cold_gets": self._cold_gets,
+                "host_wait_ms_total": round(self._host_wait_total_ms, 3),
+                "depth": self._depth}
+
+    def close(self):
+        """Stop the producer and drop the parked device batches — call
+        when abandoning the iterator before exhaustion."""
+        self._closed.set()
+        # unblock a producer parked on the full queue...
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=1.0)
+        # ...then drop whatever it managed to put while winding down
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        # and wake any consumer already parked in q.get() — it checks
+        # _closed on receipt of the sentinel via __next__'s guard on
+        # the NEXT call, and StopIterations here instead of hanging
+        try:
+            self._q.put_nowait(self._SENTINEL)
+        except queue.Full:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def prefetch_to_device(loader, depth: int = 2, *, sharding=None,
+                       mesh=None, batch_axes=("dp", "sharding"),
+                       seq_axis=None, seq_dim: int = 1
+                       ) -> DevicePrefetcher:
+    """Wrap `loader` in a depth-buffered host→device prefetch pipeline
+    (see DevicePrefetcher).  `mesh` (+ batch_axes/seq_axis) places each
+    array like the sharded trainers' shard_batch; `sharding` passes an
+    explicit jax sharding; neither → default device placement."""
+    return DevicePrefetcher(loader, depth, sharding=sharding, mesh=mesh,
+                            batch_axes=batch_axes, seq_axis=seq_axis,
+                            seq_dim=seq_dim)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -284,6 +285,10 @@ class TrainStep:
         self._remat = rematerialize
         self._compiled = None
         self._opt_states = None
+        # AOT executable store (telemetry.compile_cache): populated only
+        # while FLAGS_compile_cache_dir is armed; keyed by batch aval
+        # signature so a shape change falls back to the retracing jit
+        self._aot: Dict[Any, Any] = {}
 
     def _init_opt_states(self, params):
         from ..optimizer.jit_update import maybe_master_state
@@ -395,9 +400,19 @@ class TrainStep:
                                       advance=advance_lr_scheduler)
         step0 = jnp.asarray(self.optimizer._step_count + 1, jnp.int32)
         key = prandom.next_key()
-        losses, new_params, new_states, new_bufs = self._compiled_multi(
-            param_vals, self._opt_states, buf_vals, lrs, step0, key,
-            *batch_vals)
+        args = (param_vals, self._opt_states, buf_vals, lrs, step0, key,
+                *batch_vals)
+        from ..telemetry import compile_cache as _cc
+        fn = _cc.aot_for(self._aot, "multi", self._compiled_multi, args,
+                         batch_vals, "jit.TrainStep.multi")
+        from .. import telemetry as _tel
+        _tel.counter("train.steps").inc(k)   # lifetime total, sink or not
+        tel_on = _tel.active()
+        t0 = time.perf_counter()
+        losses, new_params, new_states, new_bufs = fn(*args)
+        if tel_on and _tel.config("sync_steps"):
+            jax.block_until_ready(losses)
+        wall_ms = (time.perf_counter() - t0) * 1e3
         commit_lr()
         self.optimizer._step_count += k
         for n, v in zip(self._names, new_params):
@@ -405,6 +420,12 @@ class TrainStep:
         for n, v in zip(self._buf_names, new_bufs):
             sd[n]._value = v
         self._opt_states = new_states
+        if tel_on:
+            _tel.step_event(self, label="jit", kind="multi",
+                            step=self.optimizer._step_count, k=k,
+                            wall_ms=wall_ms,
+                            batch_vals=tuple(b[0] for b in batch_vals),
+                            loss_fn=self.loss_fn)
         return Tensor(losses)
 
     def train_state(self):
@@ -457,16 +478,31 @@ class TrainStep:
         self.optimizer._step_count += 1
         lr = self.optimizer.get_lr()
         key = prandom.next_key()
-        loss, new_params, new_states, new_bufs = self._compiled(
-            param_vals, self._opt_states, buf_vals,
-            jnp.asarray(lr, jnp.float32),
-            jnp.asarray(self.optimizer._step_count, jnp.int32), key,
-            *batch_vals)
+        args = (param_vals, self._opt_states, buf_vals,
+                jnp.asarray(lr, jnp.float32),
+                jnp.asarray(self.optimizer._step_count, jnp.int32), key,
+                *batch_vals)
+        from ..telemetry import compile_cache as _cc
+        fn = _cc.aot_for(self._aot, "step", self._compiled, args,
+                         batch_vals, "jit.TrainStep.step")
+        from .. import telemetry as _tel
+        _tel.counter("train.steps").inc()    # lifetime total, sink or not
+        tel_on = _tel.active()
+        t0 = time.perf_counter()
+        loss, new_params, new_states, new_bufs = fn(*args)
+        if tel_on and _tel.config("sync_steps"):
+            jax.block_until_ready(loss)
+        wall_ms = (time.perf_counter() - t0) * 1e3
         for n, v in zip(self._names, new_params):
             sd[n]._value = v
         for n, v in zip(self._buf_names, new_bufs):
             sd[n]._value = v
         self._opt_states = new_states
+        if tel_on:
+            _tel.step_event(self, label="jit", kind="step",
+                            step=self.optimizer._step_count, k=1,
+                            wall_ms=wall_ms, batch_vals=batch_vals,
+                            loss_fn=self.loss_fn)
         return Tensor(loss)
 
 

@@ -67,6 +67,7 @@ channels are not supported (rejected / documented respectively).
 from __future__ import annotations
 
 import re
+import time
 from typing import Optional
 
 import numpy as np
@@ -756,6 +757,10 @@ class OffloadPipelineStep:
         lr = self.optimizer.get_lr() if lr_override is None \
             else lr_override
         key = prandom.next_key()
+        from .. import telemetry as _tel
+        _tel.counter("train.steps").inc()    # lifetime total, sink or not
+        tel_on = _tel.active()
+        t0 = time.perf_counter()
         with watched("offload pipeline step"):
             (loss, new_tail, new_tstates, self._stk_param,
              self._stk_wire, self._stk_state) = self._compiled(
@@ -764,11 +769,22 @@ class OffloadPipelineStep:
                 jnp.asarray(lr, jnp.float32),
                 jnp.asarray(self.optimizer._step_count, jnp.int32),
                 key, batch_vals)
+            if tel_on and _tel.config("sync_steps"):
+                jax.block_until_ready(loss)
         sd = self._sd
         for n, v in zip(self._tail_names, new_tail):
             sd[n]._value = v
         self._tail_states = new_tstates
         self._guard_record(loss)
+        if tel_on:
+            # no phase probe (batch_vals omitted): re-jitting the
+            # streamed model outside its per-layer pipeline would
+            # materialize every host stack in HBM — exactly what this
+            # trainer exists to avoid
+            _tel.step_event(self, label="offload", kind="step",
+                            step=self.optimizer._step_count, k=1,
+                            wall_ms=(time.perf_counter() - t0) * 1e3,
+                            extra={"prefetch_depth": self.prefetch_depth})
         return Tensor(loss)
 
     def run_steps(self, *stacked_batch, advance_lr_scheduler=True):

@@ -365,6 +365,10 @@ class PipelineEngine:
             chunks[0].inbox[i] = chunks[0].place_activation(micro_x[i])
         labels = [chunks[-1].place_activation(lb) for lb in micro_y]
 
+        from .. import telemetry as _tel
+        import time as _time
+        tel_on = _tel.active()
+        t0 = _time.perf_counter()
         with watched(f"pipeline train_batch ({schedule}, m={m})"):
             stuck = self._dispatch(
                 order,
@@ -373,6 +377,11 @@ class PipelineEngine:
                 raise RuntimeError(
                     f"pipeline schedule deadlock: stuck ops {stuck} "
                     f"(each is (stage, kind, chunk, micro))")
+        _tel.counter("pp.train_batches").inc()   # sink or not
+        if tel_on:
+            _tel.emit("pp.train_batch", schedule=schedule, micro=m,
+                      stages=pp,
+                      wall_ms=round((_time.perf_counter() - t0) * 1e3, 3))
 
         # write back grads (avg over micro-batches); a tied param seen in
         # several chunks gets the SUM of its per-chunk grads, placed like

@@ -14,6 +14,7 @@ e.g. a q_proj [h, mp] weight at stage 3 becomes [sharding → h, mp].
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -195,6 +196,9 @@ class ShardedTrainStep:
         self._buf_names = [n for n in all_names if n not in self._names]
         self._compiled = None
         self._opt_states = None
+        # AOT executable store (telemetry.compile_cache): only populated
+        # while FLAGS_compile_cache_dir is armed
+        self._aot = {}
         self._setup_shardings()
 
     @classmethod
@@ -593,13 +597,23 @@ class ShardedTrainStep:
         for the whole mesh, so every rank shares this schedule; pass
         `{rank: step.collective_schedule(*batch) for rank in ...}` to
         `check_collective_order` when composing with per-rank host
-        logic (the PipelineEngine builds its own per-stage lists)."""
+        logic (the PipelineEngine builds its own per-stage lists).
+        A live telemetry sink receives the per-kind counts as a
+        `collective.schedule` event."""
         if self._pipeline is not None:
             return self._pipeline.collective_schedule(*batch)
         from ..analysis.collectives import collective_schedule
         args = self._trace_args(batch)
         with self.mesh:
-            return collective_schedule(self._compiled, *args)
+            events = collective_schedule(self._compiled, *args)
+        from .. import telemetry as _tel
+        if _tel.active():
+            kinds = {}
+            for e in events:
+                kinds[e.kind] = kinds.get(e.kind, 0) + 1
+            _tel.emit("collective.schedule", trainer="sharded",
+                      total=len(events), kinds=kinds)
+        return events
 
     def lint(self, *batch, dtype: bool = False,
              transfers: Optional[bool] = None, donation: bool = True,
@@ -732,11 +746,21 @@ class ShardedTrainStep:
         step0 = jnp.asarray(self.optimizer._step_count + 1, jnp.int32)
         key = prandom.next_key()
         from ..distributed.watchdog import watched
+        args = (param_vals, self._states_for_call(), buf_vals, lrs,
+                step0, key, stacked)
+        from ..telemetry import compile_cache as _cc
+        fn = _cc.aot_for(self._aot, "multi", self._compiled_multi, args,
+                         stacked, f"ShardedTrainStep.multi.s{self.stage}",
+                         mesh=self.mesh)
+        from .. import telemetry as _tel
+        _tel.counter("train.steps").inc(k)   # lifetime total, sink or not
+        tel_on = _tel.active()
+        t0 = time.perf_counter()
         with watched(f"sharded train run_steps(k={k})"):
-            losses, new_params, new_states, new_bufs = \
-                self._compiled_multi(param_vals,
-                                     self._states_for_call(),
-                                     buf_vals, lrs, step0, key, stacked)
+            losses, new_params, new_states, new_bufs = fn(*args)
+            if tel_on and _tel.config("sync_steps"):
+                jax.block_until_ready(losses)
+        wall_ms = (time.perf_counter() - t0) * 1e3
         commit_lr()
         self.optimizer._step_count += k
         sd = self._sd
@@ -746,6 +770,13 @@ class ShardedTrainStep:
             sd[n]._value = v
         self._opt_states = self._park_states(new_states)
         self._guard_record(losses)
+        if tel_on:
+            _tel.step_event(self, label="sharded", kind="multi",
+                            step=self.optimizer._step_count, k=k,
+                            wall_ms=wall_ms,
+                            batch_vals=tuple(b[0] for b in stacked),
+                            loss_fn=self.loss_fn,
+                            extra={"stage": self.stage})
         return Tensor(losses)
 
     def _stack_shard(self, arr):
@@ -835,16 +866,33 @@ class ShardedTrainStep:
         self.optimizer._step_count += 1
         lr = self.optimizer.get_lr()
         key = prandom.next_key()
-        with watched("sharded train step"):
-            loss, new_params, new_states, new_bufs = self._compiled(
-                param_vals, self._states_for_call(), buf_vals,
+        args = (param_vals, self._states_for_call(), buf_vals,
                 jnp.asarray(lr, jnp.float32),
                 jnp.asarray(self.optimizer._step_count, jnp.int32), key,
                 batch_vals)
+        from ..telemetry import compile_cache as _cc
+        fn = _cc.aot_for(self._aot, "step", self._compiled, args,
+                         batch_vals, f"ShardedTrainStep.step.s{self.stage}",
+                         mesh=self.mesh)
+        from .. import telemetry as _tel
+        _tel.counter("train.steps").inc()    # lifetime total, sink or not
+        tel_on = _tel.active()
+        t0 = time.perf_counter()
+        with watched("sharded train step"):
+            loss, new_params, new_states, new_bufs = fn(*args)
+            if tel_on and _tel.config("sync_steps"):
+                jax.block_until_ready(loss)
+        wall_ms = (time.perf_counter() - t0) * 1e3
         for n, v in zip(self._names, self._park_params(new_params)):
             sd[n]._value = v
         for n, v in zip(self._buf_names, new_bufs):
             sd[n]._value = v
         self._opt_states = self._park_states(new_states)
         self._guard_record(loss)
+        if tel_on:
+            _tel.step_event(self, label="sharded", kind="step",
+                            step=self.optimizer._step_count, k=1,
+                            wall_ms=wall_ms, batch_vals=batch_vals,
+                            loss_fn=self.loss_fn,
+                            extra={"stage": self.stage})
         return Tensor(loss)

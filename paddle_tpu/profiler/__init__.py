@@ -1,23 +1,32 @@
-"""Profiler.
+"""Profiler — thin facade over :mod:`paddle_tpu.telemetry`.
 
 Reference: `python/paddle/profiler/` — Profiler state machine
 (profiler.py:358 CLOSED/READY/RECORD[_AND_RETURN], make_scheduler,
 on_trace_ready exporters), RecordEvent (utils.py), Benchmark ips timer
-(timer.py:351); C++ host/CUPTI tracers + chrome-trace export.
+(timer.py:351).
 
-TPU-native: device-side tracing delegates to jax.profiler (XLA xplane →
-TensorBoard/perfetto); host-side RecordEvent instrumentation and the
-chrome-trace JSON export are implemented here directly.
+.. deprecated::
+    The profiler's windowed-recording machinery is now a compatibility
+    shim over the always-on telemetry plane: RecordEvent spans publish
+    into the telemetry event bus, and a RECORD window is simply a
+    ChromeTraceSink attached for its duration.  New code should use
+    `paddle_tpu.telemetry` directly — `attach_chrome_trace()` /
+    `attach_jsonl()` for continuous export, `telemetry.span()` for
+    instrumentation — which also captures the producers this module
+    never saw (train steps, serving chunks, watchdog/fault/checkpoint
+    events).  The public names here stay import-compatible.
+
+TPU-native: device-side tracing still delegates to jax.profiler (XLA
+xplane → TensorBoard/perfetto); host-side spans ride telemetry.
 """
 from __future__ import annotations
 
-import contextlib
 import json
 import os
-import threading
 import time
 from enum import Enum
-from typing import Callable, Iterable, Optional
+
+from .. import telemetry as _tel
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
@@ -49,33 +58,24 @@ class SummaryView(Enum):
     MemoryView = 6
 
 
-_events = []
-_events_lock = threading.Lock()
-_recording = False
-
-
 class RecordEvent:
-    """Host-side instrumentation span (reference: profiler/utils.py:47)."""
+    """Host-side instrumentation span (reference: profiler/utils.py:47)
+    — now a telemetry span: records whenever ANY telemetry sink is
+    attached (a recording Profiler attaches one; so does a user's
+    attach_jsonl/attach_chrome_trace)."""
 
     def __init__(self, name, event_type=None):
         self.name = name
-        self._begin = None
+        self._span = None
 
     def begin(self):
-        self._begin = time.perf_counter_ns()
+        self._span = _tel.span(self.name, kind="record_event")
+        self._span.__enter__()
 
     def end(self):
-        if self._begin is None:
-            return
-        if _recording:
-            with _events_lock:
-                _events.append({
-                    "name": self.name, "ph": "X", "pid": os.getpid(),
-                    "tid": threading.get_ident(),
-                    "ts": self._begin / 1000.0,
-                    "dur": (time.perf_counter_ns() - self._begin) / 1000.0,
-                })
-        self._begin = None
+        if self._span is not None:
+            self._span.__exit__(None, None, None)
+            self._span = None
 
     def __enter__(self):
         self.begin()
@@ -112,8 +112,7 @@ def export_chrome_tracing(dir_name, worker_name=None):
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"worker_{os.getpid()}"
         path = os.path.join(dir_name, f"{name}.json")
-        with open(path, "w") as f:
-            json.dump({"traceEvents": list(_events)}, f)
+        prof.export(path)
         return path
     return handler
 
@@ -124,7 +123,8 @@ def load_profiler_result(filename):
 
 
 class Profiler:
-    """Reference: profiler/profiler.py:358."""
+    """Reference: profiler/profiler.py:358 — the state machine kept for
+    compatibility; RECORD windows attach a telemetry ChromeTraceSink."""
 
     def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=False, record_shapes=False, profile_memory=False,
@@ -139,16 +139,42 @@ class Profiler:
         self._step = 0
         self._state = ProfilerState.CLOSED
         self._jax_trace_dir = None
+        # each RECORD window attaches a FRESH ChromeTraceSink (so a
+        # scheduled profiler's second window records instead of
+        # silently no-opping on a stale reference); closed windows
+        # accumulate in _windows so summary()/export() cover EVERY
+        # window since start(), matching the pre-facade behavior of the
+        # module-global event list cleared only at start()
+        self._sink = None
+        self._attached = False
+        self._windows = []
+
+    # -- recording window == an attached ChromeTraceSink -------------------
+    def _recording(self) -> bool:
+        return self._attached
+
+    def _start_recording(self):
+        if not self._attached:
+            self._sink = _tel.add_sink(_tel.ChromeTraceSink())
+            self._attached = True
+            if not self._timer_only:
+                self._maybe_start_jax_trace()
+
+    def _stop_recording(self):
+        if self._attached:
+            _tel.remove_sink(self._sink, close=False)
+            self._attached = False
+            self._windows.append(self._sink)
+            self._maybe_stop_jax_trace()
 
     def start(self):
-        global _recording, _events
-        _events = []
+        self._windows = []
+        self._sink = None
         self._state = (self._scheduler(self._step) if self._scheduler
                        else ProfilerState.RECORD)
-        _recording = self._state in (ProfilerState.RECORD,
-                                     ProfilerState.RECORD_AND_RETURN)
-        if not self._timer_only and _recording:
-            self._maybe_start_jax_trace()
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._start_recording()
         benchmark().begin()
 
     def _maybe_start_jax_trace(self):
@@ -170,29 +196,29 @@ class Profiler:
             self._jax_trace_dir = None
 
     def step(self, num_samples=None):
-        global _recording
         benchmark().step(num_samples)
         self._step += 1
         if self._scheduler:
             new_state = self._scheduler(self._step)
             if new_state != self._state:
-                if self._state in (ProfilerState.RECORD,
-                                   ProfilerState.RECORD_AND_RETURN) \
-                        and new_state == ProfilerState.CLOSED:
-                    self._maybe_stop_jax_trace()
+                was_rec = self._state in (ProfilerState.RECORD,
+                                          ProfilerState.RECORD_AND_RETURN)
+                now_rec = new_state in (ProfilerState.RECORD,
+                                        ProfilerState.RECORD_AND_RETURN)
+                if was_rec and not now_rec:
+                    self._stop_recording()
                     if self._on_trace_ready:
                         self._on_trace_ready(self)
+                elif now_rec and not was_rec:
+                    self._start_recording()
                 self._state = new_state
-                _recording = new_state in (ProfilerState.RECORD,
-                                           ProfilerState.RECORD_AND_RETURN)
 
     def stop(self):
-        global _recording
         benchmark().end()
-        self._maybe_stop_jax_trace()
-        if _recording and self._on_trace_ready:
+        was_recording = self._recording()
+        self._stop_recording()
+        if was_recording and self._on_trace_ready:
             self._on_trace_ready(self)
-        _recording = False
         self._state = ProfilerState.CLOSED
 
     def __enter__(self):
@@ -203,15 +229,24 @@ class Profiler:
         self.stop()
         return False
 
+    def _events(self):
+        """All windows since start(), plus the live one if recording."""
+        out = []
+        for w in self._windows:
+            out.extend(w.trace_events)
+        if self._attached and self._sink is not None:
+            out.extend(self._sink.trace_events)
+        return out
+
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms", views=None):
-        with _events_lock:
-            evs = list(_events)
         agg = {}
-        for e in evs:
+        for e in self._events():
+            if e.get("ph") != "X":
+                continue
             a = agg.setdefault(e["name"], [0, 0.0])
             a[0] += 1
-            a[1] += e["dur"]
+            a[1] += e.get("dur", 0.0)
         lines = [f"{'name':<40}{'calls':>8}{'total(ms)':>12}{'avg(ms)':>12}"]
         for name, (calls, total) in sorted(agg.items(),
                                            key=lambda kv: -kv[1][1]):
@@ -221,7 +256,7 @@ class Profiler:
 
     def export(self, path, format="json"):
         with open(path, "w") as f:
-            json.dump({"traceEvents": list(_events)}, f)
+            json.dump({"traceEvents": list(self._events())}, f)
 
 
 class _Benchmark:

@@ -1,0 +1,121 @@
+"""paddle_tpu.telemetry — the fleet metrics/trace plane (ROADMAP item
+5c) plus the persistent compile/AOT cache (item 5a).
+
+One in-process plane that every producer publishes into and every
+exporter reads from:
+
+  producers                         events
+  ---------                         ------
+  jit.TrainStep / ShardedTrainStep  train.step (wall_ms, phases, k)
+  OffloadPipelineStep               train.step (trainer=offload)
+  PipelineEngine.train_batch        pp.train_batch (schedule, micro)
+  collective_schedule()             collective.schedule (kind counts)
+  ContinuousBatcher                 serve.chunk / serve.recompile
+  io.prefetch_to_device             io.step (host_wait_ms)
+  distributed.watchdog              watchdog.timeout
+  distributed.fault                 fault.hit
+  distributed.checkpoint            ckpt.commit / ckpt.gc
+  compile cache (this package)      compile.program (hit/miss, ms)
+
+Cost contract: with no sink attached the whole plane is one truthiness
+check per would-be event, and arming/disarming sinks or
+``FLAGS_compile_cache_dir`` leaves every compiled program byte-identical
+(bench.py asserts both).  Exporters: `attach_jsonl` (step log),
+`attach_chrome_trace` (chrome://tracing / Perfetto), `dump()` (the
+snapshot bench.py embeds in its JSON lines).  `tools/telemetry_report.py`
+renders a JSONL log into per-phase medians/p99, MFU trend and cache hit
+rate.
+"""
+from __future__ import annotations
+
+from .registry import (MetricsRegistry, Counter, Gauge, Histogram,  # noqa: F401
+                       registry, counter, gauge, histogram,
+                       add_sink, remove_sink, sinks, active, emit, span,
+                       configure, config, reset)
+from .exporters import (JsonlSink, ChromeTraceSink, MemorySink,  # noqa: F401
+                        attach_jsonl, attach_chrome_trace)
+from .compile_cache import (cache_dir, maybe_enable_persistent_cache,  # noqa: F401
+                            disable_persistent_cache, aot_compile,
+                            compile_report, clear_report)
+from . import probe  # noqa: F401
+
+__all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "registry", "counter", "gauge", "histogram",
+           "add_sink", "remove_sink", "sinks", "active", "emit", "span",
+           "configure", "config", "reset",
+           "JsonlSink", "ChromeTraceSink", "MemorySink",
+           "attach_jsonl", "attach_chrome_trace",
+           "cache_dir", "maybe_enable_persistent_cache",
+           "disable_persistent_cache", "aot_compile", "compile_report",
+           "clear_report", "probe", "dump", "step_event"]
+
+
+def dump(compact: bool = False) -> dict:
+    """One snapshot of the whole plane: registry instruments + the
+    compile report.  `compact` trims the per-program compile records to
+    totals (what bench.py embeds per JSON line)."""
+    out = registry().dump()
+    rep = compile_report()
+    if compact:
+        rep = {k: v for k, v in rep.items() if k != "programs"}
+    out["compile"] = rep
+    return out
+
+
+# a process launched with FLAGS_compile_cache_dir in its environment
+# (relaunched worker, fleet job) arms jax's persistent cache at import —
+# BEFORE any subsystem compiles; unset, this is one dict lookup.
+# Runtime set_flags() arming is picked up lazily at the next trainer
+# build or program-cache miss (aot_for / _model_program_cache).
+try:
+    maybe_enable_persistent_cache()
+except Exception:                       # cache must never break import
+    pass
+
+
+def step_event(trainer, *, label: str, kind: str, step: int, k: int,
+               wall_ms: float, batch_vals=(), loss_fn=None, extra=None):
+    """Publish one `train.step` event for a trainer's compiled call —
+    the ONE implementation every trainer shares (jit/sharded/offload
+    pass their label; schema changes land here once).
+
+    Callers guard with `telemetry.active()` BEFORE assembling any of
+    these arguments, and call AFTER writing the new params back into
+    the model (the one-time phase probe reads live state_dict values;
+    the pre-call buffers were just donated).  `wall_ms` covers the
+    whole (possibly K-fused) call; per-step values are derived here.
+    `batch_vals` is ONE step's batch (phase probe + token count).
+    `kind` names the compiled program ("step"/"multi"); its first event
+    per trainer is marked cold=True — that wall may include the XLA
+    compile, so the report CLI excludes cold steps."""
+    import numpy as _np
+    per_step = wall_ms / max(k, 1)
+    fields = {"trainer": label, "step": int(step), "k": int(k),
+              "wall_ms": round(wall_ms, 3),
+              "step_ms": round(per_step, 3)}
+    seen = trainer.__dict__.setdefault("_tel_seen", set())
+    if kind not in seen:
+        seen.add(kind)
+        fields["cold"] = True
+    if batch_vals and _np.issubdtype(_np.dtype(batch_vals[0].dtype),
+                                     _np.integer):
+        tokens = int(_np.prod(batch_vals[0].shape)) * k
+        fields["tokens"] = tokens
+        if wall_ms > 0:
+            fields["tokens_per_sec"] = round(tokens / (wall_ms / 1e3), 1)
+    phases = probe.trainer_phases(trainer, batch_vals, loss_fn=loss_fn) \
+        if batch_vals else None
+    if phases:
+        fields["phases"] = {
+            "fwd_ms": phases["fwd_ms"],
+            "bwd_ms": phases["bwd_ms"],
+            "opt_ms": round(max(per_step - phases["fwdbwd_ms"], 0.0), 3),
+            "n_params": phases["n_params"],
+        }
+    if extra:
+        fields.update(extra)
+    histogram("train.step_ms").observe(per_step)
+    emit("train.step", fields)
+    # NOTE: the train.steps counter is incremented by the trainers
+    # UNCONDITIONALLY (sink or not) so dump() snapshots lifetime totals
+    # — incrementing it here too would double-count

@@ -1,0 +1,288 @@
+"""Persistent compilation cache + AOT executable serialization — the
+cold-start killer (ROADMAP item 5a: MULTICHIP_r05 logged a 3-minute XLA
+compile for ONE step; a 128-chip relaunch or re-elected elastic worker
+must not pay trace+compile again).
+
+Two layers, both armed by ``FLAGS_compile_cache_dir`` and both inert
+(one flag lookup) when it is unset:
+
+  1. **XLA persistent cache** — `jax.config` compilation-cache setup
+     pointed at ``<dir>``: every `jax.jit` in the process (trainers,
+     generate(), the serving batcher's scan programs) transparently
+     reuses compiled modules across processes.  Hit/miss counts are
+     scraped from jax's monitoring events into `compile_report()`.
+  2. **AOT executable store** — trainers additionally `.lower()` their
+     step once, fingerprint the StableHLO, and serialize the compiled
+     executable to ``<dir>/aot/``; a relaunched worker deserializes and
+     SKIPS the XLA compile.  NOTE the hit path still pays tracing +
+     lowering (the fingerprint requires the StableHLO) — seconds for a
+     big model, vs the minutes-scale compile it skips; per-program
+     trace_ms in `compile_report()` shows exactly what remains.
+     `jax.experimental.serialize_executable` preserves donation and
+     shardings.
+
+`compile_report()` is the telemetry face: one record per AOT program
+(trace/compile/load ms, hit/miss, key) plus the process-wide XLA cache
+counters — cold start becomes a first-class metric.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+import time
+import warnings
+from typing import Any, Dict, List, Optional
+
+from ..framework.flags import get_flag
+# import the functions, not the module: the package __init__ re-exports
+# a `registry()` accessor that shadows the submodule attribute
+from .registry import counter as _counter, emit as _emit
+
+__all__ = ["cache_dir", "maybe_enable_persistent_cache",
+           "disable_persistent_cache", "aot_compile", "aot_for",
+           "compile_report", "clear_report"]
+
+_lock = threading.Lock()
+_records: List[dict] = []
+_xla_counts = {"hits": 0, "misses": 0}
+_enabled_dir: Optional[str] = None
+_listener_installed = False
+_prior_jax_config: Optional[dict] = None
+
+
+def cache_dir() -> Optional[str]:
+    """The armed cache directory, or None.  THE fast-path guard: every
+    producer calls this first, and unset it is one dict lookup."""
+    d = get_flag("compile_cache_dir") or ""
+    return d or None
+
+
+def _on_jax_event(event: str):
+    if event == "/jax/compilation_cache/cache_hits":
+        _xla_counts["hits"] += 1
+        _counter("compile.xla_cache_hits").inc()
+    elif event == "/jax/compilation_cache/cache_misses":
+        _xla_counts["misses"] += 1
+        _counter("compile.xla_cache_misses").inc()
+
+
+def maybe_enable_persistent_cache() -> Optional[str]:
+    """Point jax's persistent compilation cache at FLAGS_compile_cache_dir
+    (idempotent; re-arms on a changed dir).  Returns the dir or None.
+
+    min_compile_time/min_entry_size are zeroed so even small programs
+    (and the CPU-backend tier-1 programs) persist — the default 1s
+    threshold would silently exclude exactly the quick-compiling
+    programs tests use to prove the wiring."""
+    global _enabled_dir, _listener_installed
+    d = cache_dir()
+    if d == _enabled_dir:
+        return _enabled_dir
+    if d is None:
+        # flag cleared after a previous arming: honor the documented
+        # "empty disables both layers" — otherwise every later jit
+        # keeps writing the stale (possibly deleted temp) dir
+        disable_persistent_cache()
+        return None
+    with _lock:
+        global _prior_jax_config
+        if d == _enabled_dir:
+            return _enabled_dir
+        import jax
+        os.makedirs(d, exist_ok=True)
+        if _prior_jax_config is None:
+            # snapshot whatever the user/env configured so disarming
+            # restores it instead of clobbering an independently-set
+            # jax cache (JAX_COMPILATION_CACHE_DIR etc.)
+            _prior_jax_config = {
+                "jax_compilation_cache_dir":
+                    jax.config.jax_compilation_cache_dir,
+                "jax_enable_compilation_cache":
+                    jax.config.jax_enable_compilation_cache,
+                "jax_persistent_cache_min_compile_time_secs":
+                    jax.config.jax_persistent_cache_min_compile_time_secs,
+                "jax_persistent_cache_min_entry_size_bytes":
+                    jax.config.jax_persistent_cache_min_entry_size_bytes,
+            }
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_enable_compilation_cache", True)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        if not _listener_installed:
+            try:
+                from jax._src import monitoring
+                monitoring.register_event_listener(_on_jax_event)
+                _listener_installed = True
+            except Exception:
+                pass     # report simply lacks XLA-level counts
+        _enabled_dir = d
+    return d
+
+
+def disable_persistent_cache():
+    """Disarm the jax-level cache, restoring the config exactly as it
+    was before arming — including any user/env-configured cache dir and
+    the persistence thresholds (the zero-overhead bench assert and
+    flag-toggle tests restore pristine state through this)."""
+    global _enabled_dir, _prior_jax_config
+    with _lock:
+        if _enabled_dir is None:
+            return
+        import jax
+        for k, v in (_prior_jax_config or
+                     {"jax_compilation_cache_dir": None}).items():
+            jax.config.update(k, v)
+        _prior_jax_config = None
+        _enabled_dir = None
+
+
+# ---------------------------------------------------------------------------
+# AOT executable store
+
+def _fingerprint(lowered, label: str) -> str:
+    """Content key: the lowered StableHLO + versions + backend.  Any
+    change to the program (shapes, flags-driven fusions, shardings,
+    jax/jaxlib upgrade) changes the key — a stale executable can never
+    be loaded for a different program."""
+    import jax
+    try:
+        import jaxlib
+        jl = getattr(jaxlib, "__version__", "?")
+    except Exception:
+        jl = "?"
+    text = str(lowered.compiler_ir(dialect="stablehlo"))
+    h = hashlib.sha256()
+    h.update(text.encode())
+    h.update(f"|{jax.__version__}|{jl}|{jax.default_backend()}|"
+             f"{label}".encode())
+    return h.hexdigest()[:24]
+
+
+def _aot_path(d: str, label: str, key: str) -> str:
+    safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                   for c in label)
+    return os.path.join(d, "aot", f"{safe}-{key}.pdexec")
+
+
+def _record(rec: dict):
+    with _lock:
+        _records.append(rec)
+    # errors get their own counter — folding them into misses would make
+    # dump()'s counters disagree with compile_report()'s hit/miss split
+    _counter({"hit": "compile.aot_hits",
+              "miss": "compile.aot_misses"}.get(rec.get("cache"),
+                                                "compile.aot_errors")).inc()
+    _emit("compile.program", rec)
+
+
+def aot_compile(jitfn, args: tuple, label: str):
+    """Lower `jitfn` for `args`, then load-or-compile the executable
+    through the AOT store.  Returns the compiled callable, or None when
+    the flag is unset or anything in the AOT path fails (callers fall
+    back to the plain jitted function — the cache must never be able to
+    break a step).  Every outcome lands in `compile_report()`."""
+    d = cache_dir()
+    if d is None:
+        return None
+    maybe_enable_persistent_cache()
+    try:
+        t0 = time.perf_counter()
+        lowered = jitfn.lower(*args)
+        trace_ms = (time.perf_counter() - t0) * 1e3
+        key = _fingerprint(lowered, label)
+        path = _aot_path(d, label, key)
+        if os.path.exists(path):
+            from jax.experimental import serialize_executable as se
+            t0 = time.perf_counter()
+            with open(path, "rb") as f:
+                blob, in_tree, out_tree = pickle.load(f)
+            compiled = se.deserialize_and_load(blob, in_tree, out_tree)
+            load_ms = (time.perf_counter() - t0) * 1e3
+            _record({"label": label, "key": key, "cache": "hit",
+                     "trace_ms": round(trace_ms, 2),
+                     "compile_ms": 0.0,
+                     "load_ms": round(load_ms, 2), "path": path})
+            return compiled
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        compile_ms = (time.perf_counter() - t0) * 1e3
+        try:
+            from jax.experimental import serialize_executable as se
+            payload = pickle.dumps(se.serialize(compiled), protocol=4)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:          # atomic publish: a
+                f.write(payload)                # concurrent reader never
+            os.replace(tmp, path)               # sees a torn executable
+        except Exception as e:                  # noqa: BLE001
+            warnings.warn(f"compile cache: could not serialize "
+                          f"{label!r} ({type(e).__name__}: {e}); "
+                          "executable used un-persisted", RuntimeWarning)
+        _record({"label": label, "key": key, "cache": "miss",
+                 "trace_ms": round(trace_ms, 2),
+                 "compile_ms": round(compile_ms, 2), "path": path})
+        return compiled
+    except Exception as e:                      # noqa: BLE001
+        warnings.warn(f"compile cache: AOT path failed for {label!r} "
+                      f"({type(e).__name__}: {e}); falling back to "
+                      "plain jit", RuntimeWarning)
+        _record({"label": label, "cache": "error",
+                 "error": f"{type(e).__name__}: {e}"})
+        return None
+
+
+def aot_for(store: Dict[Any, Any], kind: str, jitfn, args: tuple,
+            batch_vals, label: str, mesh=None):
+    """The trainers' shared AOT swap-in: unset flag → ONE dict lookup
+    and the retracing jit runs untouched; armed → the step is lowered
+    once per (kind, batch-aval signature), the compiled executable is
+    served from (or published to) the store, and `store` memoizes it —
+    a batch shape change simply compiles a second entry.  `mesh` wraps
+    the lowering so shardings resolve exactly as the jit path's
+    would."""
+    if cache_dir() is None:
+        return jitfn
+    sig = (kind,) + tuple((tuple(b.shape), str(b.dtype))
+                          for b in batch_vals)
+    fn = store.get(sig)
+    if fn is None:
+        if mesh is not None:
+            with mesh:
+                fn = aot_compile(jitfn, args, label) or jitfn
+        else:
+            fn = aot_compile(jitfn, args, label) or jitfn
+        store[sig] = fn
+    return fn
+
+
+def compile_report() -> dict:
+    """Per-program AOT records + process-wide XLA-cache counters —
+    trace/compile ms and hit/miss per program, so cold-start cost is a
+    number, not a log line."""
+    with _lock:
+        programs = list(_records)
+    hits = sum(1 for r in programs if r.get("cache") == "hit")
+    misses = sum(1 for r in programs if r.get("cache") == "miss")
+    return {
+        "dir": _enabled_dir or cache_dir(),
+        "programs": programs,
+        "aot_hits": hits,
+        "aot_misses": misses,
+        "hit_rate": round(hits / (hits + misses), 3)
+        if (hits + misses) else None,
+        "xla_cache": dict(_xla_counts),
+        "trace_ms_total": round(sum(r.get("trace_ms", 0.0)
+                                    for r in programs), 2),
+        "compile_ms_total": round(sum(r.get("compile_ms", 0.0)
+                                      for r in programs), 2),
+    }
+
+
+def clear_report():
+    with _lock:
+        _records.clear()
+    _xla_counts["hits"] = 0
+    _xla_counts["misses"] = 0

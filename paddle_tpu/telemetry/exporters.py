@@ -1,0 +1,151 @@
+"""Telemetry exporters: JSONL step log, Chrome-trace timeline, and the
+in-memory sink tests and the profiler facade build on.
+
+Reference: the profiler's `export_chrome_tracing` handler wrote a
+`{"traceEvents": [...]}` document after a RECORD window closed; here
+any sink can be attached/detached at any time and the trainers publish
+continuously, so export is a property of the sink, not of a profiler
+state machine.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import IO, List, Optional, Union
+
+from .registry import add_sink
+
+__all__ = ["JsonlSink", "ChromeTraceSink", "MemorySink",
+           "attach_jsonl", "attach_chrome_trace"]
+
+
+class JsonlSink:
+    """One JSON object per line per event — the fleet step log.  Each
+    record is written (and flushed by default) as it arrives, so a
+    preempted worker's log is complete up to its last event — the same
+    torn-tail discipline as the checkpoint runtime."""
+
+    def __init__(self, path_or_file: Union[str, IO], flush_every: int = 1):
+        if hasattr(path_or_file, "write"):
+            self._f = path_or_file
+            self.path = getattr(path_or_file, "name", None)
+            self._own = False
+        else:
+            self.path = path_or_file
+            d = os.path.dirname(path_or_file)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(path_or_file, "a")
+            self._own = True
+        self._flush_every = max(1, int(flush_every))
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def record(self, rec: dict):
+        line = json.dumps(rec, default=_jsonable)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._n += 1
+            if self._n % self._flush_every == 0:
+                self._f.flush()
+
+    def flush(self):
+        with self._lock:
+            self._f.flush()
+
+    def close(self):
+        with self._lock:
+            try:
+                self._f.flush()
+            finally:
+                if self._own:
+                    self._f.close()
+
+
+def _jsonable(x):
+    """Last-resort JSON coercion: numpy scalars/arrays and anything
+    else stringify rather than kill the sink."""
+    try:
+        import numpy as np
+        if isinstance(x, np.ndarray):
+            return x.tolist()
+        if isinstance(x, np.generic):
+            return x.item()
+    except Exception:
+        pass
+    return str(x)
+
+
+class ChromeTraceSink:
+    """Collect events as a chrome://tracing / Perfetto timeline.
+
+    Events carrying ``dur_ms`` become complete ('X') slices; everything
+    else becomes an instant ('i') event.  ``save(path)`` (or close, when
+    constructed with a path) writes the `{"traceEvents": [...]}` doc."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.trace_events: List[dict] = []
+        self._lock = threading.Lock()
+
+    def record(self, rec: dict):
+        ts_us = rec.get("ts", 0.0) * 1e6
+        name = rec.get("event", "event")
+        pid = os.getpid()
+        tid = threading.get_ident()
+        args = {k: v for k, v in rec.items() if k not in ("ts", "event")}
+        if "dur_ms" in rec:
+            dur_us = float(rec["dur_ms"]) * 1e3
+            ev = {"name": name, "ph": "X", "pid": pid, "tid": tid,
+                  "ts": ts_us - dur_us, "dur": dur_us, "args": args}
+        else:
+            ev = {"name": name, "ph": "i", "s": "p", "pid": pid,
+                  "tid": tid, "ts": ts_us, "args": args}
+        with self._lock:
+            self.trace_events.append(ev)
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or self.path
+        if path is None:
+            raise ValueError("ChromeTraceSink.save needs a path (none "
+                             "given at construction)")
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with self._lock:
+            doc = {"traceEvents": list(self.trace_events)}
+        with open(path, "w") as f:
+            json.dump(doc, f, default=_jsonable)
+        return path
+
+    def close(self):
+        if self.path is not None:
+            self.save()
+
+
+class MemorySink:
+    """Record into a list — tests and the profiler summary view."""
+
+    def __init__(self):
+        self.records: List[dict] = []
+        self._lock = threading.Lock()
+
+    def record(self, rec: dict):
+        with self._lock:
+            self.records.append(rec)
+
+    def close(self):
+        pass
+
+
+def attach_jsonl(path_or_file, flush_every: int = 1) -> JsonlSink:
+    """Create AND attach a JSONL sink; returns it (detach with
+    `telemetry.remove_sink(sink)`)."""
+    return add_sink(JsonlSink(path_or_file, flush_every))
+
+
+def attach_chrome_trace(path: Optional[str] = None) -> ChromeTraceSink:
+    """Create AND attach a chrome-trace sink; `remove_sink` (or
+    `.save()`) writes the timeline."""
+    return add_sink(ChromeTraceSink(path))

@@ -1,0 +1,106 @@
+"""Always-on step-phase probe — tools/profile_mfu's fwd/bwd/opt
+decomposition generalized into a cheap path every trainer can afford.
+
+profile_mfu re-compiles the step in nested pieces and times each with
+median-of-reps — the honest decomposition, but too expensive to run per
+step.  The always-on shape:
+
+  * ONCE per trainer (first step with a sink attached), build and time
+    a forward-only and a forward+backward jit over the live params —
+    two small extra compiles, results cached on the trainer;
+  * EVERY step, the trainer measures wall_ms around its (single,
+    unchanged) compiled call and attaches the cached fwd/bwd split plus
+    ``opt_ms = wall - fwdbwd`` — so each step event carries the full
+    phase picture at the cost of two perf_counter() calls.
+
+The probe is pure w.r.t. training state: it never calls the real step
+(no optimizer advance, no RNG draw, no donated-buffer consumption) and
+the trainer's own program is untouched — with no sink attached none of
+this runs (the zero-overhead contract bench.py asserts).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = ["phase_probe", "trainer_phases"]
+
+
+def _sync():
+    import jax
+    import jax.numpy as jnp
+    _ = np.asarray(jax.device_get(jnp.zeros(()) + 0))
+
+
+def _timed(fn, inner: int = 2) -> float:
+    """One warmup (compile) + `inner` timed calls; returns ms/call."""
+    fn()
+    _sync()
+    t0 = time.perf_counter()
+    for _ in range(inner):
+        fn()
+    _sync()
+    return (time.perf_counter() - t0) / inner * 1e3
+
+
+def phase_probe(model, batch_vals, loss_fn: Optional[Callable] = None,
+                inner: int = 2) -> dict:
+    """Time forward-only and forward+backward jits of `model` over
+    `batch_vals` (= (*inputs, labels) jax arrays).  Returns
+    {fwd_ms, bwd_ms, fwdbwd_ms, n_params}; the caller derives
+    opt_ms = step_wall - fwdbwd per step."""
+    import jax
+    from ..jit import _swapped_state
+    from ..framework.tensor import Tensor
+
+    sd = model.state_dict()
+    names = list(sd)
+    vals = [sd[n]._value for n in names]
+    n_params = sum(int(np.prod(sd[n].value.shape))
+                   for n, _ in model.named_parameters())
+
+    def loss_of(param_vals, *batch):
+        with _swapped_state(model, names, list(param_vals)):
+            out = model(*[Tensor(b) for b in batch[:-1]])
+            if loss_fn is not None:
+                loss = loss_fn(out, Tensor(batch[-1]))
+            else:
+                loss = model.compute_loss(out, Tensor(batch[-1]))
+        return loss._value if isinstance(loss, Tensor) else loss
+
+    fwd = jax.jit(loss_of)
+    fwdbwd = jax.jit(lambda pv, *b: jax.value_and_grad(loss_of)(pv, *b))
+
+    t_fwd = _timed(lambda: fwd(vals, *batch_vals), inner)
+    t_fb = _timed(lambda: fwdbwd(vals, *batch_vals), inner)
+    return {"fwd_ms": round(t_fwd, 3),
+            "bwd_ms": round(max(t_fb - t_fwd, 0.0), 3),
+            "fwdbwd_ms": round(t_fb, 3),
+            "n_params": n_params}
+
+
+def trainer_phases(trainer, batch_vals, loss_fn=None) -> Optional[dict]:
+    """Cached phase decomposition for a trainer object: computed on the
+    first call (while a sink is live), reused for every subsequent step
+    event.  A probe failure is cached too — one warning's worth of
+    cost, never a per-step retry loop."""
+    from .registry import config
+    if not config("step_phases"):
+        return None
+    cached = getattr(trainer, "_tel_phases", None)
+    if cached is not None:
+        return cached if cached else None      # {} = earlier failure
+    try:
+        phases = phase_probe(trainer.model, batch_vals, loss_fn=loss_fn)
+    except Exception as e:                     # noqa: BLE001
+        import warnings
+        warnings.warn(f"telemetry phase probe failed for "
+                      f"{type(trainer).__name__} "
+                      f"({type(e).__name__}: {e}); step events will "
+                      "carry wall_ms only", RuntimeWarning)
+        trainer._tel_phases = {}
+        return None
+    trainer._tel_phases = phases
+    return phases

@@ -1,0 +1,333 @@
+"""MetricsRegistry + the in-process event bus.
+
+Reference: `python/paddle/profiler/` keeps host-side instrumentation in a
+module-global event list guarded by a recording flag; fleet-scale
+operability needs the inverse shape — ONE always-importable plane that
+every producer (trainers, serving batcher, watchdog, fault registry,
+checkpoint runtime, data loader) publishes into, with the cost model of
+the analysis subsystem: **near-zero when nothing is attached**.
+
+Cost contract (bench-asserted, like analysis/fault):
+
+  * `emit()` with no sink attached is one module-global truthiness
+    check and a return — no dict building, no timestamps, no locking.
+  * `span()` with no sink attached returns a shared no-op context
+    manager — no allocation.
+  * Counters/gauges always accumulate (a few ns: one dict lookup and an
+    int add) so `telemetry.dump()` can snapshot lifetime totals even
+    when no sink ever ran; histograms keep a bounded reservoir.
+  * Nothing here ever touches jax or the compiled step — the plane is
+    host-side only, so arming/disarming sinks cannot change a program
+    (bench asserts byte-identical HLO across an attach/detach cycle).
+
+Sinks are objects with a ``record(rec: dict)`` method (and optionally
+``flush()``/``close()``); see exporters.py.  A raising sink is detached
+rather than allowed to kill a train step.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "registry", "counter", "gauge", "histogram",
+           "add_sink", "remove_sink", "sinks", "active", "emit", "span",
+           "configure", "config", "reset"]
+
+
+# one lock for all instrument mutation: `value += n` is LOAD/ADD/STORE
+# under the GIL, and the producers span threads (loader prefetch,
+# watchdog monitor, checkpoint writer) — a lost increment would flake
+# exactly the count-pinning regression tests this plane feeds
+_METRICS_LOCK = threading.Lock()
+
+
+class Counter:
+    """Monotonic int counter."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1):
+        with _METRICS_LOCK:
+            self.value += n
+            return self.value
+
+
+class Gauge:
+    """Last-value-wins float."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v: float):
+        with _METRICS_LOCK:
+            self.value = float(v)
+            return self.value
+
+
+class Histogram:
+    """Running count/sum/min/max plus a bounded reservoir of recent
+    observations (enough for p50/p99 over the window without unbounded
+    growth in a long-lived server — same discipline as the serving
+    batcher's chunk-time deque)."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "_window",
+                 "_cap", "_i")
+
+    def __init__(self, name: str, window: int = 1024):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self._window: List[float] = []
+        self._cap = window
+        self._i = 0
+
+    def observe(self, v: float):
+        v = float(v)
+        with _METRICS_LOCK:
+            self.count += 1
+            self.total += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+            if len(self._window) < self._cap:
+                self._window.append(v)
+            else:                   # ring overwrite: keep the recent cap
+                self._window[self._i] = v
+                self._i = (self._i + 1) % self._cap
+
+    def percentile(self, q: float) -> float:
+        if not self._window:
+            return 0.0
+        xs = sorted(self._window)
+        k = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+        return xs[k]
+
+    def summary(self) -> dict:
+        if not self.count:
+            return {"count": 0}
+        return {"count": self.count,
+                "sum": round(self.total, 4),
+                "min": round(self.min, 4),
+                "max": round(self.max, 4),
+                "p50": round(self.percentile(50), 4),
+                "p99": round(self.percentile(99), 4)}
+
+
+class MetricsRegistry:
+    """Name → instrument store.  get-or-create accessors are the hot
+    path, so instruments are cached in plain dicts; the lock only guards
+    creation (worker threads — loader prefetch, watchdog monitor,
+    checkpoint writer — all publish here)."""
+
+    def __init__(self):
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(name))
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(name))
+        return g
+
+    def histogram(self, name: str, window: int = 1024) -> Histogram:
+        h = self._hists.get(name)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(name,
+                                           Histogram(name, window))
+        return h
+
+    def dump(self) -> dict:
+        return {
+            "counters": {n: c.value for n, c in
+                         sorted(self._counters.items())},
+            "gauges": {n: g.value for n, g in sorted(self._gauges.items())},
+            "histograms": {n: h.summary() for n, h in
+                           sorted(self._hists.items())},
+        }
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def counter(name: str) -> Counter:
+    return _REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _REGISTRY.gauge(name)
+
+
+def histogram(name: str, window: int = 1024) -> Histogram:
+    return _REGISTRY.histogram(name, window)
+
+
+# ---------------------------------------------------------------------------
+# event bus
+
+_SINKS: List = []           # truthiness of this list IS the fast path
+_SINKS_LOCK = threading.Lock()
+
+# plane configuration — host-side behavior switches only (nothing here
+# may change a compiled program):
+#   step_phases: trainers attach the one-time fwd/bwd phase
+#     decomposition to their step events while a sink is live (costs two
+#     extra small compiles per trainer, once)
+#   sync_steps: trainers block_until_ready the loss inside the step
+#     span so wall_ms is exact step wall (default off: with donated
+#     buffers steady-state dispatch wall tracks step wall, and a forced
+#     sync costs a relay round trip per step on tunneled accelerators)
+_CONFIG_DEFAULTS = {"step_phases": True, "sync_steps": False}
+_CONFIG = dict(_CONFIG_DEFAULTS)
+
+
+def configure(**kw):
+    """Update plane config; unknown keys raise (typo'd switches must
+    fail loudly, not silently do nothing)."""
+    for k, v in kw.items():
+        if k not in _CONFIG:
+            raise KeyError(f"unknown telemetry config key {k!r}; "
+                           f"known: {sorted(_CONFIG)}")
+        _CONFIG[k] = v
+    return dict(_CONFIG)
+
+
+def config(key: str):
+    return _CONFIG[key]
+
+
+def add_sink(sink):
+    """Attach a sink; returns it (so `s = add_sink(JsonlSink(p))`)."""
+    with _SINKS_LOCK:
+        if sink not in _SINKS:
+            _SINKS.append(sink)
+    return sink
+
+
+def remove_sink(sink, close: bool = True):
+    with _SINKS_LOCK:
+        if sink in _SINKS:
+            _SINKS.remove(sink)
+    if close:
+        try:
+            sink.close()
+        except Exception:
+            pass
+
+
+def sinks() -> list:
+    return list(_SINKS)
+
+
+def active() -> bool:
+    """True iff at least one sink is attached — producers consult this
+    before doing ANY per-event work beyond the check itself."""
+    return bool(_SINKS)
+
+
+def emit(event: str, fields: Optional[dict] = None, **kw):
+    """Publish one event to every attached sink.  No sink → return
+    immediately (the zero-overhead contract)."""
+    if not _SINKS:
+        return
+    rec = {"ts": time.time(), "event": event}
+    if fields:
+        rec.update(fields)
+    if kw:
+        rec.update(kw)
+    for s in list(_SINKS):
+        try:
+            s.record(rec)
+        except Exception as e:      # noqa: BLE001
+            # a broken sink (disk full, closed file) must not take the
+            # training loop down with it — detach (close=True attempts
+            # a final flush of buffered lines; remove_sink swallows a
+            # failing close) and SAY SO: a silently dying step log is
+            # the failure mode this plane exists to prevent
+            import warnings
+            warnings.warn(
+                f"telemetry: detaching sink {type(s).__name__} after "
+                f"record() failed ({type(e).__name__}: {e}); events "
+                "from here on are not exported to it", RuntimeWarning)
+            remove_sink(s, close=True)
+
+
+class _Span:
+    __slots__ = ("event", "fields", "_t0")
+
+    def __init__(self, event: str, fields: dict):
+        self.event = event
+        self.fields = fields
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = (time.perf_counter() - self._t0) * 1e3
+        emit(self.event, self.fields, dur_ms=round(dur, 4))
+        return False
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+def span(event: str, **fields):
+    """Timed context manager: emits `event` with dur_ms on exit.  With
+    no sink attached returns a shared no-op (no allocation)."""
+    if not _SINKS:
+        return _NOOP
+    return _Span(event, fields)
+
+
+def reset():
+    """Detach every sink, clear the registry, and restore the default
+    config (test isolation — the whole plane back to pristine)."""
+    for s in list(_SINKS):
+        remove_sink(s)
+    _REGISTRY.reset()
+    _CONFIG.clear()
+    _CONFIG.update(_CONFIG_DEFAULTS)

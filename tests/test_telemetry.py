@@ -1,0 +1,693 @@
+"""Telemetry plane (paddle_tpu/telemetry) + persistent compile/AOT
+cache — ISSUE 6.
+
+The contracts under test:
+
+  * a 3-step jit.TrainStep run with a JSONL sink attached emits
+    per-step events carrying phase timings (acceptance criterion);
+  * a SECOND process pointed at the same FLAGS_compile_cache_dir
+    reports a cache hit — no recompile — via telemetry.compile_report()
+    (acceptance criterion);
+  * with no sink attached the plane is free: emit() is a no-op, span()
+    allocates nothing, programs are byte-identical (bench.py asserts
+    the HLO half; here the host half);
+  * every producer (trainers, serving batcher, watchdog, fault
+    registry, checkpoint runtime, io prefetcher) publishes its events;
+  * ContinuousBatcher.stats() counters SURVIVE a forced program
+    recompile, and the pre-recompile snapshot rides the
+    serve.recompile event;
+  * io.prefetch_to_device never hands a step a cold buffer when the
+    producer outruns the consumer;
+  * the profiler facade stays import-compatible;
+  * tools/telemetry_report.py --selftest validates the schema (tier-1
+    wiring, like verify_program --selftest).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts and ends with no sinks attached and the
+    compile cache disarmed (the plane is process-global)."""
+    from paddle_tpu.framework.flags import set_flags
+    for s in telemetry.sinks():
+        telemetry.remove_sink(s)
+    yield
+    for s in telemetry.sinks():
+        telemetry.remove_sink(s)
+    set_flags({"FLAGS_compile_cache_dir": ""})
+    telemetry.disable_persistent_cache()
+
+
+def _mlp_step():
+    class _MLP(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(8, 8)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    from paddle_tpu.jit import TrainStep
+    paddle.seed(0)
+    m = _MLP()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    step = TrainStep(m, lambda o, y: paddle.nn.functional.mse_loss(o, y),
+                     opt)
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    return step, x
+
+
+# ---------------------------------------------------------------------------
+# registry + bus
+
+class TestRegistry:
+    def test_instruments(self):
+        r = telemetry.MetricsRegistry()
+        r.counter("a").inc()
+        r.counter("a").inc(2)
+        r.gauge("g").set(1.5)
+        for v in (1.0, 2.0, 3.0, 4.0):
+            r.histogram("h").observe(v)
+        d = r.dump()
+        assert d["counters"]["a"] == 3
+        assert d["gauges"]["g"] == 1.5
+        h = d["histograms"]["h"]
+        assert h["count"] == 4 and h["min"] == 1.0 and h["max"] == 4.0
+        assert h["p50"] in (2.0, 3.0)
+
+    def test_histogram_window_bounded(self):
+        h = telemetry.Histogram("h", window=8)
+        for v in range(100):
+            h.observe(float(v))
+        assert h.count == 100
+        assert len(h._window) == 8          # ring, not unbounded
+
+    def test_emit_without_sink_is_noop_and_span_singleton(self):
+        # no sink: emit returns without touching anything, span returns
+        # THE shared no-op (no allocation on the hot path)
+        telemetry.emit("x", a=1)
+        s1 = telemetry.span("x")
+        s2 = telemetry.span("y")
+        assert s1 is s2
+
+    def test_sink_receives_and_broken_sink_detached(self):
+        good = telemetry.add_sink(telemetry.MemorySink())
+
+        class Bad:
+            def record(self, rec):
+                raise RuntimeError("disk full")
+
+        bad = telemetry.add_sink(Bad())
+        telemetry.emit("ev", a=1)
+        telemetry.emit("ev", a=2)
+        telemetry.remove_sink(good)
+        assert [r["a"] for r in good.records] == [1, 2]
+        assert bad not in telemetry.sinks()  # detached, loop survived
+
+    def test_span_emits_duration(self):
+        sink = telemetry.add_sink(telemetry.MemorySink())
+        with telemetry.span("work", tag="t"):
+            time.sleep(0.01)
+        telemetry.remove_sink(sink)
+        (rec,) = sink.records
+        assert rec["event"] == "work" and rec["tag"] == "t"
+        assert rec["dur_ms"] >= 5
+
+    def test_configure_rejects_unknown_key(self):
+        with pytest.raises(KeyError):
+            telemetry.configure(not_a_switch=True)
+
+    def test_reset_restores_config_defaults(self):
+        telemetry.configure(sync_steps=True, step_phases=False)
+        telemetry.reset()
+        assert telemetry.config("sync_steps") is False
+        assert telemetry.config("step_phases") is True
+
+
+# ---------------------------------------------------------------------------
+# train-step events (acceptance: 3-step run + JSONL sink -> per-step
+# events with phase timings)
+
+class TestStepEvents:
+    def test_three_step_trainstep_jsonl(self, tmp_path):
+        log = str(tmp_path / "steps.jsonl")
+        sink = telemetry.attach_jsonl(log)
+        try:
+            step, x = _mlp_step()
+            for _ in range(3):
+                step(x, x)
+        finally:
+            telemetry.remove_sink(sink)
+        events = [json.loads(l) for l in open(log)]
+        steps = [e for e in events if e["event"] == "train.step"]
+        assert len(steps) == 3
+        assert [e["step"] for e in steps] == [1, 2, 3]
+        for e in steps:
+            assert e["trainer"] == "jit" and e["k"] == 1
+            assert e["wall_ms"] >= 0
+            ph = e["phases"]
+            for k in ("fwd_ms", "bwd_ms", "opt_ms", "n_params"):
+                assert isinstance(ph[k], (int, float)), (k, e)
+        assert steps[0].get("cold") is True
+        assert "cold" not in steps[1]
+
+    def test_sharded_step_and_run_steps_events(self):
+        import jax
+        from paddle_tpu.parallel import ShardedTrainStep
+        from paddle_tpu.distributed.topology import build_mesh
+
+        sink = telemetry.add_sink(telemetry.MemorySink())
+        try:
+            class _MLP(paddle.nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.fc = paddle.nn.Linear(8, 8)
+
+                def forward(self, x):
+                    return self.fc(x)
+
+            paddle.seed(0)
+            m = _MLP()
+            opt = paddle.optimizer.AdamW(1e-3,
+                                         parameters=m.parameters())
+            step = ShardedTrainStep(
+                m, opt, build_mesh(devices=jax.devices()[:1]),
+                loss_fn=lambda o, y:
+                paddle.nn.functional.mse_loss(o, y))
+            x = paddle.to_tensor(np.ones((4, 8), np.float32))
+            step(x, x)
+            sx = paddle.to_tensor(np.ones((2, 4, 8), np.float32))
+            step.run_steps(sx, sx)
+        finally:
+            telemetry.remove_sink(sink)
+        evs = [r for r in sink.records if r["event"] == "train.step"]
+        assert [e["k"] for e in evs] == [1, 2]
+        assert all(e["trainer"] == "sharded" for e in evs)
+        assert evs[1]["step"] == 3          # 1 single + 2 fused
+
+    def test_no_sink_no_phase_probe_state(self):
+        # without a sink the trainer must not even cache phase-probe
+        # state (the probe never ran)
+        step, x = _mlp_step()
+        step(x, x)
+        assert not hasattr(step, "_tel_phases")
+
+
+# ---------------------------------------------------------------------------
+# compile cache (acceptance: second process reports a cache hit)
+
+_CACHE_SCRIPT = r"""
+import json
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import telemetry
+from paddle_tpu.jit import TrainStep
+
+class MLP(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = paddle.nn.Linear(8, 8)
+    def forward(self, x):
+        return self.fc(x)
+
+paddle.seed(0)
+m = MLP()
+opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+step = TrainStep(m, lambda o, y: paddle.nn.functional.mse_loss(o, y),
+                 opt)
+x = paddle.to_tensor(np.ones((4, 8), np.float32))
+for _ in range(2):
+    loss = step(x, x)
+print("RESULT " + json.dumps({
+    "loss": float(np.asarray(loss.value)),
+    "report": telemetry.compile_report(),
+}))
+"""
+
+
+class TestCompileCache:
+    def _run(self, cache_dir):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   FLAGS_compile_cache_dir=cache_dir,
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        out = subprocess.run([sys.executable, "-c", _CACHE_SCRIPT],
+                             env=env, text=True, capture_output=True,
+                             timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        line = next(l for l in out.stdout.splitlines()
+                    if l.startswith("RESULT "))
+        return json.loads(line[len("RESULT "):])
+
+    def test_second_process_reports_cache_hit(self, tmp_path):
+        cache = str(tmp_path / "cache")
+        first = self._run(cache)
+        progs = first["report"]["programs"]
+        assert progs and all(p["cache"] == "miss" for p in progs)
+        assert first["report"]["aot_misses"] >= 1
+        second = self._run(cache)
+        progs2 = second["report"]["programs"]
+        # the SAME program key resolves to a hit: no recompile
+        assert progs2 and all(p["cache"] == "hit" for p in progs2)
+        assert second["report"]["hit_rate"] == 1.0
+        assert all(p["compile_ms"] == 0.0 for p in progs2)
+        assert {p["key"] for p in progs2} == {p["key"] for p in progs}
+        # and the cached executable computes the same training step
+        assert second["loss"] == pytest.approx(first["loss"])
+
+    def test_aot_in_process_flags_off_identical(self, tmp_path):
+        """Arming + disarming the cache leaves the flags-off path
+        untouched, and the armed path really serves from the store."""
+        from paddle_tpu.framework.flags import set_flags
+        step, x = _mlp_step()
+        l_off = float(np.asarray(step(x, x).value))
+        telemetry.clear_report()
+        set_flags({"FLAGS_compile_cache_dir": str(tmp_path / "c")})
+        try:
+            paddle.seed(0)
+            step2, x2 = _mlp_step()
+            l_on = float(np.asarray(step2(x2, x2).value))
+            rep = telemetry.compile_report()
+            assert rep["programs"], "armed flag produced no AOT records"
+            assert os.path.isdir(str(tmp_path / "c" / "aot"))
+        finally:
+            set_flags({"FLAGS_compile_cache_dir": ""})
+            telemetry.disable_persistent_cache()
+        assert l_on == pytest.approx(l_off)
+
+    def test_flag_clear_disarms_jax_cache(self, tmp_path):
+        """Clearing FLAGS_compile_cache_dir must disarm the jax-level
+        persistent cache on the next arming check — 'empty disables
+        both layers' (regression: it used to stay pointed at the stale
+        dir)."""
+        import jax
+        from paddle_tpu.framework.flags import set_flags
+        from paddle_tpu.telemetry import compile_cache as cc
+        set_flags({"FLAGS_compile_cache_dir": str(tmp_path / "c")})
+        try:
+            assert cc.maybe_enable_persistent_cache() is not None
+            assert jax.config.jax_compilation_cache_dir \
+                == str(tmp_path / "c")
+        finally:
+            set_flags({"FLAGS_compile_cache_dir": ""})
+        assert cc.maybe_enable_persistent_cache() is None
+        assert jax.config.jax_compilation_cache_dir is None
+
+
+# ---------------------------------------------------------------------------
+# io.prefetch_to_device
+
+class TestPrefetch:
+    def test_never_cold_buffer(self):
+        """Producer (instant) outruns consumer (sleeping): after the
+        priming get, every step must find a WARM device-resident
+        buffer."""
+        from paddle_tpu.io import prefetch_to_device
+        batches = [np.full((2, 4), i, np.float32) for i in range(8)]
+        pf = prefetch_to_device(iter(batches), depth=2)
+        # deterministic priming: wait for the pipeline to fill before
+        # the first get (scheduling noise on a loaded box must not
+        # masquerade as a cold buffer)
+        deadline = time.time() + 10
+        while pf._q.qsize() < 2 and time.time() < deadline:
+            time.sleep(0.005)
+        seen = []
+        for b in pf:
+            time.sleep(0.03)            # consumer slower than producer
+            seen.append(float(np.asarray(b.value)[0, 0]))
+        assert seen == [float(i) for i in range(8)]
+        st = pf.stats()
+        assert st["steps"] == 8
+        assert st["cold_gets"] == 0, st
+
+    def test_emits_host_wait_events_and_structure(self):
+        from paddle_tpu.io import prefetch_to_device
+        sink = telemetry.add_sink(telemetry.MemorySink())
+        try:
+            batches = [(np.ones((2, 4), np.float32),
+                        np.zeros((2,), np.int64)) for _ in range(3)]
+            out = list(prefetch_to_device(iter(batches), depth=2))
+        finally:
+            telemetry.remove_sink(sink)
+        assert len(out) == 3
+        xb, yb = out[0]
+        import jax
+        assert isinstance(xb.value, jax.Array)     # device-resident
+        evs = [r for r in sink.records if r["event"] == "io.step"]
+        assert len(evs) == 3
+        assert all("host_wait_ms" in e and "buffered" in e
+                   for e in evs)
+
+    def test_sharding_aware_with_mesh(self):
+        import jax
+        from paddle_tpu.io import prefetch_to_device
+        from paddle_tpu.distributed.topology import build_mesh
+        mesh = build_mesh(dp=4, devices=jax.devices()[:4])
+        batches = [np.ones((8, 4), np.float32) for _ in range(2)]
+        out = list(prefetch_to_device(iter(batches), depth=2,
+                                      mesh=mesh))
+        sh = out[0].value.sharding
+        # batch dim sharded over the data axes
+        assert sh.spec[0] is not None
+
+    def test_loader_error_propagates(self):
+        from paddle_tpu.io import prefetch_to_device
+
+        def gen():
+            yield np.zeros((2,), np.float32)
+            raise ValueError("planted")
+
+        pf = prefetch_to_device(gen(), depth=2)
+        next(pf)
+        with pytest.raises(ValueError, match="planted"):
+            for _ in pf:
+                pass
+
+    def test_close_on_abandon_stops_producer(self):
+        """An abandoned iterator must release its producer thread and
+        the parked device batches via close() (regression: the thread
+        used to stay parked on the full queue forever)."""
+        from paddle_tpu.io import prefetch_to_device
+
+        def gen():
+            for i in range(1000):
+                yield np.full((2,), i, np.float32)
+
+        pf = prefetch_to_device(gen(), depth=2)
+        next(pf)                        # consume one, then abandon
+        pf.close()
+        pf._thread.join(timeout=2.0)
+        assert not pf._thread.is_alive()
+        # parked DATA batches dropped (at most the wake-up sentinel
+        # remains), and further iteration raises instead of hanging
+        assert pf._q.qsize() <= 1
+        with pytest.raises(StopIteration):
+            next(pf)
+        # context-manager form does the same
+        with prefetch_to_device(gen(), depth=2) as pf2:
+            next(pf2)
+        pf2._thread.join(timeout=2.0)
+        assert not pf2._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# serving batcher: counters survive a forced recompile; snapshot event
+
+@pytest.fixture(scope="module")
+def serve_model():
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    paddle.seed(7)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            intermediate_size=128,
+                            num_attention_heads=4,
+                            num_key_value_heads=2, vocab_size=128)
+    return LlamaForCausalLM(cfg)
+
+
+def _serve_workload(model, force_recompile_at=None):
+    from paddle_tpu.inference import ContinuousBatcher
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, 128, L).astype(np.int32)
+               for L in (4, 7, 5)]
+    bat = ContinuousBatcher(model, max_batch_size=2, max_len=32,
+                            chunk=4)
+    for p in prompts[:2]:
+        bat.submit(p, 6)
+    bat.step()
+    bat.submit(prompts[2], 6)
+    n = 0
+    while bat._queue or bat.active:
+        n += 1
+        if force_recompile_at is not None and n == force_recompile_at:
+            # forced program-cache miss: the next chunk re-traces
+            model.__dict__.get("_gen_compiled", {}).clear()
+        bat.step()
+    return bat
+
+
+class TestServeTelemetry:
+    def test_stats_survive_forced_recompile(self, serve_model):
+        """Regression (ISSUE 6 satellite): a program-cache miss
+        mid-life must not lose the batcher's counters — counts across
+        a forced recompile equal the undisturbed run's."""
+        base = _serve_workload(serve_model)
+        forced = _serve_workload(serve_model, force_recompile_at=2)
+        b, f = base.stats(), forced.stats()
+        for k in ("chunks", "decode_chunks", "admit_chunks",
+                  "prefill_tokens", "decode_tokens", "tokens_produced"):
+            assert f[k] == b[k], (k, f, b)
+        # and the outputs are unchanged by the recompile
+        assert {r: list(base._finished[r].tokens)
+                for r in base._finished} \
+            == {r: list(forced._finished[r].tokens)
+                for r in forced._finished}
+
+    def test_recompile_event_snapshots_stats(self, serve_model):
+        sink = telemetry.add_sink(telemetry.MemorySink())
+        try:
+            _serve_workload(serve_model, force_recompile_at=2)
+        finally:
+            telemetry.remove_sink(sink)
+        recs = [r for r in sink.records
+                if r["event"] == "serve.recompile"]
+        assert recs, "forced recompile emitted no serve.recompile"
+        snap = recs[0]
+        # the snapshot carries the PRE-recompile counters
+        assert snap["chunks"] >= 1
+        assert "prefill_tokens" in snap and "decode_tokens" in snap
+        chunks = [r for r in sink.records
+                  if r["event"] == "serve.chunk"]
+        assert len(chunks) >= snap["chunks"]
+        assert any(c["first_use"] for c in chunks)
+
+    def test_chunk_events(self, serve_model):
+        sink = telemetry.add_sink(telemetry.MemorySink())
+        try:
+            bat = _serve_workload(serve_model)
+        finally:
+            telemetry.remove_sink(sink)
+        chunks = [r for r in sink.records if r["event"] == "serve.chunk"]
+        assert len(chunks) == bat.stats()["chunks"]
+        kinds = {c["kind"] for c in chunks}
+        assert kinds <= {"admit", "decode"} and "admit" in kinds
+        assert sum(c["prefill_tokens"] for c in chunks) \
+            == bat.stats()["prefill_tokens"]
+
+
+# ---------------------------------------------------------------------------
+# runtime producers: watchdog, fault, checkpoint, pipeline/collectives
+
+class TestRuntimeProducers:
+    def test_watchdog_timeout_event(self):
+        from paddle_tpu.distributed.watchdog import CommTaskManager
+        sink = telemetry.add_sink(telemetry.MemorySink())
+        try:
+            mgr = CommTaskManager(poll_interval=0.02)
+            task = mgr.start_task("test hang", timeout=0.05)
+            try:
+                deadline = time.time() + 5
+                while not mgr.timeout_log and time.time() < deadline:
+                    time.sleep(0.02)
+            finally:
+                task.done()
+                mgr.shutdown()
+        finally:
+            telemetry.remove_sink(sink)
+        evs = [r for r in sink.records
+               if r["event"] == "watchdog.timeout"]
+        assert evs and evs[0]["task"] == "test hang"
+        assert evs[0]["age_s"] >= 0.05
+
+    def test_fault_hit_event(self):
+        from paddle_tpu.distributed import fault
+        sink = telemetry.add_sink(telemetry.MemorySink())
+        try:
+            with fault.scope("step.begin:mode=delay:secs=0"):
+                fault.hit("step.begin", key="probe")
+        finally:
+            telemetry.remove_sink(sink)
+        evs = [r for r in sink.records if r["event"] == "fault.hit"]
+        assert evs and evs[0]["point"] == "step.begin"
+        assert evs[0]["mode"] == "delay"
+
+    def test_checkpoint_commit_and_gc_events(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+        sink = telemetry.add_sink(telemetry.MemorySink())
+        try:
+            root = str(tmp_path)
+            for s in (1, 2, 3):
+                ckpt.save_checkpoint(
+                    {"w": paddle.to_tensor(
+                        np.full((2, 2), s, np.float32))},
+                    root, s, keep=2)
+        finally:
+            telemetry.remove_sink(sink)
+        commits = [r for r in sink.records if r["event"] == "ckpt.commit"]
+        gcs = [r for r in sink.records if r["event"] == "ckpt.gc"]
+        assert [c["step"] for c in commits] == [1, 2, 3]
+        assert gcs and gcs[-1]["removed"] == ["step_00000001"]
+
+    def test_collective_schedule_event(self):
+        import jax
+        from paddle_tpu.parallel import ShardedTrainStep
+        from paddle_tpu.distributed.topology import build_mesh
+
+        class _MLP(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = paddle.nn.Linear(8, 8)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        paddle.seed(0)
+        m = _MLP()
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        step = ShardedTrainStep(
+            m, opt, build_mesh(dp=4, devices=jax.devices()[:4]),
+            loss_fn=lambda o, y: paddle.nn.functional.mse_loss(o, y))
+        x = paddle.to_tensor(np.ones((8, 8), np.float32))
+        sink = telemetry.add_sink(telemetry.MemorySink())
+        try:
+            events = step.collective_schedule(x, x)
+        finally:
+            telemetry.remove_sink(sink)
+        evs = [r for r in sink.records
+               if r["event"] == "collective.schedule"]
+        assert evs and evs[0]["total"] == len(events)
+        assert sum(evs[0]["kinds"].values()) == len(events)
+
+
+# ---------------------------------------------------------------------------
+# exporters + profiler facade + report CLI
+
+class TestExportersAndFacade:
+    def test_chrome_trace_sink(self, tmp_path):
+        path = str(tmp_path / "trace.json")
+        sink = telemetry.attach_chrome_trace(path)
+        try:
+            with telemetry.span("slice"):
+                time.sleep(0.002)
+            telemetry.emit("instant", a=1)
+        finally:
+            telemetry.remove_sink(sink)   # close() writes the doc
+        doc = json.load(open(path))
+        phs = {e["ph"] for e in doc["traceEvents"]}
+        assert phs == {"X", "i"}
+        sl = next(e for e in doc["traceEvents"] if e["ph"] == "X")
+        assert sl["name"] == "slice" and sl["dur"] > 0
+
+    def test_profiler_facade_names_and_record(self, tmp_path):
+        # import-compat surface (deprecation shim over telemetry)
+        from paddle_tpu.profiler import (Profiler, ProfilerState,
+                                         ProfilerTarget, RecordEvent,
+                                         make_scheduler,
+                                         export_chrome_tracing,
+                                         load_profiler_result,
+                                         SummaryView, benchmark)
+        assert ProfilerState.RECORD and ProfilerTarget.TPU \
+            and SummaryView.OverView
+        assert "deprecat" in sys.modules["paddle_tpu.profiler"] \
+            .__doc__.lower()
+        prof = Profiler(timer_only=True)
+        with prof:
+            with RecordEvent("my_op"):
+                time.sleep(0.002)
+            benchmark().step(4)
+        out = str(tmp_path / "prof.json")
+        prof.export(out)
+        doc = load_profiler_result(out)
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "my_op" in names
+        assert "my_op" in prof.summary()
+        sched = make_scheduler(closed=1, ready=1, record=2)
+        assert sched(0) == ProfilerState.CLOSED
+        assert export_chrome_tracing(str(tmp_path))  # handler builds
+        # the window detached its sink
+        assert not telemetry.active()
+
+    def test_record_event_outside_window_is_free(self):
+        from paddle_tpu.profiler import RecordEvent
+        with RecordEvent("noop"):
+            pass                # no sink attached -> no-op span
+
+    def test_profiler_scheduled_second_window_records(self):
+        """Regression: a scheduled profiler's second RECORD window must
+        attach a fresh sink (the first fix left self._sink set, so
+        window 2 silently recorded nothing), and on_trace_ready fires
+        once per closed window, not again at stop()."""
+        from paddle_tpu.profiler import (Profiler, RecordEvent,
+                                         make_scheduler)
+        fired = []
+        prof = Profiler(timer_only=True,
+                        scheduler=make_scheduler(closed=1, ready=0,
+                                                 record=1, repeat=2),
+                        on_trace_ready=lambda p: fired.append(
+                            len(p._events())))
+        prof.start()                    # step 0: CLOSED
+        for _ in range(4):              # steps 1..4: R, C, R, C
+            with RecordEvent("op"):
+                pass
+            prof.step()
+        prof.stop()
+        assert len(fired) == 2          # one per closed window
+        # windows ACCUMULATE: summary()/export() cover every window
+        # since start(), and window 2 really recorded
+        assert fired == [1, 2], fired
+        assert not telemetry.active()
+
+    def test_report_cli_selftest(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import telemetry_report as cli
+        finally:
+            sys.path.pop(0)
+        assert cli.main(["--selftest"]) == 0
+
+    def test_report_analyze(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import telemetry_report as cli
+        finally:
+            sys.path.pop(0)
+        log = str(tmp_path / "s.jsonl")
+        sink = telemetry.attach_jsonl(log)
+        try:
+            step, x = _mlp_step()
+            for _ in range(4):
+                step(x, x)
+        finally:
+            telemetry.remove_sink(sink)
+        rep = cli.analyze(cli.load_events(log))
+        assert rep["train_steps"] == 4 and rep["cold_steps"] == 1
+        assert set(rep["phases"]) == {"fwd_ms", "bwd_ms", "opt_ms"}
+        assert cli.render(rep)
+
+    def test_dump_snapshot_and_bench_field(self, capsys):
+        telemetry.counter("x").inc(5)
+        d = telemetry.dump(compact=True)
+        assert d["counters"]["x"] >= 5
+        assert "programs" not in d["compile"]
+        # bench.py JSON lines carry the snapshot (acceptance)
+        sys.path.insert(0, REPO)
+        try:
+            import bench
+        finally:
+            sys.path.pop(0)
+        bench._emit("m", 1.0, "u", 1.0, 0.0, [1.0])
+        rec = json.loads(capsys.readouterr().out.strip())
+        assert "telemetry" in rec and "counters" in rec["telemetry"]

@@ -1,0 +1,294 @@
+"""Telemetry step-log report CLI — the command-line face of
+paddle_tpu.telemetry (JSON output option + --selftest wired into
+tier-1, like tools/verify_program.py).
+
+    python tools/telemetry_report.py steps.jsonl [--json] [--peak F]
+        Read a JSONL step log (telemetry.attach_jsonl) and print:
+        per-phase medians/p99 over warm train.step events, tokens/s and
+        the MFU trend (first half vs second half of the run), serving
+        chunk stats, io host-wait stats, and the compile-cache hit
+        rate.
+
+    python tools/telemetry_report.py --selftest
+        CI canary: runs a 5-step toy train loop with a JSONL sink (and
+        a compile cache dir) in a temp dir, validates the emitted
+        schema (every step event carries wall_ms + fwd/bwd/opt phase
+        timings; compile.program events carry hit/miss), then renders
+        the report over it.  Exit 1 on any violation — a silently
+        empty telemetry plane is exactly the failure mode this guards.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _pct(xs, q):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    k = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[k]
+
+
+def load_events(path):
+    events = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError as e:
+                raise SystemExit(f"{path}:{i + 1}: not a JSON object "
+                                 f"({e})")
+    return events
+
+
+def analyze(events, peak=None):
+    """Aggregate a JSONL event list into the report dict."""
+    if peak is None:
+        peak = float(os.environ.get("PEAK_FLOPS", 0)) or None
+    steps = [e for e in events if e.get("event") == "train.step"]
+    warm = [e for e in steps if not e.get("cold")]
+    out = {"events": len(events), "train_steps": len(steps),
+           "cold_steps": len(steps) - len(warm)}
+
+    def series(key):
+        return [e[key] for e in warm if isinstance(e.get(key),
+                                                   (int, float))]
+
+    if warm:
+        walls = series("step_ms")
+        ph = {"fwd_ms": [], "bwd_ms": [], "opt_ms": []}
+        for e in warm:
+            for k in ph:
+                v = e.get("phases", {}).get(k)
+                if isinstance(v, (int, float)):
+                    ph[k].append(v)
+        out["step_ms"] = {"p50": round(_pct(walls, 50), 3),
+                          "p99": round(_pct(walls, 99), 3)}
+        out["phases"] = {k: {"p50": round(_pct(v, 50), 3),
+                             "p99": round(_pct(v, 99), 3)}
+                         for k, v in ph.items() if v}
+        tps = series("tokens_per_sec")
+        if tps:
+            out["tokens_per_sec"] = {"p50": round(_pct(tps, 50), 1),
+                                     "p99": round(_pct(tps, 99), 1)}
+            n_params = next((e["phases"]["n_params"] for e in warm
+                             if e.get("phases", {}).get("n_params")),
+                            None)
+            if n_params and peak:
+                mfus = [6.0 * n_params * t / peak for t in tps]
+                half = max(1, len(mfus) // 2)
+                out["mfu"] = {
+                    "p50": round(float(np.median(mfus)), 4),
+                    "first_half": round(float(np.median(mfus[:half])), 4),
+                    "second_half": round(float(np.median(mfus[half:])), 4),
+                }
+                out["mfu"]["trend"] = round(
+                    out["mfu"]["second_half"] - out["mfu"]["first_half"],
+                    4)
+
+    compiles = [e for e in events if e.get("event") == "compile.program"]
+    if compiles:
+        hits = sum(1 for e in compiles if e.get("cache") == "hit")
+        judged = sum(1 for e in compiles
+                     if e.get("cache") in ("hit", "miss"))
+        out["compile"] = {
+            "programs": len(compiles), "hits": hits,
+            "hit_rate": round(hits / judged, 3) if judged else None,
+            "trace_ms": round(sum(e.get("trace_ms", 0.0)
+                                  for e in compiles), 1),
+            "compile_ms": round(sum(e.get("compile_ms", 0.0)
+                                    for e in compiles), 1),
+        }
+
+    chunks = [e for e in events if e.get("event") == "serve.chunk"]
+    if chunks:
+        cw = [e["wall_ms"] for e in chunks if not e.get("first_use")]
+        out["serve"] = {
+            "chunks": len(chunks),
+            "chunk_ms_p50": round(_pct(cw, 50), 3),
+            "chunk_ms_p99": round(_pct(cw, 99), 3),
+            "prefill_tokens": sum(e.get("prefill_tokens", 0)
+                                  for e in chunks),
+            "decode_tokens": sum(e.get("decode_tokens", 0)
+                                 for e in chunks),
+            "recompiles": sum(1 for e in events
+                              if e.get("event") == "serve.recompile"),
+        }
+
+    io_steps = [e for e in events if e.get("event") == "io.step"]
+    if io_steps:
+        ws = [e.get("host_wait_ms", 0.0) for e in io_steps]
+        out["io"] = {"steps": len(io_steps),
+                     "host_wait_ms_p50": round(_pct(ws, 50), 3),
+                     "host_wait_ms_p99": round(_pct(ws, 99), 3),
+                     "cold_gets": sum(1 for e in io_steps
+                                      if e.get("cold"))}
+
+    for ev, key in (("watchdog.timeout", "watchdog_timeouts"),
+                    ("fault.hit", "fault_hits"),
+                    ("ckpt.commit", "ckpt_commits"),
+                    ("ckpt.gc", "ckpt_gcs")):
+        n = sum(1 for e in events if e.get("event") == ev)
+        if n:
+            out[key] = n
+    return out
+
+
+def render(rep):
+    lines = [f"events: {rep['events']}  train steps: "
+             f"{rep['train_steps']} ({rep['cold_steps']} cold, excluded)"]
+    if "step_ms" in rep:
+        lines.append(f"step ms     p50={rep['step_ms']['p50']:<10} "
+                     f"p99={rep['step_ms']['p99']}")
+    for k, v in rep.get("phases", {}).items():
+        lines.append(f"  {k:<9} p50={v['p50']:<10} p99={v['p99']}")
+    if "tokens_per_sec" in rep:
+        lines.append(f"tokens/s    p50={rep['tokens_per_sec']['p50']}")
+    if "mfu" in rep:
+        m = rep["mfu"]
+        lines.append(f"mfu         p50={m['p50']}  trend "
+                     f"{m['first_half']} -> {m['second_half']} "
+                     f"({'+' if m['trend'] >= 0 else ''}{m['trend']})")
+    if "compile" in rep:
+        c = rep["compile"]
+        rate = "n/a" if c["hit_rate"] is None else c["hit_rate"]
+        lines.append(f"compile     {c['programs']} programs, hit rate "
+                     f"{rate}, trace {c['trace_ms']}ms, "
+                     f"compile {c['compile_ms']}ms")
+    if "serve" in rep:
+        s = rep["serve"]
+        lines.append(f"serve       {s['chunks']} chunks, p50 "
+                     f"{s['chunk_ms_p50']}ms, prefill/decode "
+                     f"{s['prefill_tokens']}/{s['decode_tokens']}, "
+                     f"{s['recompiles']} recompiles")
+    if "io" in rep:
+        i = rep["io"]
+        lines.append(f"io          {i['steps']} gets, host wait p50 "
+                     f"{i['host_wait_ms_p50']}ms p99 "
+                     f"{i['host_wait_ms_p99']}ms, {i['cold_gets']} cold")
+    for k in ("watchdog_timeouts", "fault_hits", "ckpt_commits",
+              "ckpt_gcs"):
+        if k in rep:
+            lines.append(f"{k}: {rep[k]}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# selftest
+
+def _selftest():
+    import tempfile
+    problems = []
+    with tempfile.TemporaryDirectory() as d:
+        log = os.path.join(d, "steps.jsonl")
+        from paddle_tpu.framework.flags import set_flags
+        set_flags({"FLAGS_compile_cache_dir": os.path.join(d, "cache")})
+        try:
+            import paddle_tpu as paddle
+            from paddle_tpu import telemetry
+            from paddle_tpu.jit import TrainStep
+
+            sink = telemetry.attach_jsonl(log)
+            try:
+                paddle.seed(0)
+                model = paddle.nn.Sequential(
+                    paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                    paddle.nn.Linear(16, 8))
+                opt = paddle.optimizer.AdamW(
+                    1e-3, parameters=model.parameters())
+                step = TrainStep(
+                    model,
+                    lambda o, y: paddle.nn.functional.mse_loss(o, y),
+                    opt)
+                rng = np.random.RandomState(0)
+                x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+                for _ in range(5):
+                    step(x, x)
+            finally:
+                telemetry.remove_sink(sink)
+        finally:
+            set_flags({"FLAGS_compile_cache_dir": ""})
+            from paddle_tpu.telemetry import disable_persistent_cache
+            disable_persistent_cache()
+
+        events = load_events(log)
+        steps = [e for e in events if e.get("event") == "train.step"]
+        if len(steps) != 5:
+            problems.append(f"expected 5 train.step events, got "
+                            f"{len(steps)}")
+        for i, e in enumerate(steps):
+            for k in ("ts", "trainer", "step", "k", "wall_ms",
+                      "step_ms"):
+                if k not in e:
+                    problems.append(f"step event {i} missing {k!r}")
+            ph = e.get("phases", {})
+            for k in ("fwd_ms", "bwd_ms", "opt_ms", "n_params"):
+                if not isinstance(ph.get(k), (int, float)):
+                    problems.append(f"step event {i} phases missing "
+                                    f"{k!r}")
+            if e.get("wall_ms", -1) < 0:
+                problems.append(f"step event {i} negative wall_ms")
+        if [e["step"] for e in steps] != sorted(e["step"] for e in steps):
+            problems.append("step counter not monotonic")
+        compiles = [e for e in events
+                    if e.get("event") == "compile.program"]
+        if not compiles:
+            problems.append("no compile.program events with "
+                            "FLAGS_compile_cache_dir armed")
+        for e in compiles:
+            if e.get("cache") not in ("hit", "miss", "error"):
+                problems.append(f"compile event bad cache field: {e}")
+        rep = analyze(events)
+        if "phases" not in rep or "step_ms" not in rep:
+            problems.append(f"report missing phase stats: {rep}")
+        print(render(rep))
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render a telemetry JSONL step log / self-check "
+                    "the telemetry plane")
+    ap.add_argument("log", nargs="?", help="JSONL step log path")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run a 5-step toy loop and validate the "
+                         "emitted schema; exit 1 on any violation")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--peak", type=float, default=None,
+                    help="chip peak FLOP/s for MFU (default: "
+                         "PEAK_FLOPS env, else omitted)")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        problems = _selftest()
+        if problems:
+            for p in problems:
+                print(f"FAIL {p}")
+            return 1
+        print("selftest: telemetry schema ok")
+        return 0
+
+    if not args.log:
+        ap.error("provide a JSONL log path or --selftest")
+    rep = analyze(load_events(args.log), peak=args.peak)
+    if args.json:
+        print(json.dumps(rep, indent=1))
+    else:
+        print(render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
