@@ -140,3 +140,24 @@ define_flag("compile_cache_dir", "",
             "every cached program — telemetry.compile_report() records "
             "per-program trace/compile ms and hit/miss; empty disables "
             "both layers entirely")
+# paged KV cache (ISSUE 7, inference/serving.py + ops.paged_attention):
+# the serving tier's KV pool layout/precision.  Every entry of
+# generation._model_program_cache is fingerprinted with these three
+# flags, so toggling any of them mid-process can never replay a stale
+# compiled program built against the previous KV layout.
+define_flag("kv_cache_dtype", "auto",
+            "storage dtype of the serving paged KV pool: 'auto' (the "
+            "model compute dtype), 'bfloat16', 'float16', 'float32', "
+            "or 'int8' (per-page per-head scales stored alongside the "
+            "pool, dequant fused into the paged-attention kernel — "
+            "roughly halves KV HBM, doubling resident batch/context)")
+define_flag("kv_page_size", 16,
+            "rows (token positions) per KV page in the serving paged "
+            "pool; prefix sharing operates at page granularity, so "
+            "smaller pages share more of a common prompt at the cost "
+            "of a larger page table")
+define_flag("kv_pool_pages", 0,
+            "total pages in the serving KV pool (page 0 is a reserved "
+            "null page); 0 sizes the pool to dense-equivalent capacity "
+            "(every slot fully backed) — prefix sharing and int8 then "
+            "grow the EFFECTIVE resident batch inside that budget")

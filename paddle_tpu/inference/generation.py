@@ -51,6 +51,38 @@ def _sample(logits, key, temperature, top_p, top_k):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _kv_layout_fingerprint():
+    """The process-global KV-layout config a compiled program may have
+    baked in: (kv_cache_dtype, kv_page_size, kv_pool_pages).  Appended
+    to every _model_program_cache key so toggling FLAGS_kv_cache_dtype
+    or the pool geometry mid-process can never replay a stale program
+    built against the previous layout (a paged-pool program quantizing
+    into a pool that no longer exists would silently corrupt serving).
+    Deliberately blanket (the ISSUE 7 contract): programs that do not
+    bake the KV layout pay a spurious rebuild on a flag flip — rare,
+    and strictly safer than whitelisting which key tags are
+    layout-dependent and forgetting one later."""
+    from ..framework.flags import get_flag
+    return ("kvcfg", str(get_flag("kv_cache_dtype", "auto")),
+            int(get_flag("kv_page_size", 16)),
+            int(get_flag("kv_pool_pages", 0)))
+
+
+def _store_key(key):
+    """The key _model_program_cache actually stores under: the
+    caller's key plus the KV-layout fingerprint.  The SINGLE place the
+    composition lives — membership probes go through
+    _program_cache_contains, never hand-built keys."""
+    return (tuple(key) if isinstance(key, (tuple, list)) else (key,)) \
+        + (_kv_layout_fingerprint(),)
+
+
+def _program_cache_contains(model, key) -> bool:
+    """Would _model_program_cache(model, key, ...) hit, under the
+    CURRENT KV-layout flags?  (The serving batcher's first-use probe.)"""
+    return _store_key(key) in model.__dict__.get("_gen_compiled", {})
+
+
 def _model_program_cache(model, key, build, cap=16):
     """Compiled-program cache living ON the model object, so its
     lifetime (and the closed-over weights) ends with the model —
@@ -60,7 +92,10 @@ def _model_program_cache(model, key, build, cap=16):
     LRU (hits refresh recency): the batcher's step programs run every
     chunk, so generate() shape churn evicts cold generate entries
     rather than the serving hot path — FIFO would evict the
-    earliest-inserted (hottest) programs first."""
+    earliest-inserted (hottest) programs first.  Keys carry the
+    KV-layout fingerprint (see _kv_layout_fingerprint); callers keep
+    their key[0] tag — the fingerprint is appended, not prepended."""
+    key = _store_key(key)
     store = model.__dict__.setdefault("_gen_compiled", {})
     fn = store.pop(key, None)
     if fn is None:

@@ -125,6 +125,28 @@ def _init_weight(shape, std, dtype):
     return Normal(0.0, std)(tuple(shape), dtype)
 
 
+def _resolve_kv_dtype(cfg, kv_dtype=None):
+    """(jnp dtype, quantized?) for the paged KV pool: explicit arg
+    beats FLAGS_kv_cache_dtype beats the model compute dtype."""
+    from ..framework.flags import get_flag
+    name = kv_dtype if kv_dtype is not None \
+        else get_flag("kv_cache_dtype", "auto")
+    name = str(name)
+    if name in ("auto", "", "None"):
+        return cfg.compute_dtype, False
+    table = {"int8": (jnp.int8, True),
+             "bfloat16": (jnp.bfloat16, False),
+             "bf16": (jnp.bfloat16, False),
+             "float16": (jnp.float16, False),
+             "fp16": (jnp.float16, False),
+             "float32": (jnp.float32, False),
+             "fp32": (jnp.float32, False)}
+    if name not in table:
+        raise ValueError(f"unknown kv_cache_dtype {name!r}; one of "
+                         f"auto|{'|'.join(table)}")
+    return table[name]
+
+
 class LlamaRMSNorm(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
@@ -186,23 +208,30 @@ class LlamaAttention(nn.Layer):
         return run(_fn, x, self.q_proj, self.k_proj, self.v_proj,
                    self.o_proj, name="attention")
 
+    def _decode_qkv_rope(self, x, cos, sin):
+        """Shared decode-path projection + rope for BOTH KV layouts —
+        the dense and paged cached paths must stay numerically
+        identical here (they differ only in where K/V land)."""
+        cfg = self.config
+        cd = x.dtype
+        b, s, _ = x.shape
+        q = (x @ self.q_proj.value.astype(cd)).reshape(
+            b, s, cfg.num_attention_heads, cfg.head_dim)
+        k = (x @ self.k_proj.value.astype(cd)).reshape(
+            b, s, cfg.num_key_value_heads, cfg.head_dim)
+        v = (x @ self.v_proj.value.astype(cd)).reshape(
+            b, s, cfg.num_key_value_heads, cfg.head_dim)
+        q, k = tpu_ops.apply_rope(q, k, cos, sin)
+        return q, k, v
+
     def forward_cached(self, x, cos, sin, k_cache, v_cache, pos):
         """Decode-path attention: project the s_new tokens in x, write
         their K/V into the ring buffer at `pos`, attend against the
         whole cache (see ops.cached_attention).  Returns (out, k_cache,
         v_cache).  Raw jax values in and out — the generation loop is
         one jitted program, not a taped eager path."""
-        cfg = self.config
-        cd = x.dtype
         b, s, _ = x.shape
-        wq = self.q_proj.value.astype(cd)
-        wk = self.k_proj.value.astype(cd)
-        wv = self.v_proj.value.astype(cd)
-        wo = self.o_proj.value.astype(cd)
-        q = (x @ wq).reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
-        k = (x @ wk).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
-        v = (x @ wv).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
-        q, k = tpu_ops.apply_rope(q, k, cos, sin)
+        q, k, v = self._decode_qkv_rope(x, cos, sin)
         pos = jnp.asarray(pos, jnp.int32)
         z = jnp.zeros((), jnp.int32)
         if pos.ndim == 0:
@@ -220,7 +249,29 @@ class LlamaAttention(nn.Layer):
             v_cache = jax.vmap(upd)(v_cache, v.astype(v_cache.dtype),
                                     pos)
         out = tpu_ops.cached_attention(q, k_cache, v_cache, pos)
-        return out.reshape(b, s, -1) @ wo, k_cache, v_cache
+        out = out.reshape(b, s, -1) @ self.o_proj.value.astype(x.dtype)
+        return out, k_cache, v_cache
+
+    def forward_cached_paged(self, x, cos, sin, cache, page_table, pos,
+                             layer):
+        """Paged-KV decode attention (ISSUE 7): same projection + rope
+        as forward_cached, but K/V land in the shared page POOL via the
+        slot's page table (ops.paged_kv_update — int8 pools quantize
+        here) and attention gathers by page table
+        (ops.paged_attention: Pallas on TPU, take-gather twin
+        elsewhere).  Returns (out, cache)."""
+        b, s, _ = x.shape
+        q, k, v = self._decode_qkv_rope(x, cos, sin)
+        kp, vp, ks, vs = tpu_ops.paged_kv_update(
+            cache["k"], cache["v"], cache.get("k_scale"),
+            cache.get("v_scale"), page_table, pos, k, v, layer)
+        cache = dict(cache, k=kp, v=vp)
+        if ks is not None:
+            cache["k_scale"], cache["v_scale"] = ks, vs
+        out = tpu_ops.paged_attention(q, kp, vp, page_table, pos,
+                                      layer, ks, vs)
+        out = out.reshape(b, s, -1) @ self.o_proj.value.astype(x.dtype)
+        return out, cache
 
     # split entry points for the selective-recompute block structure
     # (forward above stays the single fused path)
@@ -380,14 +431,16 @@ class LlamaDecoderLayer(nn.Layer):
         x = x + self.mlp(h)
         return run(constrain_activation, x, name="constrain_resid")
 
-    def forward_cached(self, x, cos, sin, k_cache, v_cache, pos):
-        """Raw-jax decode block (see LlamaAttention.forward_cached)."""
+    def _block_cached(self, x, cos, sin, attend):
+        """Shared decode-block skeleton for both KV layouts: norm →
+        attend(h) → residual → norm → MLP → residual.  `attend(h)`
+        returns (attn_out, new_kv_state) — the ONLY point where the
+        dense ring buffer and the paged pool differ."""
         cfg = self.config
         ln1 = self.input_layernorm.weight.value
         ln2 = self.post_attention_layernorm.weight.value
         h = tpu_ops.rms_norm(x, ln1.astype(x.dtype), cfg.rms_norm_eps)
-        attn, k_cache, v_cache = self.self_attn.forward_cached(
-            h, cos, sin, k_cache, v_cache, pos)
+        attn, kv_state = attend(h)
         x = x + attn
         h = tpu_ops.rms_norm(x, ln2.astype(x.dtype), cfg.rms_norm_eps)
         if cfg.moe_num_experts > 0:
@@ -399,7 +452,25 @@ class LlamaDecoderLayer(nn.Layer):
             wu = self.mlp.up_proj.value.astype(x.dtype)
             wd = self.mlp.down_proj.value.astype(x.dtype)
             x = x + tpu_ops.swiglu(h @ wg, h @ wu) @ wd
+        return x, kv_state
+
+    def forward_cached(self, x, cos, sin, k_cache, v_cache, pos):
+        """Raw-jax decode block (see LlamaAttention.forward_cached)."""
+        def attend(h):
+            attn, kc, vc = self.self_attn.forward_cached(
+                h, cos, sin, k_cache, v_cache, pos)
+            return attn, (kc, vc)
+        x, (k_cache, v_cache) = self._block_cached(x, cos, sin, attend)
         return x, k_cache, v_cache
+
+    def forward_cached_paged(self, x, cos, sin, cache, page_table, pos,
+                             layer):
+        """Raw-jax paged decode block (see
+        LlamaAttention.forward_cached_paged)."""
+        def attend(h):
+            return self.self_attn.forward_cached_paged(
+                h, cos, sin, cache, page_table, pos, layer)
+        return self._block_cached(x, cos, sin, attend)
 
 
 class LlamaModel(nn.Layer):
@@ -439,6 +510,49 @@ class LlamaModel(nn.Layer):
         dt = cfg.compute_dtype
         return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
                 for _ in self.layers]
+
+    def init_paged_cache(self, num_pages: int, page_size: int,
+                         kv_dtype=None):
+        """Paged KV pool (ISSUE 7): ONE device-resident page pool per
+        K and V, [num_pages, page_size, layers, n_kv, head_dim], shared
+        by every serving slot through per-slot page tables.  Page 0 is
+        the reserved null page (unmapped table entries point there;
+        reads of its rows are position-masked).  kv_dtype: None reads
+        FLAGS_kv_cache_dtype ('auto' = compute dtype; 'int8' adds
+        per-page per-head fp32 scales alongside the pool)."""
+        cfg = self.config
+        dt, quant = _resolve_kv_dtype(cfg, kv_dtype)
+        shape = (num_pages, page_size, len(self.layers),
+                 cfg.num_key_value_heads, cfg.head_dim)
+        cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        if quant:
+            sshape = shape[:1] + shape[2:4]
+            # scale 1.0 on untouched pages: dequant of the zero pool
+            # stays zero, mirroring the dense zero-init cache
+            cache["k_scale"] = jnp.ones(sshape, jnp.float32)
+            cache["v_scale"] = jnp.ones(sshape, jnp.float32)
+        return cache
+
+    def forward_cached_paged(self, input_ids, cache, page_table, pos):
+        """Paged twin of forward_cached: input_ids [b, s_new]; cache:
+        init_paged_cache pytree; page_table [b, pages_per_slot] int32;
+        pos [b] int32 per-slot depths.  Returns (hidden, new_cache)."""
+        cfg = self.config
+        s = input_ids.shape[1]
+        positions = jnp.asarray(pos, jnp.int32)[..., None] \
+            + jnp.arange(s, dtype=jnp.int32)
+        cos, sin = tpu_ops.rope_cos_sin(s, cfg.head_dim, cfg.rope_theta,
+                                        jnp.float32,
+                                        position_ids=positions)
+        x = jnp.take(self.embed_tokens.value,
+                     input_ids.astype(jnp.int32),
+                     axis=0).astype(cfg.compute_dtype)
+        for li, layer in enumerate(self.layers):
+            x, cache = layer.forward_cached_paged(
+                x, cos, sin, cache, page_table, pos, li)
+        w = self.norm.weight.value
+        return tpu_ops.rms_norm(x, w.astype(x.dtype),
+                                cfg.rms_norm_eps), cache
 
     def forward_cached(self, input_ids, cache, pos):
         """input_ids: [b, s_new] jax array; cache: init_cache pytree;
@@ -493,6 +607,20 @@ class LlamaForCausalLM(nn.Layer):
 
     def init_cache(self, batch: int, max_len: int):
         return self.llama.init_cache(batch, max_len)
+
+    def init_paged_cache(self, num_pages: int, page_size: int,
+                         kv_dtype=None):
+        return self.llama.init_paged_cache(num_pages, page_size,
+                                           kv_dtype)
+
+    def forward_cached_paged(self, input_ids, cache, page_table, pos):
+        """Paged twin of forward_cached: returns (logits, new_cache)."""
+        x, cache = self.llama.forward_cached_paged(input_ids, cache,
+                                                   page_table, pos)
+        if self.config.tie_word_embeddings:
+            w = self.llama.embed_tokens.value
+            return x @ w.T.astype(x.dtype), cache
+        return x @ self.lm_head.value.astype(x.dtype), cache
 
     def forward_cached(self, input_ids, cache, pos):
         """Raw-jax cached step for the generation loop: returns

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 __all__ = ["attention", "cached_attention", "rms_norm", "layer_norm",
            "fused_add_rms_norm", "xla_fused_add_rms_norm",
            "rope", "apply_rope",
+           "paged_attention", "xla_paged_attention", "paged_kv_update",
            "swiglu", "get_attention_backend", "set_attention_backend",
            "gqa_scores", "gqa_weighted_v"]
 
@@ -143,6 +144,144 @@ def cached_attention(q, k_cache, v_cache, q_pos0, scale=None):
     w = jax.nn.softmax(logits, axis=-1)
     out = gqa_weighted_v(w.astype(v_cache.dtype), v_cache)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# paged KV (ISSUE 7): fixed-size page pool + per-slot page table
+# ---------------------------------------------------------------------------
+def _dequant_pages(pages, scales):
+    """pages [..., ps, n_kv, hd] int8 × per-page per-head scales
+    [..., n_kv] → fp32."""
+    return pages.astype(jnp.float32) * scales[..., None, :, None]
+
+
+def paged_kv_update(k_pool, v_pool, k_scale, v_scale, page_table, pos,
+                    k_new, v_new, layer):
+    """Write one step's K/V rows into the paged pool (the paged twin of
+    the dense path's per-slot dynamic_update_slice).
+
+    k_pool/v_pool: [P, ps, L, n_kv, hd] (int8 pools carry per-page
+    per-head scales [P, L, n_kv] fp32; None otherwise); page_table
+    [B, P_slot] int32 (entry 0 = reserved null page); pos [B] int32;
+    k_new/v_new [B, C, n_kv, hd] in the compute dtype; layer: python
+    int.  Returns (k_pool, v_pool, k_scale, v_scale).
+
+    Only the WINDOW of pages overlapping rows [pos, pos+C) is gathered,
+    row-updated (contiguous DUS — bit-identical rows to the dense
+    cache write) and scattered back; untouched window pages scatter
+    their ORIGINAL bytes, so shared/read-only pages are never
+    re-encoded (int8 requant drift stays confined to pages actually
+    being written).  int8 pages requantize against the page's new
+    running amax, so a page's scale is always consistent with every
+    row it holds."""
+    P, ps, L, n_kv, hd = k_pool.shape
+    B, C = k_new.shape[0], k_new.shape[1]
+    P_slot = page_table.shape[1]
+    n_t = -(-C // ps) + 1          # pages a C-row write can straddle
+    quant = k_pool.dtype == jnp.int8
+    pos = jnp.asarray(pos, jnp.int32)
+    p0 = jnp.clip(pos // ps, 0, max(P_slot - n_t, 0))
+    win = jnp.clip(p0[:, None] + jnp.arange(n_t, dtype=jnp.int32)[None],
+                   0, P_slot - 1)                            # [B, n_t]
+    ids = jnp.take_along_axis(page_table, win, axis=1)       # [B, n_t]
+    rel0 = pos - p0 * ps
+    start = win * ps                # window pages' first logical row
+    touched = (start < (pos + C)[:, None]) \
+        & ((start + ps) > pos[:, None])                      # [B, n_t]
+
+    def upd(pool, scales, rows):
+        layer_pool = pool[:, :, layer]                # [P, ps, n_kv, hd]
+        raw = jnp.take(layer_pool, ids, axis=0)       # [B, n_t, ps, ...]
+        if quant:
+            sc = jnp.take(scales[:, layer], ids, axis=0)  # [B, n_t, n_kv]
+            w = _dequant_pages(raw, sc).astype(rows.dtype)
+        else:
+            w = raw
+        w = w.reshape(B, n_t * ps, n_kv, hd)
+
+        def dus(buf, r, r0):
+            return jax.lax.dynamic_update_slice(
+                buf, r.astype(buf.dtype),
+                (r0, jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)))
+        w = jax.vmap(dus)(w, rows, rel0)
+        w = w.reshape(B, n_t, ps, n_kv, hd)
+        if quant:
+            amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=(2, 4))
+            sc_new = jnp.maximum(amax, 1e-8) / 127.0      # [B, n_t, n_kv]
+            q8 = jnp.clip(jnp.round(
+                w.astype(jnp.float32) / sc_new[:, :, None, :, None]),
+                -127, 127).astype(jnp.int8)
+            m = touched[:, :, None, None, None]
+            pages_out = jnp.where(m, q8, raw)
+            sc_out = jnp.where(touched[..., None], sc_new, sc)
+            sl = scales[:, layer].at[ids].set(sc_out)
+            scales = scales.at[:, layer].set(sl)
+        else:
+            m = touched[:, :, None, None, None]
+            pages_out = jnp.where(m, w.astype(pool.dtype), raw)
+        layer_pool = layer_pool.at[ids].set(pages_out)
+        return pool.at[:, :, layer].set(layer_pool), scales
+
+    k_pool, k_scale = upd(k_pool, k_scale, k_new)
+    v_pool, v_scale = upd(v_pool, v_scale, v_new)
+    return k_pool, v_pool, k_scale, v_scale
+
+
+def _check_paged_args(q, k_pool, k_scale, v_scale):
+    """Shared argument validation for both paged-attention paths —
+    raised HERE so a bad call fails identically on and off TPU (the
+    kernel's tiling ValueError is the only fallback trigger)."""
+    n_kv = k_pool.shape[3]
+    if q.shape[2] % n_kv:
+        raise ValueError(f"q heads {q.shape[2]} not a multiple of kv "
+                         f"heads {n_kv}")
+    if k_pool.dtype == jnp.int8 and (k_scale is None or v_scale is None):
+        raise ValueError("int8 KV pool needs k_scale/v_scale")
+
+
+def xla_paged_attention(q, k_pool, v_pool, page_table, pos, layer,
+                        k_scale=None, v_scale=None, scale=None):
+    """jnp twin of pallas.paged_attention: materialize each slot's
+    logical KV view with a `take`-based gather over the page table,
+    dequant (int8 pools), then EXACTLY the dense cached_attention math
+    — masked rows exp to 0.0 exactly, so the padded logical depth
+    (P_slot*ps vs the dense cache_len) cannot perturb the softmax and
+    the paged path stays bit-identical to the dense one off-TPU."""
+    _check_paged_args(q, k_pool, k_scale, v_scale)
+    B = q.shape[0]
+    P, ps, L, n_kv, hd = k_pool.shape
+    P_slot = page_table.shape[1]
+    quant = k_pool.dtype == jnp.int8
+
+    def gather(pool, scales):
+        lg = jnp.take(pool[:, :, layer], page_table, axis=0)
+        if quant:
+            sc = jnp.take(scales[:, layer], page_table, axis=0)
+            lg = _dequant_pages(lg, sc).astype(q.dtype)
+        return lg.reshape(B, P_slot * ps, n_kv, hd)
+
+    return cached_attention(q, gather(k_pool, k_scale),
+                            gather(v_pool, v_scale), pos, scale)
+
+
+def paged_attention(q, k_pool, v_pool, page_table, pos, layer,
+                    k_scale=None, v_scale=None, scale=None):
+    """Decode attention against the paged KV pool: Pallas kernel on TPU
+    (gather-by-page-table in the DMA index map, int8 dequant fused —
+    see ops/pallas/paged_attention.py), `take`-gather twin elsewhere.
+    Capability-gated like ops.attention: tiling-incompatible shapes
+    fall back to the twin (argument errors are validated FIRST, so the
+    fallback can never swallow them)."""
+    _check_paged_args(q, k_pool, k_scale, v_scale)
+    if _on_tpu():
+        from .pallas.paged_attention import paged_attention as _ppa
+        try:
+            return _ppa(q, k_pool, v_pool, page_table, pos, layer,
+                        k_scale, v_scale, scale)
+        except ValueError:
+            pass  # unsupported tiling → twin; real errors propagate
+    return xla_paged_attention(q, k_pool, v_pool, page_table, pos,
+                               layer, k_scale, v_scale, scale)
 
 
 def attention(q, k, v, mask=None, causal=False, scale=None, dropout_p=0.0):

@@ -337,3 +337,132 @@ class TestRope:
         cos = sin = jnp.zeros((16, 7), jnp.float32)
         with pytest.raises(ValueError):
             rope_apply(q, k, cos, sin)
+
+
+class TestPagedAttention:
+    """Paged-attention kernel (scalar-prefetch page gather) vs the
+    take-gather jnp twin (ops.xla_paged_attention)."""
+
+    def _pool(self, P=10, ps=8, L=2, n_kv=2, d=16, quant=False):
+        if quant:
+            kp = jnp.asarray(_rng.randint(-127, 128,
+                                          (P, ps, L, n_kv, d)), jnp.int8)
+            vp = jnp.asarray(_rng.randint(-127, 128,
+                                          (P, ps, L, n_kv, d)), jnp.int8)
+            ks = jnp.asarray(_rng.rand(P, L, n_kv) * 0.05 + 0.01,
+                             jnp.float32)
+            vs = jnp.asarray(_rng.rand(P, L, n_kv) * 0.05 + 0.01,
+                             jnp.float32)
+            return kp, vp, ks, vs
+        return r(P, ps, L, n_kv, d), r(P, ps, L, n_kv, d), None, None
+
+    @pytest.mark.parametrize("C,h", [(1, 4), (4, 8), (4, 2)])
+    def test_forward_vs_twin(self, C, h):
+        from paddle_tpu.ops.pallas.paged_attention import paged_attention
+        from paddle_tpu.ops import xla_paged_attention
+        B, P, ps, P_slot, L, n_kv, d = 3, 10, 8, 3, 2, 2, 16
+        kp, vp, _, _ = self._pool(P, ps, L, n_kv, d)
+        q = r(B, C, h, d)
+        pt = jnp.asarray(_rng.permutation(P - 1)[:B * P_slot]
+                         .reshape(B, P_slot) + 1, jnp.int32)
+        pos = jnp.asarray([0, 5, 13], jnp.int32)
+        for li in range(L):
+            out = paged_attention(q, kp, vp, pt, pos, li,
+                                  interpret=True)
+            ref = xla_paged_attention(q, kp, vp, pt, pos, li)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-5, rtol=2e-5)
+
+    def test_int8_dequant_fused(self):
+        from paddle_tpu.ops.pallas.paged_attention import paged_attention
+        from paddle_tpu.ops import xla_paged_attention
+        B, P, ps, P_slot, L, n_kv, d = 2, 8, 8, 3, 2, 2, 16
+        kp, vp, ks, vs = self._pool(P, ps, L, n_kv, d, quant=True)
+        q = r(B, 4, 4, d)
+        pt = jnp.asarray(_rng.permutation(P - 1)[:B * P_slot]
+                         .reshape(B, P_slot) + 1, jnp.int32)
+        pos = jnp.asarray([3, 11], jnp.int32)
+        out = paged_attention(q, kp, vp, pt, pos, 1, ks, vs,
+                              interpret=True)
+        ref = xla_paged_attention(q, kp, vp, pt, pos, 1, ks, vs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_int8_needs_scales(self):
+        from paddle_tpu.ops.pallas.paged_attention import paged_attention
+        kp, vp, _, _ = self._pool(quant=True)
+        q = r(2, 1, 4, 16)
+        pt = jnp.zeros((2, 3), jnp.int32)
+        pos = jnp.zeros((2,), jnp.int32)
+        with pytest.raises(ValueError, match="scale"):
+            paged_attention(q, kp, vp, pt, pos, 0, interpret=True)
+
+    def test_gqa_heads_must_divide(self):
+        from paddle_tpu.ops.pallas.paged_attention import paged_attention
+        kp, vp, _, _ = self._pool(n_kv=2)
+        q = r(2, 1, 3, 16)      # 3 heads over 2 kv heads
+        pt = jnp.zeros((2, 3), jnp.int32)
+        pos = jnp.zeros((2,), jnp.int32)
+        with pytest.raises(ValueError, match="multiple"):
+            paged_attention(q, kp, vp, pt, pos, 0, interpret=True)
+
+
+class TestPagedKVUpdate:
+    """Windowed page write (ops.paged_kv_update): row-exact vs a dense
+    reference, untouched pages byte-identical, int8 requant coherent."""
+
+    def test_rows_land_exactly(self):
+        from paddle_tpu.ops import paged_kv_update
+        B, C, P, ps, P_slot, L, n_kv, d = 2, 3, 12, 4, 5, 2, 2, 8
+        kp = jnp.zeros((P, ps, L, n_kv, d), jnp.float32)
+        vp = jnp.zeros((P, ps, L, n_kv, d), jnp.float32)
+        pt = jnp.asarray(_rng.permutation(P - 1)[:B * P_slot]
+                         .reshape(B, P_slot) + 1, jnp.int32)
+        pos = jnp.asarray([2, 6], jnp.int32)
+        kn, vn = r(B, C, n_kv, d), r(B, C, n_kv, d)
+        kp2, vp2, _, _ = paged_kv_update(kp, vp, None, None, pt, pos,
+                                         kn, vn, layer=1)
+        # logical view must hold exactly the written rows
+        lg = np.asarray(jnp.take(kp2[:, :, 1], pt, axis=0)
+                        .reshape(B, P_slot * ps, n_kv, d))
+        for b in range(B):
+            p0 = int(pos[b])
+            np.testing.assert_array_equal(lg[b, p0:p0 + C],
+                                          np.asarray(kn[b]))
+        # layer 0 untouched
+        assert not np.asarray(kp2[:, :, 0]).any()
+
+    def test_untouched_pages_keep_bytes(self):
+        from paddle_tpu.ops import paged_kv_update
+        B, C, P, ps, P_slot, L, n_kv, d = 1, 2, 8, 4, 4, 1, 2, 8
+        kp = r(P, ps, L, n_kv, d)
+        vp = r(P, ps, L, n_kv, d)
+        pt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        pos = jnp.asarray([5], jnp.int32)     # rows 5,6 → page 1 only
+        kn, vn = r(B, C, n_kv, d), r(B, C, n_kv, d)
+        kp2, _, _, _ = paged_kv_update(kp, vp, None, None, pt, pos,
+                                       kn, vn, layer=0)
+        # pages 3,4 (and every unmapped page) bit-identical
+        for page in (3, 4, 5, 6, 7):
+            np.testing.assert_array_equal(np.asarray(kp2[page]),
+                                          np.asarray(kp[page]))
+
+    def test_int8_requant_roundtrip(self):
+        from paddle_tpu.ops import paged_kv_update, xla_paged_attention
+        B, C, P, ps, P_slot, L, n_kv, d = 1, 4, 8, 4, 4, 1, 2, 8
+        kp = jnp.zeros((P, ps, L, n_kv, d), jnp.int8)
+        vp = jnp.zeros((P, ps, L, n_kv, d), jnp.int8)
+        ks = jnp.ones((P, L, n_kv), jnp.float32)
+        vs = jnp.ones((P, L, n_kv), jnp.float32)
+        pt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        kn, vn = r(B, C, n_kv, d), r(B, C, n_kv, d)
+        kp, vp, ks, vs = paged_kv_update(kp, vp, ks, vs, pt,
+                                         jnp.asarray([0], jnp.int32),
+                                         kn, vn, layer=0)
+        lg = np.asarray(jnp.take(kp[:, :, 0], pt, axis=0)
+                        .astype(np.float32)
+                        * np.asarray(jnp.take(ks[:, 0], pt, axis=0)
+                                     )[:, :, None, :, None]) \
+            .reshape(B, P_slot * ps, n_kv, d)
+        np.testing.assert_allclose(lg[0, :C], np.asarray(kn[0]),
+                                   atol=0.03, rtol=0.05)

@@ -126,6 +126,20 @@ def analyze(events, peak=None):
             "recompiles": sum(1 for e in events
                               if e.get("event") == "serve.recompile"),
         }
+        # paged-KV pool trajectory (serve.kv rides every chunk): last
+        # snapshot carries the lifetime counters, peak shows pressure
+        kv = [e for e in events if e.get("event") == "serve.kv"]
+        if kv:
+            last = kv[-1]
+            out["serve"]["kv"] = {
+                "pages": last.get("pages", 0),
+                "pages_used_peak": max(e.get("pages_used", 0)
+                                       for e in kv),
+                "pages_cached": last.get("pages_cached", 0),
+                "prefix_hit_tokens": last.get("prefix_hit_tokens", 0),
+                "evictions": last.get("evictions", 0),
+                "kv_bytes": last.get("kv_bytes", 0),
+            }
 
     io_steps = [e for e in events if e.get("event") == "io.step"]
     if io_steps:
@@ -173,6 +187,14 @@ def render(rep):
                      f"{s['chunk_ms_p50']}ms, prefill/decode "
                      f"{s['prefill_tokens']}/{s['decode_tokens']}, "
                      f"{s['recompiles']} recompiles")
+        if "kv" in s:
+            k = s["kv"]
+            lines.append(
+                f"  kv pool   {k['pages_used_peak']}/{k['pages']} "
+                f"pages peak ({k['pages_cached']} cached), "
+                f"prefix hits {k['prefix_hit_tokens']} tok, "
+                f"{k['evictions']} evictions, "
+                f"{k['kv_bytes'] / 1e6:.1f}MB")
     if "io" in rep:
         i = rep["io"]
         lines.append(f"io          {i['steps']} gets, host wait p50 "
