@@ -1018,7 +1018,72 @@ def _assert_telemetry_zero_overhead():
         static.disable_static()
 
 
+def _assert_serve_robustness_zero_overhead():
+    """The serve-plane robustness layer (ISSUE 9: SLO admission,
+    deadlines, load shedding, fault recovery) is HOST-plane control
+    flow only: with the flags off NOTHING about the compiled serve
+    step may change, and with the flags ON the programs must be the
+    very same ones — program-cache keys AND lowered step HLO
+    byte-identical across the flag toggle, exactly 2 compiled programs
+    under a mixed-SLO multi-length workload (prompt length and SLO mix
+    never reach a program shape).  Cheap (1-layer tiny llama); runs
+    before every bench config."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.inference import ContinuousBatcher
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+
+    paddle.seed(3)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=32,
+                            intermediate_size=64,
+                            num_attention_heads=2,
+                            num_key_value_heads=2, vocab_size=64)
+    model = LlamaForCausalLM(cfg)
+    geom = dict(max_batch_size=2, max_len=32, chunk=4, prefill_chunk=4)
+
+    def fingerprint():
+        bat = ContinuousBatcher(model, **geom)
+        keys = (bat._program_key(1, bat.chunk),
+                bat._program_key(bat.prefill_chunk, bat.admit_steps))
+        hlo = (bat.lower_step(mixed=False).as_text(),
+               bat.lower_step(mixed=True).as_text())
+        return bat, keys, hlo
+
+    _, keys_off, hlo_off = fingerprint()
+    set_flags({"FLAGS_serve_queue_depth": 8,
+               "FLAGS_serve_default_deadline_ms": 60000.0})
+    try:
+        bat_on, keys_on, hlo_on = fingerprint()
+        rng = np.random.RandomState(0)
+        for L, slo in ((3, "interactive"), (7, "batch"),
+                       (5, "best_effort"), (9, "interactive"),
+                       (11, "batch")):
+            bat_on.submit(rng.randint(1, 64, L).astype(np.int32), 4,
+                          slo=slo)
+        outs = bat_on.run()
+        st = bat_on.stats()
+    finally:
+        set_flags({"FLAGS_serve_queue_depth": 0,
+                   "FLAGS_serve_default_deadline_ms": 0.0})
+    assert keys_off == keys_on, \
+        f"robustness flags leaked into serve program keys: " \
+        f"{keys_off} vs {keys_on}"
+    assert hlo_off == hlo_on, \
+        "robustness flags changed the lowered serve-step HLO"
+    assert st["compiled_programs"] == 2, \
+        f"mixed-SLO multi-length workload compiled " \
+        f"{st['compiled_programs']} programs (want 2)"
+    assert st["requests_shed"] == 0 \
+        and st["requests_completed"] == len(outs), st
+    _, _, hlo_off2 = fingerprint()
+    assert hlo_off == hlo_off2, \
+        "serve-step HLO changed after the flag round-trip"
+
+
 def main():
+    _assert_serve_robustness_zero_overhead()
     _assert_analysis_zero_overhead()
     _assert_fault_tolerance_zero_overhead()
     _assert_mfu_fusion_zero_overhead()

@@ -54,6 +54,26 @@ Registered injection points (each exercised by `chaos_check --selftest`):
     launch.heartbeat  one heartbeat stamp         (launch/controller)
     step.begin        train-step entry            (parallel trainers, hapi)
     step.data         the batch fed to a step     (parallel trainers)
+
+Serve-plane points (ISSUE 9, inference/serving.py; exercised by
+`chaos_check --serve --selftest`) — keys carry the request/slot the
+hit belongs to (``req<id>:<slo>`` / ``slot<i>:req<id>``) so `match=`
+can target one request:
+
+    serve.admit       taking a queued request into a slot (error =
+                      transient admission fault, retried FIFO-in-place;
+                      skip = admission rejected, request shed)
+    serve.kv_alloc    the KV page-pool allocation for one admission
+                      (error = transient allocator fault -> FIFO defer;
+                      skip = simulated pool exhaustion -> defer)
+    serve.chunk       one compiled chunk dispatch (error fires BEFORE
+                      the donated carries are touched -> the chunk
+                      retries at the next boundary; delay = hung chunk,
+                      detected by the serve watchdog)
+    serve.decode      per live slot after a chunk (error/corrupt/nan =
+                      that slot's decode is poisoned -> pages evicted,
+                      request requeued or shed, rest of batch keeps
+                      decoding)
 """
 from __future__ import annotations
 
@@ -72,7 +92,9 @@ __all__ = ["Fault", "FaultError", "FaultSpecError", "hit", "is_active",
 # the documented injection points; hit() accepts only these so a typo'd
 # spec or call site fails loudly instead of never firing
 POINTS = ("ckpt.write", "ckpt.manifest", "ckpt.latest", "kv.request",
-          "launch.heartbeat", "step.begin", "step.data")
+          "launch.heartbeat", "step.begin", "step.data",
+          "serve.admit", "serve.kv_alloc", "serve.chunk",
+          "serve.decode")
 
 MODES = ("error", "truncate", "corrupt", "nan", "skip", "kill", "delay")
 

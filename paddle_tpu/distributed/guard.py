@@ -37,7 +37,8 @@ from ..framework.flags import get_flag  # the two guard flags live in
 # FLAGS_max_consecutive_bad_steps
 
 __all__ = ["StepAnomalyGuard", "BadStepBudgetExceeded",
-           "install_sigterm_drain", "drain_requested", "clear_drain"]
+           "install_sigterm_drain", "drain_requested", "request_drain",
+           "clear_drain"]
 
 
 class BadStepBudgetExceeded(RuntimeError):
@@ -140,6 +141,13 @@ def drain_requested() -> bool:
     """True once SIGTERM arrived — finish the in-flight step, write an
     emergency checkpoint, exit ELASTIC_EXIT_CODE."""
     return _drain.is_set()
+
+
+def request_drain():
+    """Set the drain flag directly (what the SIGTERM handler does) —
+    for tests and tooling that must trigger the drain protocol
+    deterministically without delivering a real signal."""
+    _drain.set()
 
 
 def clear_drain():

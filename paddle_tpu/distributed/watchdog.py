@@ -200,14 +200,24 @@ class watched:
     Exception-safe: a body that raises mid-flight still deregisters its
     task (no ghost tasks aging toward a spurious report/abort), and a
     `watched` instance is reentrant — nested/reused entries keep a
-    stack of tasks instead of clobbering the outer one."""
+    stack of tasks instead of clobbering the outer one.
+
+    `last_reported` records whether the most recently EXITED body aged
+    past its deadline while in flight (the monitor reported it) — the
+    hook a caller that survives a hang uses to classify the result as
+    suspect (the serving batcher counts these as hung chunks)."""
 
     def __init__(self, name: str, timeout: Optional[float] = None):
         self.name = name
         self.timeout = timeout
         self._stack = []
+        self.last_reported = False
 
     def __enter__(self):
+        # a fresh entry is not (yet) hung — without the reset, one
+        # reported hang would leak True into every later entry made
+        # after the watchdog is disabled (start_task -> None)
+        self.last_reported = False
         self._stack.append(
             get_comm_task_manager().start_task(self.name, self.timeout))
         return self
@@ -216,4 +226,5 @@ class watched:
         task = self._stack.pop() if self._stack else None
         if task is not None:
             task.done()
+            self.last_reported = task.reported
         return False

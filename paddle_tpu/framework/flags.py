@@ -161,3 +161,24 @@ define_flag("kv_pool_pages", 0,
             "null page); 0 sizes the pool to dense-equivalent capacity "
             "(every slot fully backed) — prefix sharing and int8 then "
             "grow the EFFECTIVE resident batch inside that budget")
+# serve-plane robustness (ISSUE 9, inference/serving.py): SLO-aware
+# admission, deadlines and load shedding.  All HOST-plane control flow:
+# with the flags at their defaults the scheduler path leaves the
+# compiled serve-step programs and their cache keys byte-identical
+# (bench-asserted), and toggling them never recompiles.
+define_flag("serve_queue_depth", 0,
+            "bound on the serving admission queue (all SLO classes "
+            "combined); a submit() past the bound load-sheds the "
+            "lowest-SLO newest-arrival queued request (best_effort "
+            "first, never an in-flight decode).  0 = unbounded")
+define_flag("serve_default_deadline_ms", 0.0,
+            "default arrival deadline for serving requests that don't "
+            "pass deadline_ms: a request still QUEUED when its "
+            "deadline passes is shed (serve.deadline_miss).  In-flight "
+            "requests are never deadline-shed.  0 disables")
+define_flag("serve_retry_budget", 3,
+            "per-request bound on serve-plane fault recoveries "
+            "(injected/real admission faults retried FIFO-in-place, "
+            "faulted-slot requeues): past the budget the request is "
+            "shed instead of retried — a poisoned request cannot spin "
+            "the batch forever")

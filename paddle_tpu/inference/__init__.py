@@ -29,9 +29,9 @@ import jax.numpy as jnp
 
 __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "PrecisionType", "PlaceType", "get_version",
-           "ContinuousBatcher", "Request"]
+           "ContinuousBatcher", "Request", "SLO_CLASSES"]
 
-from .serving import ContinuousBatcher, Request  # noqa: E402
+from .serving import ContinuousBatcher, Request, SLO_CLASSES  # noqa: E402
 
 
 def get_version() -> str:

@@ -95,6 +95,7 @@ class PageAllocator:
         self._clock = 0
         self.evictions = 0
         self.prefix_hit_tokens = 0
+        self.cow_copies = 0
 
     # -- introspection -----------------------------------------------------
     @property
@@ -311,6 +312,8 @@ class PageAllocator:
             nodes.append(node)
             parent = node
         cow = (cow_src.page, priv[0]) if cow_src is not None else None
+        if cow is not None:
+            self.cow_copies += 1
         return AdmitPlan(pages, shared_tokens, cow, nodes, n_shared)
 
     def release_plan(self, plan: AdmitPlan):

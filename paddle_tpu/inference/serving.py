@@ -52,11 +52,49 @@ occupancy, the prefill-vs-decode token split, per-chunk wall times and
 the KV-pool counters (pages used/free, prefix-hit tokens, evictions,
 pool bytes) that feed `serve.kv` telemetry and the serve bench.
 
+The r9 training plane got fault tolerance (atomic checkpoints, fault
+injection, SIGTERM drain); this file carries the SERVE-plane half of
+that contract (ISSUE 9) — all host-plane control flow, so the compiled
+step programs and their cache keys stay byte-identical with the
+robustness flags off (bench-asserted):
+
+  * SLO classes: every request is `interactive` / `batch` /
+    `best_effort` with an optional arrival DEADLINE.  Admission is a
+    priority queue — classes in priority order, strict FIFO by arrival
+    within a class, and a class head deferred by KV-pool pressure
+    blocks its own and lower classes (no head-of-line bypass, so a
+    stream of short prompts can never starve a deferred long one);
+  * load shedding: a bounded queue (`FLAGS_serve_queue_depth`) sheds
+    the lowest-SLO newest-arrival QUEUED request on overflow
+    (best_effort first), and a request still queued past its deadline
+    is shed as a deadline miss — an in-flight decode is NEVER shed;
+  * fault injection (`distributed/fault.py` points `serve.admit`,
+    `serve.kv_alloc`, `serve.chunk`, `serve.decode`) + recovery: a
+    faulted admission retries FIFO-in-place (bounded by
+    `FLAGS_serve_retry_budget`), a faulted chunk fires BEFORE the
+    donated carries are touched and simply retries, and a poisoned
+    SLOT is evicted — pages released, request requeued at its arrival
+    position for a from-scratch re-decode (greedy decode is
+    deterministic, so the re-decode is bit-exact vs a fault-free run;
+    `tools/chaos_check.py --serve` pins this) — while the rest of the
+    batch keeps decoding;
+  * a serve watchdog riding `distributed/watchdog.py`: every chunk
+    dispatch runs under `watched("serve.chunk")`
+    (FLAGS_stop_check_timeout), and a chunk that aged past the
+    deadline while in flight is counted/published as hung;
+  * SIGTERM drain mirroring the r9 training contract: once
+    `guard.drain_requested()` is set, admissions stop (queued requests
+    shed with reason "drain"), in-flight decodes finish within
+    PADDLE_DRAIN_GRACE, and on grace expiry partial results are
+    flushed — the caller exits ELASTIC_EXIT_CODE
+    (`chaos_check --serve --selftest` runs the e2e).
+
 Greedy decoding (temperature 0) — the deterministic serving mode whose
 per-sequence outputs are testable against isolated `generate()` runs.
 """
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -66,9 +104,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..framework.flags import get_flag
 from ..framework.tensor import Tensor
 
-__all__ = ["ContinuousBatcher", "Request"]
+__all__ = ["ContinuousBatcher", "Request", "SLO_CLASSES"]
+
+# admission priority order, highest first; shedding walks it in reverse
+SLO_CLASSES = ("interactive", "batch", "best_effort")
 
 
 @dataclass
@@ -78,6 +120,15 @@ class Request:
     max_new_tokens: int
     tokens: List[int] = field(default_factory=list)
     finished: bool = False
+    # -- SLO / robustness state (ISSUE 9) --
+    slo: str = "batch"
+    deadline: Optional[float] = None   # absolute monotonic seconds
+    arrival: int = 0                   # global arrival sequence number
+    shed: bool = False
+    shed_reason: Optional[str] = None
+    requeues: int = 0                  # faulted-slot re-admissions
+    admit_faults: int = 0              # injected admission-fault retries
+    partial: bool = False              # drain-flushed mid-generation
 
     def output(self) -> np.ndarray:
         return np.asarray(self.tokens[: self.max_new_tokens], np.int32)
@@ -137,10 +188,34 @@ class ContinuousBatcher:
                                else self.chunk // 4)
         self.eos = eos_token_id
         self.kv_layout = kv_layout
-        self._queue: deque = deque()
+        # one FIFO per SLO class (admission walks SLO_CLASSES in
+        # priority order; within a class strictly by arrival)
+        self._queues: Dict[str, deque] = {c: deque()
+                                          for c in SLO_CLASSES}
         self._slots: List[Optional[Request]] = [None] * self.B
         self._finished: Dict[int, Request] = {}
         self._next_id = 0
+        self._arrival_seq = 0
+        self._now = time.monotonic     # patchable time source (tests)
+        self._has_deadlines = False    # sweep is skipped until a
+        #                                deadline ever enters the queue
+        self._draining = False
+        self._drain_deadline = None
+        # serve-robustness accounting (the chaos no-leak contract:
+        # submitted == completed + shed once queue and slots drain)
+        self._submitted = 0
+        self._admissions = 0           # admission EVENTS (requeues
+        #                                re-admit, so >= completed)
+        self._completed = 0
+        self._shed_count = 0
+        self._shed_by_class = {c: 0 for c in SLO_CLASSES}
+        self._deadline_misses = 0
+        self._requeue_count = 0
+        self._chunk_retries = 0
+        self._consecutive_chunk_faults = 0
+        self._hung_chunks = 0
+        from ..distributed.watchdog import watched
+        self._watch = watched("serve.chunk")
 
         sd = model.state_dict()
         self._names = list(sd.keys())
@@ -243,9 +318,22 @@ class ContinuousBatcher:
         return pool + scales + table
 
     # -- public API --------------------------------------------------------
-    def submit(self, input_ids, max_new_tokens: int = 32) -> int:
+    def submit(self, input_ids, max_new_tokens: int = 32,
+               slo: str = "batch",
+               deadline_ms: Optional[float] = None) -> int:
         """Queue one request; returns its id.  Admission happens at the
-        next chunk boundary."""
+        next chunk boundary, in SLO-class priority order (FIFO by
+        arrival within a class).
+
+        slo: "interactive" | "batch" | "best_effort".
+        deadline_ms: latest time (from now) by which the request must
+        be ADMITTED; still queued past it = shed as a deadline miss
+        (None reads FLAGS_serve_default_deadline_ms; 0/unset = none).
+
+        Every submitted id appears exactly once in run()'s results —
+        a request shed by the bounded queue / a deadline / the drain
+        protocol comes back with `shed=True` and an empty (or partial)
+        output, never silently dropped (the chaos no-leak contract)."""
         ids = np.asarray(input_ids.value if isinstance(input_ids, Tensor)
                          else input_ids, np.int32).reshape(-1)
         if len(ids) == 0:
@@ -255,18 +343,80 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt ({len(ids)}) + {max_new_tokens} new tokens "
                 f"exceeds the slot depth max_len={self.max_len}")
+        if slo not in SLO_CLASSES:
+            raise ValueError(f"unknown SLO class {slo!r}; known: "
+                             f"{SLO_CLASSES}")
         rid = self._next_id
         self._next_id += 1
-        self._queue.append(Request(rid, ids, int(max_new_tokens)))
+        req = Request(rid, ids, int(max_new_tokens), slo=slo,
+                      arrival=self._arrival_seq)
+        self._arrival_seq += 1
+        if deadline_ms is None:
+            deadline_ms = float(get_flag("serve_default_deadline_ms")
+                                or 0.0)
+        if deadline_ms <= 0:
+            deadline_ms = None          # 0/unset = no deadline, same
+            #                             convention as the flag
+        if deadline_ms is not None:
+            req.deadline = self._now() + float(deadline_ms) / 1e3
+            self._has_deadlines = True
+        self._submitted += 1
+        if self._draining:
+            # admissions are closed: the request is accounted, shed
+            self._shed(req, "drain")
+            return rid
+        depth = int(get_flag("serve_queue_depth") or 0)
+        if depth > 0 and self._queued_count() >= depth:
+            victim = self._shed_victim(req)
+            if victim is req:
+                self._shed(req, "queue_full")
+                return rid
+            self._queues[victim.slo].remove(victim)
+            self._shed(victim, "queue_full")
+        self._queues[slo].append(req)
         return rid
 
+    def _queued_count(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    @property
+    def queued(self) -> int:
+        """Requests waiting for a slot (all SLO classes)."""
+        return self._queued_count()
+
+    def _shed_victim(self, incoming: Request) -> Request:
+        """Queue-overflow victim: lowest SLO class first, newest
+        arrival within it — the incoming request itself when nothing
+        queued ranks below it.  Only QUEUED requests are candidates;
+        in-flight slots are untouchable."""
+        order = {c: i for i, c in enumerate(SLO_CLASSES)}
+
+        def rank(r):
+            return (order[r.slo], r.arrival)
+        victim = incoming
+        for q in self._queues.values():
+            for r in q:
+                if rank(r) > rank(victim):
+                    victim = r
+        return victim
+
     def step(self) -> List[Request]:
-        """One scheduling round: evict finished slots, admit queued
-        requests into free slots, run one scan chunk (admission-mode
-        while any slot is still consuming its prompt, pure decode
-        otherwise).  Returns requests finished this round."""
+        """One scheduling round: evict finished slots, shed queued
+        requests past their deadline, admit queued requests into free
+        slots (SLO priority, FIFO within class), run one scan chunk
+        (admission-mode while any slot is still consuming its prompt,
+        pure decode otherwise).  Returns requests finished this round.
+
+        Once `guard.drain_requested()` is set (SIGTERM), admissions
+        close: queued requests are shed with reason "drain" and only
+        the in-flight slots keep decoding."""
+        from ..distributed import guard
+        if not self._draining and guard.drain_requested():
+            self._begin_drain()
         newly = self._evict()
-        self._admit()
+        if not self._draining:
+            self._shed_deadline_missed()
+            self._admit()
         if any(r is not None for r in self._slots):
             self._run_chunk(mixed=bool(self._mode_host.any()))
             # pre-chunk evictions cleared their slots, so the two
@@ -275,10 +425,165 @@ class ContinuousBatcher:
         return newly
 
     def run(self) -> Dict[int, np.ndarray]:
-        """Drive until queue and slots drain; returns {req_id: tokens}."""
-        while self._queue or any(r is not None for r in self._slots):
+        """Drive until queue and slots drain; returns {req_id: tokens}
+        for EVERY submitted request (shed ones included — empty or
+        partial outputs, `Request.shed` set).
+
+        Drain contract (mirrors the r9 training drain): when SIGTERM
+        sets the drain flag, admissions stop, in-flight decodes finish
+        within PADDLE_DRAIN_GRACE seconds, and on grace expiry the
+        still-running slots are flushed as PARTIAL results — run()
+        then returns normally so the caller can deliver what exists
+        and exit ELASTIC_EXIT_CODE."""
+        while self._queued_count() or any(r is not None
+                                          for r in self._slots):
+            if self._draining and self._drain_deadline is not None \
+                    and self._now() > self._drain_deadline:
+                self._flush_partial()
+                break
             self.step()
         return {rid: r.output() for rid, r in self._finished.items()}
+
+    @property
+    def drained(self) -> bool:
+        """True once the SIGTERM drain protocol engaged — the caller's
+        cue to exit ELASTIC_EXIT_CODE after delivering run()'s
+        results."""
+        return self._draining
+
+    # -- robustness plumbing (ISSUE 9) -------------------------------------
+    def _shed(self, req: Request, reason: str):
+        """Terminal no-service state: the request is accounted in
+        `_finished` (so run() returns it and nothing leaks) but marked
+        shed.  Callers remove it from queue/slot structures FIRST; an
+        in-flight decode is never shed."""
+        req.finished = True
+        req.shed = True
+        req.shed_reason = reason
+        self._finished[req.req_id] = req
+        self._shed_count += 1
+        self._shed_by_class[req.slo] += 1
+        from .. import telemetry as _tel
+        _tel.counter("serve.shed").inc()         # sink or not
+        if _tel.active():
+            _tel.emit("serve.shed", req=req.req_id, slo=req.slo,
+                      reason=reason, requeues=req.requeues,
+                      tokens=len(req.tokens))
+
+    def _shed_deadline_missed(self):
+        """Shed every QUEUED request whose admission deadline passed
+        (`serve.deadline_miss`).  Skipped entirely until a deadline
+        ever enters the queue — the flags-off path stays one bool."""
+        if not self._has_deadlines:
+            return
+        now = self._now()
+        from .. import telemetry as _tel
+        for cls in SLO_CLASSES:
+            q = self._queues[cls]
+            survivors = deque()
+            while q:
+                req = q.popleft()
+                if req.deadline is not None and now > req.deadline:
+                    self._deadline_misses += 1
+                    _tel.counter("serve.deadline_miss").inc()
+                    if _tel.active():
+                        _tel.emit("serve.deadline_miss",
+                                  req=req.req_id, slo=req.slo,
+                                  late_ms=round(
+                                      (now - req.deadline) * 1e3, 3))
+                    self._shed(req, "deadline")
+                else:
+                    survivors.append(req)
+            self._queues[cls] = survivors
+
+    def _requeue(self, req: Request):
+        """Put a faulted-slot request back into its class queue AT ITS
+        ARRIVAL POSITION (strict FIFO by arrival survives requeues)."""
+        q = self._queues[req.slo]
+        idx = 0
+        while idx < len(q) and q[idx].arrival < req.arrival:
+            idx += 1
+        q.insert(idx, req)
+        self._requeue_count += 1
+        from .. import telemetry as _tel
+        _tel.counter("serve.requeue").inc()
+        if _tel.active():
+            _tel.emit("serve.requeue", req=req.req_id, slo=req.slo,
+                      requeues=req.requeues)
+
+    def _clear_slot(self, i: int):
+        """Free slot i's device-side state: done/mode flags, and for
+        the paged layout the slot's page mapping (prompt pages stay
+        resident as cached prefix pages; the freed slot's junk lanes
+        write the null page)."""
+        self._slots[i] = None
+        self._done = self._done.at[i].set(True)
+        self._mode = self._mode.at[i].set(False)
+        self._mode_host[i] = False
+        self._done_host[i] = True
+        if self.kv_layout == "paged" and self._plans[i] is not None:
+            self._alloc.release_plan(self._plans[i])
+            self._plans[i] = None
+            self._page_table = self._page_table.at[i].set(
+                jnp.zeros((self.pages_per_slot,), jnp.int32))
+
+    def _fault_slot(self, i: int, reason: str = "decode_fault"):
+        """Slot i's decode came back poisoned: evict the slot (pages
+        released, pending trie nodes dropped — nothing the faulted
+        chunk wrote is ever shareable), discard every token the
+        request produced (satellite: the re-decode re-emits them, so
+        keeping them would double-count `tokens_produced`), and
+        requeue the request at its arrival position for a from-scratch
+        re-decode — or shed it when its deadline passed or its retry
+        budget (FLAGS_serve_retry_budget) is spent.  The rest of the
+        batch keeps decoding untouched."""
+        req = self._slots[i]
+        self._clear_slot(i)
+        req.tokens.clear()
+        req.requeues += 1
+        budget = int(get_flag("serve_retry_budget") or 3)
+        if (req.deadline is not None and self._now() > req.deadline) \
+                or req.requeues > budget or self._draining:
+            self._shed(req, reason)
+        else:
+            self._requeue(req)
+
+    def _begin_drain(self):
+        """SIGTERM arrived: close admissions (queued requests shed with
+        reason "drain"), start the PADDLE_DRAIN_GRACE window for the
+        in-flight decodes."""
+        self._draining = True
+        grace = float(os.environ.get("PADDLE_DRAIN_GRACE", "60"))
+        self._drain_deadline = self._now() + grace
+        n_shed = 0
+        for q in self._queues.values():
+            while q:
+                self._shed(q.popleft(), "drain")
+                n_shed += 1
+        from .. import telemetry as _tel
+        _tel.counter("serve.drains").inc()
+        if _tel.active():
+            _tel.emit("serve.drain", phase="begin", shed=n_shed,
+                      in_flight=self.active, grace_s=grace)
+
+    def _flush_partial(self):
+        """Grace expired: flush every still-running slot as a PARTIAL
+        result (tokens so far, `Request.partial` set) — delivered, not
+        shed; the chunk that was in flight completed at the last
+        boundary, so the tokens are real."""
+        flushed = 0
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            self._clear_slot(i)
+            req.finished = True
+            req.partial = True
+            self._finished[req.req_id] = req
+            self._completed += 1
+            flushed += 1
+        from .. import telemetry as _tel
+        if _tel.active():
+            _tel.emit("serve.drain", phase="flush", flushed=flushed)
 
     @property
     def active(self) -> int:
@@ -343,6 +648,23 @@ class ContinuousBatcher:
             "compiled_programs": self.compiled_programs,
             "kv_layout": self.kv_layout,
             "kv_bytes": self.kv_cache_bytes(),
+            # serve-robustness counters (ISSUE 9).  The no-leak
+            # contract chaos_check --serve asserts: once queue and
+            # slots drain, requests_submitted == requests_completed +
+            # requests_shed, with requeued requests completing exactly
+            # once (their discarded pre-fault tokens never reach
+            # tokens_produced)
+            "requests_submitted": self._submitted,
+            "requests_admitted": self._admissions,
+            "requests_completed": self._completed,
+            "requests_shed": self._shed_count,
+            "requests_requeued": self._requeue_count,
+            "shed_by_class": dict(self._shed_by_class),
+            "deadline_misses": self._deadline_misses,
+            "chunk_retries": self._chunk_retries,
+            "hung_chunks": self._hung_chunks,
+            "queued": self._queued_count(),
+            "drained": self._draining,
         }
         if self.kv_layout == "paged":
             out.update(
@@ -354,9 +676,10 @@ class ContinuousBatcher:
                 kv_dtype=self._kv_dtype,
                 prefix_hit_tokens=self._alloc.prefix_hit_tokens,
                 evictions=self._alloc.evictions,
+                cow_copies=self._alloc.cow_copies,
             )
         else:
-            out.update(prefix_hit_tokens=0, evictions=0)
+            out.update(prefix_hit_tokens=0, evictions=0, cow_copies=0)
         return out
 
     # -- scheduling --------------------------------------------------------
@@ -378,22 +701,13 @@ class ContinuousBatcher:
                     or len(req.tokens) >= req.max_new_tokens:
                 req.finished = True
                 self._finished[req.req_id] = req
-                self._slots[i] = None
-                self._done = self._done.at[i].set(True)
-                self._mode = self._mode.at[i].set(False)
-                self._mode_host[i] = False
-                self._done_host[i] = True
-                if self.kv_layout == "paged" \
-                        and self._plans[i] is not None:
-                    # unmap the slot's pages (prompt pages stay
-                    # resident as cached prefix pages) and point the
-                    # freed slot at the null page — a free slot's junk
-                    # lanes keep writing, and its old pages may be
-                    # someone else's now
-                    self._alloc.release_plan(self._plans[i])
-                    self._plans[i] = None
-                    self._page_table = self._page_table.at[i].set(
-                        jnp.zeros((self.pages_per_slot,), jnp.int32))
+                self._completed += 1
+                # _clear_slot unmaps the slot's pages (prompt pages
+                # stay resident as cached prefix pages) and points the
+                # freed slot at the null page — a free slot's junk
+                # lanes keep writing, and its old pages may be someone
+                # else's now
+                self._clear_slot(i)
                 out.append(req)
         return out
 
@@ -404,71 +718,130 @@ class ContinuousBatcher:
         buffer and flip the slot to prefill mode.  No forward pass
         happens here — the UNSHARED part of the prompt is consumed
         chunk by chunk inside the next admission-mode scan, overlapped
-        with every live slot's decode.  Under pool pressure (alloc
-        fails even after evicting cached prefix pages) admission
-        defers to a later boundary — unless nothing is running, which
-        means the pool can never serve this request: that raises."""
-        for i in range(self.B):
-            if self._slots[i] is not None or not self._queue:
-                continue
-            req = self._queue[0]
-            plan = None
-            if self.kv_layout == "paged":
-                ps = self.page_size
-                covered_rows = min(
-                    len(req.prompt) + req.max_new_tokens
-                    + self._overshoot, self._cache_len)
-                covered_pages = min(-(-covered_rows // ps),
-                                    self.pages_per_slot)
-                plan = self._alloc.admit(
-                    req.prompt if self.prefix_sharing
-                    else req.prompt[:0], covered_pages)
-                if plan is None:
-                    if self.active == 0:
-                        # nothing is running, so no pages will ever
-                        # free: deferring would spin forever
-                        raise RuntimeError(
-                            f"KV pool ({self.num_pages - 1} usable "
-                            f"pages of {ps} rows) cannot ever hold "
-                            f"this request ({covered_pages} pages); "
-                            f"grow num_pages or shrink the request")
-                    return          # pressure: defer all admissions
-            self._queue.popleft()
-            self._slots[i] = req
-            buf = np.zeros((self.max_len,), np.int32)
-            buf[: len(req.prompt)] = req.prompt
-            self._prompts = self._prompts.at[i].set(jnp.asarray(buf))
-            self._plen = self._plen.at[i].set(len(req.prompt))
-            self._tok = self._tok.at[i].set(0)
-            self._done = self._done.at[i].set(False)
-            self._done_host[i] = False
-            start = 0
-            if plan is not None:
-                self._plans[i] = plan
-                row = np.zeros((self.pages_per_slot,), np.int32)
-                row[: len(plan.pages)] = plan.pages
-                self._page_table = self._page_table.at[i].set(
-                    jnp.asarray(row))
-                if plan.cow is not None:
-                    # copy-on-write at the divergence boundary: clone
-                    # the partially-matched page into the slot's first
-                    # private page, then prefill resumes mid-page.
-                    # admit() pinned the source so pressure could not
-                    # reclaim it before this copy — unpin it now
-                    src, dst = plan.cow
-                    self._cache = self._page_copy_fn()(
-                        self._cache, jnp.asarray(src, jnp.int32),
-                        jnp.asarray(dst, jnp.int32))
-                    self._alloc.release_page(src)
-                start = plan.shared_tokens
-            # prefix-shared tokens are already resident: prefill
-            # starts at the divergence, or straight to decode when
-            # only the final prompt token remains
-            self._pos = self._pos.at[i].set(start)
-            self._pos_host[i] = start
-            prefilling = start < len(req.prompt)
-            self._mode = self._mode.at[i].set(prefilling)
-            self._mode_host[i] = prefilling
+        with every live slot's decode.
+
+        SLO order: classes in priority order, strict FIFO by arrival
+        within a class.  Under pool pressure (alloc fails even after
+        evicting cached prefix pages) the class HEAD defers to a later
+        boundary and blocks its own and lower classes — no head-of-
+        line bypass, so later short prompts can never starve a
+        deferred long one (satellite regression) — unless nothing is
+        running, which means the pool can never serve this request:
+        that raises.  Injected faults (`serve.admit` /
+        `serve.kv_alloc`) retry FIFO-in-place, bounded by
+        FLAGS_serve_retry_budget."""
+        from ..distributed import fault
+        free = [i for i in range(self.B) if self._slots[i] is None]
+
+        def retry_exhausted(q, req, reason):
+            """Injected admission-path fault: bump the per-request
+            retry count.  Past FLAGS_serve_retry_budget the request
+            is shed (True — caller moves to the next one); otherwise
+            it keeps its FIFO position for the next boundary (False —
+            caller defers this class and lower)."""
+            req.admit_faults += 1
+            if req.admit_faults > int(
+                    get_flag("serve_retry_budget") or 3):
+                q.popleft()
+                self._shed(req, reason)
+                return True
+            return False
+
+        for cls in SLO_CLASSES:
+            q = self._queues[cls]
+            while q and free:
+                req = q[0]
+                # injected admission fault: error = transient (retry
+                # this head at the next boundary, FIFO kept); skip =
+                # admission rejected outright (shed)
+                try:
+                    f = fault.hit("serve.admit",
+                                  key=f"req{req.req_id}:{cls}")
+                except fault.FaultError:
+                    if retry_exhausted(q, req, "admit_fault"):
+                        continue
+                    return          # blocked: same+lower classes wait
+                if f is not None and f.mode == "skip":
+                    q.popleft()
+                    self._shed(req, "admit_fault")
+                    continue
+                plan = None
+                if self.kv_layout == "paged":
+                    ps = self.page_size
+                    covered_rows = min(
+                        len(req.prompt) + req.max_new_tokens
+                        + self._overshoot, self._cache_len)
+                    covered_pages = min(-(-covered_rows // ps),
+                                        self.pages_per_slot)
+                    try:
+                        fk = fault.hit("serve.kv_alloc",
+                                       key=f"req{req.req_id}")
+                    except fault.FaultError:
+                        # transient allocator fault == pool pressure:
+                        # FIFO deferral, bounded like admit faults
+                        if retry_exhausted(q, req, "kv_alloc_fault"):
+                            continue
+                        return
+                    if fk is not None:
+                        # data-mode kv_alloc fault: simulated pool
+                        # exhaustion — defer exactly like pressure
+                        # (bounded so times=* cannot spin run())
+                        if retry_exhausted(q, req, "kv_alloc_fault"):
+                            continue
+                        return
+                    plan = self._alloc.admit(
+                        req.prompt if self.prefix_sharing
+                        else req.prompt[:0], covered_pages)
+                    if plan is None:
+                        if self.active == 0:
+                            # nothing is running, so no pages will
+                            # ever free: deferring would spin forever
+                            raise RuntimeError(
+                                f"KV pool ({self.num_pages - 1} usable "
+                                f"pages of {ps} rows) cannot ever hold "
+                                f"this request ({covered_pages} pages); "
+                                f"grow num_pages or shrink the request")
+                        return      # pressure: defer same+lower classes
+                q.popleft()
+                i = free.pop(0)
+                self._admissions += 1
+                self._slots[i] = req
+                buf = np.zeros((self.max_len,), np.int32)
+                buf[: len(req.prompt)] = req.prompt
+                self._prompts = self._prompts.at[i].set(
+                    jnp.asarray(buf))
+                self._plen = self._plen.at[i].set(len(req.prompt))
+                self._tok = self._tok.at[i].set(0)
+                self._done = self._done.at[i].set(False)
+                self._done_host[i] = False
+                start = 0
+                if plan is not None:
+                    self._plans[i] = plan
+                    row = np.zeros((self.pages_per_slot,), np.int32)
+                    row[: len(plan.pages)] = plan.pages
+                    self._page_table = self._page_table.at[i].set(
+                        jnp.asarray(row))
+                    if plan.cow is not None:
+                        # copy-on-write at the divergence boundary:
+                        # clone the partially-matched page into the
+                        # slot's first private page, then prefill
+                        # resumes mid-page.  admit() pinned the source
+                        # so pressure could not reclaim it before this
+                        # copy — unpin it now
+                        src, dst = plan.cow
+                        self._cache = self._page_copy_fn()(
+                            self._cache, jnp.asarray(src, jnp.int32),
+                            jnp.asarray(dst, jnp.int32))
+                        self._alloc.release_page(src)
+                    start = plan.shared_tokens
+                # prefix-shared tokens are already resident: prefill
+                # starts at the divergence, or straight to decode when
+                # only the final prompt token remains
+                self._pos = self._pos.at[i].set(start)
+                self._pos_host[i] = start
+                prefilling = start < len(req.prompt)
+                self._mode = self._mode.at[i].set(prefilling)
+                self._mode_host[i] = prefilling
 
     # -- compiled pieces ---------------------------------------------------
     def _param_vals(self):
@@ -655,14 +1028,52 @@ class ContinuousBatcher:
         return fn.lower(self._param_vals(), *self._carry_args())
 
     def _run_chunk(self, mixed: bool):
+        from ..distributed import fault
         if mixed:
             fn = self._step_fn(self.prefill_chunk, self.admit_steps)
         else:
             fn = self._step_fn(1, self.chunk)
         t0 = time.perf_counter()
-        (self._cache, page_table, self._tok, self._pos, self._mode,
-         self._plen, self._prompts, self._done, toks, n_pref,
-         n_dec) = fn(self._param_vals(), *self._carry_args())
+        kind = "admit" if mixed else "decode"
+        try:
+            # the chunk dispatch runs under the serve watchdog
+            # (FLAGS_stop_check_timeout): a hang dumps thread stacks /
+            # aborts per the r9 contract, and a delay-injected chunk
+            # that ages past the deadline is counted as hung below.
+            # The serve.chunk fault fires INSIDE the watched window
+            # but BEFORE fn touches the donated carries — an injected
+            # chunk fault loses nothing; the chunk retries at the next
+            # boundary
+            with self._watch:
+                fault.hit("serve.chunk", key=kind)
+                (self._cache, page_table, self._tok, self._pos,
+                 self._mode, self._plen, self._prompts, self._done,
+                 toks, n_pref, n_dec) = fn(self._param_vals(),
+                                           *self._carry_args())
+        except fault.FaultError:
+            self._chunk_retries += 1
+            self._consecutive_chunk_faults += 1
+            from .. import telemetry as _tel
+            _tel.counter("serve.chunk_retries").inc()
+            if _tel.active():
+                _tel.emit("serve.chunk_fault", kind=kind,
+                          retries=self._chunk_retries)
+            # a PERSISTENT chunk fault (times=*) would otherwise spin
+            # run() forever — past the budget, surface it to the
+            # caller like StepAnomalyGuard's bad-step budget
+            if self._consecutive_chunk_faults > int(
+                    get_flag("serve_retry_budget") or 3):
+                raise
+            return
+        self._consecutive_chunk_faults = 0
+        if self._watch.last_reported:
+            self._hung_chunks += 1
+            from .. import telemetry as _tel
+            _tel.counter("serve.hung_chunks").inc()
+            if _tel.active():
+                _tel.emit("serve.hung", kind=kind,
+                          wall_ms=round(
+                              (time.perf_counter() - t0) * 1e3, 3))
         if self.kv_layout == "paged":
             self._page_table = page_table
         # ONE batched host transfer per chunk — each device_get is a
@@ -675,6 +1086,27 @@ class ContinuousBatcher:
         self._mode_host = np.array(mode_h)
         self._done_host = np.array(done_h)
         self._pos_host = np.array(pos_h)
+        # serve.decode: per-live-slot fault sweep — a poisoned slot is
+        # evicted and its request requeued/shed (_fault_slot) BEFORE
+        # its pending trie nodes could be marked complete or its
+        # chunk tokens harvested, while every other slot proceeds
+        # untouched.  Unset, this whole block is one cached string
+        # compare (fault.is_active)
+        if fault.is_active():
+            faulted = []
+            for i, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                try:
+                    f = fault.hit("serve.decode",
+                                  key=f"slot{i}:req{req.req_id}")
+                except fault.FaultError:
+                    faulted.append(i)
+                    continue
+                if f is not None:   # data modes poison the slot too
+                    faulted.append(i)
+            for i in faulted:
+                self._fault_slot(i)
         dt = time.perf_counter() - t0
         # a program's FIRST call may include its XLA compile — keep it
         # out of the wall-time stats so chunk_time_max/p50 describe

@@ -10,7 +10,12 @@ exporter reads from:
   OffloadPipelineStep               train.step (trainer=offload)
   PipelineEngine.train_batch        pp.train_batch (schedule, micro)
   collective_schedule()             collective.schedule (kind counts)
-  ContinuousBatcher                 serve.chunk / serve.recompile
+  ContinuousBatcher                 serve.chunk / serve.recompile /
+                                    serve.kv, and the robustness set
+                                    (ISSUE 9): serve.shed /
+                                    serve.deadline_miss /
+                                    serve.requeue / serve.chunk_fault /
+                                    serve.hung / serve.drain
   io.prefetch_to_device             io.step (host_wait_ms)
   distributed.watchdog              watchdog.timeout
   distributed.fault                 fault.hit

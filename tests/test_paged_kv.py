@@ -457,3 +457,80 @@ def test_allocator_match_and_partial():
     assert not full2 and partial2 is None
     al2.release_plan(plan2)
     assert al2.pages_free == 7                # pending nodes dropped
+
+
+# ---------------------------------------------------------------------------
+# eviction-under-pressure interleaved with copy-on-write (ISSUE 9
+# satellite): the CoW source sits between trie match and device copy
+# while the SAME admission's private allocation is evicting under
+# pressure — the pinned source must survive and never alias a private
+
+
+def test_cow_admission_evicts_others_never_its_source():
+    """An admission that full-matches one chain, CoW-matches its next
+    page, and needs more privates than the free list holds: the
+    eviction loop must reclaim OTHER cached chains and must never
+    touch the (pinned) CoW source or the matched page — the window
+    between match_prefix and the device copy is exactly where a
+    reclaimed source would silently alias a private page."""
+    al = PageAllocator(num_pages=7, page_size=4)          # 6 usable
+    # chain A: two complete cached pages (the future match + source)
+    plan_a = al.admit(list(range(10, 18)) + [1, 2], covered_pages=3)
+    for n in plan_a.nodes:
+        al.complete_node(n)
+    al.release_plan(plan_a)
+    # chain C: two more complete cached pages (the eviction victims)
+    plan_c = al.admit(list(range(50, 58)) + [3, 4], covered_pages=3)
+    for n in plan_c.nodes:
+        al.complete_node(n)
+    al.release_plan(plan_c)
+    assert al.pages_cached == 4 and al.pages_free == 2
+    # D: full-match A page 1, diverge mid A page 2 (m=2), 3 privates
+    # needed with only 2 free -> pressure evicts from chain C
+    evicted_before = al.evictions
+    plan_d = al.admit([10, 11, 12, 13, 14, 15, 99, 98, 97, 96],
+                      covered_pages=4)
+    assert plan_d is not None and plan_d.cow is not None
+    src, dst = plan_d.cow
+    assert al.evictions > evicted_before
+    assert al.cow_copies == 1
+    # the pinned source survived the eviction sweep and is not among
+    # the plan's pages (it will be copied into dst, a fresh private)
+    assert src not in plan_d.pages and dst == plan_d.pages[1]
+    assert al._node_of.get(src) is not None
+    assert al._ref.get(src, 0) == 1                       # copy pin
+    assert len(set(plan_d.pages)) == len(plan_d.pages)
+    # matched tokens: one full page + the 2-token partial
+    assert plan_d.shared_tokens == 4 + 2
+    al.release_page(src)                                  # post-copy
+    al.release_plan(plan_d)
+
+
+def test_cow_admissions_interleave_pressure_bitexact(model):
+    """Batcher-level: staggered admissions where a CoW divergence and
+    pool-pressure evictions interleave — every request still completes
+    bit-exact (the copied page's content equals what an unshared
+    prefill would have written, even though its source was under
+    eviction pressure while mapped)."""
+    rng = np.random.RandomState(21)
+    sys_p = rng.randint(1, 128, 12).astype(np.int32)   # 1.5 pages @8
+    tails = [rng.randint(1, 128, 4).astype(np.int32) for _ in range(2)]
+    fresh = rng.randint(1, 128, 16).astype(np.int32)
+    prompts = [np.concatenate([sys_p, tails[0]]),      # seeds the trie
+               np.concatenate([sys_p, tails[1]]),      # CoW at page 2
+               fresh]                                  # needs evictions
+    bat = ContinuousBatcher(model, max_batch_size=2, max_len=48,
+                            chunk=4, prefill_chunk=8, page_size=8,
+                            num_pages=8)
+    rids = [bat.submit(prompts[0], 6)]
+    bat.step()
+    rids += [bat.submit(prompts[1], 6), bat.submit(prompts[2], 6)]
+    outs = bat.run()
+    st = bat.stats()
+    assert st["cow_copies"] >= 1, st
+    assert st["evictions"] >= 1, st
+    assert st["prefix_hit_tokens"] > 0, st
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(outs[rid],
+                                      _isolated(model, p, 6))
+    assert st["requests_submitted"] == st["requests_completed"] == 3

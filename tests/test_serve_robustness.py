@@ -1,0 +1,619 @@
+"""Serve-plane fault tolerance (ISSUE 9): SLO-aware admission,
+deadlines, load shedding, fault recovery and SIGTERM drain around
+`ContinuousBatcher`.
+
+The contracts under test:
+
+  * SLO — admission walks classes in priority order, strict FIFO by
+    arrival within a class; a class head deferred by KV-pool pressure
+    blocks its own and lower classes (starvation freedom: a stream of
+    short prompts can never indefinitely bypass a deferred long one).
+  * SHEDDING — a bounded queue (`FLAGS_serve_queue_depth`) sheds the
+    lowest-SLO newest-arrival QUEUED request; a queued request past
+    its deadline sheds as a deadline miss; an in-flight decode is
+    NEVER shed.  Every submitted id still surfaces in run()'s results.
+  * RECOVERY — injected faults at the four serve points
+    (`serve.admit`, `serve.kv_alloc`, `serve.chunk`, `serve.decode`)
+    fire and recover: retried admissions, deferred allocations,
+    retried chunks (carries untouched), and poisoned slots evicted +
+    requeued — with every surviving request's output BIT-EXACT equal
+    to its isolated fault-free run, and `tokens_produced` deduped by
+    request id across requeues (satellite regression).
+  * DRAIN — `guard.drain_requested()` closes admissions (queued shed
+    with reason "drain"), in-flight decodes finish within
+    PADDLE_DRAIN_GRACE, grace expiry flushes partial results.
+  * CONTRACT — robustness flags on, a mixed-SLO multi-length workload
+    still compiles exactly 2 serve-step programs (the r6 pin).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fault, guard
+from paddle_tpu.inference import ContinuousBatcher, SLO_CLASSES
+from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                     llama_tiny_config)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(7)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            intermediate_size=128,
+                            num_attention_heads=4,
+                            num_key_value_heads=2, vocab_size=128)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_drain():
+    guard.clear_drain()
+    yield
+    guard.clear_drain()
+
+
+def _isolated(model, ids, n):
+    out = model.generate(paddle.to_tensor(np.asarray([ids], np.int32)),
+                         max_new_tokens=n)
+    return np.asarray(out.value)[0]
+
+
+def _bat(model, **kw):
+    geom = dict(max_batch_size=2, max_len=64, chunk=4, prefill_chunk=4)
+    geom.update(kw)
+    return ContinuousBatcher(model, **geom)
+
+
+def _assert_no_leak(bat):
+    st = bat.stats()
+    assert st["requests_submitted"] == st["requests_completed"] \
+        + st["requests_shed"], st
+    assert sorted(bat._finished) \
+        == sorted(range(st["requests_submitted"])), st
+    return st
+
+
+# ---------------------------------------------------------------------------
+# SLO classes and admission order
+
+
+def test_slo_priority_admission_order(model):
+    """With one slot, a later-submitted interactive request is
+    admitted before earlier batch/best_effort ones — and everything
+    still matches isolation."""
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, 128, L).astype(np.int32)
+               for L in (5, 6, 7, 4)]
+    bat = _bat(model, max_batch_size=1)
+    r_busy = bat.submit(prompts[0], 6, slo="batch")
+    bat.step()                                # r_busy in flight
+    r_be = bat.submit(prompts[1], 4, slo="best_effort")
+    r_b = bat.submit(prompts[2], 4, slo="batch")
+    r_int = bat.submit(prompts[3], 4, slo="interactive")
+    admit_order = []
+    seen = {req.req_id for req in bat._slots if req is not None}
+    while bat.queued or bat.active:
+        bat.step()
+        for req in bat._slots:
+            if req is not None and req.req_id not in seen:
+                seen.add(req.req_id)
+                admit_order.append(req.req_id)
+    assert admit_order[0] == r_int, admit_order
+    assert admit_order.index(r_b) < admit_order.index(r_be)
+    outs = {rid: bat._finished[rid].output()
+            for rid in (r_busy, r_be, r_b, r_int)}
+    for rid, p, n in ((r_busy, prompts[0], 6), (r_be, prompts[1], 4),
+                      (r_b, prompts[2], 4), (r_int, prompts[3], 4)):
+        np.testing.assert_array_equal(outs[rid],
+                                      _isolated(model, p, n))
+    _assert_no_leak(bat)
+
+
+def test_deferred_long_prompt_not_starved_by_short_stream(model):
+    """Satellite regression (starvation freedom): a long prompt
+    deferred under KV-pool pressure keeps its FIFO position — a stream
+    of later short prompts in the SAME class must not be admitted past
+    it, even though they would fit the free pages."""
+    rng = np.random.RandomState(9)
+    short0 = rng.randint(1, 128, 4).astype(np.int32)
+    long_p = rng.randint(1, 128, 32).astype(np.int32)
+    shorts = [rng.randint(1, 128, 4).astype(np.int32)
+              for _ in range(3)]
+    # 7 usable pages @8 rows: the running short holds 2, the long
+    # needs 6 -> deferred; the later shorts (2 pages each) WOULD fit
+    bat = _bat(model, page_size=8, num_pages=8)
+    r0 = bat.submit(short0, 4, slo="batch")
+    bat.step()
+    r_long = bat.submit(long_p, 4, slo="batch")
+    r_shorts = [bat.submit(p, 4, slo="batch") for p in shorts]
+    admit_step = {}
+    step_no = 0
+    while bat.queued or bat.active:
+        bat.step()
+        step_no += 1
+        for req in bat._slots:
+            if req is not None and req.req_id not in admit_step:
+                admit_step[req.req_id] = step_no
+    assert all(admit_step[r_long] <= admit_step[r]
+               for r in r_shorts), admit_step
+    np.testing.assert_array_equal(bat._finished[r_long].output(),
+                                  _isolated(model, long_p, 4))
+    for r, p in zip(r_shorts, shorts):
+        np.testing.assert_array_equal(bat._finished[r].output(),
+                                      _isolated(model, p, 4))
+    st = _assert_no_leak(bat)
+    assert st["requests_shed"] == 0
+    assert r0 in bat._finished
+
+
+# ---------------------------------------------------------------------------
+# load shedding: bounded queue + deadlines
+
+
+def test_queue_depth_sheds_lowest_slo_newest_first(model):
+    """Overflow sheds best_effort before batch before interactive,
+    newest arrival first — and never an in-flight request."""
+    rng = np.random.RandomState(5)
+    mk = lambda L: rng.randint(1, 128, L).astype(np.int32)
+    paddle.set_flags({"FLAGS_serve_queue_depth": 2})
+    try:
+        bat = _bat(model, max_batch_size=1)
+        r_fly = bat.submit(mk(5), 4, slo="best_effort")
+        bat.step()                         # best_effort IN FLIGHT
+        r_be = bat.submit(mk(4), 4, slo="best_effort")
+        r_int = bat.submit(mk(6), 4, slo="interactive")
+        # queue full: the queued best_effort sheds (NOT the in-flight
+        # best_effort, NOT the incoming interactive)
+        r_b = bat.submit(mk(7), 4, slo="batch")
+        outs = bat.run()
+    finally:
+        paddle.set_flags({"FLAGS_serve_queue_depth": 0})
+    fin = bat._finished
+    assert fin[r_be].shed and fin[r_be].shed_reason == "queue_full"
+    assert not fin[r_fly].shed and not fin[r_int].shed \
+        and not fin[r_b].shed
+    assert len(outs[r_be]) == 0
+    st = _assert_no_leak(bat)
+    assert st["requests_shed"] == 1
+    assert st["shed_by_class"]["best_effort"] == 1
+
+
+def test_queue_depth_incoming_lowest_sheds_itself(model):
+    """When the incoming request IS the lowest-priority newest, it is
+    the victim; higher-priority queued requests are untouched."""
+    rng = np.random.RandomState(6)
+    mk = lambda L: rng.randint(1, 128, L).astype(np.int32)
+    paddle.set_flags({"FLAGS_serve_queue_depth": 1})
+    try:
+        bat = _bat(model, max_batch_size=1)
+        r1 = bat.submit(mk(5), 4, slo="interactive")
+        bat.step()
+        r2 = bat.submit(mk(4), 4, slo="interactive")
+        r3 = bat.submit(mk(6), 4, slo="best_effort")   # sheds itself
+        bat.run()
+    finally:
+        paddle.set_flags({"FLAGS_serve_queue_depth": 0})
+    assert bat._finished[r3].shed \
+        and bat._finished[r3].shed_reason == "queue_full"
+    assert not bat._finished[r2].shed and not bat._finished[r1].shed
+    _assert_no_leak(bat)
+
+
+def test_deadline_miss_sheds_queued_only(model):
+    """A queued request past its deadline sheds as a deadline miss;
+    the in-flight request (even with an already-expired deadline) is
+    never touched."""
+    rng = np.random.RandomState(8)
+    p1, p2, p3 = (rng.randint(1, 128, L).astype(np.int32)
+                  for L in (5, 7, 4))
+    bat = _bat(model, max_batch_size=1)
+    r1 = bat.submit(p1, 8, deadline_ms=1000.0)
+    bat.step()                                  # r1 admitted
+    # jump the batcher's clock: r1's deadline is now LONG past while
+    # it is in flight — still untouchable; r2's tiny deadline expires
+    # in the queue deterministically
+    real_now = bat._now
+    bat._now = lambda: real_now() + 10.0
+    r2 = bat.submit(p2, 4, deadline_ms=0.001, slo="interactive")
+    r3 = bat.submit(p3, 4)                      # no deadline
+    outs = bat.run()
+    fin = bat._finished
+    assert not fin[r1].shed                     # in flight: untouched
+    assert fin[r2].shed and fin[r2].shed_reason == "deadline"
+    assert not fin[r3].shed
+    np.testing.assert_array_equal(outs[r1], _isolated(model, p1, 8))
+    np.testing.assert_array_equal(outs[r3], _isolated(model, p3, 4))
+    st = _assert_no_leak(bat)
+    assert st["deadline_misses"] == 1
+
+
+def test_default_deadline_flag(model):
+    """FLAGS_serve_default_deadline_ms applies to requests that pass
+    no explicit deadline."""
+    rng = np.random.RandomState(12)
+    bat = _bat(model)
+    paddle.set_flags({"FLAGS_serve_default_deadline_ms": 60000.0})
+    try:
+        rid = bat.submit(rng.randint(1, 128, 4).astype(np.int32), 4)
+    finally:
+        paddle.set_flags({"FLAGS_serve_default_deadline_ms": 0.0})
+    req = next(r for q in bat._queues.values() for r in q
+               if r.req_id == rid)
+    assert req.deadline is not None
+    bat.run()
+
+
+# ---------------------------------------------------------------------------
+# fault recovery at the four serve points
+
+
+def test_decode_fault_evicts_requeues_bitexact(model):
+    """A poisoned slot mid-generation: pages evicted, request requeued
+    at its arrival position, re-decode bit-exact — while the other
+    slot keeps decoding.  Satellite regression: the discarded
+    pre-fault tokens never reach tokens_produced (dedupe by request
+    id)."""
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, 128, L).astype(np.int32)
+               for L in (5, 9, 7, 4)]
+    new = [6, 5, 7, 4]
+    with fault.scope("serve.decode:step=3:mode=error"):
+        bat = _bat(model)
+        rids = [bat.submit(p, n) for p, n in zip(prompts, new)]
+        outs = bat.run()
+        st = bat.stats()
+        fired = fault.fired_counts().get("serve.decode", 0)
+    assert fired == 1
+    assert st["requests_requeued"] >= 1, st
+    for rid, p, n in zip(rids, prompts, new):
+        np.testing.assert_array_equal(outs[rid],
+                                      _isolated(model, p, n))
+    # emitted-token accounting dedupes the requeued request's
+    # re-decoded tokens: the total equals exactly what the outputs
+    # hold, not old + re-decoded
+    assert st["tokens_produced"] == sum(len(outs[r]) for r in rids), st
+    assert st["requests_shed"] == 0
+    _assert_no_leak(bat)
+
+
+def test_decode_fault_dense_layout(model):
+    """The evict+requeue path has no paged dependency: the dense
+    layout recovers the same way."""
+    rng = np.random.RandomState(14)
+    prompts = [rng.randint(1, 128, L).astype(np.int32)
+               for L in (5, 8)]
+    with fault.scope("serve.decode:step=2:mode=error"):
+        bat = _bat(model, kv_layout="dense")
+        rids = [bat.submit(p, 5) for p in prompts]
+        outs = bat.run()
+        st = bat.stats()
+    assert st["requests_requeued"] >= 1
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(outs[rid],
+                                      _isolated(model, p, 5))
+    _assert_no_leak(bat)
+
+
+def test_decode_fault_budget_exhaustion_sheds(model):
+    """A slot that faults on EVERY chunk exhausts its retry budget
+    (FLAGS_serve_retry_budget) and is shed instead of spinning the
+    batch forever; the co-resident request still completes."""
+    rng = np.random.RandomState(15)
+    p_ok = rng.randint(1, 128, 4).astype(np.int32)
+    p_bad = rng.randint(1, 128, 5).astype(np.int32)
+    with fault.scope("serve.decode:times=*:mode=error:match=slot1"):
+        bat = _bat(model)
+        r_ok = bat.submit(p_ok, 5)        # slot 0
+        r_bad = bat.submit(p_bad, 5)      # slot 1 — always poisoned
+        outs = bat.run()
+        st = bat.stats()
+    fin = bat._finished
+    assert fin[r_bad].shed and fin[r_bad].shed_reason == "decode_fault"
+    assert not fin[r_ok].shed
+    np.testing.assert_array_equal(outs[r_ok],
+                                  _isolated(model, p_ok, 5))
+    assert st["requests_requeued"] >= 1
+    _assert_no_leak(bat)
+
+
+def test_admit_fault_retries_then_completes(model):
+    rng = np.random.RandomState(16)
+    prompts = [rng.randint(1, 128, L).astype(np.int32)
+               for L in (5, 7, 6)]
+    with fault.scope("serve.admit:step=2:mode=error"):
+        bat = _bat(model)
+        rids = [bat.submit(p, 5) for p in prompts]
+        outs = bat.run()
+        st = bat.stats()
+        fired = fault.fired_counts().get("serve.admit", 0)
+    assert fired == 1
+    assert st["requests_shed"] == 0 and st["requests_completed"] == 3
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(outs[rid],
+                                      _isolated(model, p, 5))
+    _assert_no_leak(bat)
+
+
+def test_admit_reject_sheds_request(model):
+    rng = np.random.RandomState(17)
+    prompts = [rng.randint(1, 128, L).astype(np.int32)
+               for L in (5, 7)]
+    with fault.scope("serve.admit:step=1:mode=skip"):
+        bat = _bat(model)
+        rids = [bat.submit(p, 5) for p in prompts]
+        outs = bat.run()
+    fin = bat._finished
+    assert fin[rids[0]].shed \
+        and fin[rids[0]].shed_reason == "admit_fault"
+    np.testing.assert_array_equal(outs[rids[1]],
+                                  _isolated(model, prompts[1], 5))
+    _assert_no_leak(bat)
+
+
+def test_kv_alloc_fault_defers_fifo(model):
+    """A transient allocator fault defers the head FIFO-in-place: the
+    deferred request is still admitted BEFORE later arrivals of its
+    class once the fault clears."""
+    rng = np.random.RandomState(18)
+    prompts = [rng.randint(1, 128, L).astype(np.int32)
+               for L in (6, 5, 4)]
+    with fault.scope("serve.kv_alloc:step=1:times=2:mode=error"):
+        bat = _bat(model, max_batch_size=1)
+        rids = [bat.submit(p, 4) for p in prompts]
+        admit_order = []
+        seen = set()
+        while bat.queued or bat.active:
+            bat.step()
+            for req in bat._slots:
+                if req is not None and req.req_id not in seen:
+                    seen.add(req.req_id)
+                    admit_order.append(req.req_id)
+        st = bat.stats()
+        fired = fault.fired_counts().get("serve.kv_alloc", 0)
+    assert fired == 2
+    assert admit_order == rids            # FIFO held through the fault
+    assert st["requests_shed"] == 0
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(bat._finished[rid].output(),
+                                      _isolated(model, p, 4))
+    _assert_no_leak(bat)
+
+
+def test_chunk_fault_retries_without_losing_state(model):
+    """serve.chunk fires BEFORE the donated carries are touched: the
+    chunk simply retries at the next boundary and every output is
+    bit-exact."""
+    rng = np.random.RandomState(19)
+    prompts = [rng.randint(1, 128, L).astype(np.int32)
+               for L in (5, 9, 6)]
+    with fault.scope("serve.chunk:step=2:times=2:mode=error"):
+        bat = _bat(model)
+        rids = [bat.submit(p, 5) for p in prompts]
+        outs = bat.run()
+        st = bat.stats()
+    assert st["chunk_retries"] == 2, st
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(outs[rid],
+                                      _isolated(model, p, 5))
+    _assert_no_leak(bat)
+
+
+def test_explicit_zero_deadline_means_none(model):
+    """Regression (review): deadline_ms=0 passed EXPLICITLY follows
+    the same '0 = no deadline' convention as the flag — the request
+    must complete, not be shed at the first boundary."""
+    rng = np.random.RandomState(28)
+    p = rng.randint(1, 128, 5).astype(np.int32)
+    bat = _bat(model, max_batch_size=1)
+    rid = bat.submit(p, 4, deadline_ms=0)
+    outs = bat.run()
+    assert not bat._finished[rid].shed
+    np.testing.assert_array_equal(outs[rid], _isolated(model, p, 4))
+
+
+def test_persistent_chunk_fault_raises_past_budget(model):
+    """Regression (review): a times=* serve.chunk fault cannot spin
+    run() forever — past FLAGS_serve_retry_budget consecutive chunk
+    faults the FaultError surfaces to the caller."""
+    rng = np.random.RandomState(29)
+    with fault.scope("serve.chunk:times=*:mode=error"):
+        bat = _bat(model)
+        bat.submit(rng.randint(1, 128, 5).astype(np.int32), 4)
+        with pytest.raises(fault.FaultError):
+            bat.run()
+    assert bat.stats()["chunk_retries"] > 1
+
+
+def test_watched_last_reported_resets_per_entry():
+    """Regression (review): one reported hang must not leak
+    last_reported=True into later entries — especially entries made
+    AFTER the watchdog is disabled (start_task returns None)."""
+    import time as _time
+    from paddle_tpu.distributed.watchdog import watched
+    w = watched("serve.chunk", timeout=0.05)
+    with w:
+        _time.sleep(0.6)                  # ages past the deadline
+    assert w.last_reported
+    w.timeout = None
+    paddle.set_flags({"FLAGS_stop_check_timeout": 0})
+    with w:                               # watchdog disabled
+        pass
+    assert not w.last_reported
+
+
+def test_hung_chunk_detected_by_watchdog(model):
+    """A chunk that ages past FLAGS_stop_check_timeout while in flight
+    is reported by the comm watchdog and counted as hung; the outputs
+    are unaffected."""
+    from paddle_tpu.distributed.watchdog import get_comm_task_manager
+    rng = np.random.RandomState(20)
+    p = rng.randint(1, 128, 5).astype(np.int32)
+    mgr = get_comm_task_manager()
+    n_reports = len(mgr.timeout_log)
+    paddle.set_flags({"FLAGS_stop_check_timeout": 0.05})
+    try:
+        with fault.scope("serve.chunk:step=1:mode=delay:secs=0.8"):
+            bat = _bat(model)
+            rid = bat.submit(p, 5)
+            outs = bat.run()
+            st = bat.stats()
+    finally:
+        paddle.set_flags({"FLAGS_stop_check_timeout": 0})
+    assert st["hung_chunks"] >= 1, st
+    assert len(mgr.timeout_log) > n_reports
+    assert any(name == "serve.chunk"
+               for name, _, _ in mgr.timeout_log[n_reports:])
+    np.testing.assert_array_equal(outs[rid], _isolated(model, p, 5))
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM drain
+
+
+def test_drain_sheds_queue_finishes_in_flight(model):
+    rng = np.random.RandomState(22)
+    p1, p2 = (rng.randint(1, 128, L).astype(np.int32) for L in (5, 7))
+    bat = _bat(model, max_batch_size=1)
+    r1 = bat.submit(p1, 6)
+    r2 = bat.submit(p2, 6)
+    bat.step()                            # r1 in flight, r2 queued
+    guard.request_drain()
+    outs = bat.run()
+    assert bat.drained
+    fin = bat._finished
+    assert fin[r2].shed and fin[r2].shed_reason == "drain"
+    # the in-flight decode FINISHED inside the grace window
+    assert not fin[r1].partial
+    np.testing.assert_array_equal(outs[r1], _isolated(model, p1, 6))
+    st = _assert_no_leak(bat)
+    assert st["drained"]
+
+
+def test_drain_closes_submissions(model):
+    """A submit() after the drain engaged is accounted and immediately
+    shed — admissions are closed."""
+    rng = np.random.RandomState(23)
+    bat = _bat(model, max_batch_size=1)
+    r1 = bat.submit(rng.randint(1, 128, 4).astype(np.int32), 4)
+    bat.step()
+    guard.request_drain()
+    bat.step()                            # drain engages
+    r2 = bat.submit(rng.randint(1, 128, 5).astype(np.int32), 4)
+    outs = bat.run()
+    assert bat._finished[r2].shed \
+        and bat._finished[r2].shed_reason == "drain"
+    assert len(outs[r2]) == 0 and r1 in outs
+    _assert_no_leak(bat)
+
+
+def test_drain_grace_expiry_flushes_partial(model, monkeypatch):
+    """Grace 0: the in-flight request is flushed as a PARTIAL result —
+    delivered with the tokens it produced, marked partial, counted as
+    completed (not shed)."""
+    monkeypatch.setenv("PADDLE_DRAIN_GRACE", "0")
+    rng = np.random.RandomState(24)
+    p = rng.randint(1, 128, 5).astype(np.int32)
+    bat = _bat(model, max_batch_size=1)
+    rid = bat.submit(p, 24)               # needs many decode chunks
+    bat.step()
+    guard.request_drain()
+    outs = bat.run()
+    req = bat._finished[rid]
+    assert req.partial and not req.shed
+    assert 0 < len(outs[rid]) < 24
+    # the partial prefix is bit-exact: flushed tokens came from
+    # completed chunks
+    np.testing.assert_array_equal(
+        outs[rid], _isolated(model, p, 24)[: len(outs[rid])])
+    st = _assert_no_leak(bat)
+    assert st["requests_completed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry + program contract + CLI wiring
+
+
+def test_shed_requeue_deadline_events(model):
+    from paddle_tpu import telemetry
+    rng = np.random.RandomState(25)
+    mk = lambda L: rng.randint(1, 128, L).astype(np.int32)
+    sink = telemetry.add_sink(telemetry.MemorySink())
+    try:
+        paddle.set_flags({"FLAGS_serve_queue_depth": 1})
+        try:
+            with fault.scope("serve.decode:step=2:mode=error"):
+                bat = _bat(model, max_batch_size=1)
+                bat.submit(mk(5), 4)
+                bat.step()
+                bat.submit(mk(6), 4, deadline_ms=0.001)
+                bat.submit(mk(4), 4, slo="best_effort")  # overflow
+                bat.run()
+        finally:
+            paddle.set_flags({"FLAGS_serve_queue_depth": 0})
+    finally:
+        telemetry.remove_sink(sink)
+    evs = {}
+    for r in sink.records:
+        evs.setdefault(r["event"], []).append(r)
+    assert "serve.shed" in evs and "serve.requeue" in evs, sorted(evs)
+    assert "serve.deadline_miss" in evs, sorted(evs)
+    shed = evs["serve.shed"]
+    assert all({"req", "slo", "reason"} <= set(e) for e in shed)
+    reasons = {e["reason"] for e in shed}
+    assert "queue_full" in reasons and "deadline" in reasons
+    st = bat.stats()
+    assert st["requests_shed"] == len(shed)
+    assert st["requests_requeued"] == len(evs["serve.requeue"])
+    assert st["deadline_misses"] == len(evs["serve.deadline_miss"])
+
+
+def test_flags_on_slo_mix_never_recompiles(model):
+    """Acceptance pin: with the robustness flags ON, prompt length and
+    SLO mix still never reach a program shape — exactly 2 compiled
+    serve-step programs (the recompile_guard raises with avals on
+    violation)."""
+    from paddle_tpu.analysis import recompile_guard
+    rng = np.random.RandomState(26)
+    paddle.set_flags({"FLAGS_serve_queue_depth": 16,
+                      "FLAGS_serve_default_deadline_ms": 60000.0})
+    try:
+        bat = _bat(model)
+        for L, slo in ((3, "interactive"), (6, "batch"),
+                       (9, "best_effort"), (12, "interactive"),
+                       (15, "batch"), (18, "best_effort")):
+            bat.submit(rng.randint(1, 128, L).astype(np.int32), 4,
+                       slo=slo)
+        with recompile_guard(max_programs=2, match="serve_step"):
+            bat.run()
+    finally:
+        paddle.set_flags({"FLAGS_serve_queue_depth": 0,
+                          "FLAGS_serve_default_deadline_ms": 0.0})
+    st = _assert_no_leak(bat)
+    assert st["compiled_programs"] == 2
+    assert st["requests_shed"] == 0
+
+
+def test_chaos_serve_selftest_cli():
+    """Tier-1 wiring (ISSUE 9 satellite): one planted fault per serve
+    injection point + the SIGTERM drain e2e, all must fire and
+    recover — `chaos_check --serve --selftest` exits 0."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_check as cli
+    finally:
+        sys.path.pop(0)
+    assert cli.main(["--serve", "--selftest"]) == 0
+
+
+def test_slo_validation_and_api(model):
+    rng = np.random.RandomState(27)
+    bat = _bat(model)
+    with pytest.raises(ValueError, match="SLO"):
+        bat.submit(rng.randint(1, 128, 4).astype(np.int32), 4,
+                   slo="platinum")
+    assert SLO_CLASSES == ("interactive", "batch", "best_effort")

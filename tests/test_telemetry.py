@@ -430,7 +430,7 @@ def _serve_workload(model, force_recompile_at=None):
     bat.step()
     bat.submit(prompts[2], 6)
     n = 0
-    while bat._queue or bat.active:
+    while bat.queued or bat.active:
         n += 1
         if force_recompile_at is not None and n == force_recompile_at:
             # forced program-cache miss: the next chunk re-traces

@@ -2,7 +2,7 @@
 paddle_tpu.distributed.fault (JSON output + non-zero exit on failure,
 like tools/verify_program.py).
 
-Two modes:
+Train-plane modes:
 
   python tools/chaos_check.py --spec "ckpt.write:step=2:mode=truncate"
       Run a short checkpointed train loop with the spec ARMED: any
@@ -21,6 +21,27 @@ Two modes:
       AND its recovery machinery recovers.  Exit 1 if any check fails —
       a silently dead injection point is exactly the failure mode this
       guards.
+
+Serve-plane modes (ISSUE 9):
+
+  python tools/chaos_check.py --serve --spec "serve.decode:step=3:mode=error"
+      Run a MIXED-SLO continuous-batching workload (staggered
+      interactive/batch/best_effort requests through one
+      ContinuousBatcher) with the spec armed.  Passes iff the fault
+      fired, the batch survived, every NON-SHED request's output is
+      BIT-EXACT equal to the fault-free run of the same workload, and
+      the telemetry counters reconcile with no leaks (submitted ==
+      completed + shed; every submitted id present in the results;
+      requeued requests completed exactly once).
+
+  python tools/chaos_check.py --serve --selftest
+      One planted fault per serve injection point (admission fault
+      retried, admission rejected->shed, KV-alloc fault deferred,
+      chunk fault retried, hung chunk caught by the serve watchdog,
+      poisoned slot evicted+requeued) plus the SIGTERM drain e2e (a
+      subprocess serving mid-batch receives SIGTERM, sheds its queue,
+      finishes in-flight decodes and exits ELASTIC_EXIT_CODE).
+      Tier-1-wired (tests/test_serve_robustness.py).
 
   --json     one machine-readable JSON document on stdout
   --steps N  target train steps for --spec runs (default 8)
@@ -242,6 +263,211 @@ def _selftest():
 
 
 # ---------------------------------------------------------------------------
+# serve plane (ISSUE 9): mixed-SLO workload under a serve.* spec
+# ---------------------------------------------------------------------------
+
+_serve_model_cache = []
+
+
+def _serve_model():
+    """One tiny llama shared by every serve check (programs are cached
+    on the model, so successive batchers recompile nothing)."""
+    if not _serve_model_cache:
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(11)
+        cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                                intermediate_size=128,
+                                num_attention_heads=4,
+                                num_key_value_heads=2, vocab_size=128)
+        _serve_model_cache.append(LlamaForCausalLM(cfg))
+    return _serve_model_cache[0]
+
+
+# (prompt_len, max_new, slo) — mixed classes, staggered arrival: the
+# first two are resident when the rest land mid-decode
+_SERVE_WORKLOAD = [
+    (6, 6, "interactive"), (11, 5, "batch"), (4, 7, "best_effort"),
+    (9, 4, "interactive"), (13, 6, "batch"), (5, 5, "best_effort"),
+]
+
+
+def _serve_prompts():
+    import numpy as np
+    rng = np.random.RandomState(5)
+    return [rng.randint(1, 128, L).astype(np.int32)
+            for L, _, _ in _SERVE_WORKLOAD]
+
+
+def _run_serve_workload(model):
+    from paddle_tpu.inference import ContinuousBatcher
+    bat = ContinuousBatcher(model, max_batch_size=2, max_len=64,
+                            chunk=4, prefill_chunk=4)
+    prompts = _serve_prompts()
+    rids = []
+    for p, (_, n, slo) in zip(prompts[:2], _SERVE_WORKLOAD[:2]):
+        rids.append(bat.submit(p, n, slo=slo))
+    bat.step()
+    for p, (_, n, slo) in zip(prompts[2:], _SERVE_WORKLOAD[2:]):
+        rids.append(bat.submit(p, n, slo=slo))
+    outs = bat.run()
+    return bat, rids, outs
+
+
+def run_serve(spec, stop_check_timeout=None):
+    """Run the mixed-SLO serve workload with `spec` armed; report dict
+    with report["ok"] the pass verdict (fired + batch survived + every
+    non-shed output bit-exact vs fault-free + counters leak-free)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fault
+
+    model = _serve_model()
+    # fault-free reference (spec disarmed)
+    paddle.set_flags({"FLAGS_fault_injection": ""})
+    fault.reset()
+    _, ref_rids, ref_outs = _run_serve_workload(model)
+    ref = {rid: list(map(int, ref_outs[rid])) for rid in ref_rids}
+
+    paddle.set_flags({"FLAGS_fault_injection": spec})
+    if stop_check_timeout is not None:
+        paddle.set_flags(
+            {"FLAGS_stop_check_timeout": stop_check_timeout})
+    fault.reset()
+    try:
+        bat, rids, outs = _run_serve_workload(model)
+        fired = {k: v for k, v in fault.fired_counts().items() if v}
+    finally:
+        paddle.set_flags({"FLAGS_fault_injection": ""})
+        if stop_check_timeout is not None:
+            paddle.set_flags({"FLAGS_stop_check_timeout": 0})
+        fault.reset()
+    st = bat.stats()
+    shed = [rid for rid in rids if bat._finished[rid].shed]
+    survivors = [rid for rid in rids if rid not in shed]
+    mismatches = [rid for rid in survivors
+                  if list(map(int, outs[rid])) != ref[rid]]
+    # the no-leak accounting contract: every submitted id surfaced,
+    # terminal states partition the workload, requeued requests
+    # completed exactly once (dict keying by req_id enforces that),
+    # and tokens_produced counts only tokens that survive to outputs
+    # (a requeued request's pre-fault tokens were discarded)
+    accounting = (
+        sorted(outs) == sorted(rids)
+        and st["requests_submitted"] == len(rids)
+        and st["requests_submitted"] == st["requests_completed"]
+        + st["requests_shed"]
+        and st["requests_shed"] == len(shed)
+        and st["tokens_produced"] == sum(len(outs[r]) for r in rids))
+    ok = (bool(fired) and not mismatches and accounting
+          and st["requests_completed"] >= 1
+          and st["compiled_programs"] <= 2)
+    return {"spec": spec, "fired": fired,
+            "completed": st["requests_completed"],
+            "shed": st["requests_shed"],
+            "shed_by_class": st["shed_by_class"],
+            "requeues": st["requests_requeued"],
+            "deadline_misses": st["deadline_misses"],
+            "chunk_retries": st["chunk_retries"],
+            "hung_chunks": st["hung_chunks"],
+            "mismatches": mismatches, "accounting_ok": accounting,
+            "programs": st["compiled_programs"], "ok": ok}
+
+
+_DRAIN_WORKER = r'''
+import json, os, signal, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PADDLE_DRAIN_GRACE"] = "60"
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed import guard
+from paddle_tpu.distributed.launch.controller import ELASTIC_EXIT_CODE
+from paddle_tpu.inference import ContinuousBatcher
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+
+paddle.seed(11)
+cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                        intermediate_size=128, num_attention_heads=4,
+                        num_key_value_heads=2, vocab_size=128)
+model = LlamaForCausalLM(cfg)
+rng = np.random.RandomState(5)
+bat = ContinuousBatcher(model, max_batch_size=1, max_len=64, chunk=4,
+                        prefill_chunk=4)
+r1 = bat.submit(rng.randint(1, 128, 6).astype(np.int32), 8,
+                slo="interactive")
+r2 = bat.submit(rng.randint(1, 128, 5).astype(np.int32), 8, slo="batch")
+assert guard.install_sigterm_drain()
+bat.step()                                   # r1 in flight
+os.kill(os.getpid(), signal.SIGTERM)         # the preemption notice
+outs = bat.run()
+st = bat.stats()
+ok = (bat.drained
+      and bat._finished[r2].shed
+      and bat._finished[r2].shed_reason == "drain"
+      and len(outs[r1]) == 8                 # in-flight decode finished
+      and not bat._finished[r1].partial
+      and st["requests_submitted"]
+      == st["requests_completed"] + st["requests_shed"])
+print(json.dumps({"ok": bool(ok), "shed": st["requests_shed"],
+                  "completed": st["requests_completed"]}))
+sys.exit(ELASTIC_EXIT_CODE if ok else 1)
+'''
+
+
+def _serve_drain_check():
+    """SIGTERM drain e2e in a subprocess: queued requests shed, the
+    in-flight decode finishes, the process exits ELASTIC_EXIT_CODE."""
+    import subprocess
+    from paddle_tpu.distributed.launch.controller import ELASTIC_EXIT_CODE
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    env.pop("FLAGS_fault_injection", None)
+    p = subprocess.run([sys.executable, "-c", _DRAIN_WORKER],
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    detail = (p.stdout or "").strip().splitlines()
+    detail = detail[-1] if detail else p.stderr[-300:]
+    return p.returncode == ELASTIC_EXIT_CODE, detail
+
+
+def _serve_selftest():
+    """One planted fault per serve injection point + the drain e2e."""
+    checks = []
+
+    def record(name, fired, recovered, detail=""):
+        checks.append({"check": name, "fired": bool(fired),
+                       "recovered": bool(recovered), "detail": detail})
+
+    def run(name, spec, expect=None, **kw):
+        rep = run_serve(spec, **kw)
+        extra_ok = all(rep.get(k, 0) >= v for k, v in
+                       (expect or {}).items())
+        record(name, rep["fired"], rep["ok"] and extra_ok,
+               json.dumps({k: rep[k] for k in
+                           ("completed", "shed", "requeues",
+                            "chunk_retries", "hung_chunks",
+                            "mismatches")}))
+
+    run("serve.admit-error-retry", "serve.admit:step=2:mode=error")
+    run("serve.admit-reject-shed", "serve.admit:step=2:mode=skip",
+        expect={"shed": 1})
+    run("serve.kv_alloc-error-defer",
+        "serve.kv_alloc:step=2:mode=error")
+    run("serve.kv_alloc-exhausted-defer",
+        "serve.kv_alloc:step=1:mode=corrupt")
+    run("serve.chunk-error-retry", "serve.chunk:step=2:mode=error",
+        expect={"chunk_retries": 1})
+    run("serve.chunk-hung-watchdog",
+        "serve.chunk:step=2:mode=delay:secs=0.8",
+        expect={"hung_chunks": 1}, stop_check_timeout=0.05)
+    run("serve.decode-fault-requeue",
+        "serve.decode:step=3:mode=error", expect={"requeues": 1})
+    ok, detail = _serve_drain_check()
+    record("serve.drain-sigterm-elastic-exit", ok, ok, detail)
+    return checks
+
+
+# ---------------------------------------------------------------------------
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
@@ -252,10 +478,28 @@ def main(argv=None):
     ap.add_argument("--selftest", action="store_true",
                     help="plant one fault per injection point and "
                          "assert each fires and recovers")
+    ap.add_argument("--serve", action="store_true",
+                    help="exercise the SERVE plane (ContinuousBatcher "
+                         "under serve.* specs / the serve selftest) "
+                         "instead of the train loop")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
+    if args.serve and not (args.selftest or args.spec):
+        ap.error("--serve needs --spec or --selftest")
+    if args.serve and args.spec and not args.selftest:
+        rep = run_serve(args.spec)
+        if args.as_json:
+            print(json.dumps(rep, indent=2))
+        else:
+            verdict = "RECOVERED" if rep["ok"] else "FAILED"
+            print(f"{verdict}: spec {rep['spec']!r} fired "
+                  f"{rep['fired']}, completed={rep['completed']}, "
+                  f"shed={rep['shed']}, requeues={rep['requeues']}, "
+                  f"accounting_ok={rep['accounting_ok']}, "
+                  f"mismatches={rep['mismatches']}")
+        return 0 if rep["ok"] else 1
     if args.selftest:
-        checks = _selftest()
+        checks = _serve_selftest() if args.serve else _selftest()
         bad = [c for c in checks
                if not (c["fired"] and c["recovered"])]
         if args.as_json:

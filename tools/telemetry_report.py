@@ -13,9 +13,13 @@ tier-1, like tools/verify_program.py).
         CI canary: runs a 5-step toy train loop with a JSONL sink (and
         a compile cache dir) in a temp dir, validates the emitted
         schema (every step event carries wall_ms + fwd/bwd/opt phase
-        timings; compile.program events carry hit/miss), then renders
-        the report over it.  Exit 1 on any violation — a silently
-        empty telemetry plane is exactly the failure mode this guards.
+        timings; compile.program events carry hit/miss), THEN a tiny
+        serve workload that load-sheds (bounded queue) and misses a
+        deadline, validating the serve-robustness events
+        (serve.shed carries slo+reason, serve.deadline_miss fires)
+        and their report section.  Exit 1 on any violation — a
+        silently empty telemetry plane is exactly the failure mode
+        this guards.
 """
 from __future__ import annotations
 
@@ -140,6 +144,35 @@ def analyze(events, peak=None):
                 "evictions": last.get("evictions", 0),
                 "kv_bytes": last.get("kv_bytes", 0),
             }
+    # serve-robustness events (ISSUE 9: SLO shedding, deadline misses,
+    # faulted-slot requeues, hung chunks, drain) — reported whenever
+    # any occurred, even on a log with no serve.chunk events (a drain
+    # can fire before the first chunk)
+    shed = [e for e in events if e.get("event") == "serve.shed"]
+    rob = {
+        "shed": len(shed),
+        "shed_by_class": {},
+        "shed_by_reason": {},
+        "deadline_misses": sum(1 for e in events
+                               if e.get("event")
+                               == "serve.deadline_miss"),
+        "requeues": sum(1 for e in events
+                        if e.get("event") == "serve.requeue"),
+        "chunk_faults": sum(1 for e in events
+                            if e.get("event") == "serve.chunk_fault"),
+        "hung_chunks": sum(1 for e in events
+                           if e.get("event") == "serve.hung"),
+        "drains": sum(1 for e in events
+                      if e.get("event") == "serve.drain"
+                      and e.get("phase") == "begin"),
+    }
+    for e in shed:
+        for key, field in (("shed_by_class", "slo"),
+                           ("shed_by_reason", "reason")):
+            v = str(e.get(field))
+            rob[key][v] = rob[key].get(v, 0) + 1
+    if any(v for k, v in rob.items() if not k.startswith("shed_by")):
+        out.setdefault("serve", {})["robustness"] = rob
 
     io_steps = [e for e in events if e.get("event") == "io.step"]
     if io_steps:
@@ -183,10 +216,13 @@ def render(rep):
                      f"compile {c['compile_ms']}ms")
     if "serve" in rep:
         s = rep["serve"]
-        lines.append(f"serve       {s['chunks']} chunks, p50 "
-                     f"{s['chunk_ms_p50']}ms, prefill/decode "
-                     f"{s['prefill_tokens']}/{s['decode_tokens']}, "
-                     f"{s['recompiles']} recompiles")
+        if "chunks" in s:
+            lines.append(f"serve       {s['chunks']} chunks, p50 "
+                         f"{s['chunk_ms_p50']}ms, prefill/decode "
+                         f"{s['prefill_tokens']}/{s['decode_tokens']}, "
+                         f"{s['recompiles']} recompiles")
+        else:
+            lines.append("serve       (no chunk events)")
         if "kv" in s:
             k = s["kv"]
             lines.append(
@@ -195,6 +231,17 @@ def render(rep):
                 f"prefix hits {k['prefix_hit_tokens']} tok, "
                 f"{k['evictions']} evictions, "
                 f"{k['kv_bytes'] / 1e6:.1f}MB")
+        if "robustness" in s:
+            r = s["robustness"]
+            by_cls = ", ".join(f"{c}={n}" for c, n
+                               in sorted(r["shed_by_class"].items()))
+            lines.append(
+                f"  robust    shed {r['shed']}"
+                f"{' (' + by_cls + ')' if by_cls else ''}, "
+                f"deadline misses {r['deadline_misses']}, "
+                f"requeues {r['requeues']}, "
+                f"chunk faults {r['chunk_faults']}, "
+                f"hung {r['hung_chunks']}, drains {r['drains']}")
     if "io" in rep:
         i = rep["io"]
         lines.append(f"io          {i['steps']} gets, host wait p50 "
@@ -276,6 +323,67 @@ def _selftest():
         if "phases" not in rep or "step_ms" not in rep:
             problems.append(f"report missing phase stats: {rep}")
         print(render(rep))
+
+        # serve-robustness leg (ISSUE 9): a bounded queue + a dead
+        # deadline must surface as serve.shed / serve.deadline_miss
+        # events and a serve "robustness" report section
+        slog = os.path.join(d, "serve.jsonl")
+        from paddle_tpu import telemetry
+        import paddle_tpu as paddle
+        from paddle_tpu.framework.flags import set_flags as _sf
+        from paddle_tpu.inference import ContinuousBatcher
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(13)
+        cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=32,
+                                intermediate_size=64,
+                                num_attention_heads=2,
+                                num_key_value_heads=2, vocab_size=64)
+        model = LlamaForCausalLM(cfg)
+        rng = np.random.RandomState(2)
+        sink = telemetry.attach_jsonl(slog)
+        _sf({"FLAGS_serve_queue_depth": 2})
+        try:
+            bat = ContinuousBatcher(model, max_batch_size=1,
+                                    max_len=32, chunk=4,
+                                    prefill_chunk=4)
+            bat.submit(rng.randint(1, 64, 4).astype(np.int32), 4,
+                       slo="interactive")
+            # queued past its deadline -> deadline miss at the next
+            # boundary
+            bat.submit(rng.randint(1, 64, 5).astype(np.int32), 4,
+                       slo="batch", deadline_ms=0.001)
+            bat.submit(rng.randint(1, 64, 6).astype(np.int32), 4,
+                       slo="batch")
+            # queue already at depth 2 -> lowest-SLO newest sheds
+            bat.submit(rng.randint(1, 64, 4).astype(np.int32), 4,
+                       slo="best_effort")
+            bat.run()
+        finally:
+            _sf({"FLAGS_serve_queue_depth": 0})
+            telemetry.remove_sink(sink)
+        sevents = load_events(slog)
+        sheds = [e for e in sevents if e.get("event") == "serve.shed"]
+        if len(sheds) < 2:
+            problems.append(f"expected >=2 serve.shed events, got "
+                            f"{len(sheds)}")
+        for e in sheds:
+            for k in ("req", "slo", "reason"):
+                if k not in e:
+                    problems.append(f"serve.shed missing {k!r}: {e}")
+        if not any(e.get("event") == "serve.deadline_miss"
+                   for e in sevents):
+            problems.append("no serve.deadline_miss event emitted")
+        srep = analyze(sevents)
+        rob = srep.get("serve", {}).get("robustness")
+        if not rob:
+            problems.append(f"report missing serve robustness "
+                            f"section: {srep}")
+        elif rob["shed"] != len(sheds) \
+                or rob["deadline_misses"] < 1 \
+                or "best_effort" not in rob["shed_by_class"]:
+            problems.append(f"robustness section wrong: {rob}")
+        print(render(srep))
     return problems
 
 
