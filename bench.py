@@ -93,6 +93,28 @@ def _emit(metric, value, unit, vs_baseline, spread, vals, extra=None):
     print(json.dumps(rec), flush=True)
 
 
+def _peak_hbm_fields():
+    """Measured peak HBM of this config's step program(s) — XLA's own
+    `memory_analysis()` via the telemetry memory ledger (ISSUE 10),
+    replacing hand-derived peak claims.  Resolution may recompile the
+    step once (same cost class as the phase probes); BENCH_MEM=0
+    skips it."""
+    if os.environ.get("BENCH_MEM", "1") == "0":
+        return {}
+    try:
+        from paddle_tpu import telemetry
+        mem = telemetry.memory_report(top_buffers=0)
+        if mem["peak_hbm_bytes"]:
+            out = {"peak_hbm_bytes": int(mem["peak_hbm_bytes"])}
+            if mem["device_hbm_bytes"]:
+                out["peak_hbm_share"] = round(
+                    mem["peak_hbm_bytes"] / mem["device_hbm_bytes"], 3)
+            return out
+    except Exception:
+        pass
+    return {}
+
+
 def _phase_fields(model, step, batch, seq, n_params, label,
                   remat_flops=0.0):
     """fwd/bwd/opt phase decomposition (the PROFILE_r05 method, shared
@@ -253,12 +275,13 @@ def bench_llama(offload=False):
                  f"d2h={sb['d2h_bytes'] / 1e9:.2f}G/step, "
                  f"dma_share={min(dma_s / step_wall, 9.99):.2f}, "
                  f"prefetch_depth={sb['prefetch_depth']}")
-    extra = None
+    extra = {}
     if not requested_offload:
         extra = _phase_fields(model, step, batch, seq, n_params,
-                              "llama", recompute_per_tok)
+                              "llama", recompute_per_tok) or {}
+    extra.update(_peak_hbm_fields())
     _emit(name, tokens_per_sec, unit + ")", mfu / 0.40, spread, vals,
-          extra=extra)
+          extra=extra or None)
 
 
 def _timed_train_tokens(step, x, batch, seq, steps):
@@ -627,11 +650,15 @@ def bench_llama_serve():
     prompts = [rngm.randint(0, cfg.vocab_size, L).astype(np.int32)
                for L in lens]
     last_stats = {}
+    hold = []       # keep the last batcher alive: the memory ledger's
+    #                 serve providers are weakrefs (peak-HBM resolution
+    #                 at emit time needs a live batcher)
 
     def serve_once():
         bat = ContinuousBatcher(model, max_batch_size=batch,
                                 max_len=max_len, chunk=chunk,
                                 prefill_chunk=pchunk)
+        hold[:] = [bat]
         for p_ in prompts[:batch]:
             bat.submit(p_, n_new)
         t0 = time.perf_counter()
@@ -660,7 +687,11 @@ def bench_llama_serve():
           f"{st.get('kv_bytes', 0) / 1e6:.0f}MB",
           tok_s / max(roofline, 1e-9), spread, vals,
           extra={"kv_layout": st.get("kv_layout"),
-                 "kv_bytes": st.get("kv_bytes", 0)})
+                 "kv_bytes": st.get("kv_bytes", 0),
+                 # per-request latency spans (ISSUE 10): TTFT/TPOT/e2e
+                 # percentiles over the last rep's delivered requests
+                 "latency": st.get("latency"),
+                 **_peak_hbm_fields()})
 
 
 def bench_llama_serve_prefix_shared():
@@ -696,11 +727,14 @@ def bench_llama_serve_prefix_shared():
     total_prompt = sum(len(p) for p in prompts)
     last_stats = {}
 
+    hold = []       # liveness for the ledger's weakref'd serve providers
+
     def serve_once(layout="paged", sharing=True):
         bat = ContinuousBatcher(model, max_batch_size=batch,
                                 max_len=max_len, chunk=chunk,
                                 prefill_chunk=pchunk, kv_layout=layout,
                                 page_size=ps, prefix_sharing=sharing)
+        hold[:] = [bat]
         for p_ in prompts[:batch]:
             bat.submit(p_, n_new)
         t0 = time.perf_counter()
@@ -717,6 +751,9 @@ def bench_llama_serve_prefix_shared():
     serve_once("dense")                            # compile dense
     tok_s, spread, vals = _measure(serve_once)
     st = dict(last_stats)
+    # resolve peak-HBM NOW, while the ledger's serve entries still
+    # describe the PAGED batcher (the dense reps below re-register)
+    peak_fields = _peak_hbm_fields()
     dense_tok = _measure(lambda: serve_once("dense"))[0]
     st_dense = dict(last_stats)
     hit_rate = st["prefix_hit_tokens"] / max(total_prompt, 1)
@@ -751,7 +788,8 @@ def bench_llama_serve_prefix_shared():
                  "kv_bytes_bf16": int(kv_full),
                  "evictions": int(st.get("evictions", 0)),
                  "vs_dense": round(tok_s / max(dense_tok, 1e-9), 3),
-                 "dense_tokens_per_sec": round(dense_tok, 1)})
+                 "dense_tokens_per_sec": round(dense_tok, 1),
+                 **peak_fields})
 
 
 def bench_serve_all():
@@ -971,19 +1009,27 @@ def _assert_telemetry_zero_overhead():
     with tempfile.TemporaryDirectory() as d:
         import os as _os
         sink = telemetry.attach_jsonl(_os.path.join(d, "s.jsonl"))
+        # arm the WHOLE observability surface at once: sink + compile
+        # cache + fleet identity + straggler detector flag — the r11
+        # byte-identical contract extends to the ISSUE 10 fleet plane
+        # (rank tagging, memory-ledger registration, fleet flags are
+        # all host-side)
+        telemetry.set_rank(0, 2)
         set_flags({"FLAGS_compile_cache_dir":
-                   _os.path.join(d, "cache")})
+                   _os.path.join(d, "cache"),
+                   "FLAGS_straggler_skew_ms": 50.0})
         try:
             step, x, hlo_armed = build_hlo()
             step(x, x)                      # exercise the armed path
         finally:
-            set_flags({"FLAGS_compile_cache_dir": ""})
+            set_flags({"FLAGS_compile_cache_dir": "",
+                       "FLAGS_straggler_skew_ms": 0.0})
             telemetry.disable_persistent_cache()
             telemetry.remove_sink(sink)
     _, _, hlo_off2 = build_hlo()
     assert hlo_off == hlo_armed == hlo_off2, \
-        "telemetry sink / compile-cache arming changed the train-step " \
-        "program"
+        "telemetry sink / compile-cache / fleet arming changed the " \
+        "train-step program"
     # scrub the assert's own footprint (steps/compile records from the
     # tiny MLP) so the telemetry snapshot embedded in this config's
     # metric lines reflects ONLY the config's run

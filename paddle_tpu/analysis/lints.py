@@ -37,7 +37,7 @@ from .base import Finding, RecompileError
 
 __all__ = ["iter_eqns", "lint_dtype_promotion", "lint_transfers",
            "lint_donation", "lint_materialized_logits",
-           "lint_compiled_step", "recompile_guard",
+           "lint_peak_hbm", "lint_compiled_step", "recompile_guard",
            "note_program_build"]
 
 
@@ -326,6 +326,62 @@ def lint_materialized_logits(fn_or_jaxpr, *args, vocab_size: int,
                     f"logits the fused cross-entropy path avoids",
                     op_index=i,
                     detail=(eqn.primitive.name, shape)))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# peak-HBM budget lint
+
+def lint_peak_hbm(compiled=None, *, budget_bytes: Optional[int] = None,
+                  label: str = "<program>") -> List[Finding]:
+    """Findings for programs whose XLA-reported peak HBM (arguments +
+    outputs + temps − aliased, from `compiled.memory_analysis()`)
+    exceeds `budget_bytes` — the measured replacement for hand-derived
+    peak-memory claims (SCALE_r05/PROFILE_r05).
+
+    Two modes:
+      * `compiled` given (a jax Compiled, or a Lowered — compiled
+        here): lint that one executable;
+      * `compiled=None`: lint every program in the telemetry memory
+        ledger (`telemetry.memledger`), resolving pending providers —
+        the whole-process audit `tools/fleet_report.py` renders.
+
+    `budget_bytes=None` reads the device's own reported capacity
+    (TPU memory_stats bytes_limit); with neither available the lint
+    has no budget to enforce and returns [].
+    """
+    from ..telemetry import memledger
+    if budget_bytes is None:
+        budget_bytes = memledger.device_hbm_bytes()
+    if not budget_bytes:
+        return []
+    budget_bytes = int(budget_bytes)
+
+    def judge(lbl, peak, detail) -> Optional[Finding]:
+        if peak <= budget_bytes:
+            return None
+        return Finding(
+            "peak-hbm-over-budget",
+            f"program {lbl!r} peaks at {peak / 1e9:.3f} GB — over the "
+            f"{budget_bytes / 1e9:.3f} GB budget by "
+            f"{(peak - budget_bytes) / 1e9:.3f} GB",
+            detail=detail)
+
+    findings: List[Finding] = []
+    if compiled is not None:
+        if not hasattr(compiled, "memory_analysis") \
+                and hasattr(compiled, "compile"):
+            compiled = compiled.compile()       # accept a Lowered
+        stats = memledger._stats_from(compiled)
+        f = judge(label, stats["peak_bytes"], (label, stats))
+        return [f] if f else []
+    rep = memledger.memory_report(resolve=True, top_buffers=0)
+    for lbl, rec in rep["programs"].items():
+        if rec.get("status") != "ok":
+            continue
+        f = judge(lbl, rec["peak_bytes"], (lbl, rec))
+        if f:
+            findings.append(f)
     return findings
 
 

@@ -37,6 +37,14 @@ def init_parallel_env(*args, **kwargs):
         addr = master if ":" in master else f"{master}:{port or 12355}"
         jax.distributed.initialize(coordinator_address=addr,
                                    num_processes=nproc, process_id=rank)
+    # stamp the fleet identity onto the telemetry bus: from here on
+    # every event (trainers, watchdog, fault registry, checkpoints,
+    # serving) carries (rank, world) — single process stays rank 0
+    try:
+        from .. import telemetry
+        telemetry.set_rank(rank, nproc)
+    except Exception:
+        pass            # telemetry must never break rendezvous
     _initialized = True
     return ParallelEnv()
 

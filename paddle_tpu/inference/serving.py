@@ -129,6 +129,14 @@ class Request:
     requeues: int = 0                  # faulted-slot re-admissions
     admit_faults: int = 0              # injected admission-fault retries
     partial: bool = False              # drain-flushed mid-generation
+    # -- per-request latency spans (ISSUE 10): monotonic stamps at the
+    # queue -> admit -> first-token -> finish boundaries; TTFT/e2e are
+    # measured from SUBMIT (a requeue resets admit/first, so the spans
+    # describe the decode that actually served the user)
+    t_submit: float = 0.0
+    t_admit: Optional[float] = None
+    t_first: Optional[float] = None
+    t_done: Optional[float] = None
 
     def output(self) -> np.ndarray:
         return np.asarray(self.tokens[: self.max_new_tokens], np.int32)
@@ -261,6 +269,14 @@ class ContinuousBatcher:
         # otherwise grow per-chunk lists forever); p50 is over the
         # window, max/counts/occupancy over the whole lifetime
         self._chunk_times: deque = deque(maxlen=1024)
+        # per-request latency windows (bounded, same discipline as the
+        # chunk times) + per-SLO-class deadline attainment — host
+        # aggregates that always accumulate so stats() answers sink-less
+        self._lat: Dict[str, deque] = {
+            k: deque(maxlen=1024)
+            for k in ("queue_ms", "ttft_ms", "tpot_ms", "e2e_ms")}
+        self._slo_lat = {c: {"completed": 0, "with_deadline": 0,
+                             "deadline_met": 0} for c in SLO_CLASSES}
         self._chunk_count = 0
         self._chunk_kind_counts = {"admit": 0, "decode": 0}
         self._chunk_time_max = 0.0
@@ -269,6 +285,26 @@ class ContinuousBatcher:
         self._decode_tok_total = 0
         self._programs_used: set = set()
         self._first_use = False
+        # HBM memory ledger (ISSUE 10): register both step programs as
+        # lazy providers — lower_step is the side-effect-free probe, so
+        # nothing compiles until telemetry.memory_report() asks; the
+        # weakref keeps the ledger from pinning a dead batcher (and
+        # its KV pool) alive
+        import weakref
+        from ..telemetry import memledger as _ml
+        _ref = weakref.ref(self)
+        _meta = {"kv_layout": self.kv_layout, "slots": self.B,
+                 "max_len": self.max_len}
+
+        def _provider(mixed):
+            def provider():
+                bat = _ref()
+                if bat is None:
+                    raise RuntimeError("batcher was garbage-collected")
+                return bat.lower_step(mixed=mixed).compile()
+            return provider
+        _ml.register("serve_step.decode", _provider(False), meta=_meta)
+        _ml.register("serve_step.admit", _provider(True), meta=_meta)
 
     # -- pool geometry -----------------------------------------------------
     @staticmethod
@@ -350,6 +386,7 @@ class ContinuousBatcher:
         self._next_id += 1
         req = Request(rid, ids, int(max_new_tokens), slo=slo,
                       arrival=self._arrival_seq)
+        req.t_submit = self._now()
         self._arrival_seq += 1
         if deadline_ms is None:
             deadline_ms = float(get_flag("serve_default_deadline_ms")
@@ -540,6 +577,10 @@ class ContinuousBatcher:
         req = self._slots[i]
         self._clear_slot(i)
         req.tokens.clear()
+        # the re-decode re-serves the request from scratch: its spans
+        # must describe the decode the user actually received
+        req.t_admit = None
+        req.t_first = None
         req.requeues += 1
         budget = int(get_flag("serve_retry_budget") or 3)
         if (req.deadline is not None and self._now() > req.deadline) \
@@ -547,6 +588,65 @@ class ContinuousBatcher:
             self._shed(req, reason)
         else:
             self._requeue(req)
+
+    def _finish_spans(self, req: Request):
+        """Close a DELIVERED request's latency spans: stamp t_done,
+        fold queue/TTFT/TPOT/e2e into the bounded stats windows and
+        the per-SLO attainment counters, and publish one
+        `serve.request` event (sink-gated; the host aggregates always
+        accumulate so stats() answers sink-less).  Shed requests never
+        come through here — no service, no latency sample."""
+        now = self._now()
+        req.t_done = now
+        queue_ms = ((req.t_admit if req.t_admit is not None else now)
+                    - req.t_submit) * 1e3
+        e2e_ms = (now - req.t_submit) * 1e3
+        n = min(len(req.tokens), req.max_new_tokens)
+        # TTFT/TPOT only exist once a first token did: a drain-flushed
+        # request that never produced one must not shift the TTFT
+        # percentiles with a no-token wait
+        ttft_ms = None
+        tpot_ms = None
+        if req.t_first is not None:
+            ttft_ms = (req.t_first - req.t_submit) * 1e3
+            if n > 1:
+                # chunked decode emits tokens in bursts, so per-request
+                # TPOT is the honest average over the decode window,
+                # not a per-token measurement
+                tpot_ms = (now - req.t_first) * 1e3 / (n - 1)
+        self._lat["queue_ms"].append(queue_ms)
+        self._lat["e2e_ms"].append(e2e_ms)
+        if ttft_ms is not None:
+            self._lat["ttft_ms"].append(ttft_ms)
+        if tpot_ms is not None:
+            self._lat["tpot_ms"].append(tpot_ms)
+        slo = self._slo_lat[req.slo]
+        slo["completed"] += 1
+        met = None
+        if req.deadline is not None:
+            slo["with_deadline"] += 1
+            met = (req.t_admit is not None
+                   and req.t_admit <= req.deadline)
+            if met:
+                slo["deadline_met"] += 1
+        from .. import telemetry as _tel
+        if _tel.active():
+            fields = dict(req=req.req_id, slo=req.slo, tokens=n,
+                          queue_ms=round(queue_ms, 3),
+                          e2e_ms=round(e2e_ms, 3),
+                          requeues=req.requeues, partial=req.partial)
+            if ttft_ms is not None:
+                fields["ttft_ms"] = round(ttft_ms, 3)
+            if tpot_ms is not None:
+                fields["tpot_ms"] = round(tpot_ms, 3)
+            if met is not None:
+                fields["deadline_met"] = met
+            _tel.emit("serve.request", fields)
+            _tel.histogram("serve.e2e_ms").observe(e2e_ms)
+            if ttft_ms is not None:
+                _tel.histogram("serve.ttft_ms").observe(ttft_ms)
+            if tpot_ms is not None:
+                _tel.histogram("serve.tpot_ms").observe(tpot_ms)
 
     def _begin_drain(self):
         """SIGTERM arrived: close admissions (queued requests shed with
@@ -580,6 +680,7 @@ class ContinuousBatcher:
             req.partial = True
             self._finished[req.req_id] = req
             self._completed += 1
+            self._finish_spans(req)
             flushed += 1
         from .. import telemetry as _tel
         if _tel.active():
@@ -666,6 +767,35 @@ class ContinuousBatcher:
             "queued": self._queued_count(),
             "drained": self._draining,
         }
+        # per-request latency spans (ISSUE 10): queue->admit->first-
+        # token->finish percentiles over the last 1024 delivered
+        # requests, and per-SLO-class deadline attainment
+        from ..telemetry import percentiles_of
+        latency = {}
+        for k, window in self._lat.items():
+            vals = list(window)
+            pct = percentiles_of(vals)
+            latency[k] = {"count": len(vals),
+                          "p50": round(pct["p50"], 3),
+                          "p90": round(pct["p90"], 3),
+                          "p99": round(pct["p99"], 3)}
+        out["latency"] = latency
+        attain = {}
+        for cls in SLO_CLASSES:
+            rec = dict(self._slo_lat[cls])
+            rec["shed"] = self._shed_by_class[cls]
+            if rec["with_deadline"]:
+                # deadline-bearing traffic: admitted in time / deadlined
+                rec["attainment"] = round(
+                    rec["deadline_met"] / rec["with_deadline"], 4)
+            elif rec["completed"] or rec["shed"]:
+                # best-effort notion for deadline-free traffic: the
+                # served fraction
+                rec["attainment"] = round(
+                    rec["completed"] / (rec["completed"] + rec["shed"]),
+                    4)
+            attain[cls] = rec
+        out["slo_attainment"] = attain
         if self.kv_layout == "paged":
             out.update(
                 kv_page_size=self.page_size,
@@ -702,6 +832,7 @@ class ContinuousBatcher:
                 req.finished = True
                 self._finished[req.req_id] = req
                 self._completed += 1
+                self._finish_spans(req)
                 # _clear_slot unmaps the slot's pages (prompt pages
                 # stay resident as cached prefix pages) and points the
                 # freed slot at the null page — a free slot's junk
@@ -806,6 +937,7 @@ class ContinuousBatcher:
                 i = free.pop(0)
                 self._admissions += 1
                 self._slots[i] = req
+                req.t_admit = self._now()   # re-stamped on re-admission
                 buf = np.zeros((self.max_len,), np.int32)
                 buf[: len(req.prompt)] = req.prompt
                 self._prompts = self._prompts.at[i].set(
@@ -1147,7 +1279,11 @@ class ContinuousBatcher:
                           .prefix_hit_tokens,
                           evictions=self._alloc.evictions,
                           kv_bytes=self.kv_cache_bytes())
+        t_harvest = self._now()
         for i, req in enumerate(self._slots):
             if req is None:
                 continue
             req.tokens.extend(int(t) for t in toks[i] if t >= 0)
+            if req.t_first is None and req.tokens:
+                req.t_first = t_harvest
+

@@ -564,6 +564,7 @@ class DevicePrefetcher:
             # producer's (serve.chunk_ms, train.step_ms); lifetime
             # wait totals are always in stats()
             _tel.histogram("io.host_wait_ms").observe(wait_ms)
+            _tel.gauge("io.host_wait_ms").set(wait_ms)
             _tel.emit("io.step", host_wait_ms=round(wait_ms, 3),
                       buffered=self._q.qsize(), cold=cold,
                       step=self._steps)

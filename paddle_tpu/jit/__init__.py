@@ -402,7 +402,11 @@ class TrainStep:
         key = prandom.next_key()
         args = (param_vals, self._opt_states, buf_vals, lrs, step0, key,
                 *batch_vals)
-        from ..telemetry import compile_cache as _cc
+        from ..telemetry import compile_cache as _cc, memledger as _ml
+        # ledger registration BEFORE aot_for: an armed AOT compile then
+        # overwrites the pending provider with free measured stats
+        _ml.note_jit(self, "multi", self._compiled_multi, args,
+                     "jit.TrainStep.multi")
         fn = _cc.aot_for(self._aot, "multi", self._compiled_multi, args,
                          batch_vals, "jit.TrainStep.multi")
         from .. import telemetry as _tel
@@ -482,7 +486,9 @@ class TrainStep:
                 jnp.asarray(lr, jnp.float32),
                 jnp.asarray(self.optimizer._step_count, jnp.int32), key,
                 *batch_vals)
-        from ..telemetry import compile_cache as _cc
+        from ..telemetry import compile_cache as _cc, memledger as _ml
+        _ml.note_jit(self, "step", self._compiled, args,
+                     "jit.TrainStep.step")
         fn = _cc.aot_for(self._aot, "step", self._compiled, args,
                          batch_vals, "jit.TrainStep.step")
         from .. import telemetry as _tel

@@ -758,6 +758,14 @@ class OffloadPipelineStep:
             else lr_override
         key = prandom.next_key()
         from .. import telemetry as _tel
+        from ..telemetry import memledger as _ml
+        _ml.note_jit(self, "step", self._compiled,
+                     (tail_vals, self._tail_states, self._stk_param,
+                      self._stk_wire, self._stk_state,
+                      jnp.asarray(lr, jnp.float32),
+                      jnp.asarray(self.optimizer._step_count, jnp.int32),
+                      key, batch_vals),
+                     "OffloadPipelineStep.step", mesh=self.mesh)
         _tel.counter("train.steps").inc()    # lifetime total, sink or not
         tel_on = _tel.active()
         t0 = time.perf_counter()

@@ -748,7 +748,12 @@ class ShardedTrainStep:
         from ..distributed.watchdog import watched
         args = (param_vals, self._states_for_call(), buf_vals, lrs,
                 step0, key, stacked)
-        from ..telemetry import compile_cache as _cc
+        from ..telemetry import compile_cache as _cc, memledger as _ml
+        # ledger registration BEFORE aot_for: an armed AOT compile then
+        # overwrites the pending provider with free measured stats
+        _ml.note_jit(self, "multi", self._compiled_multi, args,
+                     f"ShardedTrainStep.multi.s{self.stage}",
+                     mesh=self.mesh)
         fn = _cc.aot_for(self._aot, "multi", self._compiled_multi, args,
                          stacked, f"ShardedTrainStep.multi.s{self.stage}",
                          mesh=self.mesh)
@@ -870,7 +875,10 @@ class ShardedTrainStep:
                 jnp.asarray(lr, jnp.float32),
                 jnp.asarray(self.optimizer._step_count, jnp.int32), key,
                 batch_vals)
-        from ..telemetry import compile_cache as _cc
+        from ..telemetry import compile_cache as _cc, memledger as _ml
+        _ml.note_jit(self, "step", self._compiled, args,
+                     f"ShardedTrainStep.step.s{self.stage}",
+                     mesh=self.mesh)
         fn = _cc.aot_for(self._aot, "step", self._compiled, args,
                          batch_vals, f"ShardedTrainStep.step.s{self.stage}",
                          mesh=self.mesh)

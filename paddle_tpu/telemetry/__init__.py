@@ -36,34 +36,60 @@ from __future__ import annotations
 from .registry import (MetricsRegistry, Counter, Gauge, Histogram,  # noqa: F401
                        registry, counter, gauge, histogram,
                        add_sink, remove_sink, sinks, active, emit, span,
-                       configure, config, reset)
+                       configure, config, reset as _registry_reset,
+                       set_rank, rank_info, percentile_of,
+                       percentiles_of)
 from .exporters import (JsonlSink, ChromeTraceSink, MemorySink,  # noqa: F401
-                        attach_jsonl, attach_chrome_trace)
+                        attach_jsonl, attach_chrome_trace, chrome_event)
 from .compile_cache import (cache_dir, maybe_enable_persistent_cache,  # noqa: F401
                             disable_persistent_cache, aot_compile,
                             compile_report, clear_report)
 from . import probe  # noqa: F401
+from . import memledger  # noqa: F401
+from .memledger import memory_report  # noqa: F401
+from . import fleet  # noqa: F401
 
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
            "registry", "counter", "gauge", "histogram",
            "add_sink", "remove_sink", "sinks", "active", "emit", "span",
            "configure", "config", "reset",
+           "set_rank", "rank_info", "percentile_of", "percentiles_of",
            "JsonlSink", "ChromeTraceSink", "MemorySink",
-           "attach_jsonl", "attach_chrome_trace",
+           "attach_jsonl", "attach_chrome_trace", "chrome_event",
            "cache_dir", "maybe_enable_persistent_cache",
            "disable_persistent_cache", "aot_compile", "compile_report",
-           "clear_report", "probe", "dump", "step_event"]
+           "clear_report", "probe", "memledger", "memory_report",
+           "fleet", "dump", "step_event"]
+
+
+def reset():
+    """Detach every sink, clear registry/config/rank AND the memory
+    ledger — the whole plane back to pristine (test isolation)."""
+    _registry_reset()
+    memledger.reset()
 
 
 def dump(compact: bool = False) -> dict:
-    """One snapshot of the whole plane: registry instruments + the
-    compile report.  `compact` trims the per-program compile records to
-    totals (what bench.py embeds per JSON line)."""
+    """One snapshot of the whole plane: registry instruments, the
+    compile report, the fleet identity and the (already-resolved)
+    memory ledger.  `compact` trims the per-program compile records to
+    totals (what bench.py embeds per JSON line).  Never compiles —
+    pending ledger entries stay pending (memory_report() resolves)."""
     out = registry().dump()
     rep = compile_report()
     if compact:
         rep = {k: v for k, v in rep.items() if k != "programs"}
     out["compile"] = rep
+    info = rank_info()
+    if info is not None:
+        out["rank"] = {"rank": info[0], "world": info[1]}
+    mem = memledger.snapshot()
+    if mem["programs"]:
+        out["memory"] = mem if not compact else {
+            "programs": len(mem["programs"]),
+            "peak_hbm_bytes": mem["peak_hbm_bytes"],
+            "device_hbm_bytes": mem["device_hbm_bytes"],
+        }
     return out
 
 

@@ -209,6 +209,14 @@ def aot_compile(jitfn, args: tuple, label: str):
         t0 = time.perf_counter()
         compiled = lowered.compile()
         compile_ms = (time.perf_counter() - t0) * 1e3
+        # the executable is in hand — its HBM accounting is free here
+        # (the memory ledger's lazy providers exist for the plain-jit
+        # path, which never surfaces a Compiled)
+        try:
+            from . import memledger
+            memledger.capture(label, compiled)
+        except Exception:
+            pass
         try:
             from jax.experimental import serialize_executable as se
             payload = pickle.dumps(se.serialize(compiled), protocol=4)

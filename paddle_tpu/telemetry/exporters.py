@@ -9,6 +9,7 @@ state machine.
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
@@ -17,14 +18,19 @@ from typing import IO, List, Optional, Union
 from .registry import add_sink
 
 __all__ = ["JsonlSink", "ChromeTraceSink", "MemorySink",
-           "attach_jsonl", "attach_chrome_trace"]
+           "attach_jsonl", "attach_chrome_trace", "chrome_event"]
 
 
 class JsonlSink:
     """One JSON object per line per event — the fleet step log.  Each
     record is written (and flushed by default) as it arrives, so a
     preempted worker's log is complete up to its last event — the same
-    torn-tail discipline as the checkpoint runtime."""
+    torn-tail discipline as the checkpoint runtime.
+
+    An `atexit` hook flushes whatever a `flush_every > 1` batch still
+    buffers, so a SIGTERM drain (sys.exit path) or an uncaught crash
+    loses nothing the process ever emitted — only a hard `os._exit`
+    (mode=kill preemption) can truncate the tail."""
 
     def __init__(self, path_or_file: Union[str, IO], flush_every: int = 1):
         if hasattr(path_or_file, "write"):
@@ -41,6 +47,8 @@ class JsonlSink:
         self._flush_every = max(1, int(flush_every))
         self._n = 0
         self._lock = threading.Lock()
+        self._closed = False
+        atexit.register(self._drain_flush)
 
     def record(self, rec: dict):
         line = json.dumps(rec, default=_jsonable)
@@ -54,8 +62,19 @@ class JsonlSink:
         with self._lock:
             self._f.flush()
 
+    def _drain_flush(self):
+        # interpreter-exit path: never raise (the file may already be
+        # gone), never double-close
+        try:
+            if not self._closed:
+                self.flush()
+        except Exception:
+            pass
+
     def close(self):
+        atexit.unregister(self._drain_flush)
         with self._lock:
+            self._closed = True
             try:
                 self._f.flush()
             finally:
@@ -77,31 +96,46 @@ def _jsonable(x):
     return str(x)
 
 
+def chrome_event(rec: dict, pid: Optional[int] = None,
+                 tid: Optional[int] = None) -> dict:
+    """One telemetry record → one chrome-trace event: ``dur_ms`` makes
+    a complete ('X') slice ending at the record's ts, anything else an
+    instant ('i').  THE conversion both the live ChromeTraceSink and
+    the offline per-rank log merge (telemetry.fleet) share — the lane
+    identity (pid) is the caller's choice: process id live, RANK in a
+    merged fleet trace."""
+    ts_us = rec.get("ts", 0.0) * 1e6
+    name = rec.get("event", "event")
+    pid = os.getpid() if pid is None else pid
+    tid = threading.get_ident() if tid is None else tid
+    args = {k: v for k, v in rec.items() if k not in ("ts", "event")}
+    if "dur_ms" in rec:
+        dur_us = float(rec["dur_ms"]) * 1e3
+        return {"name": name, "ph": "X", "pid": pid, "tid": tid,
+                "ts": ts_us - dur_us, "dur": dur_us, "args": args}
+    return {"name": name, "ph": "i", "s": "p", "pid": pid,
+            "tid": tid, "ts": ts_us, "args": args}
+
+
 class ChromeTraceSink:
     """Collect events as a chrome://tracing / Perfetto timeline.
 
     Events carrying ``dur_ms`` become complete ('X') slices; everything
     else becomes an instant ('i') event.  ``save(path)`` (or close, when
-    constructed with a path) writes the `{"traceEvents": [...]}` doc."""
+    constructed with a path) writes the `{"traceEvents": [...]}` doc.
+    Constructed with a path, an `atexit` hook saves it too, so a drain
+    or crash exit still leaves the timeline on disk."""
 
     def __init__(self, path: Optional[str] = None):
         self.path = path
         self.trace_events: List[dict] = []
         self._lock = threading.Lock()
+        self._closed = False
+        if path is not None:
+            atexit.register(self._drain_save)
 
     def record(self, rec: dict):
-        ts_us = rec.get("ts", 0.0) * 1e6
-        name = rec.get("event", "event")
-        pid = os.getpid()
-        tid = threading.get_ident()
-        args = {k: v for k, v in rec.items() if k not in ("ts", "event")}
-        if "dur_ms" in rec:
-            dur_us = float(rec["dur_ms"]) * 1e3
-            ev = {"name": name, "ph": "X", "pid": pid, "tid": tid,
-                  "ts": ts_us - dur_us, "dur": dur_us, "args": args}
-        else:
-            ev = {"name": name, "ph": "i", "s": "p", "pid": pid,
-                  "tid": tid, "ts": ts_us, "args": args}
+        ev = chrome_event(rec)
         with self._lock:
             self.trace_events.append(ev)
 
@@ -119,8 +153,17 @@ class ChromeTraceSink:
             json.dump(doc, f, default=_jsonable)
         return path
 
+    def _drain_save(self):
+        try:
+            if not self._closed and self.path is not None:
+                self.save()
+        except Exception:
+            pass
+
     def close(self):
         if self.path is not None:
+            atexit.unregister(self._drain_save)
+            self._closed = True
             self.save()
 
 

@@ -1,0 +1,228 @@
+"""HBM memory ledger — per-program byte accounting from XLA's own
+`compiled.memory_analysis()` (ROADMAP item 5c: the SCALE/PROFILE peak-
+HBM numbers were hand-derived because nothing in the repo ever asked
+XLA; now every trainer and the serve step registers its program here
+and `telemetry.memory_report()` answers with measured bytes).
+
+Cost model (the plane's usual contract):
+
+  * Trainers REGISTER a provider at first call — one `seen`-set check
+    per step, one aval-ization (ShapeDtypeStructs, no live buffers
+    pinned) on the first.  Registration never lowers, never compiles,
+    never touches the step program (bench.py's byte-identical-HLO
+    assert covers the armed plane).
+  * RESOLUTION is lazy and explicit: `memory_report()` (or
+    `analysis.lint_peak_hbm`) lowers+compiles each pending provider
+    once and caches the stats — the cost is paid exactly when someone
+    asks for the numbers, the way tools/profile_mfu pays for its phase
+    probes.  The AOT path (FLAGS_compile_cache_dir) captures stats for
+    free at its own `.lower()`/compile.
+  * Labels are a small fixed space ("jit.TrainStep.step",
+    "ShardedTrainStep.step", "serve_step.decode", ...): a new trainer
+    REPLACES its label's entry, so a long test suite or notebook never
+    grows the ledger past the program zoo's size.
+
+Report shape (per program): argument/output/temp/alias/generated-code
+bytes straight from CompiledMemoryStats, plus ``peak_bytes`` =
+arguments + outputs + temps − aliased (donated buffers counted once —
+the number to hold against device HBM) and its share of the device's
+reported capacity when the backend exposes one.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["register", "note_jit", "capture", "memory_report",
+           "snapshot", "device_hbm_bytes", "reset"]
+
+_lock = threading.Lock()
+_programs: Dict[str, dict] = {}     # label -> entry (insertion-ordered)
+
+
+def _stats_from(compiled) -> dict:
+    """CompiledMemoryStats -> plain byte dict (+ derived peak)."""
+    ma = compiled.memory_analysis()
+    stats = {
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+    }
+    stats["peak_bytes"] = max(
+        0, stats["argument_bytes"] + stats["output_bytes"]
+        + stats["temp_bytes"] - stats["alias_bytes"])
+    return stats
+
+
+def register(label: str, provider: Callable[[], Any], meta:
+             Optional[dict] = None):
+    """Register a pending program under `label`; `provider()` must
+    return a jax Compiled (anything with `.memory_analysis()`) when the
+    ledger resolves.  Same label replaces — the ledger tracks the
+    CURRENT program per label, not history."""
+    with _lock:
+        _programs[label] = {"label": label, "status": "pending",
+                            "provider": provider,
+                            "meta": dict(meta or {})}
+
+
+def note_jit(owner, kind: str, jitfn, args: tuple, label: str,
+             mesh=None):
+    """The trainers' one-line hook: on the first call of `kind` for
+    this `owner`, aval-ize `args` (ShapeDtypeStructs — the ledger must
+    not pin donated buffers) and register a provider that re-lowers the
+    jitted step for those avals on demand.  Subsequent calls are one
+    set lookup."""
+    seen = owner.__dict__.setdefault("_memledger_seen", set())
+    if kind in seen:
+        return
+    seen.add(kind)
+    import jax
+    try:
+        # carry each argument's sharding AND memory kind: a host-
+        # offloaded trainer's pinned_host stacks must lower exactly as
+        # placed, or the analysis counts them as device HBM
+        avals = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(
+                a.shape, a.dtype,
+                sharding=getattr(a, "sharding", None)), args)
+    except Exception:
+        return                      # odd leaf: skip, never break a step
+
+    def provider():
+        import contextlib
+        ctx = mesh if mesh is not None else contextlib.nullcontext()
+        with ctx:
+            return jitfn.lower(*avals).compile()
+    register(label, provider)
+
+
+def capture(label: str, compiled, meta: Optional[dict] = None):
+    """Record stats from an ALREADY-compiled executable (the AOT path
+    has one in hand at `.lower()` time — its memory accounting is
+    free).  Failures record an error entry rather than raising."""
+    try:
+        stats = _stats_from(compiled)
+    except Exception as e:          # noqa: BLE001
+        with _lock:
+            _programs[label] = {"label": label, "status": "error",
+                                "error": f"{type(e).__name__}: {e}",
+                                "meta": dict(meta or {})}
+        return None
+    entry = {"label": label, "status": "ok", "meta": dict(meta or {}),
+             **stats}
+    with _lock:
+        _programs[label] = entry
+    _publish(entry)
+    return entry
+
+
+def _publish(entry: dict):
+    """mem.program event + counter — so a fleet JSONL log carries the
+    ledger and fleet_report can render a memory section offline."""
+    from .registry import counter as _counter, emit as _emit
+    _counter("mem.programs").inc()
+    _emit("mem.program",
+          {k: v for k, v in entry.items() if k != "provider"})
+
+
+def _resolve(entry: dict) -> dict:
+    with _lock:
+        # claim the provider atomically: two concurrent reports must
+        # not both compile (or leave the loser seeing half a record)
+        provider = entry.pop("provider", None)
+    if provider is None:
+        return entry
+    try:
+        stats = _stats_from(provider())
+    except Exception as e:          # noqa: BLE001
+        entry["status"] = "error"
+        entry["error"] = f"{type(e).__name__}: {e}"
+        return entry
+    entry.update(stats)
+    entry["status"] = "ok"
+    _publish(entry)
+    return entry
+
+
+def device_hbm_bytes() -> Optional[int]:
+    """The device's reported memory capacity (TPU: memory_stats
+    bytes_limit), or None when the backend doesn't say (CPU)."""
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats()
+        if stats:
+            limit = stats.get("bytes_limit")
+            if limit:
+                return int(limit)
+    except Exception:
+        pass
+    return None
+
+
+def _live_buffers(top: int = 10) -> List[dict]:
+    """Top live device allocations grouped by (shape, dtype) — the
+    census a peak-HBM post-mortem wants next to the per-program plan
+    (same source as the watchdog's hang report)."""
+    if top <= 0:
+        return []                   # dump()/bench ask for none: free
+    try:
+        import jax
+        arrs = jax.live_arrays()
+    except Exception:
+        return []
+    groups: Dict[tuple, dict] = {}
+    for a in arrs:
+        try:
+            key = (tuple(a.shape), str(a.dtype))
+            nbytes = int(a.size) * a.dtype.itemsize
+        except Exception:
+            continue
+        g = groups.setdefault(key, {"shape": list(key[0]),
+                                    "dtype": key[1], "count": 0,
+                                    "bytes": 0})
+        g["count"] += 1
+        g["bytes"] += nbytes
+    out = sorted(groups.values(), key=lambda g: -g["bytes"])[:top]
+    return out
+
+
+def memory_report(resolve: bool = True, top_buffers: int = 10) -> dict:
+    """The ledger's answer: per-program byte accounting (resolving any
+    pending providers unless resolve=False — resolution compiles, so
+    an idle dump() passes False), device capacity, per-program peak
+    share, the fleet-wide max peak, and the top live device buffers."""
+    with _lock:
+        entries = list(_programs.values())
+    if resolve:
+        for e in entries:
+            if e.get("status") == "pending":
+                _resolve(e)
+    hbm = device_hbm_bytes()
+    programs = {}
+    peak = 0
+    for e in entries:
+        rec = {k: v for k, v in e.items()
+               if k not in ("provider", "label")}
+        if e.get("status") == "ok":
+            peak = max(peak, e["peak_bytes"])
+            if hbm:
+                rec["peak_share"] = round(e["peak_bytes"] / hbm, 4)
+        programs[e["label"]] = rec
+    return {"programs": programs,
+            "device_hbm_bytes": hbm,
+            "peak_hbm_bytes": peak,
+            "live_buffers": _live_buffers(top_buffers)}
+
+
+def snapshot() -> dict:
+    """The ledger without resolution — registered-but-pending entries
+    stay pending and nothing compiles (what telemetry.dump() embeds)."""
+    return memory_report(resolve=False, top_buffers=0)
+
+
+def reset():
+    with _lock:
+        _programs.clear()

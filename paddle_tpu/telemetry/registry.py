@@ -33,7 +33,8 @@ from typing import Callable, Dict, List, Optional
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "registry", "counter", "gauge", "histogram",
            "add_sink", "remove_sink", "sinks", "active", "emit", "span",
-           "configure", "config", "reset"]
+           "configure", "config", "reset",
+           "set_rank", "rank_info", "percentile_of", "percentiles_of"]
 
 
 # one lock for all instrument mutation: `value += n` is LOAD/ADD/STORE
@@ -41,6 +42,29 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
 # watchdog monitor, checkpoint writer) — a lost increment would flake
 # exactly the count-pinning regression tests this plane feeds
 _METRICS_LOCK = threading.Lock()
+
+
+def percentile_of(values, q) -> float:
+    """One percentile over a value list (key-naming handled here —
+    fractional q like 99.9 works)."""
+    key = f"p{int(q) if float(q).is_integer() else q}"
+    return percentiles_of(values, (q,))[key]
+
+
+def percentiles_of(values, qs=(50, 90, 99)) -> Dict[str, float]:
+    """Nearest-rank percentiles over a value list — THE one percentile
+    derivation (Histogram.percentiles, stats() blocks and the report
+    CLIs all call this; the rounding convention changes in one place)."""
+    out = {f"p{int(q) if float(q).is_integer() else q}": 0.0
+           for q in qs}
+    if not values:
+        return out
+    xs = sorted(float(v) for v in values)
+    for q in qs:
+        k = min(len(xs) - 1,
+                max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+        out[f"p{int(q) if float(q).is_integer() else q}"] = xs[k]
+    return out
 
 
 class Counter:
@@ -108,21 +132,28 @@ class Histogram:
                 self._i = (self._i + 1) % self._cap
 
     def percentile(self, q: float) -> float:
-        if not self._window:
-            return 0.0
-        xs = sorted(self._window)
-        k = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
-        return xs[k]
+        key = f"p{int(q) if float(q).is_integer() else q}"
+        return self.percentiles((q,))[key]
+
+    def percentiles(self, qs=(50, 90, 99)) -> Dict[str, float]:
+        """{pN: value} over the reservoir window — consumers (dump(),
+        stats() blocks, the report CLIs) read these instead of
+        re-deriving percentiles from raw reservoir dumps."""
+        with _METRICS_LOCK:
+            window = list(self._window)
+        return percentiles_of(window, qs)
 
     def summary(self) -> dict:
         if not self.count:
             return {"count": 0}
+        pct = self.percentiles((50, 90, 99))
         return {"count": self.count,
                 "sum": round(self.total, 4),
                 "min": round(self.min, 4),
                 "max": round(self.max, 4),
-                "p50": round(self.percentile(50), 4),
-                "p99": round(self.percentile(99), 4)}
+                "p50": round(pct["p50"], 4),
+                "p90": round(pct["p90"], 4),
+                "p99": round(pct["p99"], 4)}
 
 
 class MetricsRegistry:
@@ -200,6 +231,26 @@ def histogram(name: str, window: int = 1024) -> Histogram:
 _SINKS: List = []           # truthiness of this list IS the fast path
 _SINKS_LOCK = threading.Lock()
 
+# fleet identity: (rank, world), stamped onto every emitted record once
+# distributed.env (or telemetry.fleet.init_from_env) announces it.
+# None until then — a single uninitialized process emits exactly the
+# records it always did (readers treat a missing rank as rank 0).
+_RANK: Optional[tuple] = None
+
+
+def set_rank(rank: int, world: int = 1):
+    """Announce this process's fleet identity.  From here on every
+    emitted event carries `rank` (and `world` when > 1) so per-rank
+    JSONL logs merge into one rank-laned timeline.  Called by
+    distributed.env.init_parallel_env; idempotent."""
+    global _RANK
+    _RANK = (int(rank), max(1, int(world)))
+
+
+def rank_info() -> Optional[tuple]:
+    """(rank, world) once announced, else None (treat as (0, 1))."""
+    return _RANK
+
 # plane configuration — host-side behavior switches only (nothing here
 # may change a compiled program):
 #   step_phases: trainers attach the one-time fwd/bwd phase
@@ -267,6 +318,13 @@ def emit(event: str, fields: Optional[dict] = None, **kw):
         rec.update(fields)
     if kw:
         rec.update(kw)
+    if _RANK is not None:
+        # rank-aware records (ISSUE 10): every producer — trainers,
+        # watchdog, fault registry, checkpoint runtime, serving — gets
+        # the fleet identity for free, so no call site can forget it
+        rec.setdefault("rank", _RANK[0])
+        if _RANK[1] > 1:
+            rec.setdefault("world", _RANK[1])
     for s in list(_SINKS):
         try:
             s.record(rec)
@@ -324,10 +382,13 @@ def span(event: str, **fields):
 
 
 def reset():
-    """Detach every sink, clear the registry, and restore the default
-    config (test isolation — the whole plane back to pristine)."""
+    """Detach every sink, clear the registry, drop the fleet identity
+    and restore the default config (test isolation — the whole plane
+    back to pristine)."""
+    global _RANK
     for s in list(_SINKS):
         remove_sink(s)
     _REGISTRY.reset()
     _CONFIG.clear()
     _CONFIG.update(_CONFIG_DEFAULTS)
+    _RANK = None

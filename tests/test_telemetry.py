@@ -363,6 +363,38 @@ class TestPrefetch:
         # batch dim sharded over the data axes
         assert sh.spec[0] is not None
 
+    def test_slow_loader_host_wait_accounted(self):
+        """Satellite (ISSUE 10): a loader slower than its consumer
+        must show up as host-wait — io.step events carry growing
+        host_wait_ms and the io.host_wait_ms histogram AND gauge are
+        visible in telemetry.dump()."""
+        from paddle_tpu.io import prefetch_to_device
+
+        def slow_gen():
+            for i in range(4):
+                time.sleep(0.03)        # deliberately slow producer
+                yield np.full((2,), i, np.float32)
+
+        telemetry.registry().reset()    # instrument counts start clean
+        sink = telemetry.add_sink(telemetry.MemorySink())
+        try:
+            out = list(prefetch_to_device(slow_gen(), depth=2))
+        finally:
+            telemetry.remove_sink(sink)
+        assert len(out) == 4
+        evs = [r for r in sink.records if r["event"] == "io.step"]
+        assert len(evs) == 4
+        waits = [e["host_wait_ms"] for e in evs]
+        # past the priming get, the consumer keeps blocking on the
+        # slow producer — the wait accounting must show it
+        assert sum(w > 10 for w in waits[1:]) >= 2, waits
+        d = telemetry.dump()
+        h = d["histograms"]["io.host_wait_ms"]
+        assert h["count"] == 4 and h["max"] > 10
+        assert "io.host_wait_ms" in d["gauges"]
+        assert d["gauges"]["io.host_wait_ms"] \
+            == pytest.approx(waits[-1], abs=0.001)
+
     def test_loader_error_propagates(self):
         from paddle_tpu.io import prefetch_to_device
 

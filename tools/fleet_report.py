@@ -1,0 +1,336 @@
+"""Fleet observability report CLI — the command-line face of
+paddle_tpu.telemetry.fleet (merge + straggler table + memory section;
+--selftest wired into tier-1 beside telemetry_report --selftest).
+
+    python tools/fleet_report.py rank0.jsonl rank1.jsonl ... \
+        [--trace merged.json] [--json] [--skew-ms F]
+        Merge per-rank JSONL step logs: prints the cross-rank straggler
+        table (per-step wall/arrival skew over steps every rank
+        reported, worst rank, steps past --skew-ms flagged), the
+        per-rank step/wall summary, and the memory-ledger section when
+        the logs carry `mem.program` events.  --trace additionally
+        writes ONE chrome trace with one lane per rank
+        (chrome://tracing / Perfetto).
+
+    python tools/fleet_report.py --selftest
+        CI canary: runs a 2-rank toy fleet in-process (per-rank JSONL
+        logs + FleetSink publishing to a live KV store, a delay fault
+        planted into rank 1), then validates that (a) the coordinator
+        FleetAggregator detects the planted straggler and emits
+        `fleet.straggler`, (b) the merged chrome trace has one named
+        lane per rank, (c) `telemetry.memory_report()` returns
+        non-empty per-program byte accounting with the full schema,
+        and (d) the straggler table flags rank 1.  Exit 1 on any
+        violation.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_MEM_KEYS = ("argument_bytes", "output_bytes", "temp_bytes",
+             "alias_bytes", "generated_code_bytes", "peak_bytes")
+
+
+def analyze_fleet(logs, skew_ms: float = 0.0, top: int = 10):
+    """Per-rank JSONL event lists -> the fleet report dict: per-rank
+    summaries, the per-step cross-rank skew table, straggler counts,
+    and the memory section (from mem.program events, latest per
+    label)."""
+    ranks = {}
+    collisions = []
+    for i, events in enumerate(logs):
+        steps = [e for e in events if e.get("event") == "train.step"]
+        rank = next((int(e["rank"]) for e in steps if "rank" in e), i)
+        if rank in ranks:
+            # two logs claim one lane (typically an untagged log whose
+            # positional index matches a tagged rank): give the later
+            # log the next free lane and SAY SO, never silently drop
+            # a rank's steps from the skew table
+            orig = rank
+            while rank in ranks:
+                rank += 1
+            collisions.append({"log_index": i, "claimed": orig,
+                               "assigned": rank})
+        ranks[rank] = {
+            "events": len(events),
+            "steps": {int(e["step"]): e for e in steps
+                      if "step" in e},
+        }
+    out = {"ranks": {}}
+    if collisions:
+        out["rank_collisions"] = collisions
+    for r in sorted(ranks):
+        warm = [e for e in ranks[r]["steps"].values()
+                if not e.get("cold")]
+        walls = [e["wall_ms"] for e in warm
+                 if isinstance(e.get("wall_ms"), (int, float))]
+        out["ranks"][str(r)] = {
+            "events": ranks[r]["events"],
+            "train_steps": len(ranks[r]["steps"]),
+            "wall_ms_p50": round(_pct(walls, 50), 3) if walls else None,
+        }
+
+    # cross-rank skew over steps EVERY rank reported — the SAME
+    # judge_step rule the live FleetAggregator applies (cold steps
+    # excluded: their wall includes the XLA compile)
+    from paddle_tpu.telemetry.fleet import arrivals_of, judge_step
+    skews, straggler_counts = [], {}
+    if len(ranks) >= 2:
+        common = sorted(set.intersection(
+            *[set(v["steps"]) for v in ranks.values()]))
+        baseline = None
+        for s in common:
+            recs = {r: ranks[r]["steps"][s] for r in ranks}
+            if any(e.get("cold") for e in recs.values()):
+                continue
+            if baseline is None:
+                # first warm step anchors per-rank clock offsets:
+                # arrival skew reported below is DRIFT, not raw offset
+                baseline = arrivals_of(recs)
+            verdict = judge_step(recs, skew_ms, baseline)
+            if verdict is None:
+                continue
+            if verdict["flagged"]:
+                worst = str(verdict["worst_rank"])
+                straggler_counts[worst] = \
+                    straggler_counts.get(worst, 0) + 1
+            skews.append({"step": s, "skew_ms": verdict["skew_ms"],
+                          "arrival_skew_ms":
+                          verdict["arrival_skew_ms"],
+                          "worst_rank": verdict["worst_rank"],
+                          "flagged": verdict["flagged"]})
+    out["skew_table"] = sorted(
+        skews, key=lambda e: -max(e["skew_ms"],
+                                  e["arrival_skew_ms"]))[:top]
+    out["steps_compared"] = len(skews)
+    out["stragglers"] = straggler_counts
+    out["skew_threshold_ms"] = skew_ms
+
+    # fleet detector events (a coordinator log fed through this CLI)
+    all_events = [e for events in logs for e in events]
+    for ev, key in (("fleet.straggler", "straggler_events"),
+                    ("fleet.desync", "desync_events")):
+        n = sum(1 for e in all_events if e.get("event") == ev)
+        if n:
+            out[key] = n
+
+    # memory section: latest mem.program record per label
+    mem = {}
+    for e in all_events:
+        if e.get("event") == "mem.program" and e.get("label"):
+            mem[e["label"]] = {k: e.get(k) for k in _MEM_KEYS}
+    if mem:
+        out["memory"] = {
+            "programs": mem,
+            "peak_hbm_bytes": max((m.get("peak_bytes") or 0)
+                                  for m in mem.values()),
+        }
+    return out
+
+
+def _pct(xs, q):
+    from paddle_tpu.telemetry import percentile_of
+    return percentile_of(xs, q)
+
+
+def render(rep) -> str:
+    lines = []
+    for c in rep.get("rank_collisions", []):
+        lines.append(f"WARNING: log #{c['log_index']} claimed rank "
+                     f"{c['claimed']} (already taken) — assigned "
+                     f"lane {c['assigned']}")
+    for r, v in sorted(rep["ranks"].items()):
+        lines.append(f"rank {r}: {v['train_steps']} steps, "
+                     f"{v['events']} events, wall p50 "
+                     f"{v['wall_ms_p50']}ms")
+    thr = rep.get("skew_threshold_ms") or 0
+    lines.append(f"skew over {rep['steps_compared']} matched steps"
+                 + (f" (threshold {thr}ms)" if thr else ""))
+    for e in rep["skew_table"]:
+        mark = "  << STRAGGLER" if e["flagged"] else ""
+        lines.append(f"  step {e['step']:>6}: wall skew "
+                     f"{e['skew_ms']}ms, arrival skew "
+                     f"{e['arrival_skew_ms']}ms, worst rank "
+                     f"{e['worst_rank']}{mark}")
+    if rep.get("stragglers"):
+        lines.append("stragglers: " + ", ".join(
+            f"rank {r} x{n}" for r, n
+            in sorted(rep["stragglers"].items())))
+    for key in ("straggler_events", "desync_events"):
+        if key in rep:
+            lines.append(f"{key}: {rep[key]}")
+    if "memory" in rep:
+        m = rep["memory"]
+        lines.append(f"memory ledger: {len(m['programs'])} programs, "
+                     f"peak {m['peak_hbm_bytes'] / 1e6:.2f}MB")
+        for label, rec in sorted(m["programs"].items()):
+            lines.append(
+                f"  {label:<28} peak {(rec.get('peak_bytes') or 0) / 1e6:8.2f}MB "
+                f"(args {(rec.get('argument_bytes') or 0) / 1e6:.2f} + "
+                f"temps {(rec.get('temp_bytes') or 0) / 1e6:.2f})")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# selftest
+
+def _selftest():
+    import tempfile
+    import numpy as np
+    problems = []
+    with tempfile.TemporaryDirectory() as d:
+        import paddle_tpu as paddle
+        from paddle_tpu import telemetry
+        from paddle_tpu.telemetry.fleet import (FleetSink, FleetAggregator,
+                                                merge_jsonl_traces,
+                                                load_jsonl)
+        from paddle_tpu.distributed.launch.master import KVServer, KVClient
+        from paddle_tpu.distributed import fault
+        from paddle_tpu.jit import TrainStep
+
+        server = KVServer(0, host="127.0.0.1").start()
+        kv = KVClient(f"127.0.0.1:{server.port}")
+        logs = []
+        try:
+            # 2-rank toy fleet, one process: each "rank" runs its own
+            # 4-step loop with a JSONL log + a FleetSink; rank 1 gets a
+            # planted per-step delay (the straggler)
+            for rank in (0, 1):
+                telemetry.reset()
+                telemetry.set_rank(rank, 2)
+                log = os.path.join(d, f"rank{rank}.jsonl")
+                logs.append(log)
+                sink = telemetry.attach_jsonl(log)
+                fsink = telemetry.add_sink(FleetSink(
+                    kv, job_id="self", rank=rank, world=2, every=1))
+                spec = "step.begin:mode=delay:secs=0.05:times=*" \
+                    if rank == 1 else ""
+                try:
+                    with fault.scope(spec):
+                        paddle.seed(0)
+                        m = paddle.nn.Linear(8, 8)
+                        opt = paddle.optimizer.AdamW(
+                            1e-3, parameters=m.parameters())
+                        step = TrainStep(
+                            m, lambda o, y:
+                            paddle.nn.functional.mse_loss(o, y), opt)
+                        x = paddle.to_tensor(
+                            np.ones((4, 8), np.float32))
+                        for _ in range(4):
+                            step(x, x)
+                finally:
+                    telemetry.remove_sink(fsink)
+                    telemetry.remove_sink(sink)
+
+            # coordinator: aggregate, detect the planted straggler
+            probe = telemetry.add_sink(telemetry.MemorySink())
+            try:
+                agg = FleetAggregator(kv, job_id="self", world=2,
+                                      skew_ms=10.0)
+                rep = agg.poll()
+                agg.close()
+            finally:
+                telemetry.remove_sink(probe)
+            if not rep["skews"]:
+                problems.append(f"aggregator judged no steps: {rep}")
+            stragglers = [r for r in probe.records
+                          if r.get("event") == "fleet.straggler"]
+            if not stragglers:
+                problems.append("no fleet.straggler event for the "
+                                "planted delay")
+            elif any(e.get("straggler") != 1 for e in stragglers):
+                problems.append(f"straggler misattributed: "
+                                f"{stragglers}")
+            # memory ledger: the TrainStep registered its program; the
+            # report must resolve to the full byte schema
+            mrep = telemetry.memory_report()
+            if not mrep["programs"]:
+                problems.append("memory_report() returned no programs")
+            for label, rec in mrep["programs"].items():
+                if rec.get("status") != "ok":
+                    problems.append(f"program {label} not resolved: "
+                                    f"{rec}")
+                    continue
+                for k in _MEM_KEYS:
+                    if not isinstance(rec.get(k), int):
+                        problems.append(f"program {label} missing "
+                                        f"{k!r}")
+            # merge: one chrome trace, one named lane per rank
+            trace = merge_jsonl_traces(
+                logs, out_path=os.path.join(d, "merged.json"))
+            lanes = {e["pid"] for e in trace["traceEvents"]
+                     if e.get("ph") != "M"}
+            names = {e["pid"]: e["args"]["name"]
+                     for e in trace["traceEvents"]
+                     if e.get("ph") == "M"
+                     and e.get("name") == "process_name"}
+            if lanes != {0, 1}:
+                problems.append(f"merged trace lanes wrong: {lanes}")
+            if names.get(0) != "rank 0" or names.get(1) != "rank 1":
+                problems.append(f"lane names wrong: {names}")
+            # offline straggler table over the real logs
+            frep = analyze_fleet([load_jsonl(p) for p in logs],
+                                 skew_ms=10.0)
+            if frep["stragglers"].get("1", 0) < 1:
+                problems.append(f"straggler table did not flag rank 1: "
+                                f"{frep['skew_table']}")
+            print(render(frep))
+        finally:
+            server.stop()
+            telemetry.reset()
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge per-rank telemetry logs into one fleet "
+                    "report / self-check the fleet plane")
+    ap.add_argument("logs", nargs="*", help="per-rank JSONL log paths")
+    ap.add_argument("--trace", help="write the merged chrome trace "
+                                    "here (one lane per rank)")
+    ap.add_argument("--skew-ms", type=float, default=None,
+                    help="straggler threshold (default: "
+                         "FLAGS_straggler_skew_ms)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--selftest", action="store_true",
+                    help="2-rank toy fleet + planted straggler + "
+                         "memory schema check; exit 1 on violation")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        problems = _selftest()
+        if problems:
+            for p in problems:
+                print(f"FAIL {p}")
+            return 1
+        print("selftest: fleet plane ok")
+        return 0
+
+    if not args.logs:
+        ap.error("provide per-rank JSONL log paths or --selftest")
+    from paddle_tpu.telemetry.fleet import load_jsonl, merge_jsonl_traces
+    from paddle_tpu.framework.flags import get_flag
+    skew = args.skew_ms if args.skew_ms is not None \
+        else float(get_flag("straggler_skew_ms") or 0.0)
+    logs = [load_jsonl(p) for p in args.logs]
+    rep = analyze_fleet(logs, skew_ms=skew)
+    if args.trace:
+        merge_jsonl_traces(args.logs, out_path=args.trace)
+        rep["trace"] = args.trace
+    if args.json:
+        print(json.dumps(rep, indent=1))
+    else:
+        print(render(rep))
+        if args.trace:
+            print(f"merged chrome trace: {args.trace}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

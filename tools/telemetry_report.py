@@ -35,11 +35,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def _pct(xs, q):
-    if not xs:
-        return 0.0
-    xs = sorted(xs)
-    k = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
-    return xs[k]
+    # ONE percentile derivation for the whole plane: the registry's
+    # (what Histogram.percentiles and stats() blocks use) — the report
+    # no longer re-derives its own convention from raw dumps
+    from paddle_tpu.telemetry import percentile_of
+    return percentile_of(xs, q)
 
 
 def load_events(path):
@@ -174,6 +174,36 @@ def analyze(events, peak=None):
     if any(v for k, v in rob.items() if not k.startswith("shed_by")):
         out.setdefault("serve", {})["robustness"] = rob
 
+    # per-request latency spans (ISSUE 10): queue/TTFT/TPOT/e2e
+    # percentiles + per-SLO-class deadline attainment from the
+    # serve.request events the batcher emits per delivered request
+    reqs = [e for e in events if e.get("event") == "serve.request"]
+    if reqs:
+        lat = {}
+        for k in ("queue_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
+            vals = [e[k] for e in reqs
+                    if isinstance(e.get(k), (int, float))]
+            if vals:
+                lat[k] = {"count": len(vals),
+                          "p50": round(_pct(vals, 50), 3),
+                          "p99": round(_pct(vals, 99), 3)}
+        att = {}
+        for e in reqs:
+            a = att.setdefault(str(e.get("slo")),
+                               {"requests": 0, "with_deadline": 0,
+                                "deadline_met": 0})
+            a["requests"] += 1
+            if "deadline_met" in e:
+                a["with_deadline"] += 1
+                a["deadline_met"] += bool(e["deadline_met"])
+        for a in att.values():
+            if a["with_deadline"]:
+                a["attainment"] = round(
+                    a["deadline_met"] / a["with_deadline"], 4)
+        s = out.setdefault("serve", {})
+        s["latency"] = lat
+        s["slo"] = att
+
     io_steps = [e for e in events if e.get("event") == "io.step"]
     if io_steps:
         ws = [e.get("host_wait_ms", 0.0) for e in io_steps]
@@ -231,6 +261,23 @@ def render(rep):
                 f"prefix hits {k['prefix_hit_tokens']} tok, "
                 f"{k['evictions']} evictions, "
                 f"{k['kv_bytes'] / 1e6:.1f}MB")
+        if "latency" in s:
+            parts = []
+            for k in ("ttft_ms", "tpot_ms", "e2e_ms", "queue_ms"):
+                v = s["latency"].get(k)
+                if v:
+                    parts.append(f"{k[:-3]} p50={v['p50']}/"
+                                 f"p99={v['p99']}ms")
+            if parts:
+                lines.append("  latency   " + ", ".join(parts))
+        if "slo" in s:
+            parts = []
+            for cls, a in sorted(s["slo"].items()):
+                att = a.get("attainment")
+                parts.append(f"{cls}={a['requests']}"
+                             + (f" (attain {att})" if att is not None
+                                else ""))
+            lines.append("  slo       " + ", ".join(parts))
         if "robustness" in s:
             r = s["robustness"]
             by_cls = ", ".join(f"{c}={n}" for c, n
