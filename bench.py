@@ -792,12 +792,114 @@ def bench_llama_serve_prefix_shared():
                  **peak_fields})
 
 
+def bench_llama_serve_speculative():
+    """Speculative decoding + weight-only sizing (ISSUE 11): the
+    mixed-length serve workload through the draft/verify scan, vs the
+    plain batcher on the SAME workload.  On TPU the draft is an
+    early-exit self-draft (first quarter of the layers); the CPU smoke
+    instead self-speculates with the target as its own draft — the
+    acceptance plumbing is then deterministic (accept_rate == 1), so
+    the smoke can ASSERT accept_rate > 0, accepted_per_step > 1 and
+    greedy bit-exactness vs the non-speculative batcher, which is the
+    contract that matters off-TPU (TPU accept rates with trained
+    weights land at the next driver capture).  Also reports the
+    int8/int4 weight-pool bytes for this model (pure shape
+    arithmetic — no second copy of the weights is packed)."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    from paddle_tpu.inference import ContinuousBatcher
+    from paddle_tpu.quantization.weight_only import (weight_pool_bytes,
+                                                     packed_bytes)
+
+    model, cfg, batch, n_params, roofline = _serving_model()
+    rngm = np.random.RandomState(3)
+    if on_tpu:
+        lens = [64, 128, 256, 192] * 4
+        n_new, chunk, max_len, pchunk = 128, 16, 640, 32
+        spec_kw = dict(spec_tokens=4,
+                       draft_layers=max(1, cfg.num_hidden_layers // 4))
+    else:
+        lens = [4, 8, 6, 10]
+        n_new, chunk, max_len, pchunk = 8, 4, 48, 4
+        spec_kw = dict(spec_tokens=3, draft_model=model)
+    prompts = [rngm.randint(0, cfg.vocab_size, L).astype(np.int32)
+               for L in lens]
+    last_stats = {}
+    hold = []
+
+    def serve_once(speculative=True):
+        bat = ContinuousBatcher(model, max_batch_size=batch,
+                                max_len=max_len, chunk=chunk,
+                                prefill_chunk=pchunk,
+                                **(spec_kw if speculative else {}))
+        hold[:] = [bat]
+        rids = []
+        for p_ in prompts[:batch]:
+            rids.append(bat.submit(p_, n_new))
+        t0 = time.perf_counter()
+        bat.step()
+        for p_ in prompts[batch:]:
+            rids.append(bat.submit(p_, n_new))
+        outs = bat.run()
+        dt = time.perf_counter() - t0
+        last_stats.clear()
+        last_stats.update(bat.stats())
+        return bat.tokens_produced / dt, rids, outs
+
+    serve_once()                                # compile (2 programs)
+    serve_once(False)                           # compile plain
+    tok_s, spread, vals = _measure(lambda: serve_once()[0])
+    _, rids, outs = serve_once()                # capture outputs
+    st = dict(last_stats)
+    peak_fields = _peak_hbm_fields()
+    base_tok = _measure(lambda: serve_once(False)[0])[0]
+    _, base_rids, base_outs = serve_once(False)
+    accept = st.get("spec_accept_rate", 0.0)
+    aps = st.get("spec_accepted_per_step", {})
+    wb_now = weight_pool_bytes(model)
+    if getattr(model, "_weight_only", None) is None:
+        wb_int8 = packed_bytes(model, "int8")
+        wb_int4 = packed_bytes(model, "int4")
+    else:
+        wb_int8 = wb_int4 = wb_now
+    if not on_tpu:
+        # CPU smoke: speculation must be REAL and bit-exact, not just
+        # plumbed (the acceptance criteria of ISSUE 11)
+        assert st["compiled_programs"] == 2, st
+        assert accept > 0, st
+        assert aps.get("mean", 0) > 1, st
+        for a, b in zip(rids, base_rids):
+            assert (outs[a] == base_outs[b]).all(), \
+                "speculative output diverged from the plain batcher"
+    _emit("llama_serve_speculative_tokens_per_sec", tok_s,
+          f"aggregate tok/s, {len(prompts)} staggered reqs, "
+          f"spec_tokens={st.get('spec_tokens')}, "
+          f"accept_rate={accept:.2f}, accepted/step "
+          f"p50={aps.get('p50', 0)}, vs_plain="
+          f"{tok_s / max(base_tok, 1e-9):.2f}x; weight pool "
+          f"{wb_now / 1e6:.0f}MB (int8 {wb_int8 / 1e6:.0f}MB / "
+          f"int4 {wb_int4 / 1e6:.0f}MB)",
+          tok_s / max(roofline, 1e-9), spread, vals,
+          extra={"spec_tokens": st.get("spec_tokens"),
+                 "accept_rate": accept,
+                 "accepted_per_step": aps,
+                 "vs_plain": round(tok_s / max(base_tok, 1e-9), 3),
+                 "plain_tokens_per_sec": round(base_tok, 1),
+                 "weight_pool_bytes": wb_now,
+                 "weight_pool_bytes_int8": wb_int8,
+                 "weight_pool_bytes_int4": wb_int4,
+                 "weight_only": st.get("weight_only"),
+                 **peak_fields})
+
+
 def bench_serve_all():
-    """BENCH_CONFIG=serve runs the mixed-length leg AND the
-    prefix-shared leg (fresh vs-baseline numbers for both — BENCH_r05
-    predates the r6 batcher and the r12 paged pool)."""
+    """BENCH_CONFIG=serve runs the mixed-length leg, the prefix-shared
+    leg AND the speculative leg (fresh vs-baseline numbers for all —
+    BENCH_r05 predates the r6 batcher, the r12 paged pool and the r15
+    draft/verify scan)."""
     bench_llama_serve()
     bench_llama_serve_prefix_shared()
+    bench_llama_serve_speculative()
 
 
 CONFIGS = {
@@ -822,6 +924,9 @@ _ALIASES = {
     "serve_prefix": "serve",
     "llama_serve_prefix_shared": "serve",
     "llama_serve_prefix_shared_tokens_per_sec": "serve",
+    "serve_spec": "serve",
+    "llama_serve_speculative": "serve",
+    "llama_serve_speculative_tokens_per_sec": "serve",
     "llama_decode": "decode",
     "llama_decode_tokens_per_sec_per_chip": "decode",
     "llama_train_tokens_per_sec_per_chip": "llama",
@@ -1128,8 +1233,107 @@ def _assert_serve_robustness_zero_overhead():
         "serve-step HLO changed after the flag round-trip"
 
 
+def _assert_decode_roofline_zero_overhead():
+    """ISSUE 11 flags-off contract: FLAGS_weight_only_dtype and the
+    speculation flags leave the flags-off programs byte-identical.
+    (a) the serve-step HLO and program keys of an UNQUANTIZED,
+    non-speculative batcher are identical before/during/after a flag
+    toggle cycle; (b) the llama TRAIN step never reads the flags at
+    all (HLO identical with them armed); (c) the protection is real:
+    under the armed flag the program-cache fingerprint changes, so a
+    program traced at flags-off can never be replayed (stale-replay
+    guard), and speculation swaps the decode program key; (d) restored
+    defaults hit the original programs warm.  Cheap (1-layer tiny
+    llama, lowering only); runs before every bench config."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.inference import ContinuousBatcher
+    from paddle_tpu.inference.generation import _program_cache_contains
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    from paddle_tpu.parallel import ShardedTrainStep
+    from paddle_tpu.distributed.topology import build_mesh
+
+    paddle.seed(7)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=32,
+                            intermediate_size=64,
+                            num_attention_heads=2,
+                            num_key_value_heads=2, vocab_size=64)
+    model = LlamaForCausalLM(cfg)
+    geom = dict(max_batch_size=2, max_len=32, chunk=4, prefill_chunk=4)
+
+    def fingerprint(**kw):
+        bat = ContinuousBatcher(model, weight_only_dtype="none",
+                                **geom, **kw)
+        keys = (bat._program_key(1, bat.chunk),
+                bat._program_key(bat.prefill_chunk, bat.admit_steps))
+        hlo = (bat.lower_step(mixed=False).as_text(),
+               bat.lower_step(mixed=True).as_text())
+        return bat, keys, hlo
+
+    def train_hlo():
+        paddle.seed(8)
+        m = LlamaForCausalLM(llama_tiny_config())
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters(),
+                                     weight_decay=0.1)
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            0, 512, (2, 16)).astype(np.int32))
+        step = ShardedTrainStep(m, opt,
+                                build_mesh(devices=jax.devices()[:1]),
+                                sharding_stage=0)
+        return step.compiled_hlo(ids, ids, optimized=False)
+
+    bat0, keys_off, hlo_off = fingerprint()
+    probe_key = keys_off[0]
+    # build the real decode program so the cache-miss guard below has
+    # something to protect
+    bat0._step_fn(1, bat0.chunk)
+    assert _program_cache_contains(model, probe_key)
+    t_off = train_hlo()
+    set_flags({"FLAGS_weight_only_dtype": "int8"})
+    try:
+        _, keys_on, hlo_on = fingerprint()
+        # the flags-off-traced program is UNREACHABLE under the armed
+        # flag (fingerprinted cache key) even though the lowered HLO of
+        # an unquantized model is unchanged — that is the stale-replay
+        # guard, not a recompile of different code
+        assert not _program_cache_contains(model, probe_key), \
+            "weight-only flag flip did not invalidate cached programs"
+        assert keys_on == keys_off, \
+            "weight-only flag leaked into the serve program keys"
+        assert hlo_on == hlo_off, \
+            "weight-only flag changed an unquantized serve-step HLO"
+        assert train_hlo() == t_off, \
+            "weight-only flag changed the llama train-step HLO"
+    finally:
+        set_flags({"FLAGS_weight_only_dtype": "none"})
+    assert _program_cache_contains(model, probe_key), \
+        "restored flags no longer hit the original serve programs"
+    # speculation swaps the decode program (key and HLO both differ) —
+    # and restoring the default gives back the original byte-for-byte
+    bat_s, keys_spec, hlo_spec = fingerprint(spec_tokens=2,
+                                             draft_layers=1)
+    assert keys_spec[0] != keys_off[0], \
+        "speculation did not change the decode program key"
+    assert hlo_spec[0] != hlo_off[0], \
+        "speculation did not change the decode program"
+    # donation lint over every new program shape: the draft/verify
+    # decode scan and the draft-carrying admit scan must alias every
+    # carry (a forgotten donate_argnum doubles the KV pool in HBM)
+    from paddle_tpu.analysis import lint_serve_programs
+    findings = lint_serve_programs(bat_s) + lint_serve_programs(bat0)
+    assert not findings, \
+        f"serve programs hold undonated carries: {findings}"
+    _, keys_off2, hlo_off2 = fingerprint()
+    assert keys_off2 == keys_off and hlo_off2 == hlo_off, \
+        "serve programs changed after the speculation round-trip"
+
+
 def main():
     _assert_serve_robustness_zero_overhead()
+    _assert_decode_roofline_zero_overhead()
     _assert_analysis_zero_overhead()
     _assert_fault_tolerance_zero_overhead()
     _assert_mfu_fusion_zero_overhead()

@@ -434,6 +434,21 @@ def note_program_build(key):
         cb(key)
 
 
+def lint_serve_programs(batcher) -> List[Finding]:
+    """Donation lint over BOTH of a ContinuousBatcher's step programs
+    (decode — speculative draft/verify when armed — and admission):
+    every carry buffer, including the paged KV pool, the page tables
+    and the speculation draft cache, must alias an output in the
+    lowered module.  The one call sites run after ISSUE 11 grew the
+    carry set — a forgotten donate_argnum on a new carry silently
+    doubles the dominant HBM buffer.  Uses the batcher's side-effect-
+    free `lower_step` probe (no program/timing bookkeeping)."""
+    findings: List[Finding] = []
+    for mixed in (False, True):
+        findings.extend(lint_donation(batcher.lower_step(mixed=mixed)))
+    return findings
+
+
 _COMPILE_LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch")
 _COMPILE_PAT = re.compile(r"Compiling ([\w<>\-.]+) (?:with|for)")
 

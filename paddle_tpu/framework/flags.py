@@ -176,6 +176,38 @@ define_flag("serve_default_deadline_ms", 0.0,
             "pass deadline_ms: a request still QUEUED when its "
             "deadline passes is shed (serve.deadline_miss).  In-flight "
             "requests are never deadline-shed.  0 disables")
+# decode-roofline fast path (ISSUE 11): weight-only quantization and
+# speculative decoding for the serving tier.  Both off by default — the
+# flags-off decode/serve programs must stay byte-identical
+# (bench-asserted), and every program-cache key carries
+# FLAGS_weight_only_dtype (generation._process_config_fingerprint) so a
+# mid-process toggle can never replay a stale program.
+define_flag("weight_only_dtype", "none",
+            "weight-only quantization for the DECODE path: 'int8' "
+            "(per-output-channel scales) or 'int4' (group-wise packed, "
+            "two nibbles per byte, FLAGS_weight_only_group_size rows "
+            "per scale group).  A ContinuousBatcher constructed under "
+            "this flag packs the model's linear weights in place "
+            "(quantization.weight_only.quantize_model) — decode HBM "
+            "traffic per token drops ~2x/~4x.  'none' disables")
+define_flag("weight_only_group_size", 64,
+            "rows (input-channel positions) per int4 scale group in "
+            "the weight-only packed layout; must divide half the "
+            "input dimension of every quantized weight")
+define_flag("serve_spec_tokens", 0,
+            "speculative decoding: draft tokens per verify step in the "
+            "serving decode scan.  K>0 drafts K tokens with the draft "
+            "model and verifies them in ONE target pass of width K+1 "
+            "through the same compiled chunked scan; the longest "
+            "matching prefix (plus the target's bonus token) is "
+            "accepted per step.  Greedy output is bit-exact vs "
+            "non-speculative decode.  0 disables")
+define_flag("serve_draft_layers", 0,
+            "self-drafting: build the speculative draft from the "
+            "target model's own first N layers (early exit) instead "
+            "of a separate draft model — no extra weights resident.  "
+            "Used when FLAGS_serve_spec_tokens > 0 and no draft_model "
+            "is passed; 0 requires an explicit draft_model")
 define_flag("serve_retry_budget", 3,
             "per-request bound on serve-plane fault recoveries "
             "(injected/real admission faults retried FIFO-in-place, "

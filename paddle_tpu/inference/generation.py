@@ -52,35 +52,57 @@ def _sample(logits, key, temperature, top_p, top_k):
 
 
 def _kv_layout_fingerprint():
-    """The process-global KV-layout config a compiled program may have
-    baked in: (kv_cache_dtype, kv_page_size, kv_pool_pages).  Appended
-    to every _model_program_cache key so toggling FLAGS_kv_cache_dtype
-    or the pool geometry mid-process can never replay a stale program
-    built against the previous layout (a paged-pool program quantizing
-    into a pool that no longer exists would silently corrupt serving).
-    Deliberately blanket (the ISSUE 7 contract): programs that do not
-    bake the KV layout pay a spurious rebuild on a flag flip — rare,
-    and strictly safer than whitelisting which key tags are
+    """The process-global KV-layout + decode-precision config a
+    compiled program may have baked in: (kv_cache_dtype, kv_page_size,
+    kv_pool_pages, weight_only_dtype, weight_only_group_size).
+    Appended to every _model_program_cache key so toggling
+    FLAGS_kv_cache_dtype, the pool geometry or
+    FLAGS_weight_only_dtype mid-process can never replay a stale
+    program built against the previous layout (a paged-pool program
+    quantizing into a pool that no longer exists — or an fp program
+    fed packed int8 weights — would silently corrupt serving).
+    Deliberately blanket (the ISSUE 7/11 contract): programs that do
+    not bake the KV layout pay a spurious rebuild on a flag flip —
+    rare, and strictly safer than whitelisting which key tags are
     layout-dependent and forgetting one later."""
     from ..framework.flags import get_flag
     return ("kvcfg", str(get_flag("kv_cache_dtype", "auto")),
             int(get_flag("kv_page_size", 16)),
-            int(get_flag("kv_pool_pages", 0)))
+            int(get_flag("kv_pool_pages", 0)),
+            str(get_flag("weight_only_dtype", "none")),
+            int(get_flag("weight_only_group_size", 64)))
 
 
-def _store_key(key):
+def _model_quant_fingerprint(model):
+    """The MODEL-side half of the weight-only fingerprint: whether
+    quantization.weight_only.quantize_model has packed this model's
+    weights (and at what config).  Per-model state, not a flag — an
+    explicitly quantized model under flags-off defaults must still
+    miss every program traced against its fp weights (the packed
+    state_dict carries extra scale entries, so a stale replay would
+    zip-misalign the swapped parameters)."""
+    wo = getattr(model, "_weight_only", None)
+    if wo is None:
+        return ("wo", "none")
+    return ("wo", wo["dtype"], wo["group_size"])
+
+
+def _store_key(model, key):
     """The key _model_program_cache actually stores under: the
-    caller's key plus the KV-layout fingerprint.  The SINGLE place the
-    composition lives — membership probes go through
-    _program_cache_contains, never hand-built keys."""
+    caller's key plus the KV-layout/flag fingerprint plus the model's
+    quantization fingerprint.  The SINGLE place the composition
+    lives — membership probes go through _program_cache_contains,
+    never hand-built keys."""
     return (tuple(key) if isinstance(key, (tuple, list)) else (key,)) \
-        + (_kv_layout_fingerprint(),)
+        + (_kv_layout_fingerprint(), _model_quant_fingerprint(model))
 
 
 def _program_cache_contains(model, key) -> bool:
     """Would _model_program_cache(model, key, ...) hit, under the
-    CURRENT KV-layout flags?  (The serving batcher's first-use probe.)"""
-    return _store_key(key) in model.__dict__.get("_gen_compiled", {})
+    CURRENT KV-layout flags and the model's quantization state?
+    (The serving batcher's first-use probe.)"""
+    return _store_key(model, key) in model.__dict__.get("_gen_compiled",
+                                                        {})
 
 
 def _model_program_cache(model, key, build, cap=16):
@@ -95,7 +117,7 @@ def _model_program_cache(model, key, build, cap=16):
     earliest-inserted (hottest) programs first.  Keys carry the
     KV-layout fingerprint (see _kv_layout_fingerprint); callers keep
     their key[0] tag — the fingerprint is appended, not prepended."""
-    key = _store_key(key)
+    key = _store_key(model, key)
     store = model.__dict__.setdefault("_gen_compiled", {})
     fn = store.pop(key, None)
     if fn is None:

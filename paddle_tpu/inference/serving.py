@@ -137,6 +137,25 @@ class Request:
     t_admit: Optional[float] = None
     t_first: Optional[float] = None
     t_done: Optional[float] = None
+    # -- streaming (ISSUE 11 satellite): per-request token callback,
+    # fired as chunks complete with each NEW burst of output-surviving
+    # tokens (speculation delivers a whole accepted run in one burst);
+    # `delivered` is the count already handed out — it survives a
+    # faulted-slot requeue, so the bit-exact re-decode never re-sends
+    # the prefix the caller already has
+    on_token: Optional[object] = None
+    # authoritative copy of every token actually handed to on_token —
+    # a shed after repeated faults restores it as the partial output,
+    # so the final result can never disown a streamed token even when
+    # intermediate requeues discarded (and re-decoded) `tokens`
+    delivered_tokens: List[int] = field(default_factory=list)
+
+    @property
+    def delivered(self) -> int:
+        """Tokens already streamed — DERIVED from the authoritative
+        delivered_tokens copy, so no second counter can drift out of
+        sync with what the consumer actually holds."""
+        return len(self.delivered_tokens)
 
     def output(self) -> np.ndarray:
         return np.asarray(self.tokens[: self.max_new_tokens], np.int32)
@@ -159,7 +178,10 @@ class ContinuousBatcher:
     None reads FLAGS_kv_page_size / FLAGS_kv_pool_pages /
     FLAGS_kv_cache_dtype (num_pages 0 = dense-equivalent capacity).
     prefix_sharing: admissions whose prompt prefix matches resident
-    pages map them instead of re-prefilling (paged only).
+    pages map them instead of re-prefilling (paged only).  None =
+    True, except under speculative decoding where it defaults False
+    (skipped prefill chunks starve the draft cache and collapse the
+    accept rate; explicit True keeps both and warns).
     """
 
     def __init__(self, model, max_batch_size: int = 4,
@@ -171,10 +193,24 @@ class ContinuousBatcher:
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
                  kv_dtype: Optional[str] = None,
-                 prefix_sharing: bool = True):
+                 prefix_sharing: Optional[bool] = None,
+                 weight_only_dtype: Optional[str] = None,
+                 spec_tokens: Optional[int] = None,
+                 draft_model=None,
+                 draft_layers: Optional[int] = None):
         if not hasattr(model, "forward_cached"):
             raise TypeError("ContinuousBatcher needs a decode-capable "
                             "model (forward_cached/init_cache)")
+        # -- weight-only quantization (ISSUE 11): pack the model's
+        # decode weights in place BEFORE the state_dict walk below, so
+        # the packed params + scales ride the compiled scan.  None
+        # reads FLAGS_weight_only_dtype; "none" leaves the model (and
+        # therefore every compiled program) untouched.
+        wo = weight_only_dtype if weight_only_dtype is not None \
+            else get_flag("weight_only_dtype", "none")
+        if str(wo) not in ("none", "", "None"):
+            from ..quantization.weight_only import quantize_model
+            quantize_model(model, wo)
         if kv_layout is None:
             kv_layout = "paged" if hasattr(model, "forward_cached_paged") \
                 else "dense"
@@ -196,6 +232,64 @@ class ContinuousBatcher:
                                else self.chunk // 4)
         self.eos = eos_token_id
         self.kv_layout = kv_layout
+        # -- speculative decoding (ISSUE 11): K>0 swaps the pure-decode
+        # program for a draft/verify body — draft K tokens with the
+        # (small) draft model, verify them in ONE target pass of width
+        # K+1 through the same chunked scan, accept the longest
+        # matching prefix plus the target's bonus token.  Greedy output
+        # is bit-exact vs non-speculative decode (the verify lanes ARE
+        # the non-speculative logits), and with K=0 nothing below
+        # exists — carries, programs and keys stay byte-identical.
+        k = spec_tokens if spec_tokens is not None \
+            else get_flag("serve_spec_tokens", 0)
+        self.spec_k = max(0, int(k or 0))
+        self._spec_w = self.spec_k + 1          # verify width
+        self._draft = None
+        self._draft_names: List[str] = []
+        self._draft_key = ()
+        if self.spec_k:
+            if draft_model is None:
+                n = draft_layers if draft_layers is not None \
+                    else get_flag("serve_draft_layers", 0)
+                n = int(n or 0)
+                if n <= 0:
+                    raise ValueError(
+                        "speculative decoding needs a draft: pass "
+                        "draft_model= or draft_layers= (or set "
+                        "FLAGS_serve_draft_layers) for early-exit "
+                        "self-drafting")
+                if not hasattr(model, "early_exit_draft"):
+                    raise TypeError(
+                        f"{type(model).__name__} has no "
+                        "early_exit_draft(); pass an explicit "
+                        "draft_model instead")
+                draft_model = model.early_exit_draft(n)
+                self._draft_key = ("selfdraft", n)
+            else:
+                if not hasattr(draft_model, "forward_cached"):
+                    raise TypeError("draft_model needs a cached decode "
+                                    "path (forward_cached/init_cache)")
+                # the compiled program closes over the draft OBJECT
+                # (its params are swapped in per call), so the program
+                # key carries the draft's identity — two batchers with
+                # different drafts can never share a program
+                # (satellite 2: draft identity in the program keys)
+                self._draft_key = ("draft", id(draft_model))
+                # self-speculation (draft IS the target) needs no
+                # second parameter list: the target's _swapped_state
+                # already covers every weight the draft reads —
+                # shipping state_dict twice per chunk would double the
+                # parameter traffic for nothing
+                if draft_model is not model \
+                        and hasattr(draft_model, "state_dict"):
+                    self._draft_names = list(
+                        draft_model.state_dict().keys())
+            self._draft = draft_model
+        # speculation accounting (host plane)
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_steps = 0
+        self._spec_emit_window: deque = deque(maxlen=4096)
         # one FIFO per SLO class (admission walks SLO_CLASSES in
         # priority order; within a class strictly by arrival)
         self._queues: Dict[str, deque] = {c: deque()
@@ -222,28 +316,55 @@ class ContinuousBatcher:
         self._chunk_retries = 0
         self._consecutive_chunk_faults = 0
         self._hung_chunks = 0
+        self._cb_errors = 0
         from ..distributed.watchdog import watched
         self._watch = watched("serve.chunk")
 
         sd = model.state_dict()
         self._names = list(sd.keys())
-        # the logical KV depth is prefill_chunk-1 rows DEEPER than
-        # max_len: a [B, C] step's pad lanes write up to C-1 rows past
-        # a slot's valid depth — without the margin a near-capacity
-        # write would land on valid rows
-        self._cache_len = self.max_len + self.prefill_chunk - 1
+        # the logical KV depth is C-1 rows DEEPER than max_len: a
+        # [B, C] step's pad lanes write up to C-1 rows past a slot's
+        # valid depth — without the margin a near-capacity write would
+        # land on valid rows.  Under speculation the widest writer is
+        # the verify pass, and a done slot's frozen pos can sit up to
+        # spec_w-1 rows past the clamp with another spec_w junk rows
+        # written beyond it — hence the 2*K+2 floor.
+        self._eff_chunk = max(self.prefill_chunk,
+                              2 * self.spec_k + 2) if self.spec_k \
+            else self.prefill_chunk
+        self._cache_len = self.max_len + self._eff_chunk - 1
         if kv_layout == "paged":
             from .paged_kv import PageAllocator
             (self.page_size, self.pages_per_slot,
              self.num_pages) = self._paged_geometry(
-                self.B, self.max_len, self.prefill_chunk, page_size,
+                self.B, self.max_len, self._eff_chunk, page_size,
                 num_pages)
-            self.prefix_sharing = bool(prefix_sharing)
+            # prefix sharing defaults OFF under speculation: a shared
+            # prefix SKIPS its prefill chunks, so the draft's dense
+            # cache never sees those rows — greedy output stays
+            # bit-exact (acceptance is exact-match against the target)
+            # but the accept rate silently collapses on every prefix
+            # hit, making speculation a net slowdown exactly when
+            # sharing works.  An explicit True keeps both and warns.
+            if prefix_sharing is None:
+                self.prefix_sharing = not self.spec_k
+            else:
+                self.prefix_sharing = bool(prefix_sharing)
+                if self.prefix_sharing and self.spec_k:
+                    import warnings
+                    warnings.warn(
+                        "prefix_sharing=True with speculative decoding:"
+                        " shared-prefix admissions skip the prefill"
+                        " chunks that would fill the DRAFT cache, so"
+                        " accept_rate degrades on every prefix hit"
+                        " (output stays bit-exact). Prefer one or the"
+                        " other per workload.", stacklevel=2)
             # rows a slot can write past prompt+new before the host
             # evicts it: up to max(chunk, admit_steps)-1 junk decode
-            # steps inside the finishing chunk, plus C-1 junk lanes
-            self._overshoot = max(self.chunk, self.admit_steps) \
-                + self.prefill_chunk
+            # steps inside the finishing chunk (each advancing up to
+            # spec_w rows under speculation), plus C-1 junk lanes
+            self._overshoot = max(self.chunk * self._spec_w,
+                                  self.admit_steps) + self._eff_chunk
             self._alloc = PageAllocator(self.num_pages, self.page_size)
             self._plans: List[Optional[object]] = [None] * self.B
             self._cache = model.init_paged_cache(self.num_pages,
@@ -255,6 +376,13 @@ class ContinuousBatcher:
         else:
             self.prefix_sharing = False
             self._cache = model.init_cache(self.B, self._cache_len)
+        # the draft's KV cache is DENSE per-slot ring buffers even over
+        # a paged target pool: the draft is small (that is the point),
+        # its rows are never shared, and a second page plane would buy
+        # nothing — it rides the scan carry and is donated like every
+        # other buffer
+        self._dcache = self._draft.init_cache(self.B, self._cache_len) \
+            if self.spec_k else None
         self._pos = jnp.zeros((self.B,), jnp.int32)
         self._tok = jnp.zeros((self.B,), jnp.int32)
         self._mode = jnp.zeros((self.B,), bool)  # True = prefilling
@@ -356,7 +484,8 @@ class ContinuousBatcher:
     # -- public API --------------------------------------------------------
     def submit(self, input_ids, max_new_tokens: int = 32,
                slo: str = "batch",
-               deadline_ms: Optional[float] = None) -> int:
+               deadline_ms: Optional[float] = None,
+               on_token=None) -> int:
         """Queue one request; returns its id.  Admission happens at the
         next chunk boundary, in SLO-class priority order (FIFO by
         arrival within a class).
@@ -365,6 +494,14 @@ class ContinuousBatcher:
         deadline_ms: latest time (from now) by which the request must
         be ADMITTED; still queued past it = shed as a deadline miss
         (None reads FLAGS_serve_default_deadline_ms; 0/unset = none).
+        on_token: streaming callback `on_token(req_id, tokens, done)`
+        fired from run()/step() as chunks complete — `tokens` is the
+        NEW burst of output-surviving token ids (EOS-trimmed, capped
+        at max_new_tokens; speculation delivers whole accepted runs),
+        `done=True` exactly once at the terminal delivery (finish,
+        drain flush or shed).  Callback exceptions are swallowed and
+        counted (`callback_errors`) — a broken consumer must not
+        poison the batch.
 
         Every submitted id appears exactly once in run()'s results —
         a request shed by the bounded queue / a deadline / the drain
@@ -385,7 +522,7 @@ class ContinuousBatcher:
         rid = self._next_id
         self._next_id += 1
         req = Request(rid, ids, int(max_new_tokens), slo=slo,
-                      arrival=self._arrival_seq)
+                      arrival=self._arrival_seq, on_token=on_token)
         req.t_submit = self._now()
         self._arrival_seq += 1
         if deadline_ms is None:
@@ -488,6 +625,32 @@ class ContinuousBatcher:
         results."""
         return self._draining
 
+    # -- streaming delivery (ISSUE 11 satellite) ---------------------------
+    def _deliver(self, req: Request, done: bool):
+        """Hand the request's NEW output-surviving tokens to its
+        on_token callback: the deliverable prefix is EOS-trimmed and
+        capped at max_new_tokens (exactly what output() will return),
+        so a streamed consumer never sees a token the final result
+        drops.  `done=True` fires exactly once, at the terminal
+        delivery.  Host-plane only — the compiled programs cannot
+        tell a streaming request from a plain one."""
+        if req.on_token is None:
+            return
+        cap = req.max_new_tokens
+        if self.eos is not None and self.eos in req.tokens:
+            cap = min(cap, req.tokens.index(self.eos) + 1)
+        end = min(len(req.tokens), cap)
+        burst = [int(t) for t in req.tokens[req.delivered:end]]
+        if not burst and not done:
+            return
+        req.delivered_tokens.extend(burst)
+        try:
+            req.on_token(req.req_id, burst, done)
+        except Exception:
+            self._cb_errors += 1
+            from .. import telemetry as _tel
+            _tel.counter("serve.callback_errors").inc()
+
     # -- robustness plumbing (ISSUE 9) -------------------------------------
     def _shed(self, req: Request, reason: str):
         """Terminal no-service state: the request is accounted in
@@ -498,6 +661,7 @@ class ContinuousBatcher:
         req.shed = True
         req.shed_reason = reason
         self._finished[req.req_id] = req
+        self._deliver(req, done=True)
         self._shed_count += 1
         self._shed_by_class[req.slo] += 1
         from .. import telemetry as _tel
@@ -576,15 +740,31 @@ class ContinuousBatcher:
         batch keeps decoding untouched."""
         req = self._slots[i]
         self._clear_slot(i)
-        req.tokens.clear()
+        req.requeues += 1
+        budget = int(get_flag("serve_retry_budget") or 3)
+        shedding = (req.deadline is not None
+                    and self._now() > req.deadline) \
+            or req.requeues > budget or self._draining
+        if shedding and req.delivered_tokens:
+            # a streaming consumer already HOLDS the delivered prefix —
+            # with no re-decode coming, disowning it would break the
+            # "never see a token the final result drops" contract.
+            # The final output becomes exactly what was streamed (a
+            # partial result); the undelivered tail is dropped.  The
+            # authoritative copy matters: an intermediate requeue may
+            # have discarded `tokens` and the re-decode may not have
+            # caught back up to the delivered frontier
+            req.tokens[:] = req.delivered_tokens
+            req.partial = True
+        else:
+            # the re-decode re-emits every token bit-exactly (greedy),
+            # so discarding them keeps tokens_produced honest
+            req.tokens.clear()
         # the re-decode re-serves the request from scratch: its spans
         # must describe the decode the user actually received
         req.t_admit = None
         req.t_first = None
-        req.requeues += 1
-        budget = int(get_flag("serve_retry_budget") or 3)
-        if (req.deadline is not None and self._now() > req.deadline) \
-                or req.requeues > budget or self._draining:
+        if shedding:
             self._shed(req, reason)
         else:
             self._requeue(req)
@@ -681,6 +861,7 @@ class ContinuousBatcher:
             self._finished[req.req_id] = req
             self._completed += 1
             self._finish_spans(req)
+            self._deliver(req, done=True)
             flushed += 1
         from .. import telemetry as _tel
         if _tel.active():
@@ -764,9 +945,32 @@ class ContinuousBatcher:
             "deadline_misses": self._deadline_misses,
             "chunk_retries": self._chunk_retries,
             "hung_chunks": self._hung_chunks,
+            "callback_errors": self._cb_errors,
             "queued": self._queued_count(),
             "drained": self._draining,
         }
+        wo = getattr(self.model, "_weight_only", None)
+        out["weight_only"] = wo["dtype"] if wo else "none"
+        if self.spec_k:
+            # speculation block (ISSUE 11): accept_rate over drafted
+            # tokens, accepted_per_step (= n_emit, drafts + bonus) over
+            # a bounded window of active slot-steps
+            from ..telemetry import percentiles_of
+            window = list(self._spec_emit_window)
+            pct = percentiles_of(window)
+            out.update(
+                spec_tokens=self.spec_k,
+                spec_drafted=self._spec_drafted,
+                spec_accepted=self._spec_accepted,
+                spec_accept_rate=round(
+                    self._spec_accepted / self._spec_drafted, 4)
+                if self._spec_drafted else 0.0,
+                spec_accepted_per_step={
+                    "mean": round(sum(window) / len(window), 3)
+                    if window else 0.0,
+                    "p50": round(pct["p50"], 3),
+                    "p99": round(pct["p99"], 3)},
+            )
         # per-request latency spans (ISSUE 10): queue->admit->first-
         # token->finish percentiles over the last 1024 delivered
         # requests, and per-SLO-class deadline attainment
@@ -833,6 +1037,7 @@ class ContinuousBatcher:
                 self._finished[req.req_id] = req
                 self._completed += 1
                 self._finish_spans(req)
+                self._deliver(req, done=True)
                 # _clear_slot unmaps the slot's pages (prompt pages
                 # stay resident as cached prefix pages) and points the
                 # freed slot at the null page — a free slot's junk
@@ -986,6 +1191,12 @@ class ContinuousBatcher:
         if self.kv_layout == "paged":
             base += ("paged", self.page_size, self.num_pages,
                      self.pages_per_slot, self._kv_dtype)
+        if self.spec_k:
+            # speculation changes BOTH programs (the draft cache rides
+            # the admit carry too) and the compiled body closes over
+            # the draft — K and the draft's identity are part of what
+            # the program baked in (satellite 2)
+            base += ("spec", self.spec_k) + self._draft_key
         return base
 
     def _page_copy_fn(self):
@@ -1059,69 +1270,110 @@ class ContinuousBatcher:
         C, K = int(width), int(length)
         max_len = self.max_len
         paged = self.kv_layout == "paged"
+        spec = self.spec_k > 0
+        draft = self._draft
+        draft_names = self._draft_names
         from ..jit import _swapped_state
 
         def build():
+            def step_core(carry):
+                """One [B, C] step over the shared carry layout; the
+                draft (speculation on) consumes the SAME x at the same
+                pos so its dense cache stays row-for-row in lockstep
+                with the target's — prefill fills both, decode rounds
+                in the admit program advance both by one."""
+                (cache, dcache, page_table, tok, pos, mode, plen,
+                 prompts, done) = carry
+                prefilling = mode & ~done
+                lanes = jnp.arange(C, dtype=jnp.int32)
+                idx = jnp.clip(pos[:, None] + lanes[None], 0,
+                               max_len - 1)
+                pref_x = jnp.take_along_axis(prompts, idx, axis=1)
+                dec_x = jnp.concatenate(
+                    [tok[:, None],
+                     jnp.zeros((tok.shape[0], C - 1),
+                               jnp.int32)], axis=1)
+                x = jnp.where(prefilling[:, None], pref_x, dec_x)
+                n_valid = jnp.where(
+                    prefilling,
+                    jnp.minimum(C, plen - pos),
+                    jnp.where(done, 0, 1)).astype(jnp.int32)
+                if paged:
+                    lg, cache = model.forward_cached_paged(
+                        x, cache, page_table, pos)
+                else:
+                    lg, cache = model.forward_cached(x, cache, pos)
+                if spec:
+                    # draft prefill rides the admit chunk (logits
+                    # discarded — XLA DCEs the draft's lm head here)
+                    _, dcache = draft.forward_cached(x, dcache, pos)
+                last = jnp.clip(n_valid - 1, 0, C - 1)
+                lg_last = jnp.take_along_axis(
+                    lg, last[:, None, None], axis=1)[:, 0]
+                nxt = jnp.argmax(lg_last.astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+                finishing = prefilling & (pos + n_valid >= plen)
+                emit = finishing | (~prefilling & ~done)
+                pos = pos + n_valid
+                mode = mode & ~finishing
+                tok = jnp.where(emit, nxt, tok)
+                # clamp: a slot at capacity stops advancing
+                done = done | (pos >= max_len - 1)
+                out_tok = jnp.where(emit, nxt,
+                                    jnp.full_like(nxt, -1))
+                n_pref = jnp.sum(
+                    jnp.where(prefilling, n_valid, 0))
+                n_dec = jnp.sum(
+                    (~prefilling
+                     & (n_valid > 0)).astype(jnp.int32))
+                carry = (cache, dcache, page_table, tok, pos, mode,
+                         plen, prompts, done)
+                return carry, (out_tok, n_pref, n_dec)
+
+            def run_scan(cache, dcache, page_table, tok, pos, mode,
+                         plen, prompts, done):
+                def body(carry, _):
+                    return step_core(carry)
+                carry = (cache, dcache, page_table, tok, pos, mode,
+                         plen, prompts, done)
+                carry, (toks, n_pref, n_dec) = jax.lax.scan(
+                    body, carry, None, length=K)
+                return carry, toks.T, jnp.sum(n_pref), jnp.sum(n_dec)
+
+            if spec:
+                def serve_step(param_vals, draft_vals, cache, dcache,
+                               page_table, tok, pos, mode, plen,
+                               prompts, done):
+                    with _swapped_state(model, names,
+                                        list(param_vals)):
+                        if draft_names:
+                            with _swapped_state(draft, draft_names,
+                                                list(draft_vals)):
+                                carry, toks, n_pref, n_dec = run_scan(
+                                    cache, dcache, page_table, tok,
+                                    pos, mode, plen, prompts, done)
+                        else:
+                            carry, toks, n_pref, n_dec = run_scan(
+                                cache, dcache, page_table, tok, pos,
+                                mode, plen, prompts, done)
+                    (cache, dcache, page_table, tok, pos, mode, plen,
+                     prompts, done) = carry
+                    return (cache, dcache, page_table, tok, pos, mode,
+                            plen, prompts, done, toks, n_pref, n_dec)
+                return jax.jit(serve_step,
+                               donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9,
+                                               10))
+
             def serve_step(param_vals, cache, page_table, tok, pos,
                            mode, plen, prompts, done):
                 with _swapped_state(model, names, list(param_vals)):
-                    def body(carry, _):
-                        (cache, page_table, tok, pos, mode, plen,
-                         prompts, done) = carry
-                        prefilling = mode & ~done
-                        lanes = jnp.arange(C, dtype=jnp.int32)
-                        idx = jnp.clip(pos[:, None] + lanes[None], 0,
-                                       max_len - 1)
-                        pref_x = jnp.take_along_axis(prompts, idx,
-                                                     axis=1)
-                        dec_x = jnp.concatenate(
-                            [tok[:, None],
-                             jnp.zeros((tok.shape[0], C - 1),
-                                       jnp.int32)], axis=1)
-                        x = jnp.where(prefilling[:, None], pref_x,
-                                      dec_x)
-                        n_valid = jnp.where(
-                            prefilling,
-                            jnp.minimum(C, plen - pos),
-                            jnp.where(done, 0, 1)).astype(jnp.int32)
-                        if paged:
-                            lg, cache = model.forward_cached_paged(
-                                x, cache, page_table, pos)
-                        else:
-                            lg, cache = model.forward_cached(x, cache,
-                                                             pos)
-                        last = jnp.clip(n_valid - 1, 0, C - 1)
-                        lg_last = jnp.take_along_axis(
-                            lg, last[:, None, None], axis=1)[:, 0]
-                        nxt = jnp.argmax(lg_last.astype(jnp.float32),
-                                         axis=-1).astype(jnp.int32)
-                        finishing = prefilling & (pos + n_valid >= plen)
-                        emit = finishing | (~prefilling & ~done)
-                        pos = pos + n_valid
-                        mode = mode & ~finishing
-                        tok = jnp.where(emit, nxt, tok)
-                        # clamp: a slot at capacity stops advancing
-                        done = done | (pos >= max_len - 1)
-                        out_tok = jnp.where(emit, nxt,
-                                            jnp.full_like(nxt, -1))
-                        n_pref = jnp.sum(
-                            jnp.where(prefilling, n_valid, 0))
-                        n_dec = jnp.sum(
-                            (~prefilling
-                             & (n_valid > 0)).astype(jnp.int32))
-                        carry = (cache, page_table, tok, pos, mode,
-                                 plen, prompts, done)
-                        return carry, (out_tok, n_pref, n_dec)
-
-                    carry = (cache, page_table, tok, pos, mode, plen,
-                             prompts, done)
-                    carry, (toks, n_pref, n_dec) = jax.lax.scan(
-                        body, carry, None, length=K)
-                (cache, page_table, tok, pos, mode, plen, prompts,
+                    carry, toks, n_pref, n_dec = run_scan(
+                        cache, None, page_table, tok, pos, mode, plen,
+                        prompts, done)
+                (cache, _, page_table, tok, pos, mode, plen, prompts,
                  done) = carry
                 return (cache, page_table, tok, pos, mode, plen,
-                        prompts, done, toks.T, jnp.sum(n_pref),
-                        jnp.sum(n_dec))
+                        prompts, done, toks, n_pref, n_dec)
             # donate every carry buffer: the KV pool dominates — a
             # non-donated chunk pays a pool-sized HBM copy per call
             return jax.jit(serve_step,
@@ -1143,30 +1395,197 @@ class ContinuousBatcher:
             # layouts share one program signature (and the donation
             # set); it is never read
             pt = jnp.zeros((self.B, 1), jnp.int32)
+        if self.spec_k:
+            # the draft cache is one more donated carry, slotted right
+            # after the target cache; with K=0 the signature is the
+            # pre-speculation one, byte for byte
+            return (self._cache, self._dcache, pt, self._tok, self._pos,
+                    self._mode, self._plen, self._prompts, self._done)
         return (self._cache, pt, self._tok, self._pos, self._mode,
                 self._plen, self._prompts, self._done)
+
+    def _draft_param_vals(self):
+        if not self._draft_names:
+            return []
+        sd = self._draft.state_dict()
+        return [sd[n]._value for n in self._draft_names]
+
+    def _spec_step_fn(self, record: bool = True):
+        """The speculative DECODE program (ISSUE 11): `chunk` scan
+        steps, each drafting K tokens with the draft model (an inner
+        K+1-step scan — the extra step exists only for its KV write,
+        so an all-accepted round leaves no hole in the draft cache)
+        and verifying them in ONE target pass of width K+1 — the
+        verify width folded into the chunk axis, so the r6 2-programs
+        contract holds.  Per slot and step:
+
+          drafts d_1..d_K  = greedy draft continuations of tok
+          verify x         = [tok, d_1..d_K] at pos (writes K+1 KV
+                             rows, exactly the prefill-chunk lane
+                             discipline)
+          targets t_i      = argmax of verify lane i-1 — t_1 is
+                             PRECISELY the non-speculative next token,
+                             and each accepted d_i == t_i keeps the
+                             chain exact
+          accept a         = longest prefix with d_i == t_i; emit
+                             t_1..t_{a+1} (a drafts + the bonus
+                             token), advance pos by a+1
+
+        Rejected rows (pos+a+1..pos+K) are never rolled back on
+        device: they sit beyond the new frontier, and the next verify
+        window overwrites them before any query can attend them (the
+        scan's pad-lane discipline) — the HOST rolls back nothing but
+        its own pos view, which arrives already-accepted.  Greedy
+        output is therefore bit-exact vs non-speculative decode."""
+        Kd = self.spec_k
+        W = self._spec_w
+        key = self._program_key(W, self.chunk)
+        from .generation import (_model_program_cache,
+                                 _program_cache_contains)
+        first_use = not _program_cache_contains(self.model, key)
+        if record:
+            self._first_use = first_use
+            if first_use and key in self._programs_used:
+                # mid-life re-trace (LRU eviction / cleared model
+                # cache): same snapshot contract as _step_fn
+                from .. import telemetry as _tel
+                if _tel.active():
+                    _tel.emit("serve.recompile",
+                              dict(self.stats(), program=str(key)))
+                _tel.counter("serve.recompiles").inc()
+            self._programs_used.add(key)
+        model = self.model
+        names = self._names
+        draft = self._draft
+        draft_names = self._draft_names
+        K_steps = self.chunk
+        max_len = self.max_len
+        paged = self.kv_layout == "paged"
+        from ..jit import _swapped_state
+
+        def build():
+            def spec_core(carry):
+                (cache, dcache, page_table, tok, pos, mode, plen,
+                 prompts, done) = carry
+
+                # -- draft K (+1 for the cache write) greedy tokens --
+                def dbody(dc, _):
+                    dcache, dtok, dpos = dc
+                    dlg, dcache = draft.forward_cached(
+                        dtok[:, None], dcache, dpos)
+                    nxt = jnp.argmax(dlg[:, 0].astype(jnp.float32),
+                                     axis=-1).astype(jnp.int32)
+                    return (dcache, nxt, dpos + 1), nxt
+                (dcache, _, _), drafts = jax.lax.scan(
+                    dbody, (dcache, tok, pos), None, length=Kd + 1)
+                drafts = drafts.T                       # [B, K+1]
+
+                # -- verify in one width-(K+1) target pass --
+                x = jnp.concatenate([tok[:, None], drafts[:, :Kd]],
+                                    axis=1)             # [B, K+1]
+                if paged:
+                    lg, cache = model.forward_cached_paged(
+                        x, cache, page_table, pos)
+                else:
+                    lg, cache = model.forward_cached(x, cache, pos)
+                tgt = jnp.argmax(lg.astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)  # [B, K+1]
+
+                # -- accept the longest matching prefix + bonus ------
+                match = (drafts[:, :Kd] == tgt[:, :Kd]).astype(
+                    jnp.int32)
+                acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                # capacity clamp mirrors the non-speculative one-token
+                # steps: never emit past the max_len-1 frontier
+                allowed = jnp.maximum(max_len - 1 - pos, 0)
+                n_emit = jnp.where(done, 0,
+                                   jnp.minimum(acc + 1, allowed)) \
+                    .astype(jnp.int32)
+                lanes = jnp.arange(W, dtype=jnp.int32)
+                emit_mask = lanes[None, :] < n_emit[:, None]
+                out_tok = jnp.where(emit_mask, tgt,
+                                    jnp.full_like(tgt, -1))
+                last = jnp.clip(n_emit - 1, 0, W - 1)
+                new_tok = jnp.take_along_axis(
+                    tgt, last[:, None], axis=1)[:, 0]
+                tok = jnp.where(n_emit > 0, new_tok, tok)
+                pos = pos + n_emit
+                done = done | (pos >= max_len - 1)
+                # true accepted-draft count for the accounting plane:
+                # under the capacity clamp n_emit-1 would UNDERCOUNT
+                # matches (drafted stays K, so the accepted+rejected==
+                # drafted partition needs the unclamped acc)
+                n_acc = jnp.where(n_emit > 0, acc, 0)
+                carry = (cache, dcache, page_table, tok, pos, mode,
+                         plen, prompts, done)
+                return carry, (out_tok, n_emit, n_acc)
+
+            def serve_step(param_vals, draft_vals, cache, dcache,
+                           page_table, tok, pos, mode, plen, prompts,
+                           done):
+                def run_scan():
+                    def body(carry, _):
+                        return spec_core(carry)
+                    carry = (cache, dcache, page_table, tok, pos,
+                             mode, plen, prompts, done)
+                    return jax.lax.scan(body, carry, None,
+                                        length=K_steps)
+                with _swapped_state(model, names, list(param_vals)):
+                    if draft_names:
+                        with _swapped_state(draft, draft_names,
+                                            list(draft_vals)):
+                            carry, (toks, n_emit, n_acc) = run_scan()
+                    else:
+                        carry, (toks, n_emit, n_acc) = run_scan()
+                (cache, dcache, page_table, tok, pos, mode, plen,
+                 prompts, done) = carry
+                # [K_steps, B, W] -> [B, K_steps*W]: each slot's row is
+                # its chunk-ordered emission stream (-1 = no token),
+                # the same harvest contract as the plain decode program
+                toks = toks.transpose(1, 0, 2).reshape(
+                    toks.shape[1], K_steps * W)
+                n_dec = jnp.sum(n_emit)
+                return (cache, dcache, page_table, tok, pos, mode,
+                        plen, prompts, done, toks, n_emit.T, n_acc.T,
+                        jnp.asarray(0, jnp.int32), n_dec)
+            return jax.jit(serve_step,
+                           donate_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
+        if not record and first_use:
+            return build()
+        return _model_program_cache(model, key, build)
 
     def lower_step(self, mixed: bool = False):
         """`jax.stages.Lowered` for the (admission if mixed else
         decode) step program with its donation set — the analysis
         suite's entry point for lint_donation over the paged carries.
-        A pure probe: it never touches the batcher's program or timing
+        Under speculation the decode program is the draft/verify scan
+        and both programs carry the (donated) draft cache.  A pure
+        probe: it never touches the batcher's program or timing
         bookkeeping (record=False)."""
         if mixed:
             fn = self._step_fn(self.prefill_chunk, self.admit_steps,
                                record=False)
+        elif self.spec_k:
+            fn = self._spec_step_fn(record=False)
         else:
             fn = self._step_fn(1, self.chunk, record=False)
+        if self.spec_k:
+            return fn.lower(self._param_vals(),
+                            self._draft_param_vals(),
+                            *self._carry_args())
         return fn.lower(self._param_vals(), *self._carry_args())
 
     def _run_chunk(self, mixed: bool):
         from ..distributed import fault
         if mixed:
             fn = self._step_fn(self.prefill_chunk, self.admit_steps)
+        elif self.spec_k:
+            fn = self._spec_step_fn()
         else:
             fn = self._step_fn(1, self.chunk)
         t0 = time.perf_counter()
         kind = "admit" if mixed else "decode"
+        n_emit = n_acc = None
         try:
             # the chunk dispatch runs under the serve watchdog
             # (FLAGS_stop_check_timeout): a hang dumps thread stacks /
@@ -1175,13 +1594,30 @@ class ContinuousBatcher:
             # The serve.chunk fault fires INSIDE the watched window
             # but BEFORE fn touches the donated carries — an injected
             # chunk fault loses nothing; the chunk retries at the next
-            # boundary
+            # boundary (under speculation that includes a fault
+            # mid-verify: no draft token ever leaks from a chunk that
+            # never returned)
             with self._watch:
                 fault.hit("serve.chunk", key=kind)
-                (self._cache, page_table, self._tok, self._pos,
-                 self._mode, self._plen, self._prompts, self._done,
-                 toks, n_pref, n_dec) = fn(self._param_vals(),
-                                           *self._carry_args())
+                if self.spec_k:
+                    out = fn(self._param_vals(),
+                             self._draft_param_vals(),
+                             *self._carry_args())
+                    if mixed:
+                        (self._cache, self._dcache, page_table,
+                         self._tok, self._pos, self._mode, self._plen,
+                         self._prompts, self._done, toks, n_pref,
+                         n_dec) = out
+                    else:
+                        (self._cache, self._dcache, page_table,
+                         self._tok, self._pos, self._mode, self._plen,
+                         self._prompts, self._done, toks, n_emit,
+                         n_acc, n_pref, n_dec) = out
+                else:
+                    (self._cache, page_table, self._tok, self._pos,
+                     self._mode, self._plen, self._prompts, self._done,
+                     toks, n_pref, n_dec) = fn(self._param_vals(),
+                                               *self._carry_args())
         except fault.FaultError:
             self._chunk_retries += 1
             self._consecutive_chunk_faults += 1
@@ -1212,9 +1648,11 @@ class ContinuousBatcher:
         # blocking round trip (~10ms on the tunneled relay), so
         # fetching tokens/mode/done/pos/counters separately would pay
         # it six times per boundary
-        toks, mode_h, done_h, pos_h, n_pref, n_dec = jax.device_get(
-            (toks, self._mode, self._done, self._pos, n_pref, n_dec))
-        toks = np.asarray(toks)                       # [B, K]
+        (toks, mode_h, done_h, pos_h, n_pref, n_dec, n_emit,
+         n_acc) = jax.device_get(
+            (toks, self._mode, self._done, self._pos, n_pref, n_dec,
+             n_emit, n_acc))
+        toks = np.asarray(toks)                 # [B, K] / [B, K*(k+1)]
         self._mode_host = np.array(mode_h)
         self._done_host = np.array(done_h)
         self._pos_host = np.array(pos_h)
@@ -1251,6 +1689,32 @@ class ContinuousBatcher:
         self._occupancy_total += self.active
         self._prefill_tok_total += int(n_pref)
         self._decode_tok_total += int(n_dec)
+        if n_emit is not None:
+            # speculation accounting (ISSUE 11): n_emit [B, K_steps] is
+            # tokens emitted per slot per scan step (0 = inactive);
+            # n_acc carries the TRUE accepted-draft count per step —
+            # n_emit-1 would undercount on a capacity-clamped step —
+            # so accepted + rejected == drafted holds exactly
+            ne = np.asarray(n_emit)
+            active = ne > 0
+            n_active = int(active.sum())
+            drafted = n_active * self.spec_k
+            accepted = int(np.asarray(n_acc)[active].sum())
+            self._spec_drafted += drafted
+            self._spec_accepted += accepted
+            self._spec_steps += n_active
+            self._spec_emit_window.extend(int(v) for v in ne[active])
+            from .. import telemetry as _tel
+            _tel.counter("serve.spec_drafted").inc(drafted)
+            _tel.counter("serve.spec_accepted").inc(accepted)
+            if _tel.active():
+                _tel.emit("serve.spec", drafted=drafted,
+                          accepted=accepted, steps=n_active,
+                          accept_rate=round(accepted / drafted, 4)
+                          if drafted else 0.0)
+                for v in ne[active]:
+                    _tel.histogram("serve.accepted_per_step") \
+                        .observe(float(v))
         if self.kv_layout == "paged":
             # prompt pages that finished filling this chunk become
             # shareable for the NEXT admission
@@ -1286,4 +1750,9 @@ class ContinuousBatcher:
             req.tokens.extend(int(t) for t in toks[i] if t >= 0)
             if req.t_first is None and req.tokens:
                 req.t_first = t_harvest
+            # streaming: hand out this chunk's burst now — TTFT for an
+            # interactive caller is the FIRST chunk boundary, not
+            # run()'s return (speculation lands accepted runs here in
+            # one burst)
+            self._deliver(req, done=False)
 

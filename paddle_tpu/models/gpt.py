@@ -23,6 +23,7 @@ from .. import nn
 from ..framework.tensor import Tensor, Parameter
 from ..framework.dispatch import run, to_tensor_args
 from .. import ops as tpu_ops
+from .llama import _wo_mm
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny_config",
            "gpt3_6b7_config", "shard_gpt_tp"]
@@ -119,6 +120,52 @@ class GPTBlock(nn.Layer):
                 self.fc_out, self.fc_out_bias, name="gpt_mlp")
         return x + m
 
+    def _ln(self, ln, x):
+        return tpu_ops.layer_norm(x, ln.weight.value.astype(x.dtype),
+                                  ln.bias.value.astype(x.dtype),
+                                  self.config.layer_norm_epsilon)
+
+    def forward_cached(self, x, k_cache, v_cache, pos):
+        """Raw-jax decode block (the llama forward_cached idiom, GPT
+        recipe: pre-LN, combined qkv, gelu MLP, learned positions
+        applied at the embedding).  The matmuls ride `_wo_mm`, so a
+        weight-only quantized gpt decodes through ops.quant_matmul."""
+        cfg = self.config
+        cd = x.dtype
+        b, s, h = x.shape
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        hn = self._ln(self.ln1, x)
+        qkv = _wo_mm(self, "qkv", hn) + self.qkv_bias.value.astype(cd)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, nh, hd)
+        k = k.reshape(b, s, nh, hd)
+        v = v.reshape(b, s, nh, hd)
+        pos = jnp.asarray(pos, jnp.int32)
+        z = jnp.zeros((), jnp.int32)
+        if pos.ndim == 0:
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (z, pos, z, z))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (z, pos, z, z))
+        else:
+            def upd(cb, xb, p):
+                return jax.lax.dynamic_update_slice(cb, xb, (p, z, z))
+            k_cache = jax.vmap(upd)(k_cache, k.astype(k_cache.dtype),
+                                    pos)
+            v_cache = jax.vmap(upd)(v_cache, v.astype(v_cache.dtype),
+                                    pos)
+        out = tpu_ops.cached_attention(q, k_cache, v_cache, pos)
+        a = _wo_mm(self, "proj", out.reshape(b, s, h)) \
+            + self.proj_bias.value.astype(cd)
+        x = x + a
+        hn = self._ln(self.ln2, x)
+        y = jax.nn.gelu(_wo_mm(self, "fc_in", hn)
+                        + self.fc_in_bias.value.astype(cd),
+                        approximate=True)
+        m = _wo_mm(self, "fc_out", y) \
+            + self.fc_out_bias.value.astype(cd)
+        return x + m, k_cache, v_cache
+
 
 class GPTModel(nn.Layer):
     def __init__(self, config: GPTConfig):
@@ -146,6 +193,39 @@ class GPTModel(nn.Layer):
             x = layer(x)
         return self.ln_f(x)
 
+    def init_cache(self, batch: int, max_len: int):
+        """Per-layer KV ring buffers [b, max_len, n_heads, hd] (the
+        llama init_cache contract — GPT is MHA, so n_kv == n_heads)."""
+        cfg = self.config
+        shape = (batch, max_len, cfg.num_attention_heads, cfg.head_dim)
+        dt = cfg.compute_dtype
+        return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                for _ in self.layers]
+
+    def forward_cached(self, input_ids, cache, pos):
+        """input_ids [b, s_new]; pos scalar or per-slot [b] vector
+        (continuous batching).  Returns (hidden, new_cache).  Learned
+        positions index wpe by each token's GLOBAL position, mirroring
+        the rope position_ids of the llama decode path."""
+        cfg = self.config
+        s = input_ids.shape[1]
+        positions = jnp.clip(
+            jnp.asarray(pos, jnp.int32)[..., None]
+            + jnp.arange(s, dtype=jnp.int32),
+            0, cfg.max_position_embeddings - 1)
+        x = (jnp.take(self.wte.value, input_ids.astype(jnp.int32),
+                      axis=0)
+             + jnp.take(self.wpe.value, positions, axis=0)) \
+            .astype(cfg.compute_dtype)
+        new_cache = []
+        for layer, (kc, vc) in zip(self.layers, cache):
+            x, kc, vc = layer.forward_cached(x, kc, vc, pos)
+            new_cache.append((kc, vc))
+        return tpu_ops.layer_norm(
+            x, self.ln_f.weight.value.astype(x.dtype),
+            self.ln_f.bias.value.astype(x.dtype),
+            cfg.layer_norm_epsilon), new_cache
+
 
 class GPTForCausalLM(nn.Layer):
     """Tied-embedding LM head (GPT-2/3 recipe)."""
@@ -165,6 +245,22 @@ class GPTForCausalLM(nn.Layer):
         w = self.gpt.wte
         return run(lambda v, e: v @ e.T.astype(v.dtype), x, w,
                    name="gpt_lm_head")
+
+    def init_cache(self, batch: int, max_len: int):
+        return self.gpt.init_cache(batch, max_len)
+
+    def forward_cached(self, input_ids, cache, pos):
+        """Raw-jax cached decode step: (logits [b, s_new, V],
+        new_cache).  The tied lm head reads the embedding (gathered at
+        embed time), so it stays unquantized under weight-only."""
+        x, cache = self.gpt.forward_cached(input_ids, cache, pos)
+        w = self.gpt.wte.value
+        return x @ w.T.astype(x.dtype), cache
+
+    def generate(self, input_ids, max_new_tokens=32, **kw):
+        """KV-cached generation (see inference.generation.generate)."""
+        from ..inference.generation import generate
+        return generate(self, input_ids, max_new_tokens, **kw)
 
     def compute_loss(self, logits, labels):
         """Next-token cross entropy via the shared

@@ -37,7 +37,23 @@ from .. import ops as tpu_ops
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
            "llama_tiny_config", "llama_7b_config",
-           "llama_moe_tiny_config"]
+           "llama_moe_tiny_config", "EarlyExitDraft"]
+
+
+def _wo_mm(layer, name, x):
+    """`x @ W` for the DECODE path, riding the weight-only packed
+    representation when quantization.weight_only.quantize_model
+    installed one on `layer` (ISSUE 11): the packed weight + its
+    `<name>_scale` sibling dispatch to ops.quant_matmul (in-VMEM
+    dequant fused into the matmul on TPU, bit-exact jnp twin
+    elsewhere).  Unquantized layers take the exact pre-existing
+    `x @ w.astype(x.dtype)` — byte-identical flags-off programs."""
+    w = getattr(layer, name).value
+    wo = getattr(layer, "_wo_dtype", None)
+    if wo is None:
+        return x @ w.astype(x.dtype)
+    scale = getattr(layer, name + "_scale").value
+    return tpu_ops.quant_matmul(x, w, scale, wo, layer._wo_group)
 
 
 @dataclass
@@ -213,13 +229,12 @@ class LlamaAttention(nn.Layer):
         the dense and paged cached paths must stay numerically
         identical here (they differ only in where K/V land)."""
         cfg = self.config
-        cd = x.dtype
         b, s, _ = x.shape
-        q = (x @ self.q_proj.value.astype(cd)).reshape(
+        q = _wo_mm(self, "q_proj", x).reshape(
             b, s, cfg.num_attention_heads, cfg.head_dim)
-        k = (x @ self.k_proj.value.astype(cd)).reshape(
+        k = _wo_mm(self, "k_proj", x).reshape(
             b, s, cfg.num_key_value_heads, cfg.head_dim)
-        v = (x @ self.v_proj.value.astype(cd)).reshape(
+        v = _wo_mm(self, "v_proj", x).reshape(
             b, s, cfg.num_key_value_heads, cfg.head_dim)
         q, k = tpu_ops.apply_rope(q, k, cos, sin)
         return q, k, v
@@ -249,7 +264,7 @@ class LlamaAttention(nn.Layer):
             v_cache = jax.vmap(upd)(v_cache, v.astype(v_cache.dtype),
                                     pos)
         out = tpu_ops.cached_attention(q, k_cache, v_cache, pos)
-        out = out.reshape(b, s, -1) @ self.o_proj.value.astype(x.dtype)
+        out = _wo_mm(self, "o_proj", out.reshape(b, s, -1))
         return out, k_cache, v_cache
 
     def forward_cached_paged(self, x, cos, sin, cache, page_table, pos,
@@ -270,7 +285,7 @@ class LlamaAttention(nn.Layer):
             cache["k_scale"], cache["v_scale"] = ks, vs
         out = tpu_ops.paged_attention(q, kp, vp, page_table, pos,
                                       layer, ks, vs)
-        out = out.reshape(b, s, -1) @ self.o_proj.value.astype(x.dtype)
+        out = _wo_mm(self, "o_proj", out.reshape(b, s, -1))
         return out, cache
 
     # split entry points for the selective-recompute block structure
@@ -448,10 +463,11 @@ class LlamaDecoderLayer(nn.Layer):
             # handles raw jax values; aux loss is irrelevant at decode)
             x = x + self.mlp(h).value
         else:
-            wg = self.mlp.gate_proj.value.astype(x.dtype)
-            wu = self.mlp.up_proj.value.astype(x.dtype)
-            wd = self.mlp.down_proj.value.astype(x.dtype)
-            x = x + tpu_ops.swiglu(h @ wg, h @ wu) @ wd
+            x = x + _wo_mm(self.mlp, "down_proj",
+                           tpu_ops.swiglu(_wo_mm(self.mlp, "gate_proj",
+                                                 h),
+                                          _wo_mm(self.mlp, "up_proj",
+                                                 h)))
         return x, kv_state
 
     def forward_cached(self, x, cos, sin, k_cache, v_cache, pos):
@@ -570,6 +586,8 @@ class LlamaModel(nn.Layer):
                      input_ids.astype(jnp.int32),
                      axis=0).astype(cfg.compute_dtype)
         new_cache = []
+        # zip bounds the walk at the cache's depth — an EarlyExitDraft
+        # passes an n-entry cache to run only the first n blocks
         for layer, (kc, vc) in zip(self.layers, cache):
             x, kc, vc = layer.forward_cached(x, cos, sin, kc, vc, pos)
             new_cache.append((kc, vc))
@@ -613,23 +631,35 @@ class LlamaForCausalLM(nn.Layer):
         return self.llama.init_paged_cache(num_pages, page_size,
                                            kv_dtype)
 
+    def _lm_logits(self, x):
+        """Decode-path lm head: tied embeddings stay unquantized (the
+        embedding is gathered elsewhere); an untied head rides the
+        weight-only packed path like every other decode matmul."""
+        if self.config.tie_word_embeddings:
+            w = self.llama.embed_tokens.value
+            return x @ w.T.astype(x.dtype)
+        return _wo_mm(self, "lm_head", x)
+
     def forward_cached_paged(self, input_ids, cache, page_table, pos):
         """Paged twin of forward_cached: returns (logits, new_cache)."""
         x, cache = self.llama.forward_cached_paged(input_ids, cache,
                                                    page_table, pos)
-        if self.config.tie_word_embeddings:
-            w = self.llama.embed_tokens.value
-            return x @ w.T.astype(x.dtype), cache
-        return x @ self.lm_head.value.astype(x.dtype), cache
+        return self._lm_logits(x), cache
 
     def forward_cached(self, input_ids, cache, pos):
         """Raw-jax cached step for the generation loop: returns
         (logits [b, s_new, V], new_cache)."""
         x, cache = self.llama.forward_cached(input_ids, cache, pos)
-        if self.config.tie_word_embeddings:
-            w = self.llama.embed_tokens.value
-            return x @ w.T.astype(x.dtype), cache
-        return x @ self.lm_head.value.astype(x.dtype), cache
+        return self._lm_logits(x), cache
+
+    def early_exit_draft(self, num_layers: int) -> "EarlyExitDraft":
+        """Self-drafting draft model (ISSUE 11 speculative decoding):
+        a decode-capable view over this model's FIRST `num_layers`
+        decoder blocks + the final norm and lm head — no extra weights
+        resident, and because the draft reads the target's own
+        Parameter objects it sees the serving scan's swapped-in values
+        with zero extra plumbing."""
+        return EarlyExitDraft(self, num_layers)
 
     def generate(self, input_ids, max_new_tokens=32, **kw):
         """KV-cached generation (see inference.generation.generate)."""
@@ -675,6 +705,41 @@ class LlamaForCausalLM(nn.Layer):
                         aux = Tensor(aux)
                     loss = loss + self.config.moe_aux_weight * aux
         return loss
+
+
+class EarlyExitDraft:
+    """Early-exit draft over a LlamaForCausalLM (speculative decoding's
+    self-drafting mode): embed → layers[:n] → final norm → lm head,
+    with its OWN dense KV cache (n layers deep).  A plain adapter, not
+    a Layer — it owns no parameters (state_dict would double-count the
+    target's), so the serving scan passes it no values; the target's
+    `_swapped_state` covers every weight the draft reads."""
+
+    def __init__(self, model: "LlamaForCausalLM", num_layers: int):
+        n_total = model.config.num_hidden_layers
+        n = int(num_layers)
+        if not 0 < n <= n_total:
+            raise ValueError(f"early-exit draft needs 1..{n_total} "
+                             f"layers (got {n})")
+        self._model = model
+        self.num_layers = n
+        self.config = model.config
+
+    def init_cache(self, batch: int, max_len: int):
+        cfg = self.config
+        shape = (batch, max_len, cfg.num_key_value_heads, cfg.head_dim)
+        dt = cfg.compute_dtype
+        return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                for _ in range(self.num_layers)]
+
+    def forward_cached(self, input_ids, cache, pos):
+        # LlamaModel.forward_cached zips layers with the cache, so the
+        # n-entry draft cache bounds the walk to the first n blocks —
+        # the target's own decode path (positions, rope, final norm)
+        # IS the draft path, with nothing duplicated to drift
+        m = self._model
+        x, new_cache = m.llama.forward_cached(input_ids, cache, pos)
+        return m._lm_logits(x), new_cache
 
 
 def shard_llama_tp(model: LlamaForCausalLM, mesh):

@@ -22,7 +22,9 @@ __all__ = ["attention", "cached_attention", "rms_norm", "layer_norm",
            "rope", "apply_rope",
            "paged_attention", "xla_paged_attention", "paged_kv_update",
            "swiglu", "get_attention_backend", "set_attention_backend",
-           "gqa_scores", "gqa_weighted_v"]
+           "gqa_scores", "gqa_weighted_v",
+           "quant_matmul", "xla_quant_matmul",
+           "pack_int4", "unpack_int4", "dequant_weight"]
 
 _attention_backend = "auto"  # auto | pallas | xla
 
@@ -412,6 +414,94 @@ def rope(q, k, seq_len=None, base=10000.0, position_ids=None):
     cos, sin = rope_cos_sin(sl, q.shape[-1], base,
                             position_ids=position_ids)
     return apply_rope(q, k, cos, sin)
+
+
+# ---------------------------------------------------------------------------
+# weight-only quantized matmul (ISSUE 11): int8 per-channel / packed int4
+# ---------------------------------------------------------------------------
+# Packed-int4 layout contract (the ONE place it is defined; the Pallas
+# kernel, the jnp twin and quantization.weight_only all follow it):
+# a [K, N] weight is split into HALVES along K — rows 0..K/2-1 live in
+# the LOW nibble of packed[K//2, N] int8, rows K/2..K-1 in the HIGH
+# nibble.  Unpacking is therefore two nibble extractions and a
+# concatenate (no sublane interleave — the kernel's halves feed two
+# clean [K/2, N] tiles), and scale groups along K never straddle the
+# half boundary (group_size must divide K/2).
+
+def pack_int4(q):
+    """Pack an int [K, N] array of int4 values (range [-8, 7]) into
+    [K//2, N] int8 bytes: low nibble = row k, high nibble = row
+    k + K//2 (the half-split layout above).  K must be even."""
+    K = q.shape[0]
+    if K % 2:
+        raise ValueError(f"pack_int4 needs an even K (got {K})")
+    qi = jnp.asarray(q, jnp.int32)
+    lo = qi[: K // 2] & 15
+    hi = qi[K // 2:] & 15
+    return (lo | (hi << 4)).astype(jnp.int8)
+
+
+def unpack_int4(packed):
+    """Inverse of pack_int4: [K//2, N] int8 → [K, N] int32 in [-8, 7].
+    Nibbles are two's-complement 4-bit values; sign-extension is the
+    branch-free (x ^ 8) - 8 for the low nibble and an arithmetic shift
+    for the high one — identical math in the Pallas kernel."""
+    p = jnp.asarray(packed, jnp.int32)        # sign-extends the byte
+    lo = ((p & 15) ^ 8) - 8
+    hi = p >> 4                               # arithmetic: high nibble
+    return jnp.concatenate([lo, hi], axis=0)
+
+
+def dequant_weight(qw, scales, fmt, group_size=None):
+    """fp32 [K, N] weight from its weight-only packed form.
+    fmt='int8': qw [K, N] int8, scales [N] — per-output-channel.
+    fmt='int4': qw [K//2, N] packed int8, scales [K//group, N] —
+    group-wise along K (groups never straddle the pack halves).
+    THE canonical dequant math — the twin and the kernel both compute
+    q_f32 * scale_f32, so the two paths are bit-identical."""
+    if fmt == "int8":
+        return qw.astype(jnp.float32) * scales.astype(jnp.float32)[None]
+    if fmt != "int4":
+        raise ValueError(f"unknown weight-only format {fmt!r}")
+    if group_size is None:
+        raise ValueError("int4 dequant needs group_size")
+    q = unpack_int4(qw).astype(jnp.float32)            # [K, N]
+    s = jnp.repeat(scales.astype(jnp.float32), int(group_size), axis=0)
+    return q * s
+
+
+def xla_quant_matmul(x, qw, scales, fmt, group_size=None):
+    """jnp twin of pallas.quant_matmul: dequantize to fp32, cast to the
+    activation dtype (the decode matmuls run in the compute dtype, like
+    the unquantized `x @ w.astype(x.dtype)` they replace), contract in
+    fp32 accumulation.  Bit-identical to the kernel off-TPU."""
+    w = dequant_weight(qw, scales, fmt, group_size).astype(x.dtype)
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, x.shape[-1])
+    out = jax.lax.dot_general(
+        x2, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    return out.reshape(*lead, w.shape[1])
+
+
+def quant_matmul(x, qw, scales, fmt, group_size=None):
+    """x [..., K] @ weight-only packed qw → [..., N] in x.dtype, the
+    dequant fused into the matmul (the weight is read from HBM at 1
+    byte (int8) or half a byte (int4) per element — the decode-path
+    bandwidth multiplier).  Pallas kernel on TPU (dequant in VMEM right
+    after the DMA), jnp twin elsewhere / for tiling-incompatible
+    shapes."""
+    if fmt not in ("int8", "int4"):
+        raise ValueError(f"unknown weight-only format {fmt!r}")
+    if fmt == "int4" and group_size is None:
+        raise ValueError("int4 quant_matmul needs group_size")
+    if _on_tpu():
+        from .pallas.quant_matmul import quant_matmul as _pqm
+        try:
+            return _pqm(x, qw, scales, fmt, group_size)
+        except ValueError:
+            pass  # unsupported tiling → twin; real errors propagate
+    return xla_quant_matmul(x, qw, scales, fmt, group_size)
 
 
 # ---------------------------------------------------------------------------

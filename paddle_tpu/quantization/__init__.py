@@ -27,9 +27,13 @@ from .quanters import (BaseQuanter, QuanterFactory, quanter,  # noqa: F401
                        FakeQuanterWithAbsMaxObserver,
                        FakeQuanterWithAbsMaxObserverLayer)
 from .observers import BaseObserver, AbsmaxObserver  # noqa: F401
+from .weight_only import (quantize_weight, dequantize_weight,  # noqa: F401
+                          quantize_model, weight_pool_bytes,
+                          packed_bytes, WEIGHT_ONLY_DTYPES)
 
 __all__ = ["QuantConfig", "BaseQuanter", "BaseObserver", "quanter",
-           "QAT", "PTQ"]
+           "QAT", "PTQ", "quantize_weight", "dequantize_weight",
+           "quantize_model", "weight_pool_bytes", "packed_bytes"]
 
 
 class SingleLayerConfig:

@@ -104,3 +104,129 @@ def test_predictor_from_model_generate(tiny):
     out = pred.generate(paddle.to_tensor(prompt), max_new_tokens=3)
     ref = tiny.generate(paddle.to_tensor(prompt), max_new_tokens=3)
     assert (np.asarray(out.value) == np.asarray(ref.value)).all()
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (ISSUE 11): the serve scan's draft/verify loop
+
+
+def _spec_workload(model, **kw):
+    from paddle_tpu.inference import ContinuousBatcher
+    rng = np.random.RandomState(6)
+    prompts = [rng.randint(1, 128, L).astype(np.int32)
+               for L in (6, 11, 4, 9)]
+    bat = ContinuousBatcher(model, max_batch_size=2, max_len=64,
+                            chunk=4, prefill_chunk=4, **kw)
+    rids = [bat.submit(p, 6) for p in prompts[:2]]
+    bat.step()
+    rids += [bat.submit(p, 6) for p in prompts[2:]]
+    outs = bat.run()
+    return bat, rids, outs
+
+
+def test_speculative_greedy_bit_exact_vs_plain(tiny):
+    """Greedy speculative decode must emit EXACTLY the plain batcher's
+    tokens — for an identity draft (accepts everything) AND a weak
+    early-exit self-draft (accepts almost nothing): acceptance only
+    moves throughput, never the output."""
+    _, r0, o0 = _spec_workload(tiny)
+    for kw in (dict(spec_tokens=3, draft_model=tiny),
+               dict(spec_tokens=2, draft_layers=1)):
+        _, r1, o1 = _spec_workload(tiny, **kw)
+        for a, b in zip(r0, r1):
+            assert (o0[a] == o1[b]).all(), kw
+
+
+def test_speculative_acceptance_accounting(tiny):
+    """accepted + rejected == drafted, and the identity draft accepts
+    everything: accepted_per_step == K+1 on every active step."""
+    bat, _, _ = _spec_workload(tiny, spec_tokens=3, draft_model=tiny)
+    st = bat.stats()
+    assert st["spec_drafted"] > 0
+    assert 0.0 <= st["spec_accept_rate"] <= 1.0
+    assert st["spec_accepted"] <= st["spec_drafted"]
+    assert st["spec_accept_rate"] == 1.0          # identity draft
+    assert st["spec_accepted_per_step"]["p50"] == 4.0
+    # a weak draft still satisfies the partition
+    bat2, _, _ = _spec_workload(tiny, spec_tokens=2, draft_layers=1)
+    st2 = bat2.stats()
+    rejected = st2["spec_drafted"] - st2["spec_accepted"]
+    assert rejected >= 0
+    assert st2["spec_accepted"] + rejected == st2["spec_drafted"]
+
+
+def test_speculative_two_programs_and_donation(tiny):
+    """The r6 contracts hold with the verify width folded into the
+    chunk axis: exactly 2 compiled programs (spec decode + admit) and
+    every carry — including the draft cache — donated."""
+    from paddle_tpu.analysis import lint_serve_programs
+    bat, _, _ = _spec_workload(tiny, spec_tokens=3, draft_model=tiny,
+                               kv_layout="paged")
+    assert bat.compiled_programs == 2
+    assert not lint_serve_programs(bat)
+
+
+def test_speculative_paged_rollback_leak_free(tiny):
+    """Paged KV under speculation with a faulted slot mid-decode: the
+    requeued request re-decodes bit-exactly, and the pool ends the run
+    with zero mapped pages and reconciled trie refcounts — the
+    rejected draft rows and the fault rollback leak nothing."""
+    import paddle_tpu as pd
+    from paddle_tpu.distributed import fault
+    _, r0, o0 = _spec_workload(tiny, kv_layout="paged")
+    pd.set_flags({"FLAGS_fault_injection":
+                  "serve.decode:step=3:mode=error"})
+    fault.reset()
+    try:
+        bat, r1, o1 = _spec_workload(tiny, spec_tokens=3,
+                                     draft_model=tiny,
+                                     kv_layout="paged")
+        fired = fault.fired_counts().get("serve.decode", 0)
+    finally:
+        pd.set_flags({"FLAGS_fault_injection": ""})
+        fault.reset()
+    assert fired >= 1
+    st = bat.stats()
+    assert st["requests_requeued"] >= 1
+    for a, b in zip(r0, r1):
+        if not bat._finished[b].shed:
+            assert (o0[a] == o1[b]).all()
+    # leak-free pool: every page unmapped (cached prefix pages are
+    # refcount-0 by definition) and no dangling refcounts
+    assert bat._alloc.pages_used == bat._alloc.pages_cached
+    assert all(v == 0 for v in bat._alloc._ref.values())
+
+
+def test_speculative_needs_a_draft(tiny):
+    from paddle_tpu.inference import ContinuousBatcher
+    with pytest.raises(ValueError):
+        ContinuousBatcher(tiny, max_batch_size=2, max_len=32,
+                          spec_tokens=2)
+
+
+def test_early_exit_draft_validates_layers(tiny):
+    with pytest.raises(ValueError):
+        tiny.early_exit_draft(0)
+    with pytest.raises(ValueError):
+        tiny.early_exit_draft(99)
+    d = tiny.early_exit_draft(1)
+    cache = d.init_cache(2, 16)
+    assert len(cache) == 1
+    lg, cache = d.forward_cached(
+        jnp.zeros((2, 3), jnp.int32), cache,
+        jnp.zeros((2,), jnp.int32))
+    assert lg.shape == (2, 3, tiny.config.vocab_size)
+
+
+def test_speculative_flag_defaults(tiny):
+    """FLAGS_serve_spec_tokens / FLAGS_serve_draft_layers arm
+    speculation without constructor args (the bench/env interface)."""
+    paddle.set_flags({"FLAGS_serve_spec_tokens": 2,
+                      "FLAGS_serve_draft_layers": 1})
+    try:
+        bat, _, outs = _spec_workload(tiny)
+        assert bat.spec_k == 2
+        assert bat.stats()["spec_drafted"] > 0
+    finally:
+        paddle.set_flags({"FLAGS_serve_spec_tokens": 0,
+                          "FLAGS_serve_draft_layers": 0})

@@ -143,10 +143,12 @@ class TestRecompileGuard:
     def test_generation_cache_builds_recorded(self):
         """inference.generation announces program-cache misses; the
         guard records them in .cache_builds (and a warm cache adds
-        none).  Every announced key ends with the KV-layout
-        fingerprint (ISSUE 7: toggling FLAGS_kv_cache_dtype or the
-        pool geometry mid-process re-keys — and thus rebuilds — every
-        cached program)."""
+        none).  Every announced key ends with the KV-layout/decode-
+        precision fingerprint plus the model's weight-only state
+        (ISSUE 7/11: toggling FLAGS_kv_cache_dtype, the pool geometry
+        or FLAGS_weight_only_dtype mid-process — or packing the
+        model's weights — re-keys, and thus rebuilds, every cached
+        program)."""
         from paddle_tpu.inference.generation import _model_program_cache
 
         class M:
@@ -158,7 +160,8 @@ class TestRecompileGuard:
             _model_program_cache(m, ("k", 1), lambda: "prog")  # warm
             _model_program_cache(m, ("k", 2), lambda: "prog")
         assert [k[:2] for k in g.cache_builds] == [("k", 1), ("k", 2)]
-        assert all(k[-1][0] == "kvcfg" for k in g.cache_builds)
+        assert all(k[-2][0] == "kvcfg" for k in g.cache_builds)
+        assert all(k[-1][0] == "wo" for k in g.cache_builds)
 
 
 class TestCollectiveOrder:

@@ -165,3 +165,173 @@ def test_admission_no_recompile_per_prompt_length(model):
     assert [k for k in g2.cache_builds
             if isinstance(k, tuple) and k
             and k[0] == "serve_step"] == []
+
+
+# ---------------------------------------------------------------------------
+# streaming token callbacks (ISSUE 11 satellite: the r13 leftover)
+
+
+def test_streaming_callbacks_match_outputs(model):
+    """Every request's streamed bursts concatenate to EXACTLY its
+    final output (EOS-trimmed, max_new-capped), done fires exactly
+    once per request, and the first burst lands BEFORE run() returns
+    everything (TTFT is a chunk boundary, not batch completion)."""
+    rng = np.random.RandomState(21)
+    prompts = [rng.randint(1, 128, L).astype(np.int32)
+               for L in (5, 9, 4)]
+    bat = ContinuousBatcher(model, max_batch_size=2, max_len=64,
+                            chunk=4, prefill_chunk=4)
+    events = {}
+
+    def cb(rid, toks, done):
+        events.setdefault(rid, []).append((list(toks), done))
+
+    rids = [bat.submit(p, 6, on_token=cb) for p in prompts]
+    outs = bat.run()
+    for rid in rids:
+        bursts = events[rid]
+        streamed = [t for ts, _ in bursts for t in ts]
+        assert streamed == [int(t) for t in outs[rid]]
+        assert [d for _, d in bursts].count(True) == 1
+        assert bursts[-1][1] is True
+        # chunked decode of 6 tokens through chunk=4 must take >1 burst
+        assert len([b for b, _ in bursts if b]) >= 2
+
+
+def test_streaming_never_delivers_past_eos(model):
+    """A chunk can harvest tokens past EOS before the boundary evicts
+    the slot — the stream must stop at EOS exactly like output()."""
+    rng = np.random.RandomState(22)
+    prompt = rng.randint(1, 128, 5).astype(np.int32)
+    # find the greedy first token and use it as eos so the request
+    # terminates mid-chunk
+    first = int(_isolated(model, prompt, 1)[0])
+    bat = ContinuousBatcher(model, max_batch_size=1, max_len=64,
+                            chunk=4, prefill_chunk=4,
+                            eos_token_id=first)
+    got = []
+    rid = bat.submit(prompt, 8,
+                     on_token=lambda r, t, d: got.extend(t))
+    outs = bat.run()
+    assert got == [int(t) for t in outs[rid]]
+    assert got[-1] == first and len(got) == list(outs[rid]).index(
+        first) + 1
+
+
+def test_streaming_callback_errors_counted_not_fatal(model):
+    rng = np.random.RandomState(23)
+    bat = ContinuousBatcher(model, max_batch_size=1, max_len=64,
+                            chunk=4, prefill_chunk=4)
+
+    def bad(rid, toks, done):
+        raise RuntimeError("consumer went away")
+
+    rid = bat.submit(rng.randint(1, 128, 5).astype(np.int32), 5,
+                     on_token=bad)
+    outs = bat.run()
+    assert len(outs[rid]) == 5                 # batch unharmed
+    assert bat.stats()["callback_errors"] >= 1
+
+
+def test_streaming_requeue_no_duplicate_delivery(model):
+    """A faulted-slot requeue discards the request's tokens for a
+    bit-exact re-decode — the stream must NOT re-send the prefix the
+    caller already has (delivered survives the requeue)."""
+    from paddle_tpu.distributed import fault
+    rng = np.random.RandomState(24)
+    prompts = [rng.randint(1, 128, L).astype(np.int32) for L in (5, 7)]
+    paddle.set_flags({"FLAGS_fault_injection":
+                      "serve.decode:step=3:mode=error"})
+    fault.reset()
+    try:
+        bat = ContinuousBatcher(model, max_batch_size=2, max_len=64,
+                                chunk=4, prefill_chunk=4)
+        events = {}
+
+        def cb(rid, toks, done):
+            events.setdefault(rid, []).append((list(toks), done))
+
+        rids = [bat.submit(p, 6, on_token=cb) for p in prompts]
+        outs = bat.run()
+        fired = fault.fired_counts().get("serve.decode", 0)
+    finally:
+        paddle.set_flags({"FLAGS_fault_injection": ""})
+        fault.reset()
+    assert fired >= 1 and bat.stats()["requests_requeued"] >= 1
+    for rid in rids:
+        if bat._finished[rid].shed:
+            continue
+        streamed = [t for ts, _ in events[rid] for t in ts]
+        # no duplicates, full coverage: the stream is exactly the
+        # final output once, even though the slot re-decoded
+        assert streamed == [int(t) for t in outs[rid]]
+
+
+def test_streaming_shed_after_fault_keeps_delivered_prefix(model):
+    """A streaming request shed after repeated decode faults must not
+    DISOWN tokens the consumer already holds: the delivered prefix
+    survives as a partial result, so streamed == output even on the
+    shed path (review fix: the no-retraction contract)."""
+    from paddle_tpu.distributed import fault
+    rng = np.random.RandomState(25)
+    prompt = rng.randint(1, 128, 5).astype(np.int32)
+    paddle.set_flags({"FLAGS_fault_injection":
+                      "serve.decode:step=3:mode=error:times=*"})
+    fault.reset()
+    try:
+        bat = ContinuousBatcher(model, max_batch_size=1, max_len=64,
+                                chunk=4, prefill_chunk=4)
+        events = []
+        rid = bat.submit(prompt, 8,
+                         on_token=lambda r, t, d: events.append(
+                             (list(t), d)))
+        outs = bat.run()
+    finally:
+        paddle.set_flags({"FLAGS_fault_injection": ""})
+        fault.reset()
+    req = bat._finished[rid]
+    assert req.shed and req.partial
+    streamed = [t for ts, _ in events for t in ts]
+    assert streamed, "fault fired before any delivery — workload bug"
+    assert streamed == [int(t) for t in outs[rid]]
+    assert [d for _, d in events].count(True) == 1
+
+
+def test_speculation_defaults_prefix_sharing_off(model):
+    """Prefix sharing starves the DRAFT cache (skipped prefill chunks
+    never reach it), so speculation defaults it off; explicit True
+    warns but keeps both (review fix: silent accept-rate collapse)."""
+    import warnings
+    bat = ContinuousBatcher(model, max_batch_size=2, max_len=64,
+                            chunk=4, prefill_chunk=4,
+                            kv_layout="paged", spec_tokens=2,
+                            draft_model=model)
+    assert bat.prefix_sharing is False
+    plain = ContinuousBatcher(model, max_batch_size=2, max_len=64,
+                              chunk=4, prefill_chunk=4,
+                              kv_layout="paged")
+    assert plain.prefix_sharing is True
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        both = ContinuousBatcher(model, max_batch_size=2, max_len=64,
+                                 chunk=4, prefill_chunk=4,
+                                 kv_layout="paged", spec_tokens=2,
+                                 draft_model=model,
+                                 prefix_sharing=True)
+    assert both.prefix_sharing is True
+    assert any("accept_rate" in str(x.message) for x in w)
+
+
+def test_identity_draft_ships_no_second_param_list(model):
+    """Self-speculation (draft IS the target) must not re-ship the
+    whole state_dict per chunk — the target's swap covers the draft
+    (review fix)."""
+    bat = ContinuousBatcher(model, max_batch_size=1, max_len=32,
+                            chunk=4, prefill_chunk=4, spec_tokens=2,
+                            draft_model=model)
+    assert bat._draft_names == []
+    assert bat._draft_param_vals() == []
+    rng = np.random.RandomState(26)
+    rid = bat.submit(rng.randint(1, 128, 5).astype(np.int32), 4)
+    outs = bat.run()
+    assert len(outs[rid]) == 4

@@ -1,0 +1,313 @@
+"""Weight-only int8/int4 decode path (ISSUE 11 tentpole):
+quantization.weight_only packing, the ops.quant_matmul kernel/twin
+pair, the model threading, and the program-cache fingerprint guard.
+
+Reference: python/paddle/nn/quant/quantized_linear.py
+(weight_quantize / weight_only_linear).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops as tpu_ops
+from paddle_tpu.ops.pallas.quant_matmul import quant_matmul as pallas_qm
+from paddle_tpu.quantization.weight_only import (
+    quantize_weight, dequantize_weight, quantize_model,
+    weight_pool_bytes, packed_bytes)
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny_config
+
+
+def _tiny_llama(seed=0, dtype="float32"):
+    paddle.seed(seed)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            intermediate_size=128,
+                            num_attention_heads=4,
+                            num_key_value_heads=2, vocab_size=128,
+                            dtype=dtype)
+    return LlamaForCausalLM(cfg)
+
+
+# ---------------------------------------------------------------------------
+# packing / round trips
+
+
+def test_pack_int4_roundtrip_exact():
+    rng = np.random.RandomState(0)
+    q = rng.randint(-8, 8, (64, 48))
+    out = np.asarray(tpu_ops.unpack_int4(tpu_ops.pack_int4(q)))
+    assert (out == q).all()
+
+
+def test_quantize_weight_int4_grid_roundtrip():
+    """Values already ON the int4 grid survive quantize->dequantize
+    bit-close (absmax scaling reconstructs the grid when each group
+    spans it): the packed path loses nothing beyond the grid."""
+    rng = np.random.RandomState(1)
+    g = 16
+    scale = 0.05
+    q = rng.randint(-7, 8, (64, 32)).astype(np.float32)
+    # pin every group's absmax at 7 so the derived scale IS the grid
+    # scale (amax/7 == 0.05) for every (group, column)
+    q[::g, :] = 7
+    w = q * scale
+    packed, scales = quantize_weight(w, "int4", g)
+    assert packed.shape == (32, 32) and packed.dtype == jnp.int8
+    assert scales.shape == (64 // g, 32)
+    back = np.asarray(dequantize_weight(packed, scales, "int4", g))
+    np.testing.assert_allclose(back, w, rtol=0, atol=1e-6)
+
+
+@pytest.mark.parametrize("fmt,group", [("int8", None), ("int4", 8),
+                                       ("int4", 16), ("int4", 32)])
+def test_dequant_error_bounded(fmt, group):
+    rng = np.random.RandomState(2)
+    w = rng.randn(64, 48).astype(np.float32)
+    packed, scales = quantize_weight(w, fmt, group or 64)
+    back = np.asarray(dequantize_weight(packed, scales, fmt,
+                                        group or 64))
+    # absmax grids bound the error at half a quantization step
+    if fmt == "int8":
+        bound = np.abs(w).max(axis=0) / 127.0
+    else:
+        bound = np.abs(w.reshape(64 // group, group, 48)).max(axis=1) \
+            .repeat(group, axis=0).reshape(64, 48) / 7.0
+    assert (np.abs(back - w) <= bound * 0.5001 + 1e-7).all()
+
+
+def test_quantize_weight_int4_bad_group_raises():
+    w = np.ones((64, 8), np.float32)
+    with pytest.raises(ValueError):
+        quantize_weight(w, "int4", 24)      # 24 does not divide 32
+
+
+# ---------------------------------------------------------------------------
+# kernel == twin (interpret mode off-TPU), across formats and shapes
+
+
+@pytest.mark.parametrize("fmt,group", [("int8", None), ("int4", 8),
+                                       ("int4", 16)])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_kernel_matches_twin_bit_exact(fmt, group, dtype):
+    rng = np.random.RandomState(3)
+    w = rng.randn(64, 48).astype(np.float32)
+    packed, scales = quantize_weight(w, fmt, group or 64)
+    x = jnp.asarray(rng.randn(8, 64), dtype)
+    twin = tpu_ops.xla_quant_matmul(x, packed, scales, fmt, group or 64)
+    kern = pallas_qm(x, packed, scales, fmt, group or 64,
+                     interpret=True)
+    assert twin.dtype == x.dtype
+    assert (np.asarray(twin, np.float32)
+            == np.asarray(kern, np.float32)).all()
+
+
+def test_kernel_matches_twin_3d_batch():
+    rng = np.random.RandomState(4)
+    w = rng.randn(32, 64).astype(np.float32)
+    packed, scales = quantize_weight(w, "int4", 16)
+    x = jnp.asarray(rng.randn(2, 5, 32).astype(np.float32))
+    twin = tpu_ops.xla_quant_matmul(x, packed, scales, "int4", 16)
+    kern = pallas_qm(x, packed, scales, "int4", 16, interpret=True)
+    assert twin.shape == (2, 5, 64)
+    assert (np.asarray(twin) == np.asarray(kern)).all()
+
+
+def test_quant_matmul_rejects_unknown_format():
+    x = jnp.ones((2, 8), jnp.float32)
+    with pytest.raises(ValueError):
+        tpu_ops.quant_matmul(x, jnp.ones((8, 8), jnp.int8),
+                             jnp.ones((8,)), "int2")
+    with pytest.raises(ValueError):
+        tpu_ops.quant_matmul(x, jnp.ones((4, 8), jnp.int8),
+                             jnp.ones((1, 8)), "int4")  # no group_size
+
+
+# ---------------------------------------------------------------------------
+# model pass: packing in place, logit tolerance, byte accounting
+
+
+@pytest.mark.parametrize("fmt,group", [("int8", 16), ("int4", 16)])
+def test_quantized_llama_decode_logits_close(fmt, group):
+    """Two pins: (a) the quantized decode equals an fp decode through
+    EXPLICITLY dequantized weights to float tolerance — packing,
+    threading and the fused dequant are exactly the reference math;
+    (b) the drift vs the ORIGINAL fp weights is quantization noise,
+    not garbage (int4 on random N(0, 1/sqrt(h)) weights is coarse, so
+    its bound is loose by construction)."""
+    model = _tiny_llama()
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, 128, (2, 7)).astype(np.int32)
+    cache = model.init_cache(2, 32)
+    fp_lg, _ = model.forward_cached(jnp.asarray(prompt), cache,
+                                    jnp.asarray(0, jnp.int32))
+    # reference twin model: same init, weights overwritten with the
+    # DEQUANTIZED values — its plain fp decode is the ground truth for
+    # what the fused-dequant path must compute
+    ref_model = _tiny_llama()
+    quantize_model(model, fmt, group)
+    # mirror every packed param back into ref_model, dequantized
+    qsd = model.state_dict()
+    rsd = ref_model.state_dict()
+    for name, t in rsd.items():
+        if name in qsd and name + "_scale" in qsd:
+            deq = dequantize_weight(qsd[name].value,
+                                    qsd[name + "_scale"].value,
+                                    fmt, group)
+            t._value = deq.astype(t.value.dtype)
+    cache = model.init_cache(2, 32)
+    q_lg, _ = model.forward_cached(jnp.asarray(prompt), cache,
+                                   jnp.asarray(0, jnp.int32))
+    cache = ref_model.init_cache(2, 32)
+    d_lg, _ = ref_model.forward_cached(jnp.asarray(prompt), cache,
+                                       jnp.asarray(0, jnp.int32))
+    np.testing.assert_allclose(np.asarray(q_lg, np.float32),
+                               np.asarray(d_lg, np.float32),
+                               rtol=2e-5, atol=2e-5)
+    ref = np.asarray(fp_lg, np.float32)
+    err = np.abs(np.asarray(q_lg, np.float32) - ref).max()
+    scale = max(np.abs(ref).max(), 1.0)
+    tol = 0.05 if fmt == "int8" else 0.6
+    assert err <= tol * scale, (err, scale)
+
+
+def test_weight_bytes_reduction_and_packed_bytes():
+    model = _tiny_llama()
+    fp = weight_pool_bytes(model)
+    pred8 = packed_bytes(model, "int8")
+    pred4 = packed_bytes(model, "int4", 16)
+    # fp32 storage: ~4x for int8, ~8x for int4 (scales overhead aside)
+    assert pred8 < 0.3 * fp and pred4 < 0.2 * fp and pred4 < pred8
+    quantize_model(model, "int8", 16)
+    assert weight_pool_bytes(model) == pred8
+    m4 = _tiny_llama(seed=1)
+    quantize_model(m4, "int4", 16)
+    assert weight_pool_bytes(m4) == pred4
+    with pytest.raises(ValueError):
+        packed_bytes(m4, "int8")            # already quantized
+
+
+def test_quantize_model_idempotent_and_config_locked():
+    model = _tiny_llama()
+    quantize_model(model, "int8", 16)
+    quantize_model(model, "int8", 16)       # idempotent no-op
+    with pytest.raises(ValueError):
+        quantize_model(model, "int4", 16)   # cannot re-pack
+
+
+def test_quantized_gpt_decode_logits_close():
+    paddle.seed(2)
+    model = GPTForCausalLM(gpt_tiny_config())
+    rng = np.random.RandomState(6)
+    prompt = rng.randint(0, 256, (2, 6)).astype(np.int32)
+    cache = model.init_cache(2, 24)
+    fp_lg, _ = model.forward_cached(jnp.asarray(prompt), cache,
+                                    jnp.asarray(0, jnp.int32))
+    quantize_model(model, "int8", 16)
+    cache = model.init_cache(2, 24)
+    q_lg, _ = model.forward_cached(jnp.asarray(prompt), cache,
+                                   jnp.asarray(0, jnp.int32))
+    ref = np.asarray(fp_lg, np.float32)
+    err = np.abs(np.asarray(q_lg, np.float32) - ref).max()
+    assert err <= 0.02 * max(np.abs(ref).max(), 1.0), err
+
+
+def test_quantized_generate_matches_greedy_recompute_mostly():
+    """Greedy decode THROUGH the quantized weights is deterministic
+    and self-consistent: two generate() calls agree, and the program
+    re-built after quantization really reads the packed params (a
+    stale fp program would zip-misaligned-crash or emit garbage
+    shapes)."""
+    model = _tiny_llama(seed=3)
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(0, 128, (2, 5)).astype(np.int32)
+    _ = model.generate(paddle.to_tensor(prompt), max_new_tokens=4)
+    quantize_model(model, "int4", 16)
+    a = np.asarray(model.generate(paddle.to_tensor(prompt),
+                                  max_new_tokens=4).value)
+    b = np.asarray(model.generate(paddle.to_tensor(prompt),
+                                  max_new_tokens=4).value)
+    assert a.shape == (2, 4) and (a == b).all()
+
+
+# ---------------------------------------------------------------------------
+# program-cache fingerprint guard (ISSUE 11 satellite): flag AND
+# model-state flips rebuild, restored state hits warm
+
+
+def test_program_cache_keys_guard_weight_only_flag():
+    model = _tiny_llama(seed=4)
+    from paddle_tpu.inference.generation import (
+        _model_program_cache, _kv_layout_fingerprint)
+    builds = []
+
+    def build():
+        builds.append(1)
+        return lambda: None
+
+    key = ("woguard_probe", 1)
+    _model_program_cache(model, key, build)
+    _model_program_cache(model, key, build)
+    assert len(builds) == 1                       # warm hit
+    fp0 = _kv_layout_fingerprint()
+    paddle.set_flags({"FLAGS_weight_only_dtype": "int8"})
+    try:
+        assert _kv_layout_fingerprint() != fp0
+        _model_program_cache(model, key, build)
+        assert len(builds) == 2                   # flag flip rebuilds
+        paddle.set_flags({"FLAGS_weight_only_group_size": 32})
+        _model_program_cache(model, key, build)
+        assert len(builds) == 3                   # group flip rebuilds
+    finally:
+        paddle.set_flags({"FLAGS_weight_only_dtype": "none",
+                          "FLAGS_weight_only_group_size": 64})
+    _model_program_cache(model, key, build)
+    assert len(builds) == 3                       # restored: warm hit
+
+
+def test_program_cache_keys_guard_model_quantization():
+    """An EXPLICITLY quantized model (no flag set) must also miss
+    programs traced against its fp weights — the packed state_dict
+    carries extra scale entries, so a stale replay would misalign the
+    swapped params."""
+    model = _tiny_llama(seed=5)
+    from paddle_tpu.inference.generation import (
+        _model_program_cache, _program_cache_contains)
+    builds = []
+
+    def build():
+        builds.append(1)
+        return lambda: None
+
+    key = ("woguard_model", 1)
+    _model_program_cache(model, key, build)
+    assert _program_cache_contains(model, key)
+    quantize_model(model, "int8", 16)
+    assert not _program_cache_contains(model, key)
+    _model_program_cache(model, key, build)
+    assert len(builds) == 2
+
+
+def test_batcher_flag_auto_quantizes_and_serves():
+    """FLAGS_weight_only_dtype threads the pass through the serving
+    tier: a batcher constructed under the flag packs the model and the
+    whole workload decodes through quant_matmul."""
+    model = _tiny_llama(seed=6)
+    from paddle_tpu.inference import ContinuousBatcher
+    rng = np.random.RandomState(8)
+    prompts = [rng.randint(1, 128, L).astype(np.int32) for L in (5, 8)]
+    paddle.set_flags({"FLAGS_weight_only_dtype": "int8",
+                      "FLAGS_weight_only_group_size": 16})
+    try:
+        bat = ContinuousBatcher(model, max_batch_size=2, max_len=32,
+                                chunk=4, prefill_chunk=4)
+        rids = [bat.submit(p, 5) for p in prompts]
+        outs = bat.run()
+    finally:
+        paddle.set_flags({"FLAGS_weight_only_dtype": "none",
+                          "FLAGS_weight_only_group_size": 64})
+    assert getattr(model, "_weight_only")["dtype"] == "int8"
+    assert bat.stats()["weight_only"] == "int8"
+    assert all(len(outs[r]) == 5 for r in rids)
+    assert bat.compiled_programs <= 2

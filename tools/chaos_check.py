@@ -38,10 +38,13 @@ Serve-plane modes (ISSUE 9):
       One planted fault per serve injection point (admission fault
       retried, admission rejected->shed, KV-alloc fault deferred,
       chunk fault retried, hung chunk caught by the serve watchdog,
-      poisoned slot evicted+requeued) plus the SIGTERM drain e2e (a
-      subprocess serving mid-batch receives SIGTERM, sheds its queue,
-      finishes in-flight decodes and exits ELASTIC_EXIT_CODE).
-      Tier-1-wired (tests/test_serve_robustness.py).
+      poisoned slot evicted+requeued, chunk fault MID-VERIFY under
+      speculative decoding + poisoned slot under speculation — ISSUE
+      11: recovery bit-exact with no leaked draft tokens) plus the
+      SIGTERM drain e2e (a subprocess serving mid-batch receives
+      SIGTERM, sheds its queue, finishes in-flight decodes and exits
+      ELASTIC_EXIT_CODE).  Tier-1-wired
+      (tests/test_serve_robustness.py).
 
   --json     one machine-readable JSON document on stdout
   --steps N  target train steps for --spec runs (default 8)
@@ -300,10 +303,17 @@ def _serve_prompts():
             for L, _, _ in _SERVE_WORKLOAD]
 
 
-def _run_serve_workload(model):
+def _run_serve_workload(model, speculative=False):
     from paddle_tpu.inference import ContinuousBatcher
+    kw = {}
+    if speculative:
+        # self-speculation (the target drafting for itself) exercises
+        # the full draft/verify/rollback machinery deterministically —
+        # every draft accepts, so a chunk fault lands mid-verify with
+        # the maximum number of in-flight draft tokens to lose
+        kw = dict(spec_tokens=3, draft_model=model)
     bat = ContinuousBatcher(model, max_batch_size=2, max_len=64,
-                            chunk=4, prefill_chunk=4)
+                            chunk=4, prefill_chunk=4, **kw)
     prompts = _serve_prompts()
     rids = []
     for p, (_, n, slo) in zip(prompts[:2], _SERVE_WORKLOAD[:2]):
@@ -315,15 +325,23 @@ def _run_serve_workload(model):
     return bat, rids, outs
 
 
-def run_serve(spec, stop_check_timeout=None):
+def run_serve(spec, stop_check_timeout=None, speculative=False):
     """Run the mixed-SLO serve workload with `spec` armed; report dict
     with report["ok"] the pass verdict (fired + batch survived + every
-    non-shed output bit-exact vs fault-free + counters leak-free)."""
+    non-shed output bit-exact vs fault-free + counters leak-free).
+    speculative=True runs the workload under speculative decoding
+    (ISSUE 11): the fault then lands mid-draft/verify, and recovery
+    must additionally leak no draft tokens (the bit-exact and
+    tokens_produced reconciliations below catch both)."""
     import paddle_tpu as paddle
     from paddle_tpu.distributed import fault
 
     model = _serve_model()
-    # fault-free reference (spec disarmed)
+    # fault-free reference (spec disarmed).  The reference is the
+    # PLAIN batcher even for speculative runs — greedy speculative
+    # output is bit-exact vs non-speculative decode by contract, so
+    # one reference serves both and simultaneously re-checks that
+    # contract under chaos
     paddle.set_flags({"FLAGS_fault_injection": ""})
     fault.reset()
     _, ref_rids, ref_outs = _run_serve_workload(model)
@@ -335,7 +353,8 @@ def run_serve(spec, stop_check_timeout=None):
             {"FLAGS_stop_check_timeout": stop_check_timeout})
     fault.reset()
     try:
-        bat, rids, outs = _run_serve_workload(model)
+        bat, rids, outs = _run_serve_workload(model,
+                                              speculative=speculative)
         fired = {k: v for k, v in fault.fired_counts().items() if v}
     finally:
         paddle.set_flags({"FLAGS_fault_injection": ""})
@@ -462,6 +481,16 @@ def _serve_selftest():
         expect={"hung_chunks": 1}, stop_check_timeout=0.05)
     run("serve.decode-fault-requeue",
         "serve.decode:step=3:mode=error", expect={"requeues": 1})
+    # speculation chaos (ISSUE 11): a chunk fault mid-verify loses the
+    # whole in-flight draft/verify round — recovery must stay
+    # bit-exact with no leaked draft tokens — and a poisoned slot
+    # under speculation rolls its pages AND its draft state back
+    run("serve.chunk-spec-verify-retry",
+        "serve.chunk:step=3:mode=error",
+        expect={"chunk_retries": 1}, speculative=True)
+    run("serve.decode-spec-fault-requeue",
+        "serve.decode:step=3:mode=error", expect={"requeues": 1},
+        speculative=True)
     ok, detail = _serve_drain_check()
     record("serve.drain-sigterm-elastic-exit", ok, ok, detail)
     return checks

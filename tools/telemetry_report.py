@@ -174,6 +174,28 @@ def analyze(events, peak=None):
     if any(v for k, v in rob.items() if not k.startswith("shed_by")):
         out.setdefault("serve", {})["robustness"] = rob
 
+    # speculative decoding (ISSUE 11): accept-rate + accepted-per-step
+    # from the per-chunk serve.spec events.  accepted_per_step (=
+    # accepted drafts + the bonus token) is reconstructed per chunk as
+    # its mean; p50/p99 over chunks describe the burst distribution
+    spec_ev = [e for e in events if e.get("event") == "serve.spec"]
+    if spec_ev:
+        drafted = sum(e.get("drafted", 0) for e in spec_ev)
+        accepted = sum(e.get("accepted", 0) for e in spec_ev)
+        steps = sum(e.get("steps", 0) for e in spec_ev)
+        per_step = [(e["accepted"] + e["steps"]) / e["steps"]
+                    for e in spec_ev if e.get("steps")]
+        out.setdefault("serve", {})["speculation"] = {
+            "chunks": len(spec_ev),
+            "drafted": drafted,
+            "accepted": accepted,
+            "accept_rate": round(accepted / drafted, 4) if drafted
+            else 0.0,
+            "accepted_per_step_p50": round(_pct(per_step, 50), 3),
+            "accepted_per_step_p99": round(_pct(per_step, 99), 3),
+            "verify_steps": steps,
+        }
+
     # per-request latency spans (ISSUE 10): queue/TTFT/TPOT/e2e
     # percentiles + per-SLO-class deadline attainment from the
     # serve.request events the batcher emits per delivered request
@@ -278,6 +300,14 @@ def render(rep):
                              + (f" (attain {att})" if att is not None
                                 else ""))
             lines.append("  slo       " + ", ".join(parts))
+        if "speculation" in s:
+            sp = s["speculation"]
+            lines.append(
+                f"  spec      accept_rate {sp['accept_rate']} "
+                f"({sp['accepted']}/{sp['drafted']} drafts over "
+                f"{sp['verify_steps']} verify steps), "
+                f"accepted/step p50={sp['accepted_per_step_p50']} "
+                f"p99={sp['accepted_per_step_p99']}")
         if "robustness" in s:
             r = s["robustness"]
             by_cls = ", ".join(f"{c}={n}" for c, n
@@ -431,6 +461,36 @@ def _selftest():
                 or "best_effort" not in rob["shed_by_class"]:
             problems.append(f"robustness section wrong: {rob}")
         print(render(srep))
+
+        # speculative-decoding leg (ISSUE 11): a self-speculating
+        # serve run must surface serve.spec events and a speculation
+        # report section with a sane accept rate
+        plog = os.path.join(d, "spec.jsonl")
+        sink = telemetry.attach_jsonl(plog)
+        try:
+            bat = ContinuousBatcher(model, max_batch_size=1,
+                                    max_len=32, chunk=4,
+                                    prefill_chunk=4, spec_tokens=2,
+                                    draft_model=model)
+            bat.submit(rng.randint(1, 64, 5).astype(np.int32), 6)
+            bat.run()
+        finally:
+            telemetry.remove_sink(sink)
+        pevents = load_events(plog)
+        spec_ev = [e for e in pevents if e.get("event") == "serve.spec"]
+        if not spec_ev:
+            problems.append("no serve.spec events emitted under "
+                            "speculation")
+        prep = analyze(pevents)
+        spec = prep.get("serve", {}).get("speculation")
+        if not spec:
+            problems.append(f"report missing speculation section: "
+                            f"{prep}")
+        elif not (0.0 < spec["accept_rate"] <= 1.0
+                  and spec["drafted"] > 0
+                  and spec["accepted_per_step_p50"] > 1.0):
+            problems.append(f"speculation section wrong: {spec}")
+        print(render(prep))
     return problems
 
 
