@@ -23,39 +23,75 @@ import time
 
 import numpy as np
 
-PEAK_BF16 = {
-    "v4": 275e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-}
-
-
 def chip_peak_flops():
-    if "PEAK_FLOPS" in os.environ:
-        return float(os.environ["PEAK_FLOPS"])
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    for k, v in PEAK_BF16.items():
-        if k in gen:
-            return v
-    try:
-        import jax
-        kind = jax.devices()[0].device_kind.lower()
-        if "v5 lite" in kind or "v5e" in kind:
-            return PEAK_BF16["v5e"]
-        if "v5p" in kind or "v5" in kind:
-            return PEAK_BF16["v5p"]
-        if "v4" in kind:
-            return PEAK_BF16["v4"]
-        if "v6" in kind:
-            return PEAK_BF16["v6e"]
-    except Exception:
-        pass
-    return PEAK_BF16["v5e"]
+    """Canonical bf16 peak — ONE table + sniffing for the whole repo
+    (telemetry.costledger owns it; the cost ledger's roofline and
+    these MFU lines can never quote different peaks).  Keeps bench's
+    historic contract: PEAK_FLOPS env override, PALLAS_AXON_TPU_GEN
+    relay hint, device sniffing, v5e fallback for smoke lines."""
+    from paddle_tpu.telemetry.costledger import chip_peak_flops as _cpf
+    return _cpf(default="v5e")
 
 
 def _reps():
     return max(1, int(os.environ.get("BENCH_REPS", "3")))
+
+
+# flags that change what a bench metric measures: part of the env
+# fingerprint so the perf sentry never compares a weight-only/int8-KV
+# capture against a flags-off one
+_FINGERPRINT_FLAGS = (
+    "FLAGS_fused_ce", "FLAGS_bf16_adamw_moments",
+    "FLAGS_weight_only_dtype", "FLAGS_weight_only_group_size",
+    "FLAGS_kv_cache_dtype", "FLAGS_kv_page_size",
+    "FLAGS_serve_spec_tokens", "FLAGS_serve_draft_layers",
+)
+_FINGERPRINT_ENVS = ("BENCH_BATCH", "BENCH_RECOMPUTE_LAYERS",
+                     "BENCH_OFFLOAD_SIZE", "BENCH_OFFLOAD_PREFETCH",
+                     "BENCH_LONGCTX_SEQ", "BENCH_LONGCTX_REMAT",
+                     "BENCH_UNET_DTYPE", "PEAK_FLOPS")
+_ENV_FP = None
+
+
+def _env_fingerprint():
+    """Environment fingerprint for this capture (ISSUE 12): jax/jaxlib
+    versions, backend + device kind, and the bench-relevant flags/envs.
+    The perf sentry (tools/perf_report.py) compares metric lines only
+    between captures whose fingerprints match — a library bump or a
+    flag flip must read as 'incomparable', never as a regression."""
+    global _ENV_FP
+    if _ENV_FP is not None:
+        return _ENV_FP
+    fp = {}
+    try:
+        import jax
+        import jaxlib
+        fp["jax"] = jax.__version__
+        fp["jaxlib"] = jaxlib.__version__
+        fp["backend"] = jax.default_backend()
+        fp["device"] = jax.devices()[0].device_kind
+    except Exception:
+        pass
+    try:
+        from paddle_tpu.framework.flags import get_flags
+        fp["flags"] = {k: v for k, v in sorted(
+            get_flags(list(_FINGERPRINT_FLAGS)).items())}
+    except Exception:
+        pass
+    fp["env"] = {k: os.environ[k] for k in _FINGERPRINT_ENVS
+                 if k in os.environ}
+    _ENV_FP = fp
+    return fp
+
+
+def _capture_id():
+    """Stable id of the env fingerprint (BENCH_CAPTURE_ID overrides):
+    the sentry's match key."""
+    if "BENCH_CAPTURE_ID" in os.environ:
+        return os.environ["BENCH_CAPTURE_ID"]
+    import hashlib
+    blob = json.dumps(_env_fingerprint(), sort_keys=True).encode()
+    return hashlib.sha1(blob).hexdigest()[:12]
 
 
 def _measure(rep_fn):
@@ -75,7 +111,15 @@ def _emit(metric, value, unit, vs_baseline, spread, vals, extra=None):
         "vs_baseline": round(vs_baseline, 3),
         "reps": len(vals),
         "spread": round(spread, 3),
+        # env fingerprint + capture id (ISSUE 12): the perf sentry's
+        # cross-environment refusal key
+        "capture_id": _capture_id(),
+        "env": _env_fingerprint(),
     }
+    if len(vals) < 2:
+        # a one-shot line has no spread to judge a regression against
+        # — the sentry skips it instead of false-firing
+        rec["comparable"] = False
     if extra:
         rec.update(extra)
     # the telemetry snapshot rides every metric line: lifetime counters
@@ -110,6 +154,35 @@ def _peak_hbm_fields():
                 out["peak_hbm_share"] = round(
                     mem["peak_hbm_bytes"] / mem["device_hbm_bytes"], 3)
             return out
+    except Exception:
+        pass
+    return {}
+
+
+def _cost_fields():
+    """Cost-ledger roofline fields for this config's step program(s)
+    (ISSUE 12): FLOPs/bytes/intensity + the roofline bound and the
+    predicted step time at the calibrated peaks, from the same
+    resolution pass _peak_hbm_fields already paid for.  Bench runs
+    sink-less, so no measured walls ride along (the drift check lives
+    in the live telemetry plane).  BENCH_MEM=0 skips (shared gate: the
+    ledgers resolve together)."""
+    if os.environ.get("BENCH_MEM", "1") == "0":
+        return {}
+    try:
+        from paddle_tpu import telemetry
+        rep = telemetry.cost_report()
+        rows = {}
+        for label, rec in rep["programs"].items():
+            if rec.get("status") != "ok":
+                continue
+            rows[label] = {"flops": rec["flops"],
+                           "bytes_accessed": rec["bytes_accessed"],
+                           "intensity": rec.get("intensity"),
+                           "bound": rec.get("bound"),
+                           "predicted_ms": rec.get("predicted_ms")}
+        if rows:
+            return {"cost": rows}
     except Exception:
         pass
     return {}
@@ -244,7 +317,8 @@ def bench_llama(offload=False):
     tokens_per_sec, spread, vals, floss = _timed_train_tokens(
         step, x, batch, seq, steps)
     final_loss = [floss]
-    model_flops = 6.0 * n_params * tokens_per_sec
+    from paddle_tpu.telemetry.costledger import model_train_flops
+    model_flops = model_train_flops(n_params, tokens_per_sec)
     peak = chip_peak_flops()
     mfu = model_flops / peak
     # hardware utilization: selective remat replays only gate/up MLP
@@ -280,6 +354,7 @@ def bench_llama(offload=False):
         extra = _phase_fields(model, step, batch, seq, n_params,
                               "llama", recompute_per_tok) or {}
     extra.update(_peak_hbm_fields())
+    extra.update(_cost_fields())
     _emit(name, tokens_per_sec, unit + ")", mfu / 0.40, spread, vals,
           extra=extra or None)
 
@@ -502,7 +577,9 @@ def bench_bert():
         return batch * seq * steps / (time.perf_counter() - t0)
 
     tokens_per_sec, spread, vals = _measure(rep)
-    mfu = 6.0 * n_params * tokens_per_sec / chip_peak_flops()
+    from paddle_tpu.telemetry.costledger import model_train_flops
+    mfu = model_train_flops(n_params, tokens_per_sec) \
+        / chip_peak_flops()
     _emit("bert_base_train_tokens_per_sec_per_chip", tokens_per_sec,
           f"tokens/s/chip (mfu={mfu:.3f}, params={n_params/1e6:.0f}M, "
           f"loss={final_loss[0]:.3f})", mfu / 0.40, spread, vals,
@@ -1120,21 +1197,26 @@ def _assert_telemetry_zero_overhead():
         # (rank tagging, memory-ledger registration, fleet flags are
         # all host-side)
         telemetry.set_rank(0, 2)
+        # FLAGS_mfu_floor joins the armed surface (ISSUE 12): the cost
+        # ledger's drift floor is host-plane only, so arming it must
+        # leave the compiled step byte-identical too
         set_flags({"FLAGS_compile_cache_dir":
                    _os.path.join(d, "cache"),
-                   "FLAGS_straggler_skew_ms": 50.0})
+                   "FLAGS_straggler_skew_ms": 50.0,
+                   "FLAGS_mfu_floor": 0.5})
         try:
             step, x, hlo_armed = build_hlo()
             step(x, x)                      # exercise the armed path
         finally:
             set_flags({"FLAGS_compile_cache_dir": "",
-                       "FLAGS_straggler_skew_ms": 0.0})
+                       "FLAGS_straggler_skew_ms": 0.0,
+                       "FLAGS_mfu_floor": 0.0})
             telemetry.disable_persistent_cache()
             telemetry.remove_sink(sink)
     _, _, hlo_off2 = build_hlo()
     assert hlo_off == hlo_armed == hlo_off2, \
-        "telemetry sink / compile-cache / fleet arming changed the " \
-        "train-step program"
+        "telemetry sink / compile-cache / fleet / cost-ledger arming " \
+        "changed the train-step program"
     # scrub the assert's own footprint (steps/compile records from the
     # tiny MLP) so the telemetry snapshot embedded in this config's
     # metric lines reflects ONLY the config's run

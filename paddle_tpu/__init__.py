@@ -138,9 +138,11 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
 
     x = _jnp.zeros(tuple(input_size), _jnp.float32)
     compiled = _j.jit(fwd).lower(vals, x).compile()
-    cost = compiled.cost_analysis()
-    cost = cost[0] if isinstance(cost, (list, tuple)) else cost
-    total = float((cost or {}).get("flops", 0.0))
+    # the ONE cost_analysis derivation (telemetry.costledger.cost_of):
+    # the compute cost ledger, the MFU tools and this API all read
+    # XLA's counters through the same code path
+    from .telemetry import costledger as _cl
+    total = _cl.cost_of(compiled)["flops"]
     if print_detail:
         print(f"Total Flops: {total:.0f}")
     return total

@@ -37,8 +37,8 @@ from .base import Finding, ProgramVerifyError, LintError, \
     CollectiveOrderError, RecompileError
 from .verifier import verify_program, check_program
 from .lints import lint_dtype_promotion, lint_transfers, lint_donation, \
-    lint_materialized_logits, lint_peak_hbm, lint_serve_programs, \
-    recompile_guard, note_program_build
+    lint_materialized_logits, lint_peak_hbm, lint_mfu_floor, \
+    lint_serve_programs, recompile_guard, note_program_build
 from .collectives import CollectiveEvent, collective_schedule, \
     check_collective_order
 
@@ -47,7 +47,8 @@ __all__ = [
     "RecompileError",
     "verify_program", "check_program",
     "lint_dtype_promotion", "lint_transfers", "lint_donation",
-    "lint_materialized_logits", "lint_peak_hbm", "lint_serve_programs",
+    "lint_materialized_logits", "lint_peak_hbm", "lint_mfu_floor",
+    "lint_serve_programs",
     "recompile_guard", "note_program_build",
     "CollectiveEvent", "collective_schedule", "check_collective_order",
 ]

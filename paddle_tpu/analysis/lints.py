@@ -386,6 +386,52 @@ def lint_peak_hbm(compiled=None, *, budget_bytes: Optional[int] = None,
 
 
 # ---------------------------------------------------------------------------
+# MFU-floor lint (ISSUE 12: the cost ledger's drift check as a named
+# finding, the compute twin of lint_peak_hbm)
+
+def lint_mfu_floor(report: Optional[dict] = None, *,
+                   floor: Optional[float] = None,
+                   resolve: bool = True) -> List[Finding]:
+    """Findings for programs whose measured step time falls below the
+    calibrated roofline prediction by more than the floor allows:
+    ``attained`` = predicted_ms / measured_ms < floor — the program is
+    running slower than the cost model says it should (a perf drift:
+    co-tenant interference, a silently disabled fusion, a degraded
+    input pipeline).
+
+    `report` defaults to `telemetry.cost_report()` (resolving pending
+    ledger providers when `resolve`); `floor` defaults to
+    FLAGS_mfu_floor (0 disables — returns []).  Programs without
+    measured walls (no sink ever flowed step/chunk events) are
+    skipped, never guessed at.
+    """
+    from ..framework.flags import get_flag
+    if floor is None:
+        floor = float(get_flag("mfu_floor", 0.0) or 0.0)
+    if not floor:
+        return []
+    if report is None:
+        from ..telemetry import costledger
+        report = costledger.cost_report(resolve=resolve)
+    findings: List[Finding] = []
+    for lbl, rec in report.get("programs", {}).items():
+        if rec.get("status") != "ok":
+            continue
+        attained = rec.get("attained")
+        if attained is None or attained >= floor:
+            continue
+        findings.append(Finding(
+            "mfu-floor",
+            f"program {lbl!r} attains {attained:.3f} of its calibrated "
+            f"roofline prediction (measured {rec['measured_ms']:.3f} ms "
+            f"vs predicted {rec['predicted_ms']:.3f} ms, "
+            f"{rec.get('bound', '?')}-bound) — below the "
+            f"mfu_floor={floor} floor",
+            detail=(lbl, rec)))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # combined dispatch for compiled train steps
 
 def lint_compiled_step(compiled, args, *, mesh=None, dtype=False,

@@ -208,6 +208,17 @@ define_flag("serve_draft_layers", 0,
             "of a separate draft model — no extra weights resident.  "
             "Used when FLAGS_serve_spec_tokens > 0 and no draft_model "
             "is passed; 0 requires an explicit draft_model")
+# compute cost ledger / perf sentry (ISSUE 12, telemetry/costledger):
+# host-plane observability only — the flag never reaches a traced
+# program, so the compiled-step HLO stays byte-identical across any
+# setting (bench-asserted alongside the other telemetry flags).
+define_flag("mfu_floor", 0.0,
+            "minimum attained fraction of the calibrated roofline "
+            "prediction (predicted_ms / measured_ms) per program: a "
+            "program measuring below the floor is marked as drifting "
+            "in telemetry.cost_report() (perf.drift event) and "
+            "flagged by analysis.lint_mfu_floor.  0 disables the "
+            "check")
 define_flag("serve_retry_budget", 3,
             "per-request bound on serve-plane fault recoveries "
             "(injected/real admission faults retried FIFO-in-place, "

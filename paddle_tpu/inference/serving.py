@@ -1733,6 +1733,13 @@ class ContinuousBatcher:
                       decode_tokens=int(n_dec),
                       first_use=self._first_use)
             _tel.histogram("serve.chunk_ms").observe(dt * 1e3)
+            # cost ledger measured-wall feed (ISSUE 12): the chunk
+            # wall lands on the ledger label of the very program that
+            # ran it; first_use walls (may include the compile) are
+            # excluded like the chunk-time stats above
+            _tel.costledger.observe(
+                "serve_step.admit" if mixed else "serve_step.decode",
+                dt * 1e3, cold=self._first_use)
             if self.kv_layout == "paged":
                 _tel.emit("serve.kv",
                           pages=self.num_pages,

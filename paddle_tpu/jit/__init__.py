@@ -406,7 +406,8 @@ class TrainStep:
         # ledger registration BEFORE aot_for: an armed AOT compile then
         # overwrites the pending provider with free measured stats
         _ml.note_jit(self, "multi", self._compiled_multi, args,
-                     "jit.TrainStep.multi")
+                     "jit.TrainStep.multi",
+                     sig=tuple(b.shape for b in batch_vals))
         fn = _cc.aot_for(self._aot, "multi", self._compiled_multi, args,
                          batch_vals, "jit.TrainStep.multi")
         from .. import telemetry as _tel
@@ -488,7 +489,8 @@ class TrainStep:
                 *batch_vals)
         from ..telemetry import compile_cache as _cc, memledger as _ml
         _ml.note_jit(self, "step", self._compiled, args,
-                     "jit.TrainStep.step")
+                     "jit.TrainStep.step",
+                     sig=tuple(b.shape for b in batch_vals))
         fn = _cc.aot_for(self._aot, "step", self._compiled, args,
                          batch_vals, "jit.TrainStep.step")
         from .. import telemetry as _tel

@@ -18,6 +18,7 @@ import math
 from dataclasses import dataclass
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -149,12 +150,14 @@ class BertLayer(nn.Layer):
         self._act = getattr(config, "hidden_act", "gelu_tanh")
 
     def forward(self, x, attention_mask=None):
-        x = self.attn_norm(x + self.dropout(
-            self.attention(x, attention_mask)))
-        y = self.output(nn.functional.gelu(
-            self.intermediate(x),
-            approximate=self._act == "gelu_tanh"))
-        return self.out_norm(x + self.dropout(y))
+        with jax.named_scope("attn"):
+            x = self.attn_norm(x + self.dropout(
+                self.attention(x, attention_mask)))
+        with jax.named_scope("mlp"):
+            y = self.output(nn.functional.gelu(
+                self.intermediate(x),
+                approximate=self._act == "gelu_tanh"))
+            return self.out_norm(x + self.dropout(y))
 
 
 class BertModel(nn.Layer):
@@ -175,10 +178,15 @@ class BertModel(nn.Layer):
             nn.set_compute_dtype(self, config.dtype)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        x = self.embeddings(input_ids, token_type_ids)
-        for layer in self.layers:
-            x = layer(x, attention_mask)
-        pooled = nn.functional.tanh(self.pooler(x[:, 0]))
+        # named_scope: model-structure names in HLO metadata + device
+        # traces (ISSUE 12 per-layer attribution; see llama)
+        with jax.named_scope("bert.embed"):
+            x = self.embeddings(input_ids, token_type_ids)
+        for i, layer in enumerate(self.layers):
+            with jax.named_scope(f"bert.layer{i}"):
+                x = layer(x, attention_mask)
+        with jax.named_scope("bert.pooler"):
+            pooled = nn.functional.tanh(self.pooler(x[:, 0]))
         return x, pooled
 
 

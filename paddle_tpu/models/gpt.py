@@ -113,11 +113,13 @@ class GPTBlock(nn.Layer):
                             approximate=True)
             return y @ wo.astype(cd) + bo.astype(cd)
 
-        a = run(_attn, self.ln1(x), self.qkv, self.qkv_bias, self.proj,
-                self.proj_bias, name="gpt_attention")
-        x = x + a
-        m = run(_mlp, self.ln2(x), self.fc_in, self.fc_in_bias,
-                self.fc_out, self.fc_out_bias, name="gpt_mlp")
+        with jax.named_scope("attn"):
+            a = run(_attn, self.ln1(x), self.qkv, self.qkv_bias,
+                    self.proj, self.proj_bias, name="gpt_attention")
+            x = x + a
+        with jax.named_scope("mlp"):
+            m = run(_mlp, self.ln2(x), self.fc_in, self.fc_in_bias,
+                    self.fc_out, self.fc_out_bias, name="gpt_mlp")
         return x + m
 
     def _ln(self, ln, x):
@@ -185,13 +187,18 @@ class GPTModel(nn.Layer):
         cfg = self.config
         (input_ids,) = to_tensor_args(input_ids)
         seq = input_ids.shape[1]
-        x = run(lambda w, p: (jnp.take(w, input_ids.value.astype(
-                    jnp.int32), axis=0) + p[:seq]).astype(
-                        cfg.compute_dtype),
-                self.wte, self.wpe, name="gpt_embedding")
-        for layer in self.layers:
-            x = layer(x)
-        return self.ln_f(x)
+        # named_scope: model-structure names in HLO metadata + device
+        # traces (ISSUE 12 per-layer attribution; see llama)
+        with jax.named_scope("gpt.embed"):
+            x = run(lambda w, p: (jnp.take(w, input_ids.value.astype(
+                        jnp.int32), axis=0) + p[:seq]).astype(
+                            cfg.compute_dtype),
+                    self.wte, self.wpe, name="gpt_embedding")
+        for i, layer in enumerate(self.layers):
+            with jax.named_scope(f"gpt.layer{i}"):
+                x = layer(x)
+        with jax.named_scope("gpt.norm"):
+            return self.ln_f(x)
 
     def init_cache(self, batch: int, max_len: int):
         """Per-layer KV ring buffers [b, max_len, n_heads, hd] (the
@@ -218,13 +225,15 @@ class GPTModel(nn.Layer):
              + jnp.take(self.wpe.value, positions, axis=0)) \
             .astype(cfg.compute_dtype)
         new_cache = []
-        for layer, (kc, vc) in zip(self.layers, cache):
-            x, kc, vc = layer.forward_cached(x, kc, vc, pos)
+        for li, (layer, (kc, vc)) in enumerate(zip(self.layers, cache)):
+            with jax.named_scope(f"gpt.layer{li}"):
+                x, kc, vc = layer.forward_cached(x, kc, vc, pos)
             new_cache.append((kc, vc))
-        return tpu_ops.layer_norm(
-            x, self.ln_f.weight.value.astype(x.dtype),
-            self.ln_f.bias.value.astype(x.dtype),
-            cfg.layer_norm_epsilon), new_cache
+        with jax.named_scope("gpt.norm"):
+            return tpu_ops.layer_norm(
+                x, self.ln_f.weight.value.astype(x.dtype),
+                self.ln_f.bias.value.astype(x.dtype),
+                cfg.layer_norm_epsilon), new_cache
 
 
 class GPTForCausalLM(nn.Layer):

@@ -389,14 +389,17 @@ class LlamaDecoderLayer(nn.Layer):
         from ..distributed.fleet.recompute import recompute
         # region A: norm1 + qkv + rope.  The region outputs (post-rope
         # q/k/v) are remat boundaries — saved; internals replayed.
-        q, k, v = recompute(self._qkv_part, x, cos, sin)
-        # flash attention runs unrematerialized (saves out + lse)
-        attn = self.self_attn.core_attention(q, k, v)
+        with jax.named_scope("attn"):
+            q, k, v = recompute(self._qkv_part, x, cos, sin)
+            # flash attention runs unrematerialized (saves out + lse)
+            attn = self.self_attn.core_attention(q, k, v)
         # region B: o_proj + residuals + norm2 + MLP; only the tagged
         # mid-residual is saved, the MLP matmuls replay in the backward
         policy = jax.checkpoint_policies.save_only_these_names(
             "resid_mid")
-        return recompute(self._post_attention, x, attn, policy=policy)
+        with jax.named_scope("mlp"):
+            return recompute(self._post_attention, x, attn,
+                             policy=policy)
 
     def _qkv_part(self, x, cos, sin):
         return self.self_attn.qkv_rope(self.input_layernorm(x), cos, sin)
@@ -441,9 +444,11 @@ class LlamaDecoderLayer(nn.Layer):
 
     def _block(self, x, cos, sin):
         from ..parallel.sharded_trainer import constrain_activation
-        a = self.self_attn(self.input_layernorm(x), cos, sin)
+        with jax.named_scope("attn"):
+            a = self.self_attn(self.input_layernorm(x), cos, sin)
         x, h = self._add_norm_mid(x, a)
-        x = x + self.mlp(h)
+        with jax.named_scope("mlp"):
+            x = x + self.mlp(h)
         return run(constrain_activation, x, name="constrain_resid")
 
     def _block_cached(self, x, cos, sin, attend):
@@ -510,13 +515,20 @@ class LlamaModel(nn.Layer):
         cos, sin = tpu_ops.rope_cos_sin(seq_len, cfg.head_dim,
                                         cfg.rope_theta, jnp.float32)
         from ..parallel.sharded_trainer import constrain_activation
-        x = run(lambda w: constrain_activation(
-                    jnp.take(w, input_ids.value.astype(jnp.int32),
-                             axis=0).astype(cfg.compute_dtype)),
-                self.embed_tokens, name="embedding")
-        for layer in self.layers:
-            x = layer(x, cos, sin)
-        return self.norm(x)
+        # named_scope threads model-structure names into the HLO op
+        # metadata and device traces (ISSUE 12): the cost ledger's
+        # scope census and chrome-trace lanes attribute work per layer
+        # instead of one opaque program
+        with jax.named_scope("llama.embed"):
+            x = run(lambda w: constrain_activation(
+                        jnp.take(w, input_ids.value.astype(jnp.int32),
+                                 axis=0).astype(cfg.compute_dtype)),
+                    self.embed_tokens, name="embedding")
+        for i, layer in enumerate(self.layers):
+            with jax.named_scope(f"llama.layer{i}"):
+                x = layer(x, cos, sin)
+        with jax.named_scope("llama.norm"):
+            return self.norm(x)
 
     def init_cache(self, batch: int, max_len: int):
         """Per-layer KV ring buffers [b, max_len, n_kv, hd] in the
@@ -564,11 +576,13 @@ class LlamaModel(nn.Layer):
                      input_ids.astype(jnp.int32),
                      axis=0).astype(cfg.compute_dtype)
         for li, layer in enumerate(self.layers):
-            x, cache = layer.forward_cached_paged(
-                x, cos, sin, cache, page_table, pos, li)
+            with jax.named_scope(f"llama.layer{li}"):
+                x, cache = layer.forward_cached_paged(
+                    x, cos, sin, cache, page_table, pos, li)
         w = self.norm.weight.value
-        return tpu_ops.rms_norm(x, w.astype(x.dtype),
-                                cfg.rms_norm_eps), cache
+        with jax.named_scope("llama.norm"):
+            return tpu_ops.rms_norm(x, w.astype(x.dtype),
+                                    cfg.rms_norm_eps), cache
 
     def forward_cached(self, input_ids, cache, pos):
         """input_ids: [b, s_new] jax array; cache: init_cache pytree;
@@ -588,12 +602,15 @@ class LlamaModel(nn.Layer):
         new_cache = []
         # zip bounds the walk at the cache's depth — an EarlyExitDraft
         # passes an n-entry cache to run only the first n blocks
-        for layer, (kc, vc) in zip(self.layers, cache):
-            x, kc, vc = layer.forward_cached(x, cos, sin, kc, vc, pos)
+        for li, (layer, (kc, vc)) in enumerate(zip(self.layers, cache)):
+            with jax.named_scope(f"llama.layer{li}"):
+                x, kc, vc = layer.forward_cached(x, cos, sin, kc, vc,
+                                                 pos)
             new_cache.append((kc, vc))
         w = self.norm.weight.value
-        return tpu_ops.rms_norm(x, w.astype(x.dtype),
-                                cfg.rms_norm_eps), new_cache
+        with jax.named_scope("llama.norm"):
+            return tpu_ops.rms_norm(x, w.astype(x.dtype),
+                                    cfg.rms_norm_eps), new_cache
 
 
 class LlamaForCausalLM(nn.Layer):
@@ -616,12 +633,13 @@ class LlamaForCausalLM(nn.Layer):
             # into the chunked cross entropy — the [B, S, V] fp32
             # logits (the step's largest live buffer) never materialize
             return x
-        if self.config.tie_word_embeddings:
-            w = self.llama.embed_tokens
-            return run(lambda v, e: v @ e.T.astype(v.dtype), x, w,
-                       name="lm_head")
-        return run(lambda v, w: v @ w.astype(v.dtype), x, self.lm_head,
-                   name="lm_head")
+        with jax.named_scope("llama.lm_head"):
+            if self.config.tie_word_embeddings:
+                w = self.llama.embed_tokens
+                return run(lambda v, e: v @ e.T.astype(v.dtype), x, w,
+                           name="lm_head")
+            return run(lambda v, w: v @ w.astype(v.dtype), x,
+                       self.lm_head, name="lm_head")
 
     def init_cache(self, batch: int, max_len: int):
         return self.llama.init_cache(batch, max_len)

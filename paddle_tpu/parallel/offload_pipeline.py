@@ -765,7 +765,8 @@ class OffloadPipelineStep:
                       jnp.asarray(lr, jnp.float32),
                       jnp.asarray(self.optimizer._step_count, jnp.int32),
                       key, batch_vals),
-                     "OffloadPipelineStep.step", mesh=self.mesh)
+                     "OffloadPipelineStep.step", mesh=self.mesh,
+                     sig=tuple(b.shape for b in batch_vals))
         _tel.counter("train.steps").inc()    # lifetime total, sink or not
         tel_on = _tel.active()
         t0 = time.perf_counter()

@@ -753,7 +753,8 @@ class ShardedTrainStep:
         # overwrites the pending provider with free measured stats
         _ml.note_jit(self, "multi", self._compiled_multi, args,
                      f"ShardedTrainStep.multi.s{self.stage}",
-                     mesh=self.mesh)
+                     mesh=self.mesh,
+                     sig=tuple(b.shape for b in stacked))
         fn = _cc.aot_for(self._aot, "multi", self._compiled_multi, args,
                          stacked, f"ShardedTrainStep.multi.s{self.stage}",
                          mesh=self.mesh)
@@ -878,7 +879,8 @@ class ShardedTrainStep:
         from ..telemetry import compile_cache as _cc, memledger as _ml
         _ml.note_jit(self, "step", self._compiled, args,
                      f"ShardedTrainStep.step.s{self.stage}",
-                     mesh=self.mesh)
+                     mesh=self.mesh,
+                     sig=tuple(b.shape for b in batch_vals))
         fn = _cc.aot_for(self._aot, "step", self._compiled, args,
                          batch_vals, f"ShardedTrainStep.step.s{self.stage}",
                          mesh=self.mesh)

@@ -47,6 +47,8 @@ from .compile_cache import (cache_dir, maybe_enable_persistent_cache,  # noqa: F
 from . import probe  # noqa: F401
 from . import memledger  # noqa: F401
 from .memledger import memory_report  # noqa: F401
+from . import costledger  # noqa: F401
+from .costledger import cost_report  # noqa: F401
 from . import fleet  # noqa: F401
 
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
@@ -59,14 +61,17 @@ __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
            "cache_dir", "maybe_enable_persistent_cache",
            "disable_persistent_cache", "aot_compile", "compile_report",
            "clear_report", "probe", "memledger", "memory_report",
+           "costledger", "cost_report",
            "fleet", "dump", "step_event"]
 
 
 def reset():
-    """Detach every sink, clear registry/config/rank AND the memory
-    ledger — the whole plane back to pristine (test isolation)."""
+    """Detach every sink, clear registry/config/rank AND the memory +
+    compute cost ledgers — the whole plane back to pristine (test
+    isolation)."""
     _registry_reset()
     memledger.reset()
+    costledger.reset()
 
 
 def dump(compact: bool = False) -> dict:
@@ -89,6 +94,13 @@ def dump(compact: bool = False) -> dict:
             "programs": len(mem["programs"]),
             "peak_hbm_bytes": mem["peak_hbm_bytes"],
             "device_hbm_bytes": mem["device_hbm_bytes"],
+        }
+    cost = costledger.snapshot()
+    if cost["programs"]:
+        out["cost"] = cost if not compact else {
+            "programs": len(cost["programs"]),
+            "drifts": sum(1 for r in cost["programs"].values()
+                          if r.get("drift")),
         }
     return out
 
@@ -145,6 +157,22 @@ def step_event(trainer, *, label: str, kind: str, step: int, k: int,
         }
     if extra:
         fields.update(extra)
+    # feed the cost ledger's measured-wall window (warm calls only —
+    # the first call per program may include the XLA compile).  The
+    # label is the memory ledger's, recorded by note_jit, so the wall
+    # lands on exactly the program whose cost_analysis() it describes;
+    # the whole call sits inside the caller's active() guard, keeping
+    # the no-sink path at zero.
+    ml_label = trainer.__dict__.get("_memledger_labels", {}).get(kind)
+    if ml_label:
+        # a retrace (note_jit saw a new sig) pays its compile in THIS
+        # wall — exclude it like the first use
+        fresh = trainer.__dict__.get("_memledger_fresh")
+        refreshed = bool(fresh) and kind in fresh
+        if refreshed:
+            fresh.discard(kind)
+        costledger.observe(ml_label, wall_ms,
+                           cold="cold" in fields or refreshed)
     histogram("train.step_ms").observe(per_step)
     emit("train.step", fields)
     # NOTE: the train.steps counter is incremented by the trainers

@@ -66,19 +66,43 @@ def register(label: str, provider: Callable[[], Any], meta:
         _programs[label] = {"label": label, "status": "pending",
                             "provider": provider,
                             "meta": dict(meta or {})}
+    # the label now describes a NEW program — the cost ledger's
+    # measured walls / entry for the old one must not leak onto it
+    try:
+        from . import costledger
+        costledger.program_changed(label)
+    except Exception:
+        pass
 
 
 def note_jit(owner, kind: str, jitfn, args: tuple, label: str,
-             mesh=None):
+             mesh=None, sig=None):
     """The trainers' one-line hook: on the first call of `kind` for
     this `owner`, aval-ize `args` (ShapeDtypeStructs — the ledger must
     not pin donated buffers) and register a provider that re-lowers the
     jitted step for those avals on demand.  Subsequent calls are one
-    set lookup."""
-    seen = owner.__dict__.setdefault("_memledger_seen", set())
-    if kind in seen:
+    tuple build + set lookup.
+
+    `sig` is a cheap retrace discriminator (the trainers pass their
+    batch shapes): a call whose sig DIFFERS from the previous call's
+    re-REGISTERS — the jit has retraced (e.g. run_steps at a new K),
+    so the label must describe the CURRENT program and the cost
+    ledger must drop the old program's measured walls, not mix them
+    (tracking the last sig rather than a seen-set keeps an
+    alternating-K workload honest too)."""
+    last = owner.__dict__.setdefault("_memledger_sig", {})
+    if kind in last and last[kind] == sig:
         return
-    seen.add(kind)
+    refreshed = kind in last
+    last[kind] = sig
+    if refreshed:
+        # the call that triggers a retrace pays the XLA compile in its
+        # own wall — step_event must treat it as cold for the cost
+        # ledger's measured window, like every first use
+        owner.__dict__.setdefault("_memledger_fresh", set()).add(kind)
+    # remember the ledger label per program kind: step_event feeds the
+    # cost ledger's measured walls by looking the label up here
+    owner.__dict__.setdefault("_memledger_labels", {})[kind] = label
     import jax
     try:
         # carry each argument's sharding AND memory kind: a host-
@@ -116,7 +140,19 @@ def capture(label: str, compiled, meta: Optional[dict] = None):
     with _lock:
         _programs[label] = entry
     _publish(entry)
+    _ingest_cost(label, compiled, meta)
     return entry
+
+
+def _ingest_cost(label: str, compiled, meta=None):
+    """Hand the in-hand executable to the compute cost ledger — the
+    one Compiled serves both ledgers (costledger's zero-extra-compiles
+    contract).  Never breaks the memory side."""
+    try:
+        from . import costledger
+        costledger.ingest(label, compiled, meta=meta)
+    except Exception:
+        pass
 
 
 def _publish(entry: dict):
@@ -136,7 +172,8 @@ def _resolve(entry: dict) -> dict:
     if provider is None:
         return entry
     try:
-        stats = _stats_from(provider())
+        compiled = provider()
+        stats = _stats_from(compiled)
     except Exception as e:          # noqa: BLE001
         entry["status"] = "error"
         entry["error"] = f"{type(e).__name__}: {e}"
@@ -144,6 +181,7 @@ def _resolve(entry: dict) -> dict:
     entry.update(stats)
     entry["status"] = "ok"
     _publish(entry)
+    _ingest_cost(entry["label"], compiled, entry.get("meta"))
     return entry
 
 
@@ -208,12 +246,17 @@ def memory_report(resolve: bool = True, top_buffers: int = 10) -> dict:
                if k not in ("provider", "label")}
         if e.get("status") == "ok":
             peak = max(peak, e["peak_bytes"])
-            if hbm:
-                rec["peak_share"] = round(e["peak_bytes"] / hbm, 4)
+            # a backend without memory_stats()/bytes_limit (CPU
+            # tier-1) degrades to share=None — never a KeyError or a
+            # raise downstream
+            rec["peak_share"] = round(e["peak_bytes"] / hbm, 4) \
+                if hbm else None
         programs[e["label"]] = rec
     return {"programs": programs,
             "device_hbm_bytes": hbm,
             "peak_hbm_bytes": peak,
+            "peak_hbm_share": round(peak / hbm, 4) if (hbm and peak)
+            else None,
             "live_buffers": _live_buffers(top_buffers)}
 
 

@@ -1,0 +1,401 @@
+"""Perf-regression sentry — diff a bench capture against the committed
+BENCH_r*.json trajectory (ISSUE 12: the bench trajectory finally gets
+an automated regression gate; ROADMAP's freshness caveat names the
+missing gate as the blocker for new perf claims).
+
+    python tools/perf_report.py
+        Compare the NEWEST capture in the trajectory (BENCH_r*.json at
+        the repo root) against the rest of it: for every metric
+        present in both, a drop beyond max(spread * k, threshold) on a
+        matching env fingerprint fails with a named finding.
+
+    python tools/perf_report.py --current run.jsonl
+        Compare a fresh capture (bench.py JSON lines, or a BENCH_r
+        driver file) against the committed trajectory.
+
+    python tools/perf_report.py --selftest
+        CI canary (tier-1-wired like chaos_check/fleet_report): plants
+        a regression that MUST be caught, a spread-sized wobble and a
+        cross-environment capture that must NOT fire, then runs the
+        real committed trajectory clean.  Exit 1 on any violation.
+
+Comparison rules (the sentry never false-fires by design):
+  * higher is better for every bench metric (tokens/s, images/s);
+  * a drop must clear max(k * spread, threshold) with spread = the
+    larger of the two lines' rep spreads (a noisy metric gets a wider
+    band, never a tighter one);
+  * lines marked ``comparable: false`` (one-shot aggregates like the
+    old reps=1 llama_serve_mixed) or with reps < 2 are skipped;
+  * records compare ONLY when both carry a ``capture_id`` and they
+    match — a jax bump, flag flip or different chip reads as
+    "skipped: env mismatch", and legacy captures without fingerprints
+    (pre-ISSUE-12 BENCH files) read as "skipped: no fingerprint",
+    never as a pass or a fail;
+  * a ``*_bench_error`` line in the current capture FAILS
+    (``bench-error``): a crashed leg's metrics vanish, and vanishing
+    must not read as clean — trajectory metrics absent from the
+    current capture are additionally listed as "missing" rows.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEFAULT_K = 3.0
+DEFAULT_THRESHOLD = 0.05
+
+
+# ---------------------------------------------------------------------------
+# loading
+
+def parse_capture(path: str):
+    """One capture -> metric records.  Accepts a driver BENCH_r*.json
+    (object with a ``tail`` of JSON lines) or a raw bench.py JSON-lines
+    file."""
+    with open(path) as f:
+        text = f.read()
+    records = []
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, dict) and "tail" in obj:
+        lines = obj["tail"].splitlines()
+    elif isinstance(obj, dict) and "metric" in obj:
+        return [obj]
+    elif isinstance(obj, list):
+        return [r for r in obj if isinstance(r, dict) and "metric" in r]
+    else:
+        lines = text.splitlines()
+    for line in lines:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec \
+                and "value" in rec:
+            records.append(rec)
+    return records
+
+
+_RN = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_trajectory(root: str):
+    """[(name, records)] for every BENCH_r*.json under `root`, oldest
+    first."""
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                   key=lambda p: int(_RN.search(p).group(1))
+                   if _RN.search(p) else 0)
+    return [(os.path.basename(p), parse_capture(p)) for p in paths]
+
+
+# ---------------------------------------------------------------------------
+# comparison
+
+def _comparable(rec) -> bool:
+    if rec.get("comparable") is False:
+        return False
+    return int(rec.get("reps", 1)) >= 2
+
+
+def compare(current, trajectory, k: float = DEFAULT_K,
+            threshold: float = DEFAULT_THRESHOLD):
+    """Judge `current` (metric records) against `trajectory`
+    ([(name, records)], oldest first).  Returns {findings, compared,
+    skipped, rows}; a finding is a regression verdict with the metric,
+    both values, the drop and the allowance it cleared."""
+    findings, rows = [], []
+    compared = skipped = 0
+    # per-metric candidates, NEWEST FIRST — the judge walks them for
+    # the newest baseline whose env fingerprint matches, so a stray
+    # cross-env or legacy capture appended to the trajectory can
+    # never shadow an older comparable baseline
+    baselines = {}
+    for name, records in trajectory:
+        for rec in records:
+            m = rec.get("metric")
+            if m and isinstance(rec.get("value"), (int, float)):
+                baselines.setdefault(m, []).insert(0, (name, rec))
+    seen = set()
+    for rec in current:
+        metric = rec.get("metric")
+        if metric and metric.endswith("_bench_error"):
+            # a crashed/timed-out leg is the most extreme regression —
+            # its real metric lines never appear, so the error line
+            # itself must fail the gate
+            findings.append({
+                "code": "bench-error", "metric": metric,
+                "message": f"{metric}: the bench leg produced an "
+                           f"error line instead of metrics "
+                           f"({rec.get('unit', '')!s})",
+            })
+            rows.append({"metric": metric, "value": rec.get("value"),
+                         "verdict": "BENCH ERROR"})
+            continue
+        if not metric \
+                or not isinstance(rec.get("value"), (int, float)):
+            continue
+        seen.add(metric)
+        row = {"metric": metric, "value": rec["value"]}
+        cands = baselines.get(metric)
+        if not cands:
+            row["verdict"] = "skipped: no baseline"
+            skipped += 1
+            rows.append(row)
+            continue
+        cur_id = rec.get("capture_id")
+        # newest matching-fingerprint comparable baseline wins; the
+        # newest candidate only names the skip reason when none match
+        id_matches = [c for c in cands
+                      if cur_id and c[1].get("capture_id") == cur_id]
+        match = next((c for c in id_matches if _comparable(c[1])),
+                     None)
+        bname, brec = match or (id_matches[0] if id_matches
+                                else cands[0])
+        row["baseline"] = brec["value"]
+        row["baseline_capture"] = bname
+        if match is None:
+            if id_matches:
+                row["verdict"] = "skipped: one-shot line " \
+                    "(comparable=false)"
+            elif not cur_id or not brec.get("capture_id"):
+                row["verdict"] = "skipped: no env fingerprint"
+            else:
+                row["verdict"] = "skipped: env mismatch (no " \
+                    f"{cur_id} baseline; newest is " \
+                    f"{brec.get('capture_id')})"
+            skipped += 1
+            rows.append(row)
+            continue
+        if not _comparable(rec):
+            row["verdict"] = "skipped: one-shot line (comparable=false)"
+            skipped += 1
+            rows.append(row)
+            continue
+        spread = max(float(rec.get("spread", 0.0)),
+                     float(brec.get("spread", 0.0)))
+        allowed = max(k * spread, threshold)
+        drop = (brec["value"] - rec["value"]) / brec["value"] \
+            if brec["value"] else 0.0
+        row["drop"] = round(drop, 4)
+        row["allowed"] = round(allowed, 4)
+        compared += 1
+        if drop > allowed:
+            row["verdict"] = "REGRESSION"
+            findings.append({
+                "code": "perf-regression",
+                "metric": metric,
+                "message": f"{metric} dropped {drop * 100:.1f}% "
+                           f"({brec['value']} in {bname} -> "
+                           f"{rec['value']}) — beyond the allowed "
+                           f"max({k:g} x spread {spread:g}, "
+                           f"{threshold:g}) = {allowed * 100:.1f}%",
+                "baseline": brec["value"], "value": rec["value"],
+                "drop": round(drop, 4), "allowed": round(allowed, 4),
+                "baseline_capture": bname,
+            })
+        else:
+            row["verdict"] = "ok"
+        rows.append(row)
+    # trajectory metrics the current capture never produced: surfaced
+    # as rows (a full-matrix run losing a metric is worth eyes) but
+    # not findings — a single-config `bench.py --only` run
+    # legitimately carries one metric
+    for metric in sorted(set(baselines) - seen):
+        bname, brec = baselines[metric][0]      # newest candidate
+        rows.append({"metric": metric, "baseline": brec["value"],
+                     "baseline_capture": bname,
+                     "verdict": "missing: not in current capture"})
+    return {"findings": findings, "compared": compared,
+            "skipped": skipped, "rows": rows}
+
+
+def render(rep) -> str:
+    lines = [f"compared {rep['compared']} metric(s), "
+             f"skipped {rep['skipped']}, "
+             f"{len(rep['findings'])} regression(s)"]
+    for row in rep["rows"]:
+        tail = ""
+        if "drop" in row:
+            tail = (f"  drop={row['drop'] * 100:+.1f}% "
+                    f"allowed={row['allowed'] * 100:.1f}%")
+        base = f" vs {row['baseline']} ({row['baseline_capture']})" \
+            if "baseline" in row else ""
+        val = f" = {row['value']}" if "value" in row else ""
+        lines.append(f"  {row['verdict']:<12} {row['metric']}"
+                     f"{val}{base}{tail}")
+    for f in rep["findings"]:
+        lines.append(f"FAIL [{f['code']}] {f['message']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# selftest
+
+def _mk(metric, value, spread=0.02, reps=3, capture_id="envA",
+        **kw):
+    rec = {"metric": metric, "value": value, "unit": "u",
+           "vs_baseline": 1.0, "reps": reps, "spread": spread,
+           "capture_id": capture_id}
+    rec.update(kw)
+    return rec
+
+
+def _selftest(repo_root: str):
+    problems = []
+    base = [(
+        "BENCH_s1.json",
+        [_mk("m_train", 100.0),
+         _mk("m_serve", 50.0, reps=1, spread=0.0, comparable=False),
+         {"metric": "m_legacy", "value": 80.0, "unit": "u",
+          "vs_baseline": 1.0, "reps": 3, "spread": 0.01}])]
+
+    # 1. clean capture: a wobble inside max(3*spread, threshold) passes
+    rep = compare([_mk("m_train", 97.0)], base)
+    if rep["findings"] or rep["compared"] != 1:
+        problems.append(f"clean capture misjudged: {rep}")
+
+    # 2. planted regression MUST be caught with a named finding
+    rep = compare([_mk("m_train", 80.0)], base)
+    if len(rep["findings"]) != 1 \
+            or rep["findings"][0]["code"] != "perf-regression" \
+            or rep["findings"][0]["metric"] != "m_train":
+        problems.append(f"planted regression not caught: {rep}")
+
+    # 3. spread-awareness: a noisy metric widens its own band
+    noisy = [("BENCH_s1.json", [_mk("m_train", 100.0, spread=0.10)])]
+    rep = compare([_mk("m_train", 75.0, spread=0.10)], noisy)
+    if rep["findings"]:
+        problems.append(f"spread-sized drop false-fired: {rep}")
+    rep = compare([_mk("m_train", 60.0, spread=0.10)], noisy)
+    if not rep["findings"]:
+        problems.append("drop past the spread band not caught")
+
+    # 4. cross-environment capture is refused, never compared
+    rep = compare([_mk("m_train", 10.0, capture_id="envB")], base)
+    if rep["findings"] or rep["compared"] != 0:
+        problems.append(f"cross-env capture was compared: {rep}")
+    if not any("env mismatch" in r["verdict"] for r in rep["rows"]):
+        problems.append(f"env mismatch not named: {rep['rows']}")
+
+    # 5. legacy (unfingerprinted) baselines are refused too
+    rep = compare([_mk("m_legacy", 10.0)], base)
+    if rep["findings"] or rep["compared"] != 0:
+        problems.append(f"unfingerprinted baseline compared: {rep}")
+
+    # 6. the one-shot comparable=false line is skipped
+    rep = compare([_mk("m_serve", 1.0)], base)
+    if rep["findings"] or rep["compared"] != 0:
+        problems.append(f"comparable=false line compared: {rep}")
+
+    # 6b. a stray cross-env capture appended to the trajectory must
+    # not shadow an older matching-fingerprint baseline
+    shadowed = base + [("BENCH_s2.json",
+                        [_mk("m_train", 1000.0, capture_id="envB")])]
+    rep = compare([_mk("m_train", 80.0)], shadowed)
+    if len(rep["findings"]) != 1 \
+            or rep["findings"][0]["baseline_capture"] \
+            != "BENCH_s1.json":
+        problems.append(f"cross-env capture shadowed the matching "
+                        f"baseline: {rep}")
+
+    # 7. a crashed leg's *_bench_error line must FAIL the gate
+    rep = compare([_mk("m_train", 100.0),
+                   {"metric": "serve_bench_error", "value": 0,
+                    "unit": "timeout 1500s", "vs_baseline": 0.0}],
+                  base)
+    if not any(f["code"] == "bench-error" for f in rep["findings"]):
+        problems.append(f"bench_error line did not fail: {rep}")
+
+    # 8. a trajectory metric missing from the current capture is
+    # surfaced as a row (visibility), without failing a partial run
+    rep = compare([_mk("m_train", 100.0)], base)
+    if rep["findings"]:
+        problems.append(f"partial capture false-fired: {rep}")
+    if not any(r["verdict"].startswith("missing")
+               and r["metric"] == "m_serve" for r in rep["rows"]):
+        problems.append(f"vanished metric not surfaced: {rep['rows']}")
+
+    # 9. the REAL committed trajectory passes (legacy captures skip on
+    # the fingerprint rule; nothing may raise or false-fire)
+    traj = load_trajectory(repo_root)
+    if traj:
+        latest_name, latest = traj[-1]
+        rep = compare(latest, traj[:-1])
+        if rep["findings"]:
+            problems.append(
+                f"real trajectory ({latest_name}) fired: "
+                f"{rep['findings']}")
+    else:
+        problems.append(f"no BENCH_r*.json trajectory under "
+                        f"{repo_root}")
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="perf-regression sentry over the BENCH_r* "
+                    "trajectory")
+    ap.add_argument("--current",
+                    help="fresh capture to judge (bench.py JSON lines "
+                         "or a BENCH_r driver file); default: the "
+                         "newest trajectory capture")
+    ap.add_argument("--trajectory",
+                    default=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    help="directory holding BENCH_r*.json "
+                         "(default: repo root)")
+    ap.add_argument("--k", type=float, default=DEFAULT_K,
+                    help="spread multiplier for the allowed band")
+    ap.add_argument("--threshold", type=float,
+                    default=DEFAULT_THRESHOLD,
+                    help="minimum allowed relative drop")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--selftest", action="store_true",
+                    help="plant a regression (must be caught) + run "
+                         "the real trajectory (must pass); exit 1 on "
+                         "any violation")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        problems = _selftest(args.trajectory)
+        if problems:
+            for p in problems:
+                print(f"FAIL {p}")
+            return 1
+        print("selftest: perf sentry ok (planted regression caught, "
+              "clean trajectory passes)")
+        return 0
+
+    trajectory = load_trajectory(args.trajectory)
+    if not trajectory:
+        print(f"no BENCH_r*.json captures under {args.trajectory}",
+              file=sys.stderr)
+        return 2
+    if args.current:
+        current = parse_capture(args.current)
+    else:
+        _, current = trajectory[-1]
+        trajectory = trajectory[:-1]
+    rep = compare(current, trajectory, k=args.k,
+                  threshold=args.threshold)
+    if args.json:
+        print(json.dumps(rep, indent=1))
+    else:
+        print(render(rep))
+    return 1 if rep["findings"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

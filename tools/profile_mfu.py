@@ -77,6 +77,10 @@ def _profile(model, step, batch, seq, n_params, label,
     t_full = _median_time(lambda: step(x, x), sync)
     tok = batch * seq
     peak = chip_peak_flops()
+    # per-phase model-FLOP accounting through the ONE shared derivation
+    # (telemetry.costledger.model_train_flops: 2N/4N/6N per token,
+    # regression-pinned against the values this tool always reported)
+    from paddle_tpu.telemetry.costledger import model_train_flops
     return {
         "config": label, "n_params": n_params,
         "t_fwd_ms": t_fwd * 1e3,
@@ -84,11 +88,15 @@ def _profile(model, step, batch, seq, n_params, label,
         "t_full_ms": t_full * 1e3,
         "t_bwd_ms": (t_fb - t_fwd) * 1e3,
         "t_opt_ms": (t_full - t_fb) * 1e3,
-        "fwd_util": 2.0 * n_params * tok / (t_fwd * peak),
-        "bwd_util": 4.0 * n_params * tok / ((t_fb - t_fwd) * peak),
-        "bwd_util_hw": (4.0 * n_params + remat_flops) * tok
+        "fwd_util": model_train_flops(n_params, tok, "fwd")
+        / (t_fwd * peak),
+        "bwd_util": model_train_flops(n_params, tok, "bwd")
         / ((t_fb - t_fwd) * peak),
-        "mfu_full": 6.0 * n_params * tok / (t_full * peak),
+        "bwd_util_hw": model_train_flops(
+            n_params, tok, "bwd", remat_flops_per_token=remat_flops)
+        / ((t_fb - t_fwd) * peak),
+        "mfu_full": model_train_flops(n_params, tok, "full")
+        / (t_full * peak),
     }
 
 

@@ -226,6 +226,40 @@ def analyze(events, peak=None):
         s["latency"] = lat
         s["slo"] = att
 
+    # cost/roofline section (ISSUE 12): per-program FLOPs/bytes from
+    # the cost.program records the ledger publishes on resolve, plus
+    # any perf.drift events (predicted vs measured below the floor)
+    cost_kinds = ("cost.program", "cost.measure", "perf.drift")
+    if any(e.get("event") in cost_kinds for e in events):
+        progs, n_drift = {}, 0
+        # ONE pass in log order: the LATEST record per program wins —
+        # cost.measure carries the drift STATE (perf.drift is the
+        # edge-triggered alarm), so a recovered measure after a drift
+        # episode clears the flag and a persisting one keeps it
+        for e in events:
+            kind = e.get("event")
+            if kind not in cost_kinds:
+                continue
+            p = progs.setdefault(str(e.get("label")), {})
+            if kind == "cost.program":
+                p.update({k: e[k] for k in
+                          ("flops", "bytes_accessed")
+                          if isinstance(e.get(k), (int, float))})
+                continue
+            p["predicted_ms"] = e.get("predicted_ms")
+            p["measured_ms"] = e.get("measured_ms")
+            p["attained"] = e.get("attained")
+            if kind == "perf.drift":
+                n_drift += 1
+                p["drift"] = True
+            else:
+                p["bound"] = e.get("bound")
+                if e.get("drift"):
+                    p["drift"] = True
+                else:
+                    p.pop("drift", None)
+        out["cost"] = {"programs": progs, "drifts": n_drift}
+
     io_steps = [e for e in events if e.get("event") == "io.step"]
     if io_steps:
         ws = [e.get("host_wait_ms", 0.0) for e in io_steps]
@@ -319,6 +353,26 @@ def render(rep):
                 f"requeues {r['requeues']}, "
                 f"chunk faults {r['chunk_faults']}, "
                 f"hung {r['hung_chunks']}, drains {r['drains']}")
+    if "cost" in rep:
+        c = rep["cost"]
+        lines.append(f"cost        {len(c['programs'])} program(s), "
+                     f"{c['drifts']} drift(s)")
+        for lbl, p in sorted(c["programs"].items()):
+            parts = []
+            if "flops" in p:
+                parts.append(f"{p['flops']:.3g} flops")
+            if "bytes_accessed" in p:
+                parts.append(f"{p['bytes_accessed']:.3g} B")
+            if "bound" in p and p.get("bound"):
+                parts.append(f"{p['bound']}-bound")
+            if p.get("measured_ms") is not None:
+                parts.append(
+                    f"predicted {p.get('predicted_ms')}ms vs "
+                    f"measured {p.get('measured_ms')}ms "
+                    f"(attained {p.get('attained')})")
+            if p.get("drift"):
+                parts.append("DRIFT")
+            lines.append(f"  {lbl:<24} " + ", ".join(parts))
     if "io" in rep:
         i = rep["io"]
         lines.append(f"io          {i['steps']} gets, host wait p50 "
@@ -362,6 +416,24 @@ def _selftest():
                 x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
                 for _ in range(5):
                     step(x, x)
+                # cost/roofline leg (ISSUE 12): resolving the ledger
+                # with the sink live publishes cost.program records,
+                # and a planted slow wall under FLAGS_mfu_floor must
+                # surface as perf.drift
+                telemetry.cost_report()
+                set_flags({"FLAGS_mfu_floor": 0.95})
+                try:
+                    # explicit measured= makes the plant authoritative
+                    # (a single observe() sample would drown in the
+                    # median of the real warm walls)
+                    telemetry.cost_report(
+                        measured={"jit.TrainStep.step": 1e6})
+                finally:
+                    set_flags({"FLAGS_mfu_floor": 0.0})
+                    # clear the drift edge state — the selftest must
+                    # not leak its planted drift into the caller's
+                    # ledger
+                    telemetry.costledger.reset()
             finally:
                 telemetry.remove_sink(sink)
         finally:
@@ -396,9 +468,39 @@ def _selftest():
         for e in compiles:
             if e.get("cache") not in ("hit", "miss", "error"):
                 problems.append(f"compile event bad cache field: {e}")
+        cost_ev = [e for e in events
+                   if e.get("event") == "cost.program"]
+        if not any(e.get("label") == "jit.TrainStep.step"
+                   and e.get("flops", 0) > 0
+                   and e.get("bytes_accessed", 0) > 0
+                   for e in cost_ev):
+            problems.append(f"no cost.program record for the step "
+                            f"program: {cost_ev}")
+        meas_ev = [e for e in events
+                   if e.get("event") == "cost.measure"]
+        if not any(e.get("label") == "jit.TrainStep.step"
+                   and isinstance(e.get("predicted_ms"), (int, float))
+                   and isinstance(e.get("measured_ms"), (int, float))
+                   and "attained" in e for e in meas_ev):
+            problems.append(f"no predicted-vs-measured cost.measure "
+                            f"record: {meas_ev}")
+        drift_ev = [e for e in events if e.get("event") == "perf.drift"]
+        if not drift_ev:
+            problems.append("planted drift produced no perf.drift "
+                            "event")
+        for e in drift_ev:
+            for key in ("label", "predicted_ms", "measured_ms",
+                        "attained", "floor"):
+                if key not in e:
+                    problems.append(f"perf.drift missing {key!r}: {e}")
         rep = analyze(events)
         if "phases" not in rep or "step_ms" not in rep:
             problems.append(f"report missing phase stats: {rep}")
+        cost = rep.get("cost")
+        if not cost or cost.get("drifts", 0) < 1 \
+                or "jit.TrainStep.step" not in cost.get("programs", {}):
+            problems.append(f"report missing cost/roofline section: "
+                            f"{rep.get('cost')}")
         print(render(rep))
 
         # serve-robustness leg (ISSUE 9): a bounded queue + a dead
