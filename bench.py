@@ -1093,6 +1093,45 @@ def _assert_fault_tolerance_zero_overhead():
     assert fault.hit_counts() == hits, \
         "flags-off train steps consulted the fault registry"
 
+    # elastic reshard machinery (ISSUE 13) is flags-off free: with
+    # FLAGS_ckpt_save_sharded off, (a) the trainer HLO is untouched by
+    # toggling the flag (it is pure host-plane — the step never sees
+    # it), and (b) checkpoint MANIFEST bytes and shard container bytes
+    # are byte-identical across an arm/disarm cycle — the r9 on-disk
+    # format survives the elastic merge exactly
+    import os
+    import shutil
+    import tempfile
+
+    def _save_bytes():
+        d = tempfile.mkdtemp(prefix="bench_ckpt_")
+        try:
+            ckpt.save_state_dict(
+                {"w": paddle.to_tensor(np.ones((8, 8), np.float32))}, d)
+            with open(os.path.join(d, "metadata.json"), "rb") as f:
+                manifest = f.read()
+            with open(os.path.join(d, "0.distcp"), "rb") as f:
+                shard = f.read()
+            return manifest, shard
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    hlo_before = step.compiled_hlo(x, y, optimized=False)
+    man_before, shard_before = _save_bytes()
+    paddle.set_flags({"FLAGS_ckpt_save_sharded": True})
+    try:
+        man_armed, _ = _save_bytes()   # armed save must still work
+        assert man_armed
+    finally:
+        paddle.set_flags({"FLAGS_ckpt_save_sharded": False})
+    man_after, shard_after = _save_bytes()
+    assert man_after == man_before, \
+        "FLAGS_ckpt_save_sharded toggle changed flags-off manifests"
+    assert shard_after == shard_before, \
+        "FLAGS_ckpt_save_sharded toggle changed flags-off shard bytes"
+    assert step.compiled_hlo(x, y, optimized=False) == hlo_before, \
+        "FLAGS_ckpt_save_sharded toggle changed the train-step HLO"
+
 
 def _assert_mfu_fusion_zero_overhead():
     """FLAGS_fused_ce / FLAGS_bf16_adamw_moments are toggle-stable:

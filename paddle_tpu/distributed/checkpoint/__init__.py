@@ -53,13 +53,16 @@ import jax.numpy as jnp
 from ...framework.tensor import Tensor
 from ...framework.flags import define_flag, get_flag
 from .. import fault
+from .reshard import (ReshardError, ShardSlice, assemble, index_volume,
+                      normalize_index, split_index)
 
 __all__ = ["save_state_dict", "load_state_dict",
            "synchronize_async_saves", "save_checkpoint",
            "load_checkpoint", "latest_checkpoint", "is_complete",
            "checkpoint_meta", "save_train_checkpoint",
            "restore_train_checkpoint", "optimizer_meta",
-           "apply_optimizer_meta"]
+           "apply_optimizer_meta", "ReshardError", "ShardSlice",
+           "cursor_to_meta", "cursor_from_meta"]
 
 define_flag("ckpt_write_retries", 3,
             "attempts per checkpoint shard write before the IO error "
@@ -73,6 +76,29 @@ define_flag("ckpt_commit_verify_crc", True,
             "disable on multi-GB states to avoid a full-checkpoint "
             "read per save — size/manifest checks still run, and "
             "post-crash load always verifies CRCs")
+define_flag("ckpt_save_sharded", False,
+            "write sharded arrays as per-shard slices with global index "
+            "metadata even when fully addressable (single-controller "
+            "SPMD) — the elastic reshard-on-load contract: the on-disk "
+            "layout matches what a multi-host save of the same mesh "
+            "would produce, and any other topology reassembles it from "
+            "the overlapping slices.  Off (default) keeps the r9 "
+            "gathered-full-array format byte-identical")
+
+
+def _proc_rank_world():
+    """(rank, world) identity of the saving/loading PROCESS.  A real
+    multi-host jax runtime answers jax.process_index/count; an N-proc
+    host-plane fleet job (one single-device jax per rank, rendezvoused
+    by the launch controller) answers PADDLE_TRAINER_ID/NUM — so each
+    fleet rank writes its own `<rank>.distcp` and the coordinator-only
+    commit/GC contract holds across both planes."""
+    pc = jax.process_count()
+    if pc > 1:
+        return jax.process_index(), pc
+    from ..host_collectives import host_world
+    r, w = host_world()
+    return (r, w) if w > 1 else (0, 1)
 
 # single-worker writer: async saves queue here (reference
 # save_state_dict.py:46 — a dedicated save process fed from a queue);
@@ -324,34 +350,85 @@ def _write_files(path, rank, shards, meta, coordinator_rank):
                                 json.dumps(meta).encode())
 
 
-def _read_file(fpath):
-    """Parse one .distcp file (v2 container or legacy pickle) into
-    {key: array | {"local": [...], "index": [...]}}."""
+def _entry_reader(fpath):
+    """Parse one .distcp file's HEADER (v2 container; legacy pickle
+    reads the whole dict) into ``(pieces, close)`` where pieces is
+
+        [(key, index_or_None, shape, fetch)]
+
+    ``index`` is a normalized global slice tuple for sharded entries
+    (None = a full-tensor entry) and ``fetch()`` lazily reads and
+    CRC-verifies just that entry's payload — reshard-on-load only
+    touches the bytes of the slices that actually overlap the target.
+    All fetchers share ONE read-only fd (seek-free ``os.pread``; large
+    entries ride the parallel native reader instead); the caller closes
+    it via ``close()`` once assembly is done."""
     with open(fpath, "rb") as f:
         head = f.read(len(_MAGIC))
         if head != _MAGIC:
             f.seek(0)
-            return pickle.load(f)
+            legacy = pickle.load(f)
+            out = []
+            for k, v in legacy.items():
+                if isinstance(v, dict) and "local" in v:
+                    # global extent per dim: an index pair with stop
+                    # None means "the full dim" — resolve it from the
+                    # piece's own local extent, not a zero default
+                    ndim = np.asarray(v["local"][0]).ndim
+                    dims = [0] * ndim
+                    for local, index in zip(v["local"], v["index"]):
+                        for d, p in enumerate(index):
+                            start = (p.start if isinstance(p, slice)
+                                     else p[0]) or 0
+                            stop = p.stop if isinstance(p, slice) \
+                                else p[1]
+                            if stop is None:
+                                stop = start + int(
+                                    np.asarray(local).shape[d])
+                            dims[d] = max(dims[d], int(stop))
+                    for local, index in zip(v["local"], v["index"]):
+                        idx = normalize_index(
+                            [p if isinstance(p, slice)
+                             else slice(p[0] or 0, p[1]) for p in index],
+                            dims)
+                        out.append((k, idx, local.shape,
+                                    (lambda a=local: a)))
+                else:
+                    out.append((k, None, np.asarray(v).shape,
+                                (lambda a=v: np.asarray(a))))
+            return out, (lambda: None)
         hlen = int.from_bytes(f.read(8), "little")
         header = json.loads(f.read(hlen))
         base = len(_MAGIC) + 8 + hlen
-        # payload extent comes from the HEADER, not the file size —
-        # trailing garbage then fails the per-entry crc, not silently
-        size = 0
-        for ent in header["entries"]:
-            for e in ([ent] if not ent.get("sharded") else ent["locals"]):
-                size = max(size, e["offset"] + e["nbytes"])
-        from ... import _native
-        io = _native.io_lib()
-        if io is not None and size > 0:
-            payload = None      # read via the parallel engine below
-        else:
-            payload = f.read(size)
-    if payload is None:
-        payload = io.read(fpath, size, base, 8)
+
+    state = {"fd": None}
+    _NATIVE_MIN = 8 * 1024 * 1024
+
+    def _pread(off, nbytes):
+        if nbytes >= _NATIVE_MIN:
+            from ... import _native
+            io = _native.io_lib()
+            if io is not None:
+                return io.read(fpath, nbytes, off, 8)
+        if state["fd"] is None:
+            state["fd"] = os.open(fpath, os.O_RDONLY)
+        chunks, want = [], nbytes
+        while want > 0:
+            b = os.pread(state["fd"], want, off)
+            if not b:
+                break   # short file: the length/CRC check reports it
+            chunks.append(b)
+            off += len(b)
+            want -= len(b)
+        return chunks[0] if len(chunks) == 1 else b"".join(chunks)
+
+    def close():
+        if state["fd"] is not None:
+            os.close(state["fd"])
+            state["fd"] = None
 
     def mat(e):
-        raw = payload[e["offset"]:e["offset"] + e["nbytes"]]
+        raw = _pread(base + e["offset"], e["nbytes"])
         if len(raw) != e["nbytes"] \
                 or (zlib.crc32(raw) & 0xFFFFFFFF) != e["crc"]:
             raise IOError(
@@ -359,25 +436,74 @@ def _read_file(fpath):
         return np.frombuffer(raw, np.dtype(e["dtype"])) \
             .reshape(e["shape"]).copy()
 
-    out = {}
+    out = []
     for ent in header["entries"]:
         if ent.get("sharded"):
-            out[ent["key"]] = {
-                "local": [mat(e) for e in ent["locals"]],
-                "index": [[tuple(p) for p in e["index"]]
-                          for e in ent["locals"]]}
+            for e in ent["locals"]:
+                # pre-reshard v2 files serialized a replicated dim's
+                # slice as [start, null] (stop None = the full dim) —
+                # resolve it from the blob's own local extent
+                idx = tuple(
+                    (int(p[0] or 0),
+                     int(p[1]) if p[1] is not None
+                     else int(p[0] or 0) + int(s))
+                    for p, s in zip(e["index"], e["shape"]))
+                out.append((ent["key"], idx, tuple(e["shape"]),
+                            (lambda e=e: mat(e))))
         else:
-            out[ent["key"]] = mat(ent)
+            out.append((ent["key"], None, tuple(ent["shape"]),
+                        (lambda e=ent: mat(e))))
+    return out, close
+
+
+def _legacy_gshape(indices, local=None):
+    """Best-effort global shape for sharded entries without manifest
+    metadata (the max stop per dim across the known slices)."""
+    ndim = np.asarray(local).ndim if local is not None \
+        else max((len(ix) for ix in indices), default=0)
+    dims = [0] * ndim
+    for ix in indices:
+        for d, p in enumerate(ix):
+            stop = p.stop if isinstance(p, slice) else p[1]
+            dims[d] = max(dims[d], int(stop or 0))
+    return dims
+
+
+def _unique_shards(arr):
+    """[(normalized_index, np_data)] of an addressable jax array's
+    DISTINCT shards — replicated copies (dp axes) dedupe to one slice
+    per index, so a dp=8 replicated param still writes one full copy
+    and a dp=2×sharding=4 moment writes 4 slices, not 8."""
+    out, seen = [], set()
+    for s in arr.addressable_shards:
+        idx = normalize_index(s.index, arr.shape)
+        if idx in seen:
+            continue
+        seen.add(idx)
+        out.append((idx, np.asarray(s.data)))
     return out
 
 
 def save_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, async_save=False, meta_extra=None):
+                    coordinator_rank=0, async_save=False, meta_extra=None,
+                    rank=None, world=None, save_sharded=None):
     """async_save=True: snapshot to host now, write files on the
     background queue; returns a Future (also joined by
     synchronize_async_saves).  A previously failed async save raises
     HERE, immediately (fail-fast), instead of waiting for the next
-    synchronize_async_saves."""
+    synchronize_async_saves.
+
+    Reshard-on-load contract: values may be :class:`ShardSlice` objects
+    (this rank's slice of a globally-shaped tensor — the host-plane
+    fleet path), and with ``save_sharded`` (default:
+    FLAGS_ckpt_save_sharded) mesh-sharded jax arrays are written as
+    per-shard slices with global index metadata instead of a gathered
+    full array.  The manifest records each tensor's global shape, dtype
+    and — for sharded saves — the writing rank's shard-slice layout, so
+    any later topology reassembles its own shards from the overlaps.
+    `rank`/`world` override the process identity (tooling/tests);
+    defaults follow jax.process_index/count or, for host-plane fleet
+    jobs, PADDLE_TRAINER_ID/NUM."""
     stored = _take_writer_error()
     if stored is not None:
         # raising here OBSERVES the failure: drop the already-settled
@@ -387,31 +513,49 @@ def save_state_dict(state_dict, path, process_group=None,
             _prune_pending_locked()
         raise stored
     os.makedirs(path, exist_ok=True)
-    rank = jax.process_index()
+    prank, pworld = _proc_rank_world()
+    rank = prank if rank is None else int(rank)
+    world = pworld if world is None else int(world)
+    if save_sharded is None:
+        save_sharded = bool(get_flag("ckpt_save_sharded"))
     meta = {}
     shards = {}
     for k, v in state_dict.items():
+        if isinstance(v, ShardSlice):
+            idx = v.index
+            shards[k] = {"local": [v.data], "index": [list(idx)]}
+            meta[k] = {"global_shape": list(v.global_shape),
+                       "dtype": str(v.data.dtype), "rank": rank,
+                       "sharded": True,
+                       "layout": [[list(p) for p in idx]]}
+            continue
         arr = v.value if isinstance(v, Tensor) else jnp.asarray(v)
-        # gather fully-addressable data; for multi-host each process saves
-        # its addressable shards
-        if getattr(arr, "is_fully_addressable", True):
+        fully = getattr(arr, "is_fully_addressable", True)
+        sharding = getattr(arr, "sharding", None)
+        # a mesh-sharded array under the reshard contract writes real
+        # slices; a replicated one still gathers to one full copy
+        split = (not fully) or (
+            save_sharded and sharding is not None
+            and not getattr(sharding, "is_fully_replicated", True)
+            and getattr(arr, "ndim", 0) >= 1)
+        if not split:
             np_arr = np.asarray(arr)
             shards[k] = np_arr
             meta[k] = {"global_shape": list(np_arr.shape),
                        "dtype": str(np_arr.dtype),
                        "rank": rank}
         else:
-            local = [np.asarray(s.data) for s in arr.addressable_shards]
-            idx = [s.index for s in arr.addressable_shards]
-            shards[k] = {"local": local,
-                         "index": [[(sl.start or 0, sl.stop) for sl in ix]
-                                   for ix in idx]}
+            uniq = _unique_shards(arr)
+            shards[k] = {"local": [d for _, d in uniq],
+                         "index": [list(ix) for ix, _ in uniq]}
             meta[k] = {"global_shape": list(arr.shape),
                        "dtype": str(arr.dtype), "rank": rank,
-                       "sharded": True}
+                       "sharded": True,
+                       "layout": [[list(p) for p in ix]
+                                  for ix, _ in uniq]}
     # completeness contract: the manifest records how many rank shards
     # this checkpoint must contain (and any train-loop metadata)
-    meta["__world__"] = jax.process_count()
+    meta["__world__"] = world
     if meta_extra is not None:
         meta["__train_meta__"] = meta_extra
     if async_save:
@@ -434,11 +578,26 @@ def save_state_dict(state_dict, path, process_group=None,
 
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, offload=False, coverage=None):
-    """In-place load into `state_dict` tensors, resharding to each tensor's
-    current NamedSharding via device_put.  `coverage` (optional dict) is
-    filled with `missing` (state_dict keys the files didn't provide) and
-    `unexpected` (file keys state_dict didn't ask for) so callers that
-    require a FULL restore can fail or warn loudly."""
+    """In-place load into `state_dict` values, resharding each tensor
+    to its CURRENT target layout — this is reshard-on-load, the default
+    checkpoint contract:
+
+    * a Tensor target is assembled from the overlapping saved slices of
+      whatever topology produced the checkpoint (full arrays, stage-3
+      'sharding' splits, per-rank fleet slices) and placed into its own
+      NamedSharding — sharded targets assemble per LOCAL shard via
+      jax.make_array_from_callback, so the full array never
+      materializes host-side;
+    * a :class:`ShardSlice` target (host-plane fleet rank) gets exactly
+      its slice of the new world filled into ``.data``.
+
+    A topology the save cannot satisfy — global-shape mismatch, or a
+    coverage gap from missing rank shard files — raises the named
+    :class:`ReshardError` instead of an opaque shard-count error.
+    `coverage` (optional dict) is filled with `missing` (state_dict
+    keys the files didn't provide) and `unexpected` (file keys
+    state_dict didn't ask for) so callers that require a FULL restore
+    can fail or warn loudly."""
     files = [f for f in os.listdir(path) if f.endswith(".distcp")]
     meta = None
     try:
@@ -446,44 +605,126 @@ def load_state_dict(state_dict, path, process_group=None,
             meta = json.load(mf)
     except (OSError, ValueError):
         pass
+    detail = ""
     if meta is not None and "__world__" in meta:
         # read exactly the ranks this save produced: a re-save into the
         # same step dir after an elastic world SHRINK leaves stale
         # higher-rank shards behind, and mixing them in would silently
         # restore old-step values
-        expected = {f"{r}.distcp" for r in range(int(meta["__world__"]))}
+        world = int(meta["__world__"])
+        expected = {f"{r}.distcp" for r in range(world)}
+        missing = sorted(expected - set(files))
         files = [f for f in files if f in expected]
-    loaded = {}
-    for fname in sorted(files):
-        part = _read_file(os.path.join(path, fname))
-        for k, v in part.items():
-            if isinstance(v, dict) and "local" in v:
-                if meta is None:
-                    with open(os.path.join(path, "metadata.json")) as mf:
-                        meta = json.load(mf)
-                # accumulate shards from every rank file into ONE array:
-                # each rank's file carries only its addressable shards
-                full = loaded.get(k)
-                if full is None:
-                    full = np.zeros(meta[k]["global_shape"],
-                                    np.dtype(meta[k]["dtype"]))
-                for local, index in zip(v["local"], v["index"]):
-                    sl = tuple(slice(s, e) for s, e in index)
-                    full[sl] = local
-                loaded[k] = full
-            else:
-                loaded[k] = v
+        if missing:
+            detail = (f"; saved at world {world} but rank file(s) "
+                      f"{missing} are absent")
+    # piece index: key -> [(normalized_index|None, shape, fetch)]
+    pieces = {}
+    closers = []
+    try:
+        for fname in sorted(files):
+            plist, close = _entry_reader(os.path.join(path, fname))
+            closers.append(close)
+            for k, idx, shape, fetch in plist:
+                pieces.setdefault(k, []).append((idx, shape, fetch))
+        return _assemble_targets(state_dict, pieces, meta, detail,
+                                 coverage)
+    finally:
+        for close in closers:
+            close()
+
+
+def _assemble_targets(state_dict, pieces, meta, detail, coverage):
+    """Reshard-on-load assembly: fill every ``state_dict`` target from
+    the overlapping saved pieces (the back half of load_state_dict —
+    split out so the caller can close the shared per-file fds the
+    fetchers read through as soon as assembly finishes)."""
     if coverage is not None:
-        coverage["missing"] = sorted(set(state_dict) - set(loaded))
-        coverage["unexpected"] = sorted(set(loaded) - set(state_dict))
+        coverage["missing"] = sorted(set(state_dict) - set(pieces))
+        coverage["unexpected"] = sorted(set(pieces) - set(state_dict))
     for k, t in state_dict.items():
-        if k not in loaded:
+        plist = pieces.get(k)
+        if not plist:
             continue
-        arr = jnp.asarray(loaded[k])
+        kmeta = (meta or {}).get(k) or {}
+        gshape = kmeta.get("global_shape")
+        if gshape is None:
+            full = next((shape for idx, shape, _ in plist
+                         if idx is None), None)
+            gshape = list(full) if full is not None \
+                else _legacy_gshape([idx for idx, _, _ in plist
+                                     if idx is not None], None)
+        gshape = tuple(int(d) for d in gshape)
+        sdtype = np.dtype(kmeta["dtype"]) if kmeta.get("dtype") \
+            else None
+        def _memo(fn):
+            # each saved piece is read from disk AT MOST once per key,
+            # however many local target shards its slice overlaps
+            box = []
+
+            def get():
+                if not box:
+                    box.append(fn())
+                return box[0]
+            return get
+
+        norm = [(normalize_index(idx, gshape) if idx is not None
+                 else normalize_index(None, gshape), _memo(fetch))
+                for idx, _, fetch in plist]
+        if isinstance(t, ShardSlice):
+            if gshape != t.global_shape:
+                raise ReshardError(
+                    f"checkpoint key {k!r}: saved global shape "
+                    f"{gshape} != ShardSlice global shape "
+                    f"{t.global_shape}{detail}")
+            if t.data is None:
+                t.data = np.zeros(t.local_shape,
+                                  sdtype or np.float32)
+            assemble(t.index, norm, t.data, key=k, detail=detail)
+            continue
         tgt = t.value
+        tshape = tuple(getattr(tgt, "shape", gshape))
+        if gshape != tshape:
+            raise ReshardError(
+                f"checkpoint key {k!r}: saved global shape {gshape} "
+                f"!= target shape {tshape}{detail} — an elastic resume "
+                "must keep global shapes; reshard by giving the target "
+                "its new mesh sharding (or a ShardSlice), not a "
+                "different shape")
         sharding = getattr(tgt, "sharding", None)
-        if sharding is not None:
-            arr = jax.device_put(arr.astype(tgt.dtype), sharding)
+        if sdtype is None:
+            probe = norm[0][1]()
+            sdtype = probe.dtype
+            norm[0] = (norm[0][0], (lambda a=probe: a))
+        whole = next((f for idx, f in norm
+                      if index_volume(idx) == index_volume(
+                          normalize_index(None, gshape))), None)
+        from jax.sharding import NamedSharding
+        if whole is not None:
+            arr = jnp.asarray(whole())
+            if sharding is not None:
+                arr = jax.device_put(arr.astype(tgt.dtype), sharding)
+        elif isinstance(sharding, NamedSharding) \
+                and getattr(sharding, "num_devices",
+                            len(sharding.device_set)) > 1:
+            # assemble each LOCAL shard of the target sharding from the
+            # overlapping saved slices — the full array never exists
+            tdt = np.dtype(tgt.dtype)
+
+            def cb(idx, _k=k, _g=gshape, _n=norm, _dt=sdtype, _t=tdt):
+                tix = normalize_index(idx, _g)
+                out = np.zeros(tuple(e - s for s, e in tix), _dt)
+                assemble(tix, _n, out, key=_k, detail=detail)
+                return out.astype(_t, copy=False)
+
+            arr = jax.make_array_from_callback(gshape, sharding, cb)
+        else:
+            out = np.zeros(gshape, sdtype)
+            assemble(normalize_index(None, gshape), norm, out,
+                     key=k, detail=detail)
+            arr = jnp.asarray(out)
+            if sharding is not None:
+                arr = jax.device_put(arr.astype(tgt.dtype), sharding)
         t._value = arr
     return state_dict
 
@@ -620,7 +861,7 @@ def _commit_latest(root, dirname, keep, wait_secs=60.0):
     the cheap no-CRC completeness — for the other ranks' shards to land
     on the shared filesystem before the full verification."""
     path = os.path.join(root, dirname)
-    if jax.process_count() > 1:
+    if _proc_rank_world()[1] > 1:
         deadline = time.monotonic() + wait_secs
         while not is_complete(path, crc=False) \
                 and time.monotonic() < deadline:
@@ -663,10 +904,12 @@ def save_checkpoint(state_dict, root, step, keep=3, async_save=False,
         _prune_pending_locked()
         queued_behind = bool(_pending)
     on_queue = async_save or queued_behind
+    rank, world = _proc_rank_world()
     fut = save_state_dict(state_dict, path, process_group,
                           coordinator_rank, async_save=on_queue,
-                          meta_extra=dict(meta or {}, step=int(step)))
-    commit_rank = jax.process_index() == coordinator_rank
+                          meta_extra=dict(meta or {}, step=int(step),
+                                          world=world))
+    commit_rank = rank == coordinator_rank
     if not on_queue:
         return _commit_latest(root, dirname, keep) if commit_rank \
             else path
@@ -722,21 +965,36 @@ def load_checkpoint(state_dict, root, candidate=None, coverage=None):
     peek) — tried first without paying the CRC scan a second time.
     `coverage`: passed through to load_state_dict."""
     tried = set()
+    reshard_err, other_fail = None, False
     while True:
         if candidate is not None:
             path, candidate = candidate, None
         else:
             path = _next_candidate(root, tried)
         if path is None:
+            if reshard_err is not None and not other_fail:
+                # every candidate failed the RESHARD contract (shape
+                # mismatch / coverage gap) rather than corruption:
+                # surface the newest named diagnosis instead of a
+                # silent cold-start None
+                raise reshard_err
             return None
         try:
             load_state_dict(state_dict, path, coverage=coverage)
             meta = checkpoint_meta(path) or {}
             step = meta.get("step", _step_of(os.path.basename(path)))
             return int(step), meta
+        except ReshardError as e:
+            # a coverage gap in the newest step (e.g. a torn elastic
+            # save left stale rank files) falls back like corruption —
+            # an older intact step may still satisfy the target
+            if reshard_err is None:
+                reshard_err = e
+            tried.add(path)
         except (IOError, OSError, ValueError, KeyError):
             # completeness said yes but the load failed (e.g. per-entry
             # crc) — fall back to the next newest complete dir
+            other_fail = True
             tried.add(path)
 
 
@@ -839,4 +1097,54 @@ def restore_train_checkpoint(trainer, root):
     _, meta = got
     trainer.load_train_state(
         {k: t.value for k, t in wrapped.items()}, meta)
+    note_elastic_resume(meta, step=meta.get("step_count"))
     return meta
+
+
+def note_elastic_resume(meta, step=None):
+    """Detect and announce a resume at a DIFFERENT world size than the
+    checkpoint was saved at (the elastic shrink/grow path): emits the
+    `fleet.elastic` telemetry event + counter `tools/fleet_report.py`
+    renders.  Returns (old_world, new_world) when they differ, else
+    None.  The restore itself needs nothing special — reshard-on-load
+    is the default contract — this is the observability half."""
+    old = (meta or {}).get("world")
+    if old is None:
+        return None
+    new = _proc_rank_world()[1]
+    if int(old) == int(new):
+        return None
+    from ... import telemetry as _tel
+    _tel.counter("fleet.elastic_resumes").inc()
+    _tel.emit("fleet.elastic", phase="resume", old_world=int(old),
+              new_world=int(new), step=step,
+              cursor=(meta or {}).get("data_cursor"))
+    import warnings
+    warnings.warn(
+        f"elastic resume: checkpoint saved at world {old}, restoring "
+        f"at world {new} (reshard-on-load)", RuntimeWarning)
+    return int(old), new
+
+
+# ---------------------------------------------------------------------------
+# topology-aware data cursor plumbing (io.ElasticDataCursor)
+# ---------------------------------------------------------------------------
+
+def cursor_to_meta(owner, meta):
+    """Fold an attached data cursor (`owner.attach_data_cursor`) into a
+    train_state meta dict: the (epoch, global_sample_offset) pair is
+    topology-independent, so a job resumed at a new dp degree replays
+    exactly the unseen samples."""
+    cur = getattr(owner, "_data_cursor", None)
+    if cur is not None:
+        meta["data_cursor"] = dict(cur.state_dict())
+    return meta
+
+
+def cursor_from_meta(owner, meta):
+    """Restore an attached data cursor from a train_state meta dict
+    (no-op when either side is absent)."""
+    cur = getattr(owner, "_data_cursor", None)
+    state = (meta or {}).get("data_cursor")
+    if cur is not None and state:
+        cur.load_state_dict(dict(state))

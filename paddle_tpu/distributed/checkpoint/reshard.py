@@ -1,0 +1,213 @@
+"""Reshard-on-load: slice-overlap assembly across checkpoint topologies.
+
+The elastic-resume contract (ROADMAP item 1): a checkpoint saved at ANY
+topology (dp=8 replicated, stage-3 'sharding'-split, host-plane rank
+slices from an N-proc fleet) restores into ANY other topology
+(dp=2×mp=4, unsharded single device, an (N−1)-proc fleet) bit-exactly.
+The machinery is index arithmetic, not collectives: every saved piece
+carries its global index `[(start, stop), ...]` per dim, and each
+LOADER-side target region is assembled from the overlapping slices of
+whatever pieces the save produced.
+
+Two piece sources share this module:
+
+* device-plane — `save_state_dict` records each jax shard's global
+  index (`FLAGS_ckpt_save_sharded` writes real per-shard slices even
+  for fully-addressable arrays, so a single-controller stage-3 save
+  produces the same on-disk topology a multi-host save would);
+* host-plane — :class:`ShardSlice` lets one PROCESS of an N-proc fleet
+  job save/load its contiguous slice of a globally-shaped tensor
+  (optimizer moments split across data-parallel ranks) without any jax
+  multi-host runtime; `chaos_check --fleet` drives this path for real.
+
+Coverage is verified, never assumed: a target region any saved piece
+fails to cover raises :class:`ReshardError` naming the gap — the named
+replacement for the opaque shard-count/shape errors a world-size
+mismatch used to produce.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ReshardError", "ShardSlice", "normalize_index", "split_index",
+           "overlap_index", "index_volume", "assemble"]
+
+Index = Tuple[Tuple[int, int], ...]
+
+
+class ReshardError(RuntimeError):
+    """A checkpoint's saved topology cannot satisfy the requested
+    target (missing rank shards, a coverage gap, or a global-shape
+    mismatch).  Restore at a different topology by giving the loader an
+    explicit target — a Tensor with the new mesh's NamedSharding, or a
+    :class:`ShardSlice` carrying this rank's slice of the new world —
+    and `load_state_dict` assembles it from the overlapping saved
+    slices (see README "Elastic resume & resharding")."""
+
+
+def normalize_index(index, shape) -> Index:
+    """Canonical ((start, stop), ...) per dim.  Accepts slices (stop
+    None = dim size), (start, stop) pairs, or None (= the full dim);
+    pads missing trailing dims to full."""
+    out = []
+    index = list(index or [])
+    index += [None] * (len(shape) - len(index))
+    for i, (ix, dim) in enumerate(zip(index, shape)):
+        if ix is None:
+            s, e = 0, int(dim)
+        elif isinstance(ix, slice):
+            s = int(ix.start or 0)
+            e = int(dim if ix.stop is None else ix.stop)
+        else:
+            s, e = int(ix[0]), int(ix[1])
+        if not (0 <= s <= e <= int(dim)):
+            raise ReshardError(
+                f"shard index {ix} out of bounds for dim {i} of "
+                f"shape {tuple(shape)}")
+        out.append((s, e))
+    return tuple(out)
+
+
+def split_index(global_shape, rank: int, world: int, axis: int = 0
+                ) -> Index:
+    """The canonical contiguous rank slice: dim `axis` split into
+    `world` near-equal runs (np.array_split boundaries, so uneven and
+    even world-degenerate splits — more ranks than rows — are both
+    well-defined; a rank past the rows gets an empty slice)."""
+    if not (0 <= rank < world):
+        raise ReshardError(f"rank {rank} outside world {world}")
+    n = int(global_shape[axis])
+    base, extra = divmod(n, world)
+    starts = [min(r, extra) + r * base for r in range(world + 1)]
+    idx = [(0, int(d)) for d in global_shape]
+    idx[axis] = (starts[rank], starts[rank + 1])
+    return tuple(idx)
+
+
+def overlap_index(a: Index, b: Index) -> Optional[Index]:
+    """Intersection of two normalized indices, or None when empty."""
+    out = []
+    for (as_, ae), (bs, be) in zip(a, b):
+        s, e = max(as_, bs), min(ae, be)
+        if s >= e:
+            return None
+        out.append((s, e))
+    return tuple(out)
+
+
+def index_volume(idx: Index) -> int:
+    v = 1
+    for s, e in idx:
+        v *= max(0, e - s)
+    return v
+
+
+class ShardSlice:
+    """One process's contiguous slice of a globally-shaped tensor —
+    the host-plane twin of a jax addressable shard.
+
+    Saving: ``ShardSlice.of(arr, rank, world)`` wraps this rank's rows
+    so `save_state_dict` writes a sharded entry with real index
+    metadata.  Loading: ``ShardSlice.placeholder(global_shape, dtype,
+    rank, world)`` is a target the loader fills (`.data`) from the
+    overlapping slices of ANY saved topology — the reshard itself.
+    """
+
+    __slots__ = ("data", "index", "global_shape")
+
+    def __init__(self, data, index, global_shape):
+        self.global_shape = tuple(int(d) for d in global_shape)
+        self.index = normalize_index(index, self.global_shape)
+        self.data = None if data is None else np.asarray(data)
+        if self.data is not None:
+            want = tuple(e - s for s, e in self.index)
+            if tuple(self.data.shape) != want:
+                raise ReshardError(
+                    f"ShardSlice data shape {tuple(self.data.shape)} "
+                    f"!= index extent {want} (index {self.index}, "
+                    f"global {self.global_shape})")
+
+    @classmethod
+    def of(cls, arr, rank: int, world: int, axis: int = 0):
+        arr = np.asarray(arr)
+        idx = split_index(arr.shape, rank, world, axis)
+        sl = tuple(slice(s, e) for s, e in idx)
+        return cls(arr[sl], idx, arr.shape)
+
+    @classmethod
+    def placeholder(cls, global_shape, dtype, rank: int, world: int,
+                    axis: int = 0):
+        idx = split_index(global_shape, rank, world, axis)
+        shape = tuple(e - s for s, e in idx)
+        return cls(np.zeros(shape, np.dtype(dtype)), idx, global_shape)
+
+    @property
+    def local_shape(self):
+        return tuple(e - s for s, e in self.index)
+
+    def __repr__(self):
+        return (f"ShardSlice(index={self.index}, "
+                f"global_shape={self.global_shape})")
+
+
+def assemble(target_index: Index, pieces: Sequence, out: np.ndarray,
+             key: str = "?", detail: str = ""):
+    """Fill `out` (shaped like target_index's extent) from the saved
+    pieces overlapping it.
+
+    `pieces`: [(index, fetch)] where fetch() lazily yields the piece's
+    array (so only overlapping shard payloads are ever read).  Coverage
+    is exact-checked: identical indices are deduplicated (replicated
+    saves write the same slice from every rank); when distinct kept
+    pieces PARTIALLY overlap each other (mixed-topology leftovers in
+    one dir), a boolean fill mask replaces the volume sum so the check
+    cannot be fooled by double-counting — any uncovered region raises
+    ReshardError naming the tensor and the gap.
+    """
+    t_idx = tuple(target_index)
+    volume = index_volume(t_idx)
+    covered = 0
+    seen = set()
+    used = []          # target-local overlap boxes actually written
+    for idx, fetch in pieces:
+        if idx in seen:
+            continue
+        ov = overlap_index(idx, t_idx)
+        if ov is None:
+            seen.add(idx)
+            continue
+        seen.add(idx)
+        data = np.asarray(fetch())
+        # piece-local and target-local coordinates of the overlap
+        src = tuple(slice(s - ps, e - ps)
+                    for (s, e), (ps, _) in zip(ov, idx))
+        dst = tuple(slice(s - ts, e - ts)
+                    for (s, e), (ts, _) in zip(ov, t_idx))
+        out[dst] = data[src]
+        used.append(dst)
+        covered += index_volume(ov)
+    if covered >= volume and len(used) > 1:
+        # the volume sum is only exact for mutually disjoint pieces;
+        # overlapping distinct pieces double-count, so verify with a
+        # fill mask before trusting it
+        for i, a in enumerate(used):
+            if any(overlap_index(
+                    tuple((s.start, s.stop) for s in a),
+                    tuple((s.start, s.stop) for s in b)) is not None
+                    for b in used[:i]):
+                mask = np.zeros(tuple(e - s for s, e in t_idx), bool)
+                for dst in used:
+                    mask[dst] = True
+                covered = int(mask.sum())
+                break
+    if covered < volume:
+        raise ReshardError(
+            f"checkpoint key {key!r}: saved shards cover only "
+            f"{covered}/{volume} elements of the requested region "
+            f"{t_idx}{detail} — the save's topology is incomplete for "
+            "this target (missing rank shard files?); pass the intended "
+            "target sharding (Tensor sharding / ShardSlice) and restore "
+            "from a complete step dir")
+    return out

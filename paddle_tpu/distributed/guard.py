@@ -38,7 +38,7 @@ from ..framework.flags import get_flag  # the two guard flags live in
 
 __all__ = ["StepAnomalyGuard", "BadStepBudgetExceeded",
            "install_sigterm_drain", "drain_requested", "request_drain",
-           "clear_drain"]
+           "clear_drain", "elastic_world", "elastic_resume"]
 
 
 class BadStepBudgetExceeded(RuntimeError):
@@ -98,6 +98,34 @@ class StepAnomalyGuard:
             "  Skipped steps left params and optimizer state untouched; "
             "resume from the last checkpoint with a lower LR or loss "
             "scale.")
+
+
+# ---------------------------------------------------------------------------
+# elastic world detection (train-loop side of the shrink/grow loop)
+# ---------------------------------------------------------------------------
+
+def elastic_world():
+    """(rank, world, elastic_epoch) of THIS incarnation, from the
+    launch controller's env.  A relaunch after a gang re-form carries a
+    bumped PADDLE_ELASTIC_EPOCH and the NEW world size — the train loop
+    compares `world` against its checkpoint's saved world to know it is
+    resuming across a topology change."""
+    import os
+    from .host_collectives import host_world
+    rank, world = host_world()
+    return (rank, world,
+            int(os.environ.get("PADDLE_ELASTIC_EPOCH", "0") or 0))
+
+
+def elastic_resume(meta):
+    """Detect a world change between a restored checkpoint's meta and
+    this incarnation; returns (old_world, new_world) or None.  Emits
+    the `fleet.elastic` telemetry event — the restore itself already
+    went through reshard-on-load (the default contract), this is the
+    loud half.  Call after `restore_train_checkpoint` (which also calls
+    it internally for trainers restored through that path)."""
+    from .checkpoint import note_elastic_resume
+    return note_elastic_resume(meta, step=(meta or {}).get("step_count"))
 
 
 # ---------------------------------------------------------------------------

@@ -45,13 +45,20 @@ class KVCollectives:
     launcher's KV store (PADDLE_MASTER)."""
 
     def __init__(self, endpoint: str, rank: int, world: int,
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, namespace: str = None):
         from .launch.master import KVClient
         self.kv = KVClient(endpoint if "://" in endpoint
                            else f"http://{endpoint}")
         self._rank = int(rank)
         self.world = int(world)
         self.timeout = timeout
+        # rounds are namespaced by the ELASTIC EPOCH: a gang re-formed
+        # after a rank death restarts its sequence counters at 0, and
+        # without the namespace it would read the dead incarnation's
+        # stale round payloads as its own (same group id, same seq)
+        if namespace is None:
+            namespace = f"e{os.environ.get('PADDLE_ELASTIC_EPOCH', '0')}"
+        self._ns = f"coll/{namespace}" if namespace else "coll"
         self._seq = defaultdict(int)
         # keys this rank wrote, per (op, gid) round — deleted two rounds
         # later (any rank entering round s proves every rank finished
@@ -92,7 +99,7 @@ class KVCollectives:
         seq = self._seq[(op, gid)]
         self._seq[(op, gid)] += 1
         self._gc((op, gid), seq)
-        return f"coll/{op}/{gid}/{seq}"
+        return f"{self._ns}/{op}/{gid}/{seq}"
 
     def _note_written(self, op: str, ranks: Sequence[int], seq_key: str,
                       keys, ack_need: int = 0) -> None:
@@ -241,13 +248,13 @@ class KVCollectives:
     def send(self, arr, dst: int, tag: str = ""):
         seq = self._seq[("p2p", dst, tag)]
         self._seq[("p2p", dst, tag)] += 1
-        self.kv.put(f"coll/p2p/{self.rank}.{dst}.{tag}/{seq}",
+        self.kv.put(f"{self._ns}/p2p/{self.rank}.{dst}.{tag}/{seq}",
                     _encode(np.asarray(arr)))
 
     def recv(self, src: int, tag: str = ""):
         seq = self._seq[("p2p-r", src, tag)]
         self._seq[("p2p-r", src, tag)] += 1
-        key = f"coll/p2p/{src}.{self.rank}.{tag}"
+        key = f"{self._ns}/p2p/{src}.{self.rank}.{tag}"
         deadline = time.time() + self.timeout
         while time.time() < deadline:
             v = self.kv.get(f"{key}/{seq}")
@@ -281,9 +288,19 @@ def _reduce(op, stacked):
 
 
 def host_world():
-    """(rank, world) of the host-process group from the launcher env."""
-    return (int(os.environ.get("PADDLE_TRAINER_ID", "0")),
-            int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+    """(rank, world) of the host-process group from the launcher env.
+    THE single parser of PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM (guard
+    and checkpoint identity both route here): unset/empty means the
+    single-process default, but a malformed value raises LOUDLY — a
+    silent (0, 1) fallback would make every fleet rank write the same
+    0.distcp and self-elect as commit coordinator."""
+    try:
+        return (int(os.environ.get("PADDLE_TRAINER_ID") or 0),
+                int(os.environ.get("PADDLE_TRAINERS_NUM") or 1))
+    except ValueError as e:
+        raise ValueError(
+            "malformed PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM env "
+            f"(expected integers): {e}") from None
 
 
 _instance: Optional[KVCollectives] = None

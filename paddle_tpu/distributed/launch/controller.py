@@ -30,22 +30,67 @@ from .master import KVClient, KVServer
 
 __all__ = ["CollectiveController", "ProcEntry"]
 
-HEARTBEAT_INTERVAL = 2.0
+def _elastic_env(name: str, default: float, legacy: str = None,
+                 minimum: float = 0.0, inclusive: bool = False) -> float:
+    """One validated PADDLE_ELASTIC_* knob.  A malformed or
+    out-of-range value fails LOUDLY at import (naming the env var) —
+    a silently-ignored elastic timing override is exactly how a fleet
+    ends up reaping healthy pods.  `legacy` names a pre-existing env
+    spelling kept working (PADDLE_HEARTBEAT_TTL); `inclusive` admits
+    the minimum itself (drain grace 0 = terminate immediately)."""
+    raw, src = os.environ.get(name), name
+    if raw is None and legacy is not None:
+        raw, src = os.environ.get(legacy), legacy
+    if raw is None:
+        return float(default)
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{src}={raw!r}: expected a number of seconds") from None
+    if not (val >= minimum if inclusive else val > minimum):
+        raise ValueError(
+            f"{src}={raw!r}: must be "
+            f"{'>=' if inclusive else '>'} {minimum:g} seconds")
+    return val
+
+
+# elastic control-plane cadence — every knob is a documented
+# PADDLE_ELASTIC_* env (see README "Elastic resume & resharding"):
+#
+#   PADDLE_ELASTIC_HEARTBEAT_INTERVAL  seconds between lease stamps
+#   PADDLE_ELASTIC_HEARTBEAT_TTL       lease TTL before a pod is judged
+#                                      dead (legacy spelling
+#                                      PADDLE_HEARTBEAT_TTL honored)
+#   PADDLE_ELASTIC_SETTLE              late-joiner absorption window at
+#                                      rendezvous / re-form
+#   PADDLE_ELASTIC_SCALE_CHECK         watch-loop poll cadence for peer
+#                                      scale requests / new registrations
+#   PADDLE_DRAIN_GRACE                 SIGTERM drain window
+HEARTBEAT_INTERVAL = _elastic_env("PADDLE_ELASTIC_HEARTBEAT_INTERVAL",
+                                  2.0)
 # lease TTL >> interval: a saturated host (parallel compiles, CI load)
 # can starve the heartbeat thread for TENS of seconds — observed: a
 # full-suite run + XLA compiles starved a launcher past 20s and a
 # false dead-peer verdict tore the gang down.  Env-overridable so
-# latency-sensitive deployments can tighten it.
-HEARTBEAT_TTL = float(os.environ.get("PADDLE_HEARTBEAT_TTL", "45"))
-ELASTIC_SETTLE = 2.0   # absorb late joiners up to nnodes_max for this long
+# latency-sensitive deployments (and the chaos harness) can tighten it.
+HEARTBEAT_TTL = _elastic_env("PADDLE_ELASTIC_HEARTBEAT_TTL", 45.0,
+                             legacy="PADDLE_HEARTBEAT_TTL")
+if HEARTBEAT_TTL <= HEARTBEAT_INTERVAL:
+    raise ValueError(
+        f"PADDLE_ELASTIC_HEARTBEAT_TTL ({HEARTBEAT_TTL:g}s) must exceed "
+        f"PADDLE_ELASTIC_HEARTBEAT_INTERVAL ({HEARTBEAT_INTERVAL:g}s): "
+        "a lease shorter than its refresh cadence reaps every pod")
+# absorb late joiners up to nnodes_max for this long
+ELASTIC_SETTLE = _elastic_env("PADDLE_ELASTIC_SETTLE", 2.0)
 # reference fleet/elastic/manager.py:33 — a child exiting with this code
 # asks the launcher to re-form the gang instead of counting a failure
 ELASTIC_EXIT_CODE = 101
-SCALE_CHECK_INTERVAL = 5.0
+SCALE_CHECK_INTERVAL = _elastic_env("PADDLE_ELASTIC_SCALE_CHECK", 5.0)
 # SIGTERM drain window: how long children get to finish the in-flight
 # step and write their emergency checkpoint before being terminated
 # (preemption notices are typically 30-120s; tests tighten via env)
-DRAIN_GRACE = float(os.environ.get("PADDLE_DRAIN_GRACE", "60"))
+DRAIN_GRACE = _elastic_env("PADDLE_DRAIN_GRACE", 60.0, inclusive=True)
 
 
 class ProcEntry:

@@ -204,7 +204,16 @@ class FaultTolerantCheckpoint(Callback):
         if not self.resume:
             return
         meta = restore_train_checkpoint(self.model, self.root)
-        if meta and meta.get("cursor"):
+        live_cursor = getattr(self.model, "_data_cursor", None)
+        if meta and meta.get("data_cursor") and live_cursor is not None:
+            # topology-aware cursor (io.ElasticDataCursor): restored in
+            # place by load_train_state — the elastic sampler resumes
+            # the global sample stream at the exact committed offset,
+            # valid at ANY world size; no iterator fast-forward
+            print(f"[ckpt] resumed from step {meta.get('step_count')} "
+                  f"(data cursor {meta['data_cursor']}, "
+                  f"saved world {meta.get('world', '?')})", flush=True)
+        elif meta and meta.get("cursor"):
             self.model._resume_cursor = dict(meta["cursor"])
             print(f"[ckpt] resumed from step {meta.get('step_count')} "
                   f"(cursor {meta['cursor']})", flush=True)

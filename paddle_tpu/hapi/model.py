@@ -156,6 +156,31 @@ class Model:
             steps = len(train_loader)
         except TypeError:
             steps = None
+        # topology-aware data cursor (io.ElasticBatchSampler): bind the
+        # sampler's cursor to the model BEFORE callbacks run, so a
+        # FaultTolerantCheckpoint restore lands the checkpointed
+        # (epoch, offset) straight into the object the sampler iterates
+        # from — resume then REPLAYS the unseen samples instead of
+        # fast-forwarding the iterator, and stays exact across a world
+        # change
+        sampler = getattr(train_loader, "batch_sampler", None)
+        ecursor = getattr(sampler, "cursor", None) \
+            if hasattr(sampler, "global_batch_size") else None
+        if ecursor is not None:
+            if num_iters is not None:
+                # num_iters cuts epochs mid-stream, which would leave
+                # the cursor parked at the tail while the epoch loop
+                # keeps "completing" zero-batch epochs — reject loudly
+                # rather than silently train nothing
+                raise ValueError(
+                    "num_iters is incompatible with an "
+                    "ElasticBatchSampler-driven loader: the data "
+                    "cursor tracks the full global stream; bound the "
+                    "run with epochs/steps instead")
+        # always (re)bind: a cursor left over from a previous elastic
+        # fit must not be checkpointed beside a plain loader's batches
+        # (its stale (epoch, offset) would hijack the next resume)
+        self._data_cursor = ecursor
         cbks = config_callbacks(callbacks, model=self, epochs=epochs,
                                 steps=steps, log_freq=log_freq,
                                 save_freq=save_freq, save_dir=save_dir,
@@ -165,10 +190,15 @@ class Model:
         # resume cursor (set by FaultTolerantCheckpoint.on_train_begin
         # after restoring a checkpoint): fast-forward to the epoch and
         # skip the batches the restored step count already consumed, so
-        # the data iterator lines up with the optimizer state
+        # the data iterator lines up with the optimizer state.  With an
+        # elastic sampler the restored cursor already positions the
+        # sample stream — no batch skipping.
         start_epoch, skip_steps = 0, 0
         cursor = getattr(self, "_resume_cursor", None)
-        if cursor:
+        if ecursor is not None:
+            start_epoch = int(ecursor.epoch)
+            self._resume_cursor = None
+        elif cursor:
             start_epoch = int(cursor.get("epoch", 0))
             skip_steps = int(cursor.get("step", -1)) + 1
             self._resume_cursor = None
@@ -177,7 +207,13 @@ class Model:
             cbks.on_epoch_begin(epoch)
             logs = self._run_one_epoch(
                 train_loader, cbks, "train", num_iters=num_iters,
-                skip_steps=skip_steps if epoch == start_epoch else 0)
+                skip_steps=skip_steps if epoch == start_epoch else 0,
+                cursor_advance=(ecursor, sampler.global_batch_size)
+                if ecursor is not None else None)
+            if ecursor is not None:
+                # the epoch's global stream is exhausted: one atomic
+                # epoch/offset rollover, checkpointed by the next save
+                ecursor.next_epoch()
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
@@ -234,7 +270,7 @@ class Model:
         return outputs
 
     def _run_one_epoch(self, loader, cbks, mode, num_iters=None,
-                       skip_steps=0):
+                       skip_steps=0, cursor_advance=None):
         logs = {}
         for m in self._metrics:
             if mode == "train":
@@ -249,6 +285,12 @@ class Model:
             inputs, labels = batch[:-1], batch[-1:]
             if mode == "train":
                 res = self.train_batch(inputs, labels)
+                if cursor_advance is not None:
+                    # the step COMMITTED: advance the elastic cursor by
+                    # one global batch before any checkpoint callback
+                    # captures it (a crash mid-step re-trains this batch)
+                    cur, gbs = cursor_advance
+                    cur.advance(gbs)
             else:
                 res = self.eval_batch(inputs, labels)
             if isinstance(res, tuple):
@@ -277,6 +319,13 @@ class Model:
         return names
 
     # -- fault tolerance ---------------------------------------------------
+    def attach_data_cursor(self, cursor):
+        """Attach an io.ElasticDataCursor (done automatically by `fit`
+        when the train loader uses an ElasticBatchSampler): rides
+        train_state meta so checkpoints carry the topology-independent
+        data position."""
+        self._data_cursor = cursor
+
     def train_state(self):
         """(arrays, meta) of the full training state — the
         save_train_checkpoint/restore_train_checkpoint contract shared
@@ -286,10 +335,11 @@ class Model:
         follows what train_batch ACTUALLY ran (a multi-label loss falls
         through to eager even under jit=True), and the choice is
         recorded in the meta so restore takes the same one."""
+        from ..distributed.checkpoint import cursor_to_meta
         if self._jit_path_active():
             arrays, meta = self._get_train_step().train_state()
             meta["hapi_path"] = "jit"
-            return arrays, meta
+            return arrays, cursor_to_meta(self, meta)
         from ..distributed.checkpoint import optimizer_meta
         sd = self.network.state_dict()
         arrays = {f"model.{n}": sd[n]._value for n in sd}
@@ -319,7 +369,7 @@ class Model:
         else:
             meta = {"step_count": 0, "lr_sched": None, "rng": None}
         meta["hapi_path"] = "eager"
-        return arrays, meta
+        return arrays, cursor_to_meta(self, meta)
 
     def _jit_path_active(self):
         """Whether checkpoint state lives in the jitted TrainStep (vs
@@ -337,9 +387,14 @@ class Model:
             self._stepped_eager = (path == "eager")
 
     def load_train_state(self, arrays, meta):
+        from ..distributed.checkpoint import cursor_from_meta
         saved_path = (meta or {}).get("hapi_path")
         use_jit = self._jit_path_active() if saved_path is None \
             else saved_path == "jit"
+        # the data cursor is attached to the MODEL (fit binds the
+        # elastic sampler's cursor here) — restore it on this object
+        # whichever capture branch the arrays take
+        cursor_from_meta(self, meta)
         if use_jit:
             return self._get_train_step().load_train_state(arrays, meta)
         self._stepped_eager = True   # keep later saves on this branch

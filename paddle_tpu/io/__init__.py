@@ -26,7 +26,7 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ChainDataset",
            "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
            "BatchSampler", "DistributedBatchSampler", "DataLoader",
            "get_worker_info", "default_collate_fn", "prefetch_to_device",
-           "DevicePrefetcher"]
+           "DevicePrefetcher", "ElasticDataCursor", "ElasticBatchSampler"]
 
 
 class Dataset:
@@ -260,6 +260,121 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+
+
+class ElasticDataCursor:
+    """Explicit (epoch, global_sample_offset) data position — the
+    topology-aware replacement for iterator fast-forward.
+
+    The offset counts SAMPLES of the epoch's global order consumed by
+    COMMITTED train steps, so it is independent of rank, world size and
+    per-rank batch shape: a checkpoint carrying this cursor resumed at
+    a different dp degree replays exactly the unseen samples, none
+    skipped, none twice.  The cursor is advanced by the training loop
+    (``advance(global_batch_size)`` after each completed step,
+    ``next_epoch()`` at epoch end) — never by the sampler at yield
+    time, so loader prefetch can never overshoot what a checkpoint
+    claims was consumed.  Rides train_state meta via
+    ``trainer.attach_data_cursor(cursor)`` /
+    ``distributed.checkpoint.cursor_to_meta``."""
+
+    def __init__(self, epoch: int = 0, offset: int = 0):
+        self.epoch = int(epoch)
+        self.offset = int(offset)
+
+    def advance(self, n: int):
+        self.offset += int(n)
+
+    def next_epoch(self):
+        self.epoch += 1
+        self.offset = 0
+
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch, "offset": self.offset}
+
+    def load_state_dict(self, state: dict):
+        self.epoch = int(state.get("epoch", 0))
+        self.offset = int(state.get("offset", 0))
+
+    def __repr__(self):
+        return f"ElasticDataCursor(epoch={self.epoch}, offset={self.offset})"
+
+
+class ElasticBatchSampler(Sampler):
+    """Topology-aware batch sampler: one GLOBAL sample order per epoch
+    (a function of ``(seed, epoch)`` only — never of rank or world),
+    walked in fixed ``global_batch_size`` strides from the cursor's
+    offset; each yield is THIS RANK's contiguous slice of the stride.
+
+    Because the global order and the cursor are world-independent, a
+    job that checkpoints the cursor and resumes at a different dp
+    degree (dp=4 → dp=2) consumes exactly the samples the old world had
+    not: the new ranks re-slice the same global stream from the same
+    offset.  ``global_batch_size`` must divide by ``world`` (each step
+    is one global batch regardless of topology) and the final ragged
+    global batch of an epoch is always dropped (it cannot re-split
+    across elastic worlds), i.e. drop_last is structural.
+
+    rank/world default to the launcher env (PADDLE_TRAINER_ID/NUM);
+    shuffle permutes per epoch with a (seed, epoch)-keyed RandomState.
+    """
+
+    def __init__(self, dataset, global_batch_size, cursor=None,
+                 rank=None, world=None, shuffle=False, seed=0):
+        if rank is None or world is None:
+            from ..distributed.host_collectives import host_world
+            erank, eworld = host_world()
+            rank = erank if rank is None else rank
+            world = eworld if world is None else world
+        self.world = int(world)
+        self.rank = int(rank)
+        if self.world < 1 or not (0 <= self.rank < self.world):
+            raise ValueError(
+                f"ElasticBatchSampler: rank {rank} outside world {world}")
+        self.global_batch_size = int(global_batch_size)
+        if self.global_batch_size % self.world != 0:
+            raise ValueError(
+                f"global_batch_size {global_batch_size} must divide by "
+                f"world {world}: each step consumes one fixed global "
+                "batch at every topology")
+        self.num_samples = dataset if isinstance(dataset, int) \
+            else len(dataset)
+        self.cursor = cursor if cursor is not None else ElasticDataCursor()
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+
+    def global_order(self, epoch: int) -> np.ndarray:
+        """The epoch's world-independent global sample order."""
+        if self.shuffle:
+            rng = np.random.RandomState([self.seed, int(epoch)])
+            return rng.permutation(self.num_samples)
+        return np.arange(self.num_samples)
+
+    def global_batch(self, epoch: int, offset: int) -> np.ndarray:
+        """The FULL global batch starting at `offset` — what all ranks
+        together consume in one step (tooling/verification)."""
+        order = self.global_order(epoch)
+        return order[int(offset):int(offset) + self.global_batch_size]
+
+    def __iter__(self):
+        g = self.global_batch_size
+        per = g // self.world
+        order = self.global_order(self.cursor.epoch)
+        off = int(self.cursor.offset)
+        while off + g <= self.num_samples:
+            gbatch = order[off:off + g]
+            yield gbatch[self.rank * per:(self.rank + 1) * per].tolist()
+            off += g
+
+    def __len__(self):
+        left = self.num_samples - int(self.cursor.offset)
+        return max(0, left // self.global_batch_size)
+
+    def set_epoch(self, epoch):
+        """DistributedBatchSampler-compatible epoch pin (prefer letting
+        the cursor track epochs via next_epoch())."""
+        self.cursor.epoch = int(epoch)
+        self.cursor.offset = 0
 
 
 class _WorkerInfo:

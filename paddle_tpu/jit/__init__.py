@@ -433,12 +433,19 @@ class TrainStep:
                             loss_fn=self.loss_fn)
         return Tensor(losses)
 
+    def attach_data_cursor(self, cursor):
+        """Attach an io.ElasticDataCursor: its (epoch, offset) rides
+        train_state meta so checkpoints carry the topology-independent
+        data position beside params/opt state."""
+        self._data_cursor = cursor
+
     def train_state(self):
         """(arrays, meta) of the full training state — params, buffers,
-        optimizer state, global step, LR scheduler, RNG — for
-        `distributed.checkpoint.save_train_checkpoint` (same contract
-        as ShardedTrainStep.train_state; the resume is bit-exact)."""
-        from ..distributed.checkpoint import optimizer_meta
+        optimizer state, global step, LR scheduler, RNG, attached data
+        cursor — for `distributed.checkpoint.save_train_checkpoint`
+        (same contract as ShardedTrainStep.train_state; the resume is
+        bit-exact)."""
+        from ..distributed.checkpoint import optimizer_meta, cursor_to_meta
         sd = self.model.state_dict()
         if self._opt_states is None:
             self._opt_states = self._init_opt_states(
@@ -447,10 +454,11 @@ class TrainStep:
         for n, st in zip(self._names, self._opt_states):
             for k, v in st.items():
                 arrays[f"opt.{n}.{k}"] = v
-        return arrays, optimizer_meta(self.optimizer)
+        return arrays, cursor_to_meta(self, optimizer_meta(self.optimizer))
 
     def load_train_state(self, arrays, meta):
-        from ..distributed.checkpoint import apply_optimizer_meta
+        from ..distributed.checkpoint import (apply_optimizer_meta,
+                                              cursor_from_meta)
         sd = self.model.state_dict()
         for n in sd:
             if f"model.{n}" in arrays:
@@ -463,6 +471,7 @@ class TrainStep:
                 if f"opt.{n}.{k}" in arrays:
                     st[k] = arrays[f"opt.{n}.{k}"]
         apply_optimizer_meta(self.optimizer, meta)
+        cursor_from_meta(self, meta)
 
     def __call__(self, *batch):
         """batch: (*inputs, label) Tensors; returns loss Tensor."""

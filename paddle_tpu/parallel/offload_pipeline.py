@@ -836,13 +836,18 @@ class OffloadPipelineStep:
         self._guard.record(float(np.asarray(loss)),
                            step=self.optimizer._step_count)
 
+    def attach_data_cursor(self, cursor):
+        """Attach an io.ElasticDataCursor: rides train_state meta (see
+        ShardedTrainStep.attach_data_cursor)."""
+        self._data_cursor = cursor
+
     def train_state(self):
         """(arrays, meta) of the full streamed-pipeline training state:
         tail params + their optimizer state, the host-parked per-leaf
         param/state STACKS (authoritative between steps — no
         sync_to_model detour, so the capture is exact), global step, LR
-        scheduler and RNG."""
-        from ..distributed.checkpoint import optimizer_meta
+        scheduler, RNG and any attached data cursor."""
+        from ..distributed.checkpoint import optimizer_meta, cursor_to_meta
         if not self._stacks_ready:
             self._init_stacks()
         sd = self.model.state_dict()
@@ -854,10 +859,11 @@ class OffloadPipelineStep:
             arrays[f"stack.{s}"] = self._stk_param[s]
             for k, v in self._stk_state[s].items():
                 arrays[f"stack_state.{s}.{k}"] = v
-        return arrays, optimizer_meta(self.optimizer)
+        return arrays, cursor_to_meta(self, optimizer_meta(self.optimizer))
 
     def load_train_state(self, arrays, meta):
-        from ..distributed.checkpoint import apply_optimizer_meta
+        from ..distributed.checkpoint import (apply_optimizer_meta,
+                                              cursor_from_meta)
         if not self._stacks_ready:
             self._init_stacks()
         sd = self.model.state_dict()
@@ -883,6 +889,7 @@ class OffloadPipelineStep:
                     self._stk_state[s][k] = \
                         arrays[f"stack_state.{s}.{k}"]
         apply_optimizer_meta(self.optimizer, meta)
+        cursor_from_meta(self, meta)
         # keep the module-API view consistent with the restored stacks
         self.sync_to_model()
 

@@ -804,15 +804,25 @@ class ShardedTrainStep:
             self._pipeline.sync_to_model()
 
     # -- fault tolerance ---------------------------------------------------
+    def attach_data_cursor(self, cursor):
+        """Attach an io.ElasticDataCursor so checkpoints carry the
+        topology-independent (epoch, global_sample_offset) beside the
+        arrays — a resume at a different dp degree replays exactly the
+        unseen samples."""
+        if self._pipeline is not None:
+            self._pipeline.attach_data_cursor(cursor)
+        self._data_cursor = cursor
+
     def train_state(self):
         """(arrays, meta) of the FULL training state: model params and
-        buffers, per-param optimizer state, global step, LR scheduler
-        and process RNG — everything a bit-exact resume needs (N steps
-        ≡ N/2 + save + restore-into-fresh-state + N/2).  Feed to
+        buffers, per-param optimizer state, global step, LR scheduler,
+        process RNG and any attached data cursor — everything a
+        bit-exact resume needs (N steps ≡ N/2 + save +
+        restore-into-fresh-state + N/2).  Feed to
         `distributed.checkpoint.save_train_checkpoint`."""
         if self._pipeline is not None:
             return self._pipeline.train_state()
-        from ..distributed.checkpoint import optimizer_meta
+        from ..distributed.checkpoint import optimizer_meta, cursor_to_meta
         sd = self.model.state_dict()
         if self._opt_states is None:
             self._opt_states = self._init_opt_states()
@@ -820,12 +830,13 @@ class ShardedTrainStep:
         for n, st in zip(self._names, self._opt_states):
             for k, v in st.items():
                 arrays[f"opt.{n}.{k}"] = v
-        return arrays, optimizer_meta(self.optimizer)
+        return arrays, cursor_to_meta(self, optimizer_meta(self.optimizer))
 
     def load_train_state(self, arrays, meta):
         if self._pipeline is not None:
             return self._pipeline.load_train_state(arrays, meta)
-        from ..distributed.checkpoint import apply_optimizer_meta
+        from ..distributed.checkpoint import (apply_optimizer_meta,
+                                              cursor_from_meta)
         sd = self.model.state_dict()
         for n in sd:
             if f"model.{n}" in arrays:
@@ -837,6 +848,7 @@ class ShardedTrainStep:
                 if f"opt.{n}.{k}" in arrays:
                     st[k] = arrays[f"opt.{n}.{k}"]
         apply_optimizer_meta(self.optimizer, meta)
+        cursor_from_meta(self, meta)
 
     def _step_faults(self, batch_vals):
         """Thread the train-step injection points: `step.begin`

@@ -152,6 +152,32 @@ def _worker_losses(path):
     return out
 
 
+def test_fleet_chaos_selftest():
+    """ISSUE 13 acceptance: `chaos_check --fleet --selftest` runs a
+    REAL 2-proc data-parallel job, kills rank 1 mid-run via the fault
+    grammar, the surviving pod re-forms the gang at world 1, and the
+    resumed job restores through reshard-on-load (two rank ShardSlices
+    → full arrays) + the topology-aware cursor: all steps complete,
+    post-resume losses BIT-EXACT vs an uninterrupted world-1 run
+    restored from the same checkpoint, zero samples lost or duplicated,
+    and the fleet.elastic event renders in fleet_report."""
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu")
+    env.pop("FLAGS_fault_injection", None)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_check.py"),
+         "--fleet", "--selftest", "--json"],
+        capture_output=True, text=True, timeout=600, env=env)
+    tail = (p.stdout or "")[-2000:] + (p.stderr or "")[-1000:]
+    assert p.returncode == 0, tail
+    rep = json.loads(p.stdout)
+    assert rep["ok"], tail
+    by_name = {c["check"]: c for c in rep["checks"]}
+    assert by_name["fleet.kill-shrink-resume"]["recovered"]
+    assert by_name["fleet.elastic-event-rendered"]["recovered"]
+
+
 def _launch(tmp_path, env_extra, max_restart=2):
     script = tmp_path / "worker.py"
     script.write_text(textwrap.dedent(WORKER))

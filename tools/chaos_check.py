@@ -22,6 +22,29 @@ Train-plane modes:
       a silently dead injection point is exactly the failure mode this
       guards.
 
+Fleet-plane modes (ISSUE 13 — the elastic shrink loop):
+
+  python tools/chaos_check.py --fleet [--ranks N] [--steps T] [--kill-step K]
+      Run a REAL N-process data-parallel job (N launcher pods on
+      localhost sharing one KV master, JAX_PLATFORMS=cpu, grads
+      all-reduced over the host-collective plane, every rank saving its
+      ShardSlice of the train state per step) and kill one rank mid-run
+      via the r9 fault grammar (`step.begin:step=K:mode=kill`).  The
+      surviving pods reap the dead peer's lease, re-form the gang at
+      world N−1 and relaunch; the resumed workers restore through
+      reshard-on-load (N saved slices → N−1 targets) with the
+      topology-aware data cursor.  Passes iff the kill fired, the job
+      completed all T steps, every post-resume loss is BIT-EXACT equal
+      to an uninterrupted N−1 run restored from the same checkpoint,
+      and the consumed global sample indices per step exactly match the
+      world-independent schedule — zero samples lost or duplicated
+      across the shrink.
+
+  python tools/chaos_check.py --fleet --selftest
+      The killed-rank e2e above (2 pods → 1) plus `fleet.elastic`
+      telemetry/report checks.  Tier-1-wired
+      (tests/test_elastic_resume.py).
+
 Serve-plane modes (ISSUE 9):
 
   python tools/chaos_check.py --serve --spec "serve.decode:step=3:mode=error"
@@ -497,6 +520,391 @@ def _serve_selftest():
 
 
 # ---------------------------------------------------------------------------
+# fleet plane (ISSUE 13): N-proc elastic shrink under a killed rank
+# ---------------------------------------------------------------------------
+
+# the deterministic fleet job: a tiny MLP trained data-parallel across
+# N PROCESSES — identical init on every rank (one seed), one FIXED
+# global batch per step regardless of world size (ElasticBatchSampler
+# hands each rank its slice), per-sample loss/grad SUMS all-reduced
+# over the host-collective plane then normalized by the global batch,
+# so every rank holds identical params after every step and the
+# post-resume math at world W' is identical to an uninterrupted W' run
+FLEET_SEED = 7
+FLEET_DATA_SEED = 100
+FLEET_SAMPLE_SEED = 5
+
+
+def fleet_model():
+    import paddle_tpu as paddle
+
+    class MLP(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = paddle.nn.Linear(8, 16)
+            self.fc2 = paddle.nn.Linear(16, 1)
+
+        def forward(self, x):
+            return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+    paddle.seed(FLEET_SEED)
+    m = MLP()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters(),
+                                 weight_decay=0.1)
+    return m, opt
+
+
+def fleet_data(n):
+    import numpy as np
+    rng = np.random.RandomState(FLEET_DATA_SEED)
+    return (rng.randn(n, 8).astype(np.float32),
+            rng.randn(n, 1).astype(np.float32))
+
+
+def fleet_state(model, opt):
+    """{key: np.ndarray} snapshot of the full train state, in the
+    shared `model.<name>` / `opt.<name>.<k>` key scheme — what each
+    rank saves as its ShardSlice and a restore reassembles."""
+    import numpy as np
+    arrays = {}
+    for n, p in model.named_parameters():
+        arrays[f"model.{n}"] = np.asarray(p.value)
+        for k, v in opt._state_for(p).items():
+            arrays[f"opt.{n}.{k}"] = np.asarray(v)
+    return arrays
+
+
+def fleet_apply_state(model, opt, arrays):
+    import jax.numpy as jnp
+    for n, p in model.named_parameters():
+        if f"model.{n}" in arrays:
+            p._value = jnp.asarray(arrays[f"model.{n}"])
+        st = opt._state_for(p)
+        for k in list(st):
+            if f"opt.{n}.{k}" in arrays:
+                st[k] = jnp.asarray(arrays[f"opt.{n}.{k}"])
+
+
+def fleet_train_step(model, opt, x, y, gbs, reduce_fn=None):
+    """One dp step on this rank's slice: local per-sample SUM loss +
+    grads, cross-rank sum via `reduce_fn` (None = single rank), then
+    normalize by the GLOBAL batch and update.  Identical math on every
+    rank; deterministic for a fixed world size."""
+    import numpy as np
+    import paddle_tpu as paddle
+    out = model(paddle.to_tensor(x))
+    diff = out - paddle.to_tensor(y)
+    loss_sum = paddle.sum(diff * diff)
+    loss_sum.backward()
+    params = list(model.named_parameters())
+    flat = np.concatenate(
+        [np.asarray(loss_sum.value).reshape(1)]
+        + [np.asarray(p.grad.value).ravel() for _, p in params])
+    if reduce_fn is not None:
+        flat = np.asarray(reduce_fn(flat), np.float32)
+    scale = np.float32(gbs)
+    off = 1
+    for _, p in params:
+        sz = int(np.prod(p.value.shape))
+        g = (flat[off:off + sz].reshape(p.value.shape)
+             / scale).astype(np.float32)
+        p.grad = paddle.to_tensor(g)
+        off += sz
+    opt.step()
+    opt.clear_grad()
+    return float(flat[0] / scale)
+
+
+def fleet_worker_main():
+    """One rank of the fleet chaos job (run under the launch
+    controller; `chaos_check.py --fleet-worker`).  Config rides the
+    FLEET_CFG env json; identity comes from the launcher env
+    (PADDLE_TRAINER_ID/NUM, PADDLE_ELASTIC_EPOCH)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import telemetry
+    from paddle_tpu.distributed import fault, guard
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.distributed.checkpoint import ShardSlice
+    from paddle_tpu.distributed.host_collectives import \
+        get_host_collectives
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.io import ElasticBatchSampler, ElasticDataCursor
+
+    cfg = json.loads(os.environ["FLEET_CFG"])
+    rank, world, eepoch = guard.elastic_world()
+    root, dump = cfg["ckpt"], cfg["dump"]
+    steps, gbs, n = cfg["steps"], cfg["gbs"], cfg["n_samples"]
+    telemetry.set_rank(rank, world)
+    telemetry.attach_jsonl(
+        os.path.join(dump, f"tel.e{eepoch}.r{rank}.jsonl"))
+    restart = int(os.environ.get("PADDLE_RESTART_CNT", "0"))
+    if (cfg.get("kill_spec") and rank == cfg.get("kill_rank", 1)
+            and eepoch == 0 and restart == 0):
+        # the victim's FIRST incarnation arms the r9 fault grammar; a
+        # relaunched epoch never re-arms, so the job can finish
+        paddle.set_flags({"FLAGS_fault_injection": cfg["kill_spec"]})
+
+    model, opt = fleet_model()
+    cursor = ElasticDataCursor()
+    sampler = ElasticBatchSampler(n, gbs, cursor=cursor, rank=rank,
+                                  world=world, shuffle=True,
+                                  seed=FLEET_SAMPLE_SEED)
+    X, Y = fleet_data(n)
+    hc = get_host_collectives()
+    reduce_fn = (lambda v: hc.all_reduce(v)) if hc is not None else None
+
+    log = open(os.path.join(dump, f"losses.e{eepoch}.r{rank}.jsonl"),
+               "a", buffering=1)
+    # restore (reshard-on-load): FULL-array targets assembled from the
+    # rank slices of WHATEVER world saved the newest complete step
+    skel = {k: Tensor(jnp.asarray(v))
+            for k, v in fleet_state(model, opt).items()}
+    got = ckpt.load_checkpoint(skel, root)
+    if got is not None:
+        _, meta = got
+        fleet_apply_state(
+            model, opt, {k: np.asarray(t.value) for k, t in skel.items()})
+        ckpt.apply_optimizer_meta(opt, meta)
+        if meta.get("data_cursor"):
+            cursor.load_state_dict(dict(meta["data_cursor"]))
+        guard.elastic_resume(meta)  # fleet.elastic event on a shrink
+        log.write(json.dumps(
+            {"resumed_from": int(meta.get("step_count", 0)),
+             "world": world, "old_world": meta.get("world"),
+             "epoch": eepoch}) + "\n")
+
+    while opt._step_count < steps:
+        i = opt._step_count + 1
+        fault.hit("step.begin", key=f"step{i}")
+        local = next(iter(sampler), None)
+        if local is None:
+            raise RuntimeError("fleet worker: sample stream exhausted "
+                               f"at step {i} (cursor {cursor})")
+        loss = fleet_train_step(model, opt, X[local], Y[local], gbs,
+                                reduce_fn)
+        cursor.advance(gbs)
+        log.write(json.dumps(
+            {"step": i, "loss": loss, "world": world, "epoch": eepoch,
+             "indices": [int(s) for s in local]}) + "\n")
+        arrays = {k: ShardSlice.of(v, rank, world)
+                  for k, v in fleet_state(model, opt).items()}
+        meta = ckpt.optimizer_meta(opt)
+        meta["data_cursor"] = cursor.state_dict()
+        ckpt.save_checkpoint(arrays, root, step=i,
+                             keep=cfg.get("keep", steps + 2), meta=meta)
+    log.close()
+    return 0
+
+
+def run_fleet(ranks=2, steps=8, kill_step=4, kill_rank=1, gbs=12,
+              workdir=None):
+    """Drive the N-proc elastic shrink chaos scenario; returns a report
+    dict with report["ok"] the pass verdict (see module docstring)."""
+    import subprocess
+
+    if gbs % ranks:
+        raise ValueError(f"gbs {gbs} must divide by ranks {ranks}")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_fleet_")
+    dump = os.path.join(workdir, "dump")
+    root = os.path.join(workdir, "ckpt")
+    os.makedirs(dump, exist_ok=True)
+    cfg = {"steps": steps, "gbs": gbs, "n_samples": steps * gbs + 3,
+           "ckpt": root, "dump": dump, "kill_rank": kill_rank,
+           "kill_spec": f"step.begin:step={kill_step}:mode=kill"}
+
+    from paddle_tpu.distributed.launch.master import KVServer
+    srv = KVServer(0).start()
+    env = dict(os.environ,
+               PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu",
+               FLEET_CFG=json.dumps(cfg),
+               # tight elastic cadence: the harness must detect the
+               # dead pod and re-form in seconds, not the production
+               # 45s.  The kill path is detected via the dead
+               # launcher's explicit lease WITHDRAWAL (instant), so the
+               # TTL is only a backstop — keep it lax enough that a
+               # loaded CI box (parallel jax imports) can't starve a
+               # healthy launcher past it and trigger a spurious
+               # re-form mid-verification
+               PADDLE_ELASTIC_HEARTBEAT_INTERVAL="0.2",
+               PADDLE_ELASTIC_HEARTBEAT_TTL="15",
+               PADDLE_ELASTIC_SETTLE="0.5",
+               PADDLE_ELASTIC_SCALE_CHECK="1")
+    for stale in ("FLAGS_fault_injection", "PADDLE_TRAINER_ID",
+                  "PADDLE_TRAINERS_NUM", "PADDLE_ELASTIC_EPOCH",
+                  "PADDLE_MASTER", "PADDLE_KV_MASTER", "PADDLE_NNODES",
+                  "PADDLE_RESTART_CNT"):
+        env.pop(stale, None)
+    this = os.path.abspath(__file__)
+    procs = []
+    try:
+        for _ in range(ranks):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 f"--master=127.0.0.1:{srv.port}",
+                 f"--nnodes=1:{ranks}", "--max_restart=0",
+                 "--elastic_timeout=120",
+                 f"--log_dir={workdir}/log", "--job_id=fleetchaos",
+                 this, "--fleet-worker"],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT))
+        rcs, outs = [], []
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            rcs.append(p.returncode)
+            outs.append(out.decode(errors="replace"))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.stop()
+
+    # ---- collect the per-(epoch, rank) loss logs: later epochs win
+    records, resumes = {}, []
+    import glob as _glob
+    for path in sorted(_glob.glob(os.path.join(dump, "losses.e*.jsonl"))):
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if "resumed_from" in rec:
+                    resumes.append(rec)
+                    continue
+                key = (rec["step"],)
+                cur = records.setdefault(key, [])
+                cur.append(rec)
+    by_step = {}
+    cross_rank_mismatch = []
+    for (step,), recs in records.items():
+        top_epoch = max(r["epoch"] for r in recs)
+        top = [r for r in recs if r["epoch"] == top_epoch]
+        losses = {r["loss"] for r in top}
+        if len(losses) != 1:
+            cross_rank_mismatch.append(step)
+        indices = [i for r in top for i in r["indices"]]
+        by_step[step] = {"loss": top[0]["loss"],
+                         "world": top[0]["world"],
+                         "epoch": top_epoch,
+                         "indices": sorted(indices),
+                         "dup": len(indices) != len(set(indices))}
+
+    completed = sorted(by_step)
+    all_steps = completed == list(range(1, steps + 1))
+    worlds = [by_step[s]["world"] for s in completed]
+    shrank = bool(worlds) and worlds[0] == ranks \
+        and worlds[-1] == ranks - 1
+    fired = shrank and any(rc not in (0, None) for rc in rcs)
+
+    # ---- data coverage: each step consumed EXACTLY its stride of the
+    # world-independent global order — no sample lost, none duplicated
+    from paddle_tpu.io import ElasticBatchSampler
+    probe = ElasticBatchSampler(cfg["n_samples"], gbs, rank=0, world=1,
+                                shuffle=True, seed=FLEET_SAMPLE_SEED)
+    coverage_bad = []
+    for s in completed:
+        want = sorted(int(i) for i in probe.global_batch(0, (s - 1) * gbs))
+        if by_step[s]["indices"] != want or by_step[s]["dup"]:
+            coverage_bad.append(s)
+
+    # ---- bit-exact reference: an UNINTERRUPTED world-(N−1) run
+    # restored from the same checkpoint the resumed gang used (only
+    # computable in-process for a shrink to world 1 — the selftest
+    # scenario; the comparison is exact, not tolerance-based)
+    resume_step = max((r["resumed_from"] for r in resumes
+                       if r.get("world") == ranks - 1), default=None)
+    mismatch = []
+    ref_applicable = ranks - 1 == 1
+    if ref_applicable and resume_step is not None:
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.distributed import checkpoint as ckpt
+        from paddle_tpu.framework.tensor import Tensor
+        from paddle_tpu.io import ElasticDataCursor
+        model, opt = fleet_model()
+        skel = {k: Tensor(jnp.asarray(v))
+                for k, v in fleet_state(model, opt).items()}
+        cand = os.path.join(root, f"step_{resume_step:08d}")
+        got = ckpt.load_checkpoint(skel, root, candidate=cand)
+        assert got is not None, "reference restore found no checkpoint"
+        _, meta = got
+        fleet_apply_state(
+            model, opt, {k: np.asarray(t.value) for k, t in skel.items()})
+        ckpt.apply_optimizer_meta(opt, meta)
+        cursor = ElasticDataCursor()
+        cursor.load_state_dict(dict(meta.get("data_cursor") or {}))
+        ref_sampler = ElasticBatchSampler(
+            cfg["n_samples"], gbs, cursor=cursor, rank=0, world=1,
+            shuffle=True, seed=FLEET_SAMPLE_SEED)
+        X, Y = fleet_data(cfg["n_samples"])
+        for s in range(resume_step + 1, steps + 1):
+            local = next(iter(ref_sampler))
+            loss = fleet_train_step(model, opt, X[local], Y[local], gbs)
+            cursor.advance(gbs)
+            got_loss = by_step.get(s, {}).get("loss")
+            if got_loss != loss:
+                mismatch.append({"step": s, "fleet": got_loss,
+                                 "reference": loss})
+
+    ok = (fired and all_steps and shrank and resume_step is not None
+          and not cross_rank_mismatch and not coverage_bad
+          and not mismatch)
+    return {"ranks": ranks, "steps": steps, "kill_step": kill_step,
+            "launcher_rcs": rcs, "fired": fired, "shrank": shrank,
+            "completed": len(completed), "resume_step": resume_step,
+            "resumes": len(resumes),
+            "reference": "checked" if ref_applicable else "skipped",
+            "cross_rank_mismatch": cross_rank_mismatch,
+            "coverage_bad": coverage_bad, "mismatch": mismatch,
+            "workdir": workdir, "ok": ok,
+            "tail": "" if ok else "\n".join(o[-800:] for o in outs)}
+
+
+def _fleet_selftest():
+    """The killed-rank elastic shrink e2e + the fleet.elastic
+    observability contract."""
+    checks = []
+    rep = run_fleet(ranks=2, steps=6, kill_step=4)
+    checks.append({"check": "fleet.kill-shrink-resume",
+                   "fired": rep["fired"], "recovered": rep["ok"],
+                   "detail": json.dumps({k: rep[k] for k in
+                                         ("launcher_rcs", "completed",
+                                          "resume_step",
+                                          "cross_rank_mismatch",
+                                          "coverage_bad", "mismatch")})})
+    # the shrink must be observable: a fleet.elastic event in the
+    # resumed rank's telemetry log, rendered by tools/fleet_report.py
+    import glob as _glob
+    events = []
+    for path in _glob.glob(os.path.join(rep["workdir"], "dump",
+                                        "tel.e*.jsonl")):
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("event") == "fleet.elastic":
+                    events.append(rec)
+    ev_ok = any(e.get("old_world") == 2 and e.get("new_world") == 1
+                for e in events)
+    rendered = ""
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from fleet_report import render_elastic
+        rendered = render_elastic(events)
+    except Exception as e:  # noqa: BLE001 — surfaced in the check
+        rendered = f"render failed: {e}"
+    checks.append({"check": "fleet.elastic-event-rendered",
+                   "fired": bool(events),
+                   "recovered": ev_ok and "2 -> 1" in rendered,
+                   "detail": rendered[:300]})
+    return checks
+
+
+# ---------------------------------------------------------------------------
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
@@ -511,8 +919,54 @@ def main(argv=None):
                     help="exercise the SERVE plane (ContinuousBatcher "
                          "under serve.* specs / the serve selftest) "
                          "instead of the train loop")
+    ap.add_argument("--fleet", action="store_true",
+                    help="exercise the FLEET plane: an N-proc elastic "
+                         "job, one rank killed mid-run, gang re-forms "
+                         "at N-1 and resumes via reshard-on-load")
+    ap.add_argument("--fleet-worker", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: one fleet rank
+    ap.add_argument("--ranks", type=int, default=2,
+                    help="fleet processes to launch (--fleet)")
+    ap.add_argument("--kill-step", type=int, default=4,
+                    help="global step whose entry kills the victim "
+                         "rank (--fleet)")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
+    if args.fleet_worker:
+        return fleet_worker_main()
+    if args.fleet:
+        if args.selftest:
+            checks = _fleet_selftest()
+            bad = [c for c in checks
+                   if not (c["fired"] and c["recovered"])]
+            if args.as_json:
+                print(json.dumps({"mode": "fleet-selftest",
+                                  "checks": checks, "ok": not bad},
+                                 indent=2))
+            else:
+                for c in checks:
+                    mark = "ok " if c["fired"] and c["recovered"] \
+                        else "FAIL"
+                    print(f"  [{mark}] {c['check']} "
+                          f"(fired={c['fired']}, "
+                          f"recovered={c['recovered']}) {c['detail']}")
+                print(f"fleet selftest: {len(checks) - len(bad)}"
+                      f"/{len(checks)} checks passed")
+            return 1 if bad else 0
+        rep = run_fleet(ranks=args.ranks, steps=args.steps,
+                        kill_step=args.kill_step)
+        if args.as_json:
+            print(json.dumps(rep, indent=2))
+        else:
+            verdict = "RECOVERED" if rep["ok"] else "FAILED"
+            print(f"{verdict}: {rep['ranks']}-proc job, kill at step "
+                  f"{rep['kill_step']}, completed {rep['completed']}/"
+                  f"{rep['steps']} steps, resume_step="
+                  f"{rep['resume_step']}, coverage_bad="
+                  f"{rep['coverage_bad']}, mismatch={rep['mismatch']}")
+            if not rep["ok"]:
+                print(rep["tail"])
+        return 0 if rep["ok"] else 1
     if args.serve and not (args.selftest or args.spec):
         ap.error("--serve needs --spec or --selftest")
     if args.serve and args.spec and not args.selftest:

@@ -120,6 +120,12 @@ def analyze_fleet(logs, skew_ms: float = 0.0, top: int = 10):
         if n:
             out[key] = n
 
+    # elastic resume events (ISSUE 13): a rank restoring a checkpoint
+    # saved at a DIFFERENT world size announces the reshard-on-load
+    elastic = [e for e in all_events if e.get("event") == "fleet.elastic"]
+    if elastic:
+        out["elastic_events"] = elastic
+
     # memory section: latest mem.program record per label
     mem = {}
     for e in all_events:
@@ -137,6 +143,22 @@ def analyze_fleet(logs, skew_ms: float = 0.0, top: int = 10):
 def _pct(xs, q):
     from paddle_tpu.telemetry import percentile_of
     return percentile_of(xs, q)
+
+
+def render_elastic(events) -> str:
+    """The elastic-resume section: one line per `fleet.elastic` event
+    (world transition, resume step, data cursor) — the human face of
+    the shrink/grow loop (`chaos_check --fleet` asserts this renders)."""
+    lines = [f"elastic resumes: {len(events)}"]
+    for e in events:
+        cur = e.get("cursor") or {}
+        where = f" rank {e['rank']}" if "rank" in e else ""
+        lines.append(
+            f"  world {e.get('old_world', '?')} -> "
+            f"{e.get('new_world', '?')}{where} at step "
+            f"{e.get('step', '?')} (cursor epoch {cur.get('epoch', '?')}"
+            f", offset {cur.get('offset', '?')})")
+    return "\n".join(lines)
 
 
 def render(rep) -> str:
@@ -165,6 +187,8 @@ def render(rep) -> str:
     for key in ("straggler_events", "desync_events"):
         if key in rep:
             lines.append(f"{key}: {rep[key]}")
+    if rep.get("elastic_events"):
+        lines.append(render_elastic(rep["elastic_events"]))
     if "memory" in rep:
         m = rep["memory"]
         lines.append(f"memory ledger: {len(m['programs'])} programs, "
