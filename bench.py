@@ -37,19 +37,6 @@ def _reps():
     return max(1, int(os.environ.get("BENCH_REPS", "3")))
 
 
-# flags that change what a bench metric measures: part of the env
-# fingerprint so the perf sentry never compares a weight-only/int8-KV
-# capture against a flags-off one
-_FINGERPRINT_FLAGS = (
-    "FLAGS_fused_ce", "FLAGS_bf16_adamw_moments",
-    "FLAGS_weight_only_dtype", "FLAGS_weight_only_group_size",
-    "FLAGS_kv_cache_dtype", "FLAGS_kv_page_size",
-    "FLAGS_serve_spec_tokens", "FLAGS_serve_draft_layers",
-)
-_FINGERPRINT_ENVS = ("BENCH_BATCH", "BENCH_RECOMPUTE_LAYERS",
-                     "BENCH_OFFLOAD_SIZE", "BENCH_OFFLOAD_PREFETCH",
-                     "BENCH_LONGCTX_SEQ", "BENCH_LONGCTX_REMAT",
-                     "BENCH_UNET_DTYPE", "PEAK_FLOPS")
 _ENV_FP = None
 
 
@@ -58,40 +45,22 @@ def _env_fingerprint():
     versions, backend + device kind, and the bench-relevant flags/envs.
     The perf sentry (tools/perf_report.py) compares metric lines only
     between captures whose fingerprints match — a library bump or a
-    flag flip must read as 'incomparable', never as a regression."""
+    flag flip must read as 'incomparable', never as a regression.
+    THE derivation lives in telemetry.flightrec (ISSUE 14: incident
+    bundles carry the same identity, so a rendered incident matches
+    the BENCH baselines it drifted from)."""
     global _ENV_FP
-    if _ENV_FP is not None:
-        return _ENV_FP
-    fp = {}
-    try:
-        import jax
-        import jaxlib
-        fp["jax"] = jax.__version__
-        fp["jaxlib"] = jaxlib.__version__
-        fp["backend"] = jax.default_backend()
-        fp["device"] = jax.devices()[0].device_kind
-    except Exception:
-        pass
-    try:
-        from paddle_tpu.framework.flags import get_flags
-        fp["flags"] = {k: v for k, v in sorted(
-            get_flags(list(_FINGERPRINT_FLAGS)).items())}
-    except Exception:
-        pass
-    fp["env"] = {k: os.environ[k] for k in _FINGERPRINT_ENVS
-                 if k in os.environ}
-    _ENV_FP = fp
-    return fp
+    if _ENV_FP is None:
+        from paddle_tpu.telemetry.flightrec import env_fingerprint
+        _ENV_FP = env_fingerprint()
+    return _ENV_FP
 
 
 def _capture_id():
     """Stable id of the env fingerprint (BENCH_CAPTURE_ID overrides):
     the sentry's match key."""
-    if "BENCH_CAPTURE_ID" in os.environ:
-        return os.environ["BENCH_CAPTURE_ID"]
-    import hashlib
-    blob = json.dumps(_env_fingerprint(), sort_keys=True).encode()
-    return hashlib.sha1(blob).hexdigest()[:12]
+    from paddle_tpu.telemetry.flightrec import capture_id
+    return capture_id(_env_fingerprint())
 
 
 def _measure(rep_fn):
@@ -1189,7 +1158,9 @@ def _assert_telemetry_zero_overhead():
     """No sink attached + FLAGS_compile_cache_dir unset ⇒ the telemetry
     plane costs the hot paths nothing: the compiled train-step HLO is
     byte-identical to flags-off (arming and disarming a sink + the
-    compile cache leaves zero residue in the program), and flags-off
+    incident flight recorder + the compile cache leaves zero residue
+    in the program — with FLAGS_numerics_stats unset; ON, the flag
+    must genuinely change the program, asserted below), and flags-off
     static-executor replays neither grow the replay-cache key set nor
     emit events.  Cheap (tiny MLP + tiny program), runs before every
     bench config."""
@@ -1236,6 +1207,14 @@ def _assert_telemetry_zero_overhead():
         # (rank tagging, memory-ledger registration, fleet flags are
         # all host-side)
         telemetry.set_rank(0, 2)
+        # the incident flight recorder joins the armed surface (ISSUE
+        # 14): it is a plain sink (ring append + trigger lookup), so
+        # attaching it — with FLAGS_numerics_stats left unset — must
+        # leave the compiled step AND its cache keys byte-identical.
+        # Scope it: a production recorder armed via FLAGS_flightrec_dir
+        # must be back in place when the assert finishes
+        _prev_rec = telemetry.flightrec.detach()
+        telemetry.flightrec.attach(_os.path.join(d, "incidents"))
         # FLAGS_mfu_floor joins the armed surface (ISSUE 12): the cost
         # ledger's drift floor is host-plane only, so arming it must
         # leave the compiled step byte-identical too
@@ -1251,16 +1230,32 @@ def _assert_telemetry_zero_overhead():
                        "FLAGS_straggler_skew_ms": 0.0,
                        "FLAGS_mfu_floor": 0.0})
             telemetry.disable_persistent_cache()
+            telemetry.flightrec.detach()
+            telemetry.flightrec.restore(_prev_rec)
             telemetry.remove_sink(sink)
     _, _, hlo_off2 = build_hlo()
     assert hlo_off == hlo_armed == hlo_off2, \
-        "telemetry sink / compile-cache / fleet / cost-ledger arming " \
-        "changed the train-step program"
+        "telemetry sink / compile-cache / fleet / cost-ledger / " \
+        "flight-recorder arming changed the train-step program"
+    # the numerics plane is a PROGRAM switch (ISSUE 14): ON it must
+    # actually change the build (per-layer reductions in-graph) — a
+    # vacuous flag would make the byte-identical assert above prove
+    # nothing about it
+    set_flags({"FLAGS_numerics_stats": True})
+    try:
+        _, _, hlo_num = build_hlo()
+    finally:
+        set_flags({"FLAGS_numerics_stats": False})
+    assert hlo_num != hlo_off, \
+        "FLAGS_numerics_stats did not reach the compiled train step"
     # scrub the assert's own footprint (steps/compile records from the
     # tiny MLP) so the telemetry snapshot embedded in this config's
-    # metric lines reflects ONLY the config's run
+    # metric lines reflects ONLY the config's run — then put the
+    # production flight recorder back (reset() detaches every sink,
+    # which would otherwise undo the finally-block restore above)
     telemetry.reset()
     telemetry.clear_report()
+    telemetry.flightrec.restore(_prev_rec)
 
     # static-executor replay hot path: flags-off replays must not grow
     # the replay-cache key set or publish events
@@ -1302,6 +1297,7 @@ def _assert_serve_robustness_zero_overhead():
     before every bench config."""
     import numpy as np
     import paddle_tpu as paddle
+    from paddle_tpu import telemetry
     from paddle_tpu.framework.flags import set_flags
     from paddle_tpu.inference import ContinuousBatcher
     from paddle_tpu.models.llama import (LlamaForCausalLM,
@@ -1324,6 +1320,13 @@ def _assert_serve_robustness_zero_overhead():
         return bat, keys, hlo
 
     _, keys_off, hlo_off = fingerprint()
+    # the flight recorder joins the armed surface here too (ISSUE 14):
+    # with it attached (and FLAGS_numerics_stats unset) the serve-step
+    # HLO and program-cache keys must stay byte-identical
+    import tempfile as _tempfile
+    _fr_dir = _tempfile.mkdtemp(prefix="bench-flightrec-")
+    _prev_rec = telemetry.flightrec.detach()   # scope: restore below
+    telemetry.flightrec.attach(_fr_dir)
     set_flags({"FLAGS_serve_queue_depth": 8,
                "FLAGS_serve_default_deadline_ms": 60000.0})
     try:
@@ -1339,11 +1342,16 @@ def _assert_serve_robustness_zero_overhead():
     finally:
         set_flags({"FLAGS_serve_queue_depth": 0,
                    "FLAGS_serve_default_deadline_ms": 0.0})
+        telemetry.flightrec.detach()
+        telemetry.flightrec.restore(_prev_rec)
+        import shutil as _shutil
+        _shutil.rmtree(_fr_dir, ignore_errors=True)
     assert keys_off == keys_on, \
-        f"robustness flags leaked into serve program keys: " \
-        f"{keys_off} vs {keys_on}"
+        f"robustness flags / flight recorder leaked into serve " \
+        f"program keys: {keys_off} vs {keys_on}"
     assert hlo_off == hlo_on, \
-        "robustness flags changed the lowered serve-step HLO"
+        "robustness flags / flight-recorder arming changed the " \
+        "lowered serve-step HLO"
     assert st["compiled_programs"] == 2, \
         f"mixed-SLO multi-length workload compiled " \
         f"{st['compiled_programs']} programs (want 2)"

@@ -68,7 +68,13 @@ class StepAnomalyGuard:
         self.total_steps = 0
         self._recent = []           # (step, loss) of recent bad steps
 
-    def record(self, loss: float, step: Optional[int] = None) -> bool:
+    def record(self, loss: float, step: Optional[int] = None,
+               layer: Optional[str] = None) -> bool:
+        """`layer` (ISSUE 14): the first nonfinite layer bundle the
+        numerics plane attributed this step to (FLAGS_numerics_stats)
+        — it rides the recent-bad-step history and the abort report,
+        so a budget-exhausted abort names WHERE the divergence started,
+        not just that it happened."""
         self.total_steps += 1
         bad = not math.isfinite(loss)
         if not bad:
@@ -76,10 +82,24 @@ class StepAnomalyGuard:
             return False
         self.consecutive_bad += 1
         self.total_bad += 1
-        self._recent.append((step, float(loss)))
+        self._recent.append((step, float(loss)) if layer is None
+                            else (step, float(loss), layer))
         self._recent = self._recent[-16:]
         if self.scaler is not None and hasattr(self.scaler, "backoff"):
             self.scaler.backoff()
+        # the flight recorder's nonfinite-step trigger (no sink -> one
+        # truthiness check); emitted from the HOST guard so the trigger
+        # exists even without the compiled numerics plane
+        try:
+            from .. import telemetry as _tel
+            _tel.counter("train.bad_steps").inc()
+            _tel.emit("train.anomaly", name=self.name, step=step,
+                      loss=float(loss),
+                      consecutive=self.consecutive_bad,
+                      budget=self.budget, source="guard",
+                      **({"layer": layer} if layer else {}))
+        except Exception:
+            pass
         if self.consecutive_bad >= self.budget:
             raise BadStepBudgetExceeded(self.report())
         return True
@@ -88,13 +108,16 @@ class StepAnomalyGuard:
         scale = None
         if self.scaler is not None:
             scale = getattr(self.scaler, "_scale", None)
+        layers = [r[2] for r in self._recent if len(r) > 2]
+        first_layer = f"\n  first nonfinite layer: {layers[0]}" \
+            if layers else ""
         return (
             f"[anomaly-guard] {self.name}: {self.consecutive_bad} "
             f"consecutive nonfinite steps (budget "
             f"{self.budget}; {self.total_bad}/{self.total_steps} bad "
             f"total) — persistent divergence, aborting.\n"
-            f"  recent bad steps (step, loss): {self._recent}\n"
-            f"  loss scale: {scale}\n"
+            f"  recent bad steps (step, loss[, layer]): {self._recent}\n"
+            f"  loss scale: {scale}{first_layer}\n"
             "  Skipped steps left params and optimizer state untouched; "
             "resume from the last checkpoint with a lower LR or loss "
             "scale.")

@@ -219,6 +219,30 @@ define_flag("mfu_floor", 0.0,
             "in telemetry.cost_report() (perf.drift event) and "
             "flagged by analysis.lint_mfu_floor.  0 disables the "
             "check")
+# incident flight recorder + in-step numerics (ISSUE 14).  The
+# flight-recorder flags live in telemetry/flightrec.py (local plane
+# switches); these two are CORE because trainers/exporters read them
+# at build/construct time and a relaunched worker must pick them up
+# from the env before any subsystem imports.
+define_flag("numerics_stats", False,
+            "compile the numerics plane into train steps: the step "
+            "additionally returns per-layer-bundle grad-norm / "
+            "param-norm / update-ratio scalars and a first-nonfinite-"
+            "layer index, computed in-graph from the already-"
+            "materialized grads (one fused reduction per bundle — no "
+            "extra fwd/bwd, donation untouched), emitted as "
+            "train.numerics events; a nonfinite bundle emits the "
+            "train.anomaly flight-recorder trigger naming the layer.  "
+            "Off (default), the compiled step is byte-identical to an "
+            "unflagged build (bench-asserted); read at trainer BUILD "
+            "time like FLAGS_skip_nonfinite_steps")
+define_flag("telemetry_max_log_mb", 0.0,
+            "size cap (MB) on a JsonlSink's log file: past the cap the "
+            "sink rotates events.jsonl -> events.jsonl.1 (existing "
+            "rotated segments shift up) and keeps writing — a long-"
+            "running job's step log stays bounded per segment, and "
+            "merge_jsonl_traces reads the segments back in order.  0 "
+            "(default) disables rotation")
 define_flag("serve_retry_budget", 3,
             "per-request bound on serve-plane fault recoveries "
             "(injected/real admission faults retried FIFO-in-place, "

@@ -973,16 +973,20 @@ class ContinuousBatcher:
             )
         # per-request latency spans (ISSUE 10): queue->admit->first-
         # token->finish percentiles over the last 1024 delivered
-        # requests, and per-SLO-class deadline attainment
-        from ..telemetry import percentiles_of
+        # requests, and per-SLO-class deadline attainment.  The shared
+        # summary derivation (ISSUE 14) adds TRUE window min/max —
+        # percentile reservoirs sample away exactly the extreme
+        # straggler/TTFT outliers an incident investigation needs
+        from ..telemetry import summary_of
         latency = {}
         for k, window in self._lat.items():
-            vals = list(window)
-            pct = percentiles_of(vals)
-            latency[k] = {"count": len(vals),
-                          "p50": round(pct["p50"], 3),
-                          "p90": round(pct["p90"], 3),
-                          "p99": round(pct["p99"], 3)}
+            s = summary_of(list(window))
+            latency[k] = {"count": s["count"],
+                          "min": round(s["min"], 3),
+                          "max": round(s["max"], 3),
+                          "p50": round(s["p50"], 3),
+                          "p90": round(s["p90"], 3),
+                          "p99": round(s["p99"], 3)}
         out["latency"] = latency
         attain = {}
         for cls in SLO_CLASSES:

@@ -244,14 +244,17 @@ def per_step_lrs(optimizer, k: int, advance: bool = True):
 
 def _step_faults(batch_vals, where):
     """Train-step fault-injection boundary (distributed.fault):
-    `step.begin` handles kill/error/delay itself; `step.data` mode=nan
-    poisons the first float batch array so THIS step's loss and grads
-    go genuinely nonfinite (the deterministic NaN-step harness)."""
+    `step.begin` handles kill/error/delay itself; mode=nan at EITHER
+    point poisons the first float batch array so THIS step's loss and
+    grads go genuinely nonfinite (the deterministic NaN-step harness —
+    `step.begin:mode=nan` and `step.data:mode=nan` are equivalent
+    plants; step.begin used to swallow data modes silently)."""
     from ..distributed import fault
     if not fault.is_active():
         return batch_vals
-    fault.hit("step.begin", key=where)
-    f = fault.hit("step.data", key=where)
+    f = fault.hit("step.begin", key=where)
+    if f is None or f.mode != "nan":
+        f = fault.hit("step.data", key=where)
     if f is not None and f.mode == "nan":
         batch_vals = list(batch_vals)
         for i, b in enumerate(batch_vals):
@@ -318,6 +321,13 @@ class TrainStep:
                 wd = 0.0
             wds.append(wd)
         remat = self._remat
+        # numerics plane (ISSUE 14): compiled in only when the flag is
+        # on at build time — flags off, the step program is
+        # byte-identical to an unflagged build (bench-asserted)
+        from ..telemetry import numerics as _numerics
+        numerics_on = self._numerics = _numerics.enabled()
+        if numerics_on:
+            self._num_bundles, num_assign = _numerics.bundles_of(names)
 
         def loss_of(param_vals, buf_vals, key, *batch):
             def fwd(param_vals):
@@ -344,6 +354,11 @@ class TrainStep:
                 loss_of, has_aux=True)(param_vals, buf_vals, key, *batch)
             new_params, new_states = apply_updates(
                 upd, param_vals, grads, opt_states, lr, wds, step_i, hp)
+            if numerics_on:
+                nstats = _numerics.graph_stats(
+                    num_assign, len(self._num_bundles), param_vals,
+                    grads, new_params)
+                return loss, new_params, new_states, new_bufs, nstats
             return loss, new_params, new_states, new_bufs
 
         self._step_fn = step
@@ -358,20 +373,28 @@ class TrainStep:
         the fused window); step_i advances inside the scan so Adam bias
         correction stays exact."""
         step = self._step_fn
+        numerics_on = getattr(self, "_numerics", False)
 
         def multi(param_vals, opt_states, buf_vals, lrs, step0, key,
                   *stacked):
             def body(carry, xs):
                 params, states, bufs, i = carry
                 k = jax.random.fold_in(key, i)
-                loss, params, states, bufs = step(
+                out = step(
                     params, states, bufs, lrs[i], step0 + i, k, *xs)
+                if numerics_on:
+                    loss, params, states, bufs, nstats = out
+                    return (params, states, bufs, i + 1), (loss, nstats)
+                loss, params, states, bufs = out
                 return (params, states, bufs, i + 1), loss
             init = (list(param_vals), opt_states, list(buf_vals),
                     jnp.asarray(0, jnp.int32))
-            (params, states, bufs, _), losses = jax.lax.scan(
+            (params, states, bufs, _), ys = jax.lax.scan(
                 body, init, tuple(stacked))
-            return losses, params, states, bufs
+            if numerics_on:
+                losses, nstats = ys
+                return losses, params, states, bufs, nstats
+            return ys, params, states, bufs
 
         donate = (0, 1, 2) if self._donate else ()
         self._compiled_multi = jax.jit(multi, donate_argnums=donate)
@@ -414,7 +437,11 @@ class TrainStep:
         _tel.counter("train.steps").inc(k)   # lifetime total, sink or not
         tel_on = _tel.active()
         t0 = time.perf_counter()
-        losses, new_params, new_states, new_bufs = fn(*args)
+        out = fn(*args)
+        if getattr(self, "_numerics", False):
+            losses, new_params, new_states, new_bufs, nstats = out
+        else:
+            (losses, new_params, new_states, new_bufs), nstats = out, None
         if tel_on and _tel.config("sync_steps"):
             jax.block_until_ready(losses)
         wall_ms = (time.perf_counter() - t0) * 1e3
@@ -431,6 +458,10 @@ class TrainStep:
                             wall_ms=wall_ms,
                             batch_vals=tuple(b[0] for b in batch_vals),
                             loss_fn=self.loss_fn)
+        if nstats is not None:
+            from ..telemetry import numerics as _numerics
+            _numerics.record("jit", self.optimizer._step_count, k,
+                             self._num_bundles, nstats)
         return Tensor(losses)
 
     def attach_data_cursor(self, cursor):
@@ -506,7 +537,11 @@ class TrainStep:
         _tel.counter("train.steps").inc()    # lifetime total, sink or not
         tel_on = _tel.active()
         t0 = time.perf_counter()
-        loss, new_params, new_states, new_bufs = fn(*args)
+        out = fn(*args)
+        if getattr(self, "_numerics", False):
+            loss, new_params, new_states, new_bufs, nstats = out
+        else:
+            (loss, new_params, new_states, new_bufs), nstats = out, None
         if tel_on and _tel.config("sync_steps"):
             jax.block_until_ready(loss)
         wall_ms = (time.perf_counter() - t0) * 1e3
@@ -520,6 +555,10 @@ class TrainStep:
                             step=self.optimizer._step_count, k=1,
                             wall_ms=wall_ms, batch_vals=batch_vals,
                             loss_fn=self.loss_fn)
+        if nstats is not None:
+            from ..telemetry import numerics as _numerics
+            _numerics.record("jit", self.optimizer._step_count, 1,
+                             self._num_bundles, nstats)
         return Tensor(loss)
 
 

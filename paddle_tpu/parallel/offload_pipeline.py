@@ -513,6 +513,20 @@ class OffloadPipelineStep:
         # the documented cost of the opt-in guard).
         from ..framework.flags import get_flag
         guard_on = bool(get_flag("skip_nonfinite_steps"))
+        # numerics plane (ISSUE 14): per-LAYER grad/param/update norms
+        # accumulated INSIDE the backward scan body (the grads only
+        # ever exist one layer at a time here — the scan's ys stack is
+        # the per-layer vector the dense trainers get from their flat
+        # grad list), plus one "tail" bundle for the pre/post params.
+        # Build-time flag, same contract as the guard: off, the step
+        # program is byte-identical (bench-asserted).
+        from ..telemetry import numerics as _numerics
+        numerics_on = self._numerics = _numerics.enabled()
+        if numerics_on:
+            self._num_bundles = [f"layer{i}" for i in range(L)] + ["tail"]
+
+        def _sumsq(x):
+            return jnp.sum(jnp.square(x.astype(jnp.float32)))
 
         def leaf_update(p, g, s, lr_, wd, step_i):
             """One streamed slice's update, as its gradient lands: the
@@ -645,6 +659,10 @@ class OffloadPipelineStep:
                     _, blk_vjp = jax.vjp(replay, wire_i, h_in, dex)
                     dws, dh_prev, d_dex = blk_vjp(dh)
                     d_acc = jax.tree.map(jnp.add, d_acc, d_dex)
+                    if numerics_on:
+                        l_g2 = jnp.float32(0.0)
+                        l_p2 = jnp.float32(0.0)
+                        l_u2 = jnp.float32(0.0)
                     for s in leaves:
                         wd, ls = policies[s]
                         g = dws[s]
@@ -653,6 +671,12 @@ class OffloadPipelineStep:
                         new_p, new_st = leaf_update(
                             param_i[s], g, state_i[s],
                             lr if ls == 1.0 else lr * ls, wd, step_i)
+                        if numerics_on:
+                            l_g2 = l_g2 + _sumsq(g)
+                            l_p2 = l_p2 + _sumsq(param_i[s])
+                            l_u2 = l_u2 + _sumsq(
+                                new_p.astype(jnp.float32)
+                                - param_i[s].astype(jnp.float32))
                         stk_p = dict(stk_p)
                         stk_p[s] = _dus(stk_p[s], new_p, idx)
                         if casts:
@@ -671,14 +695,19 @@ class OffloadPipelineStep:
                         lg = sum(jnp.sum(jnp.square(
                             dws[s].astype(jnp.float32))) for s in leaves)
                         out_carry = out_carry + (gsq + lg,)
-                    return out_carry, None
+                    # ys: this layer's numerics sums — the scan stacks
+                    # them into the per-layer [L] vectors at positions
+                    # matching the layer index (reverse scan fills ys
+                    # by xs position, not visit order)
+                    ys = (l_g2, l_p2, l_u2) if numerics_on else None
+                    return out_carry, ys
 
                 d_acc0 = jax.tree.map(jnp.zeros_like, dex)
                 carry0 = (dh, d_acc0, bwindow0, stk_param, stk_wire,
                           stk_state)
                 if guard_on:
                     carry0 = carry0 + (jnp.float32(0),)
-                out_carry, _ = jax.lax.scan(
+                out_carry, layer_ys = jax.lax.scan(
                     bbody, carry0, (resid, jnp.arange(L)), reverse=True)
                 if guard_on:
                     (dh0, d_dex_sum, _, new_stk_p, new_stk_w,
@@ -691,6 +720,10 @@ class OffloadPipelineStep:
                 # ---- tail grads (pre + post contributions) and update
                 (d_tail_pre,) = pre_vjp((dh0, d_dex_sum))
                 new_tail, new_tstates = [], []
+                if numerics_on:
+                    t_g2 = jnp.float32(0.0)
+                    t_p2 = jnp.float32(0.0)
+                    t_u2 = jnp.float32(0.0)
                 for i, (p, st) in enumerate(zip(tail_vals, tail_states)):
                     g = d_tail_post[i] + d_tail_pre[i]
                     if guard_on:
@@ -700,8 +733,20 @@ class OffloadPipelineStep:
                     np_, ns = leaf_update(
                         p, g, st, lr if ls == 1.0 else lr * ls, wd,
                         step_i)
+                    if numerics_on:
+                        t_g2 = t_g2 + _sumsq(g)
+                        t_p2 = t_p2 + _sumsq(p)
+                        t_u2 = t_u2 + _sumsq(np_.astype(jnp.float32)
+                                             - p.astype(jnp.float32))
                     new_tail.append(np_)
                     new_tstates.append(ns)
+                nstats = None
+                if numerics_on:
+                    lg2, lp2, lu2 = layer_ys
+                    nstats = _numerics.stats_from_sumsq(
+                        jnp.concatenate([lg2, t_g2[None]]),
+                        jnp.concatenate([lp2, t_p2[None]]),
+                        jnp.concatenate([lu2, t_u2[None]]))
                 if guard_on:
                     ok = (jnp.isfinite(loss.astype(jnp.float32))
                           & jnp.isfinite(gsq_total))
@@ -714,6 +759,9 @@ class OffloadPipelineStep:
                     new_stk_p = sel(new_stk_p, stk_param)
                     new_stk_w = sel(new_stk_w, stk_wire)
                     new_stk_s = sel(new_stk_s, stk_state)
+            if numerics_on:
+                return (loss, new_tail, new_tstates, new_stk_p,
+                        new_stk_w, new_stk_s, nstats)
             return (loss, new_tail, new_tstates, new_stk_p, new_stk_w,
                     new_stk_s)
 
@@ -722,6 +770,8 @@ class OffloadPipelineStep:
         stkw_sh = jax.tree.map(lambda _: host, self._stk_wire)
         stks_sh = jax.tree.map(lambda _: host, self._stk_state)
         out_sh = (None, None, None, stk_sh, stkw_sh, stks_sh)
+        if numerics_on:
+            out_sh = out_sh + (None,)
         donate = (0, 1, 2, 3, 4) if self._donate else ()
         self._step_fn = step
         with self.mesh:
@@ -771,20 +821,32 @@ class OffloadPipelineStep:
         tel_on = _tel.active()
         t0 = time.perf_counter()
         with watched("offload pipeline step"):
-            (loss, new_tail, new_tstates, self._stk_param,
-             self._stk_wire, self._stk_state) = self._compiled(
+            out = self._compiled(
                 tail_vals, self._tail_states, self._stk_param,
                 self._stk_wire, self._stk_state,
                 jnp.asarray(lr, jnp.float32),
                 jnp.asarray(self.optimizer._step_count, jnp.int32),
                 key, batch_vals)
+            if getattr(self, "_numerics", False):
+                (loss, new_tail, new_tstates, self._stk_param,
+                 self._stk_wire, self._stk_state, nstats) = out
+            else:
+                (loss, new_tail, new_tstates, self._stk_param,
+                 self._stk_wire, self._stk_state) = out
+                nstats = None
             if tel_on and _tel.config("sync_steps"):
                 jax.block_until_ready(loss)
         sd = self._sd
         for n, v in zip(self._tail_names, new_tail):
             sd[n]._value = v
         self._tail_states = new_tstates
-        self._guard_record(loss)
+        bad_layer = None
+        if nstats is not None:
+            from ..telemetry import numerics as _numerics
+            bad_layer = _numerics.record(
+                "offload", self.optimizer._step_count, 1,
+                self._num_bundles, nstats)
+        self._guard_record(loss, layer=bad_layer)
         if tel_on:
             # no phase probe (batch_vals omitted): re-jitting the
             # streamed model outside its per-layer pipeline would
@@ -825,7 +887,7 @@ class OffloadPipelineStep:
         from ..jit import _step_faults
         return tuple(_step_faults(batch_vals, "offload"))
 
-    def _guard_record(self, loss):
+    def _guard_record(self, loss, layer=None):
         from ..framework.flags import get_flag
         if not get_flag("skip_nonfinite_steps"):
             return
@@ -834,7 +896,7 @@ class OffloadPipelineStep:
             self._guard = StepAnomalyGuard(scaler=self._scaler,
                                            name="offload pipeline step")
         self._guard.record(float(np.asarray(loss)),
-                           step=self.optimizer._step_count)
+                           step=self.optimizer._step_count, layer=layer)
 
     def attach_data_cursor(self, cursor):
         """Attach an io.ElasticDataCursor: rides train_state meta (see

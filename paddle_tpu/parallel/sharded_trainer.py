@@ -476,6 +476,19 @@ class ShardedTrainStep:
         # bounds how many may run consecutively.
         from ..framework.flags import get_flag
         guard_on = bool(get_flag("skip_nonfinite_steps"))
+        # numerics plane (ISSUE 14), same build-time contract as the
+        # guard: off, the step program is byte-identical; on, the step
+        # additionally returns per-layer-bundle norm scalars computed
+        # from the grads/params it already holds
+        from ..telemetry import numerics as _numerics
+        numerics_on = self._numerics = _numerics.enabled()
+        if numerics_on:
+            self._num_bundles, num_assign = _numerics.bundles_of(names)
+
+        def _numerics_stats(param_vals, grads, new_params):
+            return _numerics.graph_stats(
+                num_assign, len(self._num_bundles), param_vals, grads,
+                new_params)
 
         def _finite_pred(loss, grads):
             gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -499,11 +512,19 @@ class ShardedTrainStep:
                 new_params, new_states = apply_updates(
                     upd, param_vals, grads, opt_states, lr, wds, step_i,
                     hp, lr_scales=lr_scales)
+                if numerics_on:
+                    # stats read the ATTEMPTED update (pre-guard
+                    # selection): a refused step still reports which
+                    # layer's grad went nonfinite
+                    nstats = _numerics_stats(param_vals, grads,
+                                             new_params)
                 if guard_on:
                     ok = _finite_pred(loss, grads)
                     new_params = _guarded(ok, new_params, param_vals)
                     new_states = _guarded(ok, new_states, opt_states)
                     new_bufs = _guarded(ok, new_bufs, buf_vals)
+                if numerics_on:
+                    return loss, new_params, new_states, new_bufs, nstats
                 return loss, new_params, new_states, new_bufs
             new_params, new_states = [], []
             token = None
@@ -543,11 +564,15 @@ class ShardedTrainStep:
                 new_states.append(ns)
                 if chain_updates and (i + 1) % chain_every == 0:
                     token = np_
+            if numerics_on:
+                nstats = _numerics_stats(param_vals, grads, new_params)
             if guard_on:
                 ok = _finite_pred(loss, grads)
                 new_params = _guarded(ok, new_params, param_vals)
                 new_states = _guarded(ok, new_states, opt_states)
                 new_bufs = _guarded(ok, new_bufs, buf_vals)
+            if numerics_on:
+                return loss, new_params, new_states, new_bufs, nstats
             return loss, new_params, new_states, new_bufs
 
         param_sh = [self._param_store_shardings[n] if stream_params
@@ -563,10 +588,14 @@ class ShardedTrainStep:
         donate = (0, 1, 2) if self._donate else ()
         self._step_fn = step
         self._out_shardings = (None, param_sh, opt_sh, buf_sh)
+        if numerics_on:
+            # the stats pytree is tiny per-bundle scalars — leave its
+            # placement to XLA (None = unconstrained subtree)
+            self._out_shardings = self._out_shardings + (None,)
         with self.mesh:
             self._compiled = jax.jit(
                 step, donate_argnums=donate,
-                out_shardings=(None, param_sh, opt_sh, buf_sh))
+                out_shardings=self._out_shardings)
 
     def compiled_hlo(self, *batch, optimized: bool = True) -> str:
         """Compile the step for `batch` (without executing) and return the
@@ -688,6 +717,7 @@ class ShardedTrainStep:
         (host-loop elision — see jit.TrainStep._build_multi)."""
         step = self._step_fn
         stream = self._stream_offload
+        numerics_on = getattr(self, "_numerics", False)
         dev_opt_sh = [self._dev_opt_shardings[n] for n in self._names]
 
         def multi(param_vals, opt_states, buf_vals, lrs, step0, key,
@@ -705,14 +735,21 @@ class ShardedTrainStep:
             def body(carry, xs):
                 params, states, bufs, i = carry
                 k = jax.random.fold_in(key, i)
-                loss, params, states, bufs = step(
+                out = step(
                     params, states, bufs, lrs[i], step0 + i, k, xs)
+                if numerics_on:
+                    loss, params, states, bufs, nstats = out
+                    return (params, states, bufs, i + 1), (loss, nstats)
+                loss, params, states, bufs = out
                 return (params, states, bufs, i + 1), loss
             init = (list(param_vals), opt_states, list(buf_vals),
                     jnp.asarray(0, jnp.int32))
-            (params, states, bufs, _), losses = jax.lax.scan(
+            (params, states, bufs, _), ys = jax.lax.scan(
                 body, init, stacked)
-            return losses, params, states, bufs
+            if numerics_on:
+                losses, nstats = ys
+                return losses, params, states, bufs, nstats
+            return ys, params, states, bufs
 
         donate = (0, 1, 2) if self._donate else ()
         with self.mesh:
@@ -763,7 +800,12 @@ class ShardedTrainStep:
         tel_on = _tel.active()
         t0 = time.perf_counter()
         with watched(f"sharded train run_steps(k={k})"):
-            losses, new_params, new_states, new_bufs = fn(*args)
+            out = fn(*args)
+            if getattr(self, "_numerics", False):
+                losses, new_params, new_states, new_bufs, nstats = out
+            else:
+                (losses, new_params, new_states, new_bufs), nstats = \
+                    out, None
             if tel_on and _tel.config("sync_steps"):
                 jax.block_until_ready(losses)
         wall_ms = (time.perf_counter() - t0) * 1e3
@@ -775,7 +817,13 @@ class ShardedTrainStep:
         for n, v in zip(self._buf_names, new_bufs):
             sd[n]._value = v
         self._opt_states = self._park_states(new_states)
-        self._guard_record(losses)
+        bad_layer = None
+        if nstats is not None:
+            from ..telemetry import numerics as _numerics
+            bad_layer = _numerics.record(
+                "sharded", self.optimizer._step_count, k,
+                self._num_bundles, nstats, extra={"stage": self.stage})
+        self._guard_record(losses, layer=bad_layer)
         if tel_on:
             _tel.step_event(self, label="sharded", kind="multi",
                             step=self.optimizer._step_count, k=k,
@@ -858,11 +906,13 @@ class ShardedTrainStep:
         from ..jit import _step_faults
         return tuple(_step_faults(batch_vals, "sharded"))
 
-    def _guard_record(self, loss):
+    def _guard_record(self, loss, layer=None):
         """Host half of the skip-step path: budget consecutive bad
         steps, back off the attached GradScaler.  Only consulted when
         FLAGS_skip_nonfinite_steps is on (it forces a host sync on the
-        loss — never on the flags-off hot path)."""
+        loss — never on the flags-off hot path).  `layer` is the
+        numerics plane's first-nonfinite attribution — the abort
+        report then names where the divergence started."""
         from ..framework.flags import get_flag
         if not get_flag("skip_nonfinite_steps"):
             return
@@ -871,7 +921,8 @@ class ShardedTrainStep:
             self._guard = StepAnomalyGuard(scaler=self._scaler,
                                            name="sharded train step")
         for v in np.atleast_1d(np.asarray(loss)):
-            self._guard.record(float(v), step=self.optimizer._step_count)
+            self._guard.record(float(v), step=self.optimizer._step_count,
+                               layer=layer)
 
     # -- run ---------------------------------------------------------------
     def __call__(self, *batch):
@@ -901,7 +952,12 @@ class ShardedTrainStep:
         tel_on = _tel.active()
         t0 = time.perf_counter()
         with watched("sharded train step"):
-            loss, new_params, new_states, new_bufs = fn(*args)
+            out = fn(*args)
+            if getattr(self, "_numerics", False):
+                loss, new_params, new_states, new_bufs, nstats = out
+            else:
+                (loss, new_params, new_states, new_bufs), nstats = \
+                    out, None
             if tel_on and _tel.config("sync_steps"):
                 jax.block_until_ready(loss)
         wall_ms = (time.perf_counter() - t0) * 1e3
@@ -910,7 +966,13 @@ class ShardedTrainStep:
         for n, v in zip(self._buf_names, new_bufs):
             sd[n]._value = v
         self._opt_states = self._park_states(new_states)
-        self._guard_record(loss)
+        bad_layer = None
+        if nstats is not None:
+            from ..telemetry import numerics as _numerics
+            bad_layer = _numerics.record(
+                "sharded", self.optimizer._step_count, 1,
+                self._num_bundles, nstats, extra={"stage": self.stage})
+        self._guard_record(loss, layer=bad_layer)
         if tel_on:
             _tel.step_event(self, label="sharded", kind="step",
                             step=self.optimizer._step_count, k=1,

@@ -38,7 +38,7 @@ from .registry import (MetricsRegistry, Counter, Gauge, Histogram,  # noqa: F401
                        add_sink, remove_sink, sinks, active, emit, span,
                        configure, config, reset as _registry_reset,
                        set_rank, rank_info, percentile_of,
-                       percentiles_of)
+                       percentiles_of, summary_of)
 from .exporters import (JsonlSink, ChromeTraceSink, MemorySink,  # noqa: F401
                         attach_jsonl, attach_chrome_trace, chrome_event)
 from .compile_cache import (cache_dir, maybe_enable_persistent_cache,  # noqa: F401
@@ -50,6 +50,9 @@ from .memledger import memory_report  # noqa: F401
 from . import costledger  # noqa: F401
 from .costledger import cost_report  # noqa: F401
 from . import fleet  # noqa: F401
+from . import flightrec  # noqa: F401
+from .flightrec import FlightRecorder  # noqa: F401
+from . import numerics  # noqa: F401
 
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
            "registry", "counter", "gauge", "histogram",
@@ -62,16 +65,19 @@ __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
            "disable_persistent_cache", "aot_compile", "compile_report",
            "clear_report", "probe", "memledger", "memory_report",
            "costledger", "cost_report",
-           "fleet", "dump", "step_event"]
+           "fleet", "flightrec", "FlightRecorder", "numerics",
+           "summary_of", "dump", "step_event"]
 
 
 def reset():
     """Detach every sink, clear registry/config/rank AND the memory +
-    compute cost ledgers — the whole plane back to pristine (test
-    isolation)."""
+    compute cost ledgers and the flight recorder — the whole plane
+    back to pristine (test isolation)."""
     _registry_reset()
     memledger.reset()
     costledger.reset()
+    flightrec.reset()
+    numerics.reset()
 
 
 def dump(compact: bool = False) -> dict:
@@ -113,6 +119,14 @@ def dump(compact: bool = False) -> dict:
 try:
     maybe_enable_persistent_cache()
 except Exception:                       # cache must never break import
+    pass
+
+# same idiom for the incident flight recorder: FLAGS_flightrec_dir in
+# the environment arms the recorder before any subsystem emits; unset,
+# this is one flag lookup.
+try:
+    flightrec.maybe_attach()
+except Exception:                       # recorder must never break import
     pass
 
 

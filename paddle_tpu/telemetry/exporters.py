@@ -30,9 +30,18 @@ class JsonlSink:
     An `atexit` hook flushes whatever a `flush_every > 1` batch still
     buffers, so a SIGTERM drain (sys.exit path) or an uncaught crash
     loses nothing the process ever emitted — only a hard `os._exit`
-    (mode=kill preemption) can truncate the tail."""
+    (mode=kill preemption) can truncate the tail.
 
-    def __init__(self, path_or_file: Union[str, IO], flush_every: int = 1):
+    Size-capped rotation (ISSUE 14): under ``FLAGS_telemetry_max_log_mb``
+    (or `max_mb`) a path-owned sink whose file crosses the cap rotates
+    it to ``<path>.1`` (existing segments shift up: .1 -> .2, ...) and
+    reopens a fresh file — a long-running job's log never grows one
+    unbounded file, the atexit drain-flush keeps covering the LIVE
+    segment, and `telemetry.fleet.merge_jsonl_traces` reads the
+    rotated segments back oldest-first."""
+
+    def __init__(self, path_or_file: Union[str, IO], flush_every: int = 1,
+                 max_mb: Optional[float] = None):
         if hasattr(path_or_file, "write"):
             self._f = path_or_file
             self.path = getattr(path_or_file, "name", None)
@@ -44,6 +53,18 @@ class JsonlSink:
                 os.makedirs(d, exist_ok=True)
             self._f = open(path_or_file, "a")
             self._own = True
+        if max_mb is None:
+            from ..framework.flags import get_flag
+            max_mb = float(get_flag("telemetry_max_log_mb", 0.0) or 0.0)
+        # rotation needs to own the file AND know its name
+        self._max_bytes = int(max_mb * 1e6) \
+            if (max_mb and self._own and self.path) else 0
+        self._bytes = 0
+        if self._max_bytes:
+            try:
+                self._bytes = os.path.getsize(self.path)
+            except OSError:
+                pass
         self._flush_every = max(1, int(flush_every))
         self._n = 0
         self._lock = threading.Lock()
@@ -57,6 +78,41 @@ class JsonlSink:
             self._n += 1
             if self._n % self._flush_every == 0:
                 self._f.flush()
+            if self._max_bytes:
+                self._bytes += len(line) + 1
+                if self._bytes >= self._max_bytes:
+                    self._rotate_locked()
+
+    def _rotate_locked(self):
+        """Shift <path>.i -> <path>.(i+1) (highest first), publish the
+        live file as <path>.1, reopen fresh.  Called under self._lock;
+        a rotation failure (permissions, races) keeps writing to the
+        current file rather than losing events — and keeps the TRUE
+        byte count, so the cap retries at the next record instead of
+        granting another full segment of unbounded growth."""
+        try:
+            self._f.flush()
+            self._f.close()
+        except Exception:           # noqa: BLE001 — reopen below anyway
+            pass
+        rotated = True
+        try:
+            n = 1
+            while os.path.exists(f"{self.path}.{n}"):
+                n += 1
+            for i in range(n, 1, -1):
+                os.replace(f"{self.path}.{i - 1}", f"{self.path}.{i}")
+            os.replace(self.path, f"{self.path}.1")
+        except OSError:
+            rotated = False
+        self._f = open(self.path, "a")
+        if rotated:
+            self._bytes = 0
+        else:
+            try:
+                self._bytes = os.path.getsize(self.path)
+            except OSError:
+                self._bytes = 0
 
     def flush(self):
         with self._lock:
@@ -182,10 +238,11 @@ class MemorySink:
         pass
 
 
-def attach_jsonl(path_or_file, flush_every: int = 1) -> JsonlSink:
+def attach_jsonl(path_or_file, flush_every: int = 1,
+                 max_mb: Optional[float] = None) -> JsonlSink:
     """Create AND attach a JSONL sink; returns it (detach with
     `telemetry.remove_sink(sink)`)."""
-    return add_sink(JsonlSink(path_or_file, flush_every))
+    return add_sink(JsonlSink(path_or_file, flush_every, max_mb=max_mb))
 
 
 def attach_chrome_trace(path: Optional[str] = None) -> ChromeTraceSink:

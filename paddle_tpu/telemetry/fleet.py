@@ -49,7 +49,8 @@ from .registry import (counter as _counter, emit as _emit,
                        set_rank)
 
 __all__ = ["init_from_env", "FleetSink", "FleetAggregator",
-           "judge_step", "merge_jsonl_traces", "load_jsonl"]
+           "judge_step", "merge_jsonl_traces", "load_jsonl",
+           "log_segments"]
 
 define_flag("straggler_skew_ms", 0.0,
             "cross-rank per-step wall/arrival skew (ms) above which the "
@@ -467,23 +468,39 @@ def load_jsonl(path: str) -> List[dict]:
     return out
 
 
+def log_segments(path: str) -> List[str]:
+    """A JSONL log plus its size-rotated segments, OLDEST FIRST
+    (``events.jsonl.N ... events.jsonl.1 events.jsonl`` — the
+    JsonlSink rotation shifts older segments to higher suffixes).
+    A log that never rotated is just ``[path]``."""
+    segs: List[str] = []
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        segs.append(f"{path}.{n}")
+        n += 1
+    return list(reversed(segs)) + [path]
+
+
 def merge_jsonl_traces(paths: List[str], out_path: Optional[str] = None,
                        ranks: Optional[List[int]] = None) -> dict:
     """Merge per-rank JSONL step logs into ONE chrome trace, one lane
     (pid) per rank.  Each record's own `rank` tag wins; a log whose
     records are untagged (single-process, pre-fleet) gets `ranks[i]`
-    (default: its position in `paths`).  Returns the trace doc and
-    writes it to `out_path` when given — load in chrome://tracing or
-    Perfetto and every rank is a named lane on one timeline."""
+    (default: its position in `paths`).  A log that size-rotated
+    (FLAGS_telemetry_max_log_mb) contributes all its segments in
+    order.  Returns the trace doc and writes it to `out_path` when
+    given — load in chrome://tracing or Perfetto and every rank is a
+    named lane on one timeline."""
     from .exporters import chrome_event, _jsonable
     events: List[dict] = []
     lanes: set = set()
     for i, path in enumerate(paths):
         default_rank = ranks[i] if ranks is not None else i
-        for rec in load_jsonl(path):
-            rank = int(rec.get("rank", default_rank))
-            lanes.add(rank)
-            events.append(chrome_event(rec, pid=rank, tid=0))
+        for seg in log_segments(path):
+            for rec in load_jsonl(seg):
+                rank = int(rec.get("rank", default_rank))
+                lanes.add(rank)
+                events.append(chrome_event(rec, pid=rank, tid=0))
     meta = []
     for rank in sorted(lanes):
         meta.append({"name": "process_name", "ph": "M", "pid": rank,

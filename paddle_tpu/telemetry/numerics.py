@@ -1,0 +1,201 @@
+"""In-step numerics telemetry — per-layer grad/param/update norms and
+the first-nonfinite-layer index, computed IN-GRAPH from the grads the
+train step already materialized (ISSUE 14's numerics plane: the loop
+stops being numerically blind between "loss is finite" and "loss is
+NaN" — when a step goes bad, the event names the layer that went bad
+first).
+
+Cost contract (the plane's usual shape, but note the flag is a
+PROGRAM switch, not a host switch):
+
+  * ``FLAGS_numerics_stats`` is read at trainer BUILD time, exactly
+    like ``FLAGS_skip_nonfinite_steps``: off (the default), the
+    compiled step is byte-identical to a numerics-free build
+    (bench-asserted alongside the other telemetry flags); on, the step
+    additionally returns one small stats pytree — one fused reduction
+    per layer bundle over the already-materialized grads/params/new
+    params, no extra forward or backward pass, donation contracts
+    untouched.
+  * The HOST half (`record`) emits `train.numerics` events and feeds
+    the registry histograms; with no sink attached the emit is the
+    usual single truthiness check.  A detected nonfinite bundle also
+    emits `train.anomaly` — the flight recorder's nonfinite-step
+    trigger — and returns the offending layer's name so the trainers
+    hand it to :class:`StepAnomalyGuard` (an abort-after-bad-steps
+    report then names the first offending layer, not just the loss).
+
+Layer bundles: parameters group by the first NUMERIC path component of
+their state-dict name ("layers.3.attn.q_proj.weight" → "layers.3"),
+falling back to the leading component ("fc.weight" → "fc") — the same
+model-structure vocabulary the cost ledger's scope census uses, derived
+from names instead of HLO metadata.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["enabled", "bundles_of", "graph_stats", "record", "reset"]
+
+# trainer labels whose bundle list already rode an event: the list is
+# positional metadata, identical every step — emitting it on every
+# event would dominate a deep model's step log, so it rides the FIRST
+# train.numerics event per label (consumers index positionally after
+# that; the nonfinite layer is always resolved by name in-event)
+_announced: set = set()
+
+
+def reset():
+    """Forget which labels announced their bundles (test isolation —
+    telemetry.reset() calls this)."""
+    _announced.clear()
+
+
+def enabled() -> bool:
+    """FLAGS_numerics_stats — trainers consult this at BUILD time (a
+    mid-process toggle takes effect at the next trainer build, the
+    skip-step guard's documented behavior)."""
+    from ..framework.flags import get_flag
+    return bool(get_flag("numerics_stats"))
+
+
+def bundles_of(names: Sequence[str]) -> Tuple[List[str], List[int]]:
+    """Group parameter names into layer bundles.
+
+    Returns ``(labels, assign)``: bundle labels in first-seen order and
+    the per-parameter bundle index.  A name's bundle is its path up to
+    (and including) the first numeric component ("layers.3"), else its
+    leading component ("fc"), else the name itself.
+    """
+    labels: List[str] = []
+    index: Dict[str, int] = {}
+    assign: List[int] = []
+    for n in names:
+        parts = n.split(".")
+        label = None
+        for i, p in enumerate(parts[:-1]):
+            if p.isdigit():
+                label = ".".join(parts[:i + 1])
+                break
+        if label is None:
+            label = parts[0] if len(parts) > 1 else n
+        if label not in index:
+            index[label] = len(labels)
+            labels.append(label)
+        assign.append(index[label])
+    return labels, assign
+
+
+def graph_stats(assign: Sequence[int], n_bundles: int, param_vals,
+                grads, new_params) -> dict:
+    """The in-graph reduction: per-bundle grad-norm / param-norm /
+    update-ratio vectors (shape [n_bundles], fp32) plus the first
+    bundle index whose grad went nonfinite (int32, -1 = all finite).
+
+    Traced inside the step function AFTER the optimizer update, from
+    values the program already holds — per-parameter sum-of-squares
+    folded into one scalar per bundle (XLA fuses the chain), so the
+    numerics plane adds reductions, never a second fwd/bwd.
+    """
+    import jax.numpy as jnp
+
+    def _sumsq(x):
+        return jnp.sum(jnp.square(x.astype(jnp.float32)))
+
+    g2 = [jnp.float32(0.0)] * n_bundles
+    p2 = [jnp.float32(0.0)] * n_bundles
+    u2 = [jnp.float32(0.0)] * n_bundles
+    for i, (p, g, np_) in enumerate(zip(param_vals, grads, new_params)):
+        b = assign[i]
+        g2[b] = g2[b] + _sumsq(g)
+        p2[b] = p2[b] + _sumsq(p)
+        u2[b] = u2[b] + _sumsq(np_.astype(jnp.float32)
+                               - p.astype(jnp.float32))
+    return stats_from_sumsq(jnp.stack(g2), jnp.stack(p2), jnp.stack(u2))
+
+
+def stats_from_sumsq(grad_sq, param_sq, update_sq) -> dict:
+    """Per-bundle sum-of-squares vectors → the stats pytree the step
+    returns.  Shared with the offload pipeline, whose backward scan
+    accumulates the per-layer sums itself (one ys entry per layer)."""
+    import jax.numpy as jnp
+    eps = jnp.float32(1e-12)
+    grad_norm = jnp.sqrt(grad_sq)
+    param_norm = jnp.sqrt(param_sq)
+    # update/param ratio: the "is the step size sane" signal (LR sweeps
+    # and divergence both show here before the loss does)
+    update_ratio = jnp.sqrt(update_sq) / (param_norm + eps)
+    bad = ~jnp.isfinite(grad_sq)
+    first_nonfinite = jnp.where(jnp.any(bad),
+                                jnp.argmax(bad).astype(jnp.int32),
+                                jnp.int32(-1))
+    return {"grad_norm": grad_norm, "param_norm": param_norm,
+            "update_ratio": update_ratio,
+            "first_nonfinite": first_nonfinite}
+
+
+def record(label: str, step0: int, k: int, bundles: Sequence[str],
+           stats, extra: Optional[dict] = None) -> Optional[str]:
+    """HOST half: publish one compiled call's numerics stats.
+
+    `stats` is the step's returned pytree — per-bundle vectors for a
+    single step, or stacked [K, n_bundles] vectors from a fused
+    multi-step scan.  Emits `train.numerics` for the LAST step of the
+    window (the trend sample) and, when any step saw a nonfinite
+    bundle, for the FIRST bad step too — plus the `train.anomaly`
+    trigger naming the first offending layer.  Returns that layer name
+    (or None) so the caller can feed StepAnomalyGuard.
+
+    `step0` is the optimizer step count AFTER the call (the trainers'
+    convention); inner step i of the window is step0 - k + 1 + i.
+    """
+    import numpy as np
+    from .registry import counter, emit, histogram
+
+    gn = np.atleast_2d(np.asarray(stats["grad_norm"]))
+    pn = np.atleast_2d(np.asarray(stats["param_norm"]))
+    ur = np.atleast_2d(np.asarray(stats["update_ratio"]))
+    fi = np.atleast_1d(np.asarray(stats["first_nonfinite"]))
+    k = max(1, int(k))
+    bundles = list(bundles)
+
+    def _fields(i, announce=False):
+        f = {"trainer": label, "step": int(step0 - k + 1 + i),
+             "grad_norm": [round(float(v), 6) for v in gn[i]],
+             "param_norm": [round(float(v), 6) for v in pn[i]],
+             "update_ratio": [round(float(v), 6) for v in ur[i]],
+             "first_nonfinite": int(fi[i])}
+        if announce:
+            f["bundles"] = bundles
+        if int(fi[i]) >= 0:
+            f["first_nonfinite_layer"] = bundles[int(fi[i])]
+        if extra:
+            f.update(extra)
+        return f
+
+    announce = label not in _announced
+    _announced.add(label)
+    bad_layer = None
+    bad_steps = [i for i in range(len(fi)) if int(fi[i]) >= 0]
+    first_bad = bad_steps[0] if bad_steps else None
+    if bad_steps:
+        bad_layer = bundles[int(fi[first_bad])]
+        counter("numerics.nonfinite_steps").inc(len(bad_steps))
+        emit("train.numerics", _fields(first_bad, announce=announce))
+        announce = False
+        # the flight recorder's nonfinite-step trigger: one compact
+        # event naming the layer that went bad first
+        emit("train.anomaly", trainer=label,
+             step=int(step0 - k + 1 + first_bad), layer=bad_layer,
+             source="numerics")
+    last = len(fi) - 1
+    if last != first_bad:           # trend sample, unless already sent
+        emit("train.numerics", _fields(last, announce=announce))
+    # registry histograms always accumulate (dump() carries the trend
+    # even when no sink ever ran): the global grad norm and the worst
+    # update ratio of the window's last step
+    if np.all(np.isfinite(gn[last])):
+        histogram("numerics.grad_norm").observe(
+            float(np.sqrt(np.sum(gn[last] ** 2))))
+    if ur[last].size and np.all(np.isfinite(ur[last])):
+        histogram("numerics.update_ratio").observe(float(np.max(ur[last])))
+    return bad_layer

@@ -34,7 +34,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "registry", "counter", "gauge", "histogram",
            "add_sink", "remove_sink", "sinks", "active", "emit", "span",
            "configure", "config", "reset",
-           "set_rank", "rank_info", "percentile_of", "percentiles_of"]
+           "set_rank", "rank_info", "percentile_of", "percentiles_of",
+           "summary_of"]
 
 
 # one lock for all instrument mutation: `value += n` is LOAD/ADD/STORE
@@ -64,6 +65,22 @@ def percentiles_of(values, qs=(50, 90, 99)) -> Dict[str, float]:
         k = min(len(xs) - 1,
                 max(0, int(round(q / 100.0 * (len(xs) - 1)))))
         out[f"p{int(q) if float(q).is_integer() else q}"] = xs[k]
+    return out
+
+
+def summary_of(values, qs=(50, 90, 99)) -> Dict[str, float]:
+    """Count + TRUE min/max + nearest-rank percentiles over a value
+    list — THE one window-summary derivation (ISSUE 14: the serving
+    latency blocks and the report CLIs read through here).  The
+    percentiles come from whatever window the caller kept, but min/max
+    are exact over it — reservoir-style sampling upstream of this call
+    is what loses the extreme straggler/TTFT outliers an incident
+    investigation needs, so keep the raw window and summarize HERE."""
+    vals = [float(v) for v in values]
+    out = {"count": len(vals),
+           "min": min(vals) if vals else 0.0,
+           "max": max(vals) if vals else 0.0}
+    out.update(percentiles_of(vals, qs))
     return out
 
 
@@ -354,9 +371,16 @@ class _Span:
         self._t0 = time.perf_counter()
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type, exc, tb):
         dur = (time.perf_counter() - self._t0) * 1e3
-        emit(self.event, self.fields, dur_ms=round(dur, 4))
+        if exc_type is not None:
+            # a raising body must be distinguishable from a clean one
+            # in the trace (ISSUE 14): mark the span and RE-raise — an
+            # incident bundle's timeline then shows the failing phase
+            emit(self.event, self.fields, dur_ms=round(dur, 4),
+                 error=exc_type.__name__)
+        else:
+            emit(self.event, self.fields, dur_ms=round(dur, 4))
         return False
 
 

@@ -70,10 +70,17 @@ def analyze_fleet(logs, skew_ms: float = 0.0, top: int = 10):
                 if not e.get("cold")]
         walls = [e["wall_ms"] for e in warm
                  if isinstance(e.get("wall_ms"), (int, float))]
+        # the shared summary derivation (ISSUE 14): true min/max ride
+        # beside the p50 — the extreme step the percentile hides is
+        # the straggler episode a fleet investigation wants
+        from paddle_tpu.telemetry import summary_of
+        s = summary_of(walls) if walls else None
         out["ranks"][str(r)] = {
             "events": ranks[r]["events"],
             "train_steps": len(ranks[r]["steps"]),
-            "wall_ms_p50": round(_pct(walls, 50), 3) if walls else None,
+            "wall_ms_p50": round(s["p50"], 3) if s else None,
+            "wall_ms_min": round(s["min"], 3) if s else None,
+            "wall_ms_max": round(s["max"], 3) if s else None,
         }
 
     # cross-rank skew over steps EVERY rank reported — the SAME
@@ -338,11 +345,15 @@ def main(argv=None):
 
     if not args.logs:
         ap.error("provide per-rank JSONL log paths or --selftest")
-    from paddle_tpu.telemetry.fleet import load_jsonl, merge_jsonl_traces
+    from paddle_tpu.telemetry.fleet import (load_jsonl, log_segments,
+                                            merge_jsonl_traces)
     from paddle_tpu.framework.flags import get_flag
     skew = args.skew_ms if args.skew_ms is not None \
         else float(get_flag("straggler_skew_ms") or 0.0)
-    logs = [load_jsonl(p) for p in args.logs]
+    # a size-rotated log (FLAGS_telemetry_max_log_mb) contributes all
+    # its segments, oldest first — same rule as merge_jsonl_traces
+    logs = [[rec for seg in log_segments(p) for rec in load_jsonl(seg)]
+            for p in args.logs]
     rep = analyze_fleet(logs, skew_ms=skew)
     if args.trace:
         merge_jsonl_traces(args.logs, out_path=args.trace)

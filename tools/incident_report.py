@@ -1,0 +1,315 @@
+"""Incident-bundle report CLI — the command-line face of the flight
+recorder (paddle_tpu/telemetry/flightrec.py), --selftest wired into
+tier-1 like tools/telemetry_report.py.
+
+    python tools/incident_report.py <bundle-dir> [--json]
+        Render ONE incident bundle: the trigger, the recent-event
+        timeline from the ring, the top programs by predicted-vs-
+        measured step time (the cost snapshot's drift suspects), the
+        memory-ledger peak, and the numerics trend (grad-norm drift,
+        worst update ratio, the first nonfinite layer if one fired).
+
+    python tools/incident_report.py <incidents-dir> [--json]
+        Render every bundle under the directory, newest last.
+
+    python tools/incident_report.py --selftest
+        CI canary: in a temp dir, attach the flight recorder, plant a
+        perf drift (configure_peaks + FLAGS_mfu_floor against a real
+        compiled program) and a nonfinite step (FLAGS_fault_injection
+        step.data:mode=nan under FLAGS_numerics_stats), assert exactly
+        one bundle lands per trigger kind with the trigger event inside
+        (and the nan bundle carries the train.numerics event naming the
+        first nonfinite layer), then render both.  Exit 1 on any
+        violation — a flight recorder that silently drops incidents is
+        exactly the failure mode this guards.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load(bundle, name):
+    path = os.path.join(bundle, name)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        if name.endswith(".jsonl"):
+            out = []
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+            return out
+        return json.load(f)
+
+
+def is_bundle(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, "manifest.json"))
+
+
+def bundles_under(path: str):
+    """`path` itself when it is a bundle, else its incident-* children
+    (oldest first)."""
+    if is_bundle(path):
+        return [path]
+    try:
+        names = sorted(n for n in os.listdir(path)
+                       if n.startswith("incident-"))
+    except OSError:
+        return []
+    return [os.path.join(path, n) for n in names
+            if is_bundle(os.path.join(path, n))]
+
+
+def analyze(bundle: str) -> dict:
+    """One bundle -> report dict (render() prints it)."""
+    manifest = _load(bundle, "manifest.json") or {}
+    trigger = _load(bundle, "trigger.json") or {}
+    events = _load(bundle, "events.jsonl") or []
+    cost = _load(bundle, "cost.json") or {}
+    memory = _load(bundle, "memory.json") or {}
+    fingerprint = _load(bundle, "fingerprint.json") or {}
+
+    rep = {"bundle": bundle,
+           "kind": manifest.get("kind", trigger.get("event")),
+           "rank": manifest.get("rank", 0),
+           "trigger": trigger,
+           "capture_id": fingerprint.get("capture_id"),
+           "events": len(events)}
+
+    # timeline: the tail of the ring, with seconds-before-trigger
+    t_end = trigger.get("ts") or (events[-1].get("ts") if events else 0)
+    timeline = []
+    for rec in events[-12:]:
+        entry = {"t_rel_s": round(float(rec.get("ts", 0)) - float(t_end),
+                                  3),
+                 "event": rec.get("event")}
+        for k in ("label", "trainer", "step", "kind", "point", "task",
+                  "straggler", "attained", "first_nonfinite_layer",
+                  "dur_ms", "error"):
+            if k in rec:
+                entry[k] = rec[k]
+        timeline.append(entry)
+    rep["timeline"] = timeline
+
+    # top programs by predicted-vs-measured (the drift suspects): worst
+    # attained first, measured-only entries ranked before unmeasured
+    progs = []
+    for label, e in (cost.get("programs") or {}).items():
+        if e.get("status") != "ok":
+            continue
+        progs.append({"label": label,
+                      "predicted_ms": e.get("predicted_ms"),
+                      "measured_ms": e.get("measured_ms"),
+                      "attained": e.get("attained"),
+                      "bound": e.get("bound"),
+                      "drift": bool(e.get("drift"))})
+    progs.sort(key=lambda p: (p["attained"] is None,
+                              p["attained"] if p["attained"] is not None
+                              else 0.0))
+    rep["programs"] = progs[:8]
+    if memory.get("peak_hbm_bytes"):
+        rep["peak_hbm_bytes"] = memory["peak_hbm_bytes"]
+
+    # numerics trend over the ring's train.numerics events
+    nums = [r for r in events if r.get("event") == "train.numerics"]
+    if nums:
+        first, last = nums[0], nums[-1]
+
+        def _norm(rec):
+            vals = [v for v in rec.get("grad_norm", [])
+                    if isinstance(v, (int, float))]
+            return round(sum(v * v for v in vals) ** 0.5, 6) \
+                if vals else None
+        trend = {"samples": len(nums),
+                 "grad_norm_first": _norm(first),
+                 "grad_norm_last": _norm(last),
+                 "max_update_ratio": max(
+                     (max(r.get("update_ratio") or [0.0]) for r in nums),
+                     default=0.0)}
+        bad = [r for r in nums if r.get("first_nonfinite", -1) >= 0]
+        if bad:
+            trend["first_nonfinite_layer"] = \
+                bad[0].get("first_nonfinite_layer")
+            trend["first_nonfinite_step"] = bad[0].get("step")
+        rep["numerics"] = trend
+    return rep
+
+
+def render(rep: dict) -> str:
+    lines = []
+    lines.append(f"== incident: {rep['kind']}  "
+                 f"(rank {rep['rank']}, capture {rep['capture_id']})")
+    lines.append(f"   bundle: {rep['bundle']}")
+    trig = rep["trigger"]
+    detail = ", ".join(f"{k}={trig[k]}" for k in
+                       ("label", "attained", "straggler", "skew_ms",
+                        "task", "point", "mode", "layer", "step",
+                        "kind") if k in trig)
+    lines.append(f"   trigger: {trig.get('event')}  {detail}")
+    lines.append(f"   ring: {rep['events']} events")
+    if rep.get("timeline"):
+        lines.append("   timeline (s before trigger):")
+        for e in rep["timeline"]:
+            extra = ", ".join(f"{k}={v}" for k, v in e.items()
+                              if k not in ("t_rel_s", "event"))
+            lines.append(f"     {e['t_rel_s']:+9.3f}  {e['event']}"
+                         + (f"  [{extra}]" if extra else ""))
+    if rep.get("programs"):
+        lines.append("   programs (worst attained first):")
+        for p in rep["programs"]:
+            att = p["attained"]
+            lines.append(
+                f"     {p['label']}: predicted {p['predicted_ms']} ms"
+                f" measured {p['measured_ms']} ms attained "
+                f"{att if att is not None else '-'}"
+                f"{'  << DRIFT' if p['drift'] else ''}")
+    if rep.get("peak_hbm_bytes"):
+        lines.append(f"   peak HBM: {rep['peak_hbm_bytes'] / 1e9:.3f} GB")
+    if rep.get("numerics"):
+        n = rep["numerics"]
+        lines.append(
+            f"   numerics: {n['samples']} samples, grad_norm "
+            f"{n['grad_norm_first']} -> {n['grad_norm_last']}, max "
+            f"update_ratio {n['max_update_ratio']}")
+        if "first_nonfinite_layer" in n:
+            lines.append(
+                f"     first nonfinite layer: "
+                f"{n['first_nonfinite_layer']} (step "
+                f"{n.get('first_nonfinite_step')})")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# selftest
+
+def selftest() -> int:
+    import tempfile
+
+    import numpy as np
+
+    problems = []
+    with tempfile.TemporaryDirectory() as d:
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        from paddle_tpu import telemetry
+        from paddle_tpu.framework.flags import set_flags
+        from paddle_tpu.telemetry import costledger, flightrec
+
+        telemetry.reset()
+        rec = flightrec.attach(os.path.join(d, "incidents"))
+        try:
+            # 1) perf drift: a REAL compiled program whose measured
+            # wall sits far below the calibrated prediction
+            fn = jax.jit(lambda x: x @ x)
+            compiled = fn.lower(
+                jnp.ones((64, 64), jnp.float32)).compile()
+            costledger.ingest("selftest.prog", compiled)
+            costledger.observe("selftest.prog", 250.0)
+            costledger.configure_peaks(flops_per_sec=1e15,
+                                       hbm_bytes_per_sec=1e15)
+            set_flags({"FLAGS_mfu_floor": 0.5})
+            telemetry.cost_report()
+            drift = [b for b in rec.bundles() if "perf-drift" in b]
+            if len(drift) != 1:
+                problems.append(
+                    f"planted drift produced {len(drift)} bundles")
+
+            # 2) nonfinite step under the numerics plane: the nan
+            # fault poisons the batch, the compiled stats name the
+            # first bad layer, train.anomaly dumps the bundle
+            set_flags({"FLAGS_numerics_stats": True})
+            from paddle_tpu.distributed import fault
+            from paddle_tpu.jit import TrainStep
+            paddle.seed(0)
+            m = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                     paddle.nn.ReLU(),
+                                     paddle.nn.Linear(16, 8))
+            opt = paddle.optimizer.AdamW(1e-3,
+                                         parameters=m.parameters())
+            step = TrainStep(
+                m, lambda o, y: paddle.nn.functional.mse_loss(o, y),
+                opt)
+            x = paddle.to_tensor(np.ones((4, 8), np.float32))
+            step(x, x)                       # one clean step first
+            with fault.scope("step.data:mode=nan"):
+                step(x, x)
+            anom = [b for b in rec.bundles() if "train-anomaly" in b]
+            if len(anom) != 1:
+                problems.append(
+                    f"planted nan produced {len(anom)} anomaly "
+                    f"bundles (bundles: {rec.bundles()})")
+
+            # 3) bundle contents: trigger inside the ring, numerics
+            # event naming the layer, and both render
+            for b, kind in ([(b, "perf.drift") for b in drift[:1]]
+                            + [(b, "train.anomaly") for b in anom[:1]]):
+                events = _load(b, "events.jsonl") or []
+                if not events:
+                    problems.append(f"{b}: empty ring")
+                if not any(e.get("event") == kind for e in events):
+                    problems.append(f"{b}: trigger {kind} not in ring")
+                rep = analyze(b)
+                if rep["kind"] != kind:
+                    problems.append(
+                        f"{b}: kind {rep['kind']} != {kind}")
+                if not render(rep):
+                    problems.append(f"{b}: empty render")
+            if anom:
+                rep = analyze(anom[0])
+                layer = (rep.get("numerics") or {}).get(
+                    "first_nonfinite_layer")
+                if layer is None:
+                    problems.append(
+                        "nan bundle's numerics trend names no "
+                        f"first-nonfinite layer: {rep.get('numerics')}")
+        finally:
+            set_flags({"FLAGS_mfu_floor": 0.0,
+                       "FLAGS_numerics_stats": False})
+            telemetry.reset()
+    if problems:
+        print("incident_report selftest FAILED:")
+        for p in problems:
+            print("  -", p)
+        return 1
+    print("incident_report selftest OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", nargs="?",
+                    help="an incident bundle, or a directory of them")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if not args.path:
+        ap.error("need a bundle path (or --selftest)")
+    found = bundles_under(args.path)
+    if not found:
+        print(f"no incident bundles under {args.path}", file=sys.stderr)
+        return 1
+    reps = [analyze(b) for b in found]
+    if args.json:
+        print(json.dumps(reps, indent=1))
+    else:
+        for rep in reps:
+            print(render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,8 +78,15 @@ def analyze(events, peak=None):
                 v = e.get("phases", {}).get(k)
                 if isinstance(v, (int, float)):
                     ph[k].append(v)
-        out["step_ms"] = {"p50": round(_pct(walls, 50), 3),
-                          "p99": round(_pct(walls, 99), 3)}
+        # the shared summary derivation (ISSUE 14) adds TRUE window
+        # min/max beside the percentiles — the outliers a percentile
+        # window samples away are what an incident hunt needs
+        from paddle_tpu.telemetry import summary_of
+        s = summary_of(walls)
+        out["step_ms"] = {"p50": round(s["p50"], 3),
+                          "p99": round(s["p99"], 3),
+                          "min": round(s["min"], 3),
+                          "max": round(s["max"], 3)}
         out["phases"] = {k: {"p50": round(_pct(v, 50), 3),
                              "p99": round(_pct(v, 99), 3)}
                          for k, v in ph.items() if v}
@@ -201,14 +208,18 @@ def analyze(events, peak=None):
     # serve.request events the batcher emits per delivered request
     reqs = [e for e in events if e.get("event") == "serve.request"]
     if reqs:
+        from paddle_tpu.telemetry import summary_of
         lat = {}
         for k in ("queue_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
             vals = [e[k] for e in reqs
                     if isinstance(e.get(k), (int, float))]
             if vals:
-                lat[k] = {"count": len(vals),
-                          "p50": round(_pct(vals, 50), 3),
-                          "p99": round(_pct(vals, 99), 3)}
+                s = summary_of(vals)
+                lat[k] = {"count": s["count"],
+                          "p50": round(s["p50"], 3),
+                          "p99": round(s["p99"], 3),
+                          "min": round(s["min"], 3),
+                          "max": round(s["max"], 3)}
         att = {}
         for e in reqs:
             a = att.setdefault(str(e.get("slo")),
@@ -260,6 +271,29 @@ def analyze(events, peak=None):
                     p.pop("drift", None)
         out["cost"] = {"programs": progs, "drifts": n_drift}
 
+    # numerics plane (ISSUE 14): grad-norm trend + nonfinite-step
+    # attribution from the train.numerics events the flagged trainers
+    # emit (and the train.anomaly triggers the guard/numerics publish)
+    nums = [e for e in events if e.get("event") == "train.numerics"]
+    if nums:
+        def _gn(e):
+            vals = [v for v in e.get("grad_norm", [])
+                    if isinstance(v, (int, float))]
+            return round(sum(v * v for v in vals) ** 0.5, 6) \
+                if vals else None
+        bad = [e for e in nums if e.get("first_nonfinite", -1) >= 0]
+        out["numerics"] = {
+            "samples": len(nums),
+            "grad_norm_first": _gn(nums[0]),
+            "grad_norm_last": _gn(nums[-1]),
+            "nonfinite_steps": len(bad),
+            "anomalies": sum(1 for e in events
+                             if e.get("event") == "train.anomaly"),
+        }
+        if bad:
+            out["numerics"]["first_nonfinite_layer"] = \
+                bad[0].get("first_nonfinite_layer")
+
     io_steps = [e for e in events if e.get("event") == "io.step"]
     if io_steps:
         ws = [e.get("host_wait_ms", 0.0) for e in io_steps]
@@ -284,7 +318,17 @@ def render(rep):
              f"{rep['train_steps']} ({rep['cold_steps']} cold, excluded)"]
     if "step_ms" in rep:
         lines.append(f"step ms     p50={rep['step_ms']['p50']:<10} "
-                     f"p99={rep['step_ms']['p99']}")
+                     f"p99={rep['step_ms']['p99']:<10} "
+                     f"min={rep['step_ms'].get('min')} "
+                     f"max={rep['step_ms'].get('max')}")
+    if "numerics" in rep:
+        n = rep["numerics"]
+        line = (f"numerics    {n['samples']} samples, grad_norm "
+                f"{n['grad_norm_first']} -> {n['grad_norm_last']}, "
+                f"{n['nonfinite_steps']} nonfinite")
+        if n.get("first_nonfinite_layer"):
+            line += f" (first: {n['first_nonfinite_layer']})"
+        lines.append(line)
     for k, v in rep.get("phases", {}).items():
         lines.append(f"  {k:<9} p50={v['p50']:<10} p99={v['p99']}")
     if "tokens_per_sec" in rep:
