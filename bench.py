@@ -938,14 +938,148 @@ def bench_llama_serve_speculative():
                  **peak_fields})
 
 
+def bench_llama_serve_fleet():
+    """Serve-fleet router (ISSUE 15): a staggered shared-prefix
+    workload through TWO in-process ContinuousBatcher replicas behind
+    the prefix-aware SLO-aware ServeRouter, vs ONE replica of the same
+    per-replica capacity on the same workload.  Reports aggregate
+    tok/s, the prefix-ROUTE hit rate (routes whose chosen replica
+    already held the prompt's prefix) and the vs_single_replica
+    multiplier.  The router is HOST-plane only: the CPU smoke asserts
+    both replicas actually served traffic, the run was requeue-free
+    and complete, and the flags-off single-batcher serve HLO +
+    program-cache keys are byte-identical with the router module
+    imported and a whole fleet run behind it."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    from paddle_tpu.inference import ContinuousBatcher
+    from paddle_tpu.inference.router import ServeRouter
+
+    model, cfg, batch, n_params, roofline = _serving_model()
+    rngm = np.random.RandomState(4)
+    if on_tpu:
+        sys_len, n_req = 256, 16
+        tail_lens = [16, 48, 32, 64] * 4
+        n_new, chunk, max_len, pchunk, ps = 128, 64, 640, 32, 32
+        rb = max(1, batch // 2)         # per-replica slots
+    else:
+        sys_len, n_req = 24, 8
+        tail_lens = [4, 8, 6, 5] * 2
+        n_new, chunk, max_len, pchunk, ps = 8, 4, 48, 4, 8
+        rb = 1
+    sys_prompt = rngm.randint(0, cfg.vocab_size, sys_len) \
+        .astype(np.int32)
+    prompts = [np.concatenate(
+        [sys_prompt, rngm.randint(0, cfg.vocab_size, L)
+         .astype(np.int32)]) for L in tail_lens[:n_req]]
+    geom = dict(max_batch_size=rb, max_len=max_len, chunk=chunk,
+                prefill_chunk=pchunk, page_size=ps)
+    # stagger rounds before the tail arrives: enough for the shared
+    # system prompt to finish prefilling (its pages then sit in the
+    # early replicas' prefix tries, so later routes can chase them) —
+    # one admit chunk advances admit_steps*prefill_chunk prompt rows
+    stagger = max(1, -(-sys_len // max(1, (chunk // 4) * pchunk)) + 1)
+
+    def fingerprint():
+        bat = ContinuousBatcher(model, **geom)
+        keys = (bat._program_key(1, bat.chunk),
+                bat._program_key(bat.prefill_chunk, bat.admit_steps))
+        return keys, (bat.lower_step(mixed=False).as_text(),
+                      bat.lower_step(mixed=True).as_text())
+
+    keys0, hlo0 = fingerprint()
+    last_stats = {}
+    hold = []
+
+    def fleet_once():
+        bats = [ContinuousBatcher(model, **geom) for _ in range(2)]
+        router = ServeRouter(batchers=bats)
+        hold[:] = [router]
+        n_first = max(2, 2 * rb)
+        for p_ in prompts[:n_first]:
+            router.submit(p_, n_new)
+        t0 = time.perf_counter()
+        for _ in range(stagger):
+            router.step()
+        for p_ in prompts[n_first:]:
+            router.submit(p_, n_new)
+        outs = router.run()
+        dt = time.perf_counter() - t0
+        last_stats.clear()
+        last_stats.update(router.stats())
+        return sum(len(v) for v in outs.values()) / dt
+
+    def single_once():
+        bat = ContinuousBatcher(model, **geom)
+        hold[:] = [bat]
+        n_first = max(2, 2 * rb)
+        for p_ in prompts[:n_first]:
+            bat.submit(p_, n_new)
+        t0 = time.perf_counter()
+        for _ in range(stagger):
+            bat.step()
+        for p_ in prompts[n_first:]:
+            bat.submit(p_, n_new)
+        outs = bat.run()
+        return sum(len(v) for v in outs.values()) \
+            / (time.perf_counter() - t0)
+
+    fleet_once()                               # compile (shared progs)
+    single_once()
+    tok_s, spread, vals = _measure(fleet_once)
+    st = dict(last_stats)
+    single_tok = _measure(single_once)[0]
+    keys1, hlo1 = fingerprint()
+    assert keys0 == keys1, \
+        "running the serve-fleet router changed single-batcher " \
+        "program keys"
+    assert hlo0 == hlo1, \
+        "running the serve-fleet router changed the flags-off " \
+        "single-batcher serve HLO"
+    if not on_tpu:
+        # CPU smoke: the fleet must be REAL — both replicas routed
+        # traffic, nothing requeued/shed, every request completed,
+        # and prefix-affinity actually steered at least one route
+        routed = st["routed_by_replica"]
+        assert all(v > 0 for v in routed.values()), st
+        assert st["requests_requeued"] == 0 \
+            and st["requests_shed"] == 0, st
+        assert st["requests_completed"] == n_req, st
+        assert st["prefix_route_hit_rate"] > 0, st
+        assert all(r.get("dead") is False
+                   for r in st["per_replica"]), st
+    vs_single = tok_s / max(single_tok, 1e-9)
+    _emit("llama_serve_fleet_tokens_per_sec", tok_s,
+          f"aggregate tok/s, {n_req} staggered reqs sharing a "
+          f"{sys_len}-token system prompt across 2 replicas x {rb} "
+          f"slots; prefix_route_hit_rate="
+          f"{st['prefix_route_hit_rate']:.2f}, routed="
+          f"{st['routed_by_replica']}, decide p50="
+          f"{st['decision_ms']['p50']}ms, "
+          f"vs_single_replica={vs_single:.2f}x",
+          tok_s / max(roofline, 1e-9), spread, vals,
+          extra={"replicas": 2,
+                 "slots_per_replica": rb,
+                 "prefix_route_hit_rate": st["prefix_route_hit_rate"],
+                 "routed_by_replica": {str(k): v for k, v in
+                                       st["routed_by_replica"].items()},
+                 "requeued": st["requests_requeued"],
+                 "decision_ms": st["decision_ms"],
+                 "vs_single_replica": round(vs_single, 3),
+                 "single_replica_tokens_per_sec": round(single_tok, 1),
+                 **_peak_hbm_fields()})
+
+
 def bench_serve_all():
     """BENCH_CONFIG=serve runs the mixed-length leg, the prefix-shared
-    leg AND the speculative leg (fresh vs-baseline numbers for all —
-    BENCH_r05 predates the r6 batcher, the r12 paged pool and the r15
-    draft/verify scan)."""
+    leg, the speculative leg AND the serve-fleet router leg (fresh
+    vs-baseline numbers for all — BENCH_r05 predates the r6 batcher,
+    the r12 paged pool, the r15 draft/verify scan and the r19
+    router)."""
     bench_llama_serve()
     bench_llama_serve_prefix_shared()
     bench_llama_serve_speculative()
+    bench_llama_serve_fleet()
 
 
 CONFIGS = {
@@ -973,6 +1107,10 @@ _ALIASES = {
     "serve_spec": "serve",
     "llama_serve_speculative": "serve",
     "llama_serve_speculative_tokens_per_sec": "serve",
+    "serve_fleet": "serve",
+    "fleet_serve": "serve",
+    "llama_serve_fleet": "serve",
+    "llama_serve_fleet_tokens_per_sec": "serve",
     "llama_decode": "decode",
     "llama_decode_tokens_per_sec_per_chip": "decode",
     "llama_train_tokens_per_sec_per_chip": "llama",

@@ -243,6 +243,33 @@ define_flag("telemetry_max_log_mb", 0.0,
             "running job's step log stays bounded per segment, and "
             "merge_jsonl_traces reads the segments back in order.  0 "
             "(default) disables rotation")
+# serve-fleet router (ISSUE 15, inference/router.py): N batcher
+# replicas behind a prefix-aware, SLO-aware router.  Pure HOST-plane
+# scheduling — none of these flags ever reaches a traced program, so
+# the flags-off single-batcher serve HLO and program-cache keys stay
+# byte-identical with the router imported and running (bench-asserted).
+define_flag("serve_replicas", 0,
+            "replica count for inference.fleet_serve() when none is "
+            "passed explicitly: the router fronts N ContinuousBatcher "
+            "replicas (in-process handles; replica-per-rank workers "
+            "publish their views over the launch KV plane).  0 falls "
+            "back to 2")
+define_flag("router_prefix_weight", 1.0,
+            "weight on a replica's prefix_hit_tokens (prompt tokens "
+            "already resident in its prefix cache — prefill work the "
+            "route would skip) in the routing score; 0 disables "
+            "prefix affinity and routes purely by load/SLO balance")
+define_flag("router_rebalance_ms", 0.0,
+            "interval for the router's queued-request rebalance sweep: "
+            "every N ms a QUEUED request on an overloaded replica "
+            "migrates to an idle one (lossless — only never-started "
+            "requests move).  0 (default) disables rebalancing")
+define_flag("router_attainment_floor", 0.9,
+            "interactive SLO floor for routing: an interactive request "
+            "never routes to a replica whose interactive attainment "
+            "sits below the floor while another candidate has "
+            "headroom (at/above it, or no attainment signal yet).  0 "
+            "disables the floor")
 define_flag("serve_retry_budget", 3,
             "per-request bound on serve-plane fault recoveries "
             "(injected/real admission faults retried FIFO-in-place, "

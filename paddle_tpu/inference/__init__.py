@@ -29,9 +29,26 @@ import jax.numpy as jnp
 
 __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "PrecisionType", "PlaceType", "get_version",
-           "ContinuousBatcher", "Request", "SLO_CLASSES"]
+           "ContinuousBatcher", "Request", "SLO_CLASSES",
+           "ServeRouter", "pick_replica", "fleet_serve"]
 
 from .serving import ContinuousBatcher, Request, SLO_CLASSES  # noqa: E402
+from .router import ServeRouter, pick_replica  # noqa: E402
+
+
+def fleet_serve(model=None, replicas=None, **kw) -> ServeRouter:
+    """Serve-fleet entry point (ISSUE 15): a `ServeRouter` fronting N
+    `ContinuousBatcher` replicas — N from `replicas`, else
+    FLAGS_serve_replicas (0 -> 2).  Keyword args are split between the
+    router (kv=, job_id=, batchers=) and the batchers (everything
+    else: max_batch_size, max_len, chunk, kv_layout, ...).
+
+        router = paddle.inference.fleet_serve(model, replicas=4,
+                                              max_batch_size=8)
+        gid = router.submit(ids, 128, slo="interactive")
+        outs = router.run()
+    """
+    return ServeRouter(model, replicas=replicas, **kw)
 
 
 def get_version() -> str:

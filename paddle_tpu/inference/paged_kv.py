@@ -189,6 +189,24 @@ class PageAllocator:
             self._touch(node)       # newly cached: most-recent end
 
     # -- prefix trie -------------------------------------------------------
+    def prefix_match_len(self, tokens) -> int:
+        """READ-ONLY probe: how many leading tokens of `tokens` are
+        already resident as shareable prefix pages (complete full-page
+        chunks plus the best mid-page partial match), capped at
+        len(tokens)-1 exactly like admit() — the answer is the prefill
+        work an admission here would SKIP.
+
+        Pure trie walk: no refcount change, no LRU touch, no CoW, no
+        allocation — the serve-fleet router calls this against every
+        replica per routed request, so probing must never pin a page
+        or perturb the eviction order (regression-pinned)."""
+        plen = len(tokens)
+        if plen <= 1:
+            return 0
+        full, partial = self.match_prefix(tokens, max_share=plen - 1)
+        return len(full) * self.page_size \
+            + (partial[1] if partial is not None else 0)
+
     def match_prefix(self, tokens, max_share: int):
         """(full_nodes, partial) for `tokens`: full_nodes are complete
         trie nodes matching whole page_size chunks (walk stops at the

@@ -1,0 +1,755 @@
+"""Serve-fleet router (ISSUE 15) — prefix-aware, SLO-aware routing
+across N `ContinuousBatcher` replicas with lossless drain-and-requeue.
+
+The single-host batcher already carries the whole r12/r13/r15 serving
+story (paged prefix-shared KV, SLO admission + shedding + drain,
+speculative decode, streaming); this module is the layer ABOVE it —
+the millions-of-users architecture of ROADMAP item 2: a `ServeRouter`
+fronting N replicas, each its own batcher with its own KV pool, slots
+and queues.  Reference shape: the disaggregated multi-replica serving
+designs in the Orca/vLLM lineage (continuous batching + paged KV as
+the per-replica substrate, a prefix-cache-aware scheduler on top).
+
+Routing policy (``pick_replica`` — a pure function over per-replica
+policy views, unit-testable with synthetic stats):
+
+  1. **prefix affinity** — every replica's `PageAllocator` trie is
+     probed READ-ONLY for the longest resident prefix of the incoming
+     prompt (`ContinuousBatcher.prefix_match_len`: no page pinned, no
+     LRU touch).  Hit tokens are prefill work the route would skip,
+     weighted by ``FLAGS_router_prefix_weight``.
+  2. **load/SLO balance** — the score subtracts queue depth and shed
+     rate (in token-cost units), ties break deterministically by
+     (fewer queued, fewer active, lowest replica index).  The r13 SLO
+     classes are honored end-to-end: an interactive request never
+     routes to a replica whose interactive attainment sits below
+     ``FLAGS_router_attainment_floor`` while another candidate has
+     headroom; draining/dead replicas are never picked.
+
+Drain-and-requeue (the r13 contract lifted fleet-wide): on replica
+SIGTERM/kill the router harvests what finished, then requeues the
+replica's queued AND non-terminal in-flight requests onto survivors AT
+ARRIVAL POSITION — the router assigns GLOBAL arrival numbers, so FIFO
+within an SLO class is fleet-consistent across migrations.  Greedy
+decode is deterministic, so a migrated request's re-decode is
+bit-exact vs a fault-free run (``chaos_check --serve`` replica-kill
+specs pin this), and a STREAMING request keeps its delivered prefix:
+the router's dedup wrapper replays the survivor's re-decode against
+the tokens already handed out and forwards only the new suffix — no
+duplicate delivery, ever.
+
+Replica-per-rank mode rides the existing ``distributed/launch``
+KVClient/KVServer plane, reusing the r14 FleetSink key schema:
+``ReplicaPublisher`` PUTs each replica's ``router_view()`` under
+``<job>/serve/<replica>/latest`` (+ a master-clock heartbeat stamp),
+``discover_replicas`` reads them back, and ``pick_replica`` runs the
+same policy over the discovered views — discovery, heartbeat and
+per-replica stats publication share one store with the train fleet.
+
+Everything here is HOST-plane control flow: no compiled program, cache
+key or donation contract changes — per-replica serve programs remain
+exactly 2 per shape (replicas of one geometry share them through the
+model-level program cache), and the flags-off single-batcher serve HLO
+is byte-identical with this module imported (bench-asserted).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..framework.flags import get_flag
+from ..framework.tensor import Tensor
+from .serving import ContinuousBatcher, SLO_CLASSES
+
+__all__ = ["ServeRouter", "pick_replica", "ReplicaPublisher",
+           "discover_replicas"]
+
+#: load penalty per queued request, in prefix-hit-token units — one
+#: queued request costs the route as much as ~a page of skipped
+#: prefill buys it (policy scale, overridable per call)
+QUEUE_COST_TOKENS = 16.0
+
+
+# ---------------------------------------------------------------------------
+# the policy — a pure function over per-replica views
+# ---------------------------------------------------------------------------
+
+def pick_replica(views: List[dict], slo: str = "batch",
+                 prefix_weight: Optional[float] = None,
+                 attainment_floor: Optional[float] = None,
+                 queue_cost: float = QUEUE_COST_TOKENS
+                 ) -> Optional[int]:
+    """Choose one replica for a request of class `slo` from per-replica
+    policy views (`ContinuousBatcher.router_view()` dicts, or the same
+    records read back off the KV plane) — returns the chosen view's
+    ``replica`` id, or None when nothing is routable (every replica
+    draining/dead).
+
+    Two-tier, deterministic:
+
+      1. draining/dead replicas are dropped;
+      2. interactive traffic drops replicas whose interactive
+         attainment sits below the floor WHILE another candidate has
+         headroom (at/above it, or no attainment signal yet); if every
+         candidate is below the floor the tier is waived — degraded
+         service beats no service;
+      3. score = prefix_weight * prefix_hit_tokens
+                 - queue_cost * queued  - queue_cost * shed_rate,
+         ties broken by (fewer queued, fewer active, lowest replica
+         id) — byte-for-byte reproducible for a given view list.
+    """
+    if prefix_weight is None:
+        prefix_weight = float(get_flag("router_prefix_weight") or 0.0)
+    if attainment_floor is None:
+        attainment_floor = float(
+            get_flag("router_attainment_floor") or 0.0)
+    cands = [v for v in views
+             if not v.get("draining") and not v.get("dead")]
+    if not cands:
+        return None
+    if slo == "interactive" and attainment_floor > 0:
+        def headroom(v):
+            att = (v.get("attainment") or {}).get("interactive")
+            return att is None or att >= attainment_floor
+        floored = [v for v in cands if headroom(v)]
+        if floored:
+            cands = floored
+
+    def rank(v):
+        score = (prefix_weight * float(v.get("prefix_hit_tokens") or 0)
+                 - queue_cost * float(v.get("queued") or 0)
+                 - queue_cost * float(v.get("shed_rate") or 0.0))
+        return (score, -float(v.get("queued") or 0),
+                -float(v.get("active") or 0),
+                -int(v.get("replica", 0)))
+    return int(max(cands, key=rank)["replica"])
+
+
+# ---------------------------------------------------------------------------
+# router bookkeeping
+# ---------------------------------------------------------------------------
+
+class _RouterReq:
+    """The router's own record of one global request — everything a
+    migration needs to re-place it losslessly: the prompt, the GLOBAL
+    arrival number (FIFO across the fleet), the absolute deadline, and
+    the streaming dedup state (`delivered` is authoritative across
+    incarnations; `seen` counts the CURRENT incarnation's replay)."""
+    __slots__ = ("gid", "prompt", "max_new", "slo", "deadline",
+                 "arrival", "on_token", "delivered", "seen",
+                 "incarnation", "replica", "local_id", "requeues",
+                 "done", "shed", "shed_reason")
+
+    def __init__(self, gid, prompt, max_new, slo, deadline, arrival,
+                 on_token):
+        self.gid = gid
+        self.prompt = prompt
+        self.max_new = max_new
+        self.slo = slo
+        self.deadline = deadline        # absolute monotonic, or None
+        self.arrival = arrival
+        self.on_token = on_token
+        self.delivered: List[int] = []  # tokens the consumer HOLDS
+        self.seen = 0                   # replay cursor, this incarnation
+        self.incarnation = 0
+        self.replica: Optional[int] = None
+        self.local_id: Optional[int] = None
+        self.requeues = 0
+        self.done = False
+        self.shed = False
+        self.shed_reason: Optional[str] = None
+
+
+class _Replica:
+    """One in-process replica handle: the batcher plus the router's
+    local-id <-> global-id mapping and per-replica route counters."""
+    __slots__ = ("idx", "bat", "dead", "draining", "local2g", "routed",
+                 "requeued_in")
+
+    def __init__(self, idx, bat):
+        self.idx = idx
+        self.bat = bat
+        self.dead = False
+        self.draining = False
+        self.local2g: Dict[int, int] = {}
+        self.routed = 0
+        self.requeued_in = 0
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+class ServeRouter:
+    """Front N `ContinuousBatcher` replicas with one submit/run API.
+
+    Construction: either pass pre-built ``batchers=[...]`` (replicas
+    may differ in geometry/KV precision) or a `model` plus `replicas=N`
+    and batcher kwargs — N batchers are built over the shared model, so
+    same-geometry replicas share their 2 compiled serve programs
+    through the model-level program cache.  ``replicas=None`` reads
+    ``FLAGS_serve_replicas`` (0 -> 2).
+
+    kv/job_id: optional KV plane (endpoint string or
+    `launch.master.KVClient`) — every router step publishes each live
+    replica's `router_view()` under ``<job_id>/serve/<replica>/latest``
+    (the r14 FleetSink key schema) so coordinators/ops discover the
+    fleet with `discover_replicas` and replay `pick_replica` offline.
+
+    The router is single-threaded over its replicas (one scheduling
+    round steps each replica that has work); submit() may race run()
+    from another thread — the batcher's queue lock (ISSUE 15
+    satellite) keeps the structure consistent.
+    """
+
+    def __init__(self, model=None, replicas: Optional[int] = None,
+                 batchers: Optional[List[ContinuousBatcher]] = None,
+                 kv=None, job_id: str = "serve", **batcher_kw):
+        if batchers is None:
+            if model is None:
+                raise ValueError("ServeRouter needs a model (plus "
+                                 "replicas=N) or explicit batchers=")
+            n = int(replicas if replicas is not None
+                    else get_flag("serve_replicas") or 0) or 2
+            batchers = [ContinuousBatcher(model, **batcher_kw)
+                        for _ in range(n)]
+        elif batcher_kw or model is not None or replicas is not None:
+            raise ValueError("pass model/replicas/batcher kwargs OR "
+                             "batchers=, not both")
+        if not batchers:
+            raise ValueError("ServeRouter needs >= 1 replica")
+        self._reps = [_Replica(i, b) for i, b in enumerate(batchers)]
+        self._reqs: Dict[int, _RouterReq] = {}
+        self._results: Dict[int, np.ndarray] = {}
+        self._next_gid = 0
+        self._arrival = 0
+        self._completed = 0
+        self._shed_count = 0
+        self._requeued = 0
+        self._rebalanced = 0
+        self._kills = 0
+        self._prefix_routed = 0
+        self._routes = 0
+        self._decision_ms: deque = deque(maxlen=4096)
+        self._last_rebalance = time.monotonic()
+        self._draining = False
+        self._pubs: List[Optional["ReplicaPublisher"]] = []
+        if kv is not None:
+            self._pubs = [ReplicaPublisher(kv, job_id=job_id,
+                                           replica=r.idx)
+                          for r in self._reps]
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def replicas(self) -> int:
+        return len(self._reps)
+
+    @property
+    def live_replicas(self) -> int:
+        return sum(not r.dead for r in self._reps)
+
+    @property
+    def drained(self) -> bool:
+        """True once the process-level SIGTERM drain reached the
+        fleet — same caller cue as `ContinuousBatcher.drained`."""
+        return self._draining
+
+    def _live(self) -> List[_Replica]:
+        return [r for r in self._reps if not r.dead]
+
+    def _views(self, prompt=None, exclude: Optional[int] = None
+               ) -> List[dict]:
+        # prefix affinity off (weight 0) -> the hit count is
+        # multiplied by zero anyway; skip the O(replicas x prompt)
+        # trie probes on the routing hot path entirely
+        if prompt is not None \
+                and not float(get_flag("router_prefix_weight") or 0.0):
+            prompt = None
+        views = []
+        for rep in self._reps:
+            if rep.dead or rep.idx == exclude:
+                continue
+            v = rep.bat.router_view(prompt)
+            v["replica"] = rep.idx
+            if rep.draining:
+                v["draining"] = True
+            views.append(v)
+        return views
+
+    # -- submission --------------------------------------------------------
+    def submit(self, input_ids, max_new_tokens: int = 32,
+               slo: str = "batch",
+               deadline_ms: Optional[float] = None,
+               on_token=None) -> int:
+        """Route one request to a replica; returns its GLOBAL id (the
+        key of run()'s results).  Same contract as the batcher's
+        submit — SLO classes, deadlines (resolved to an absolute
+        deadline HERE so a migration never restarts the clock),
+        streaming on_token(gid, tokens, done) — plus the routing
+        decision: prefix affinity first, load/SLO balance second."""
+        ids = np.asarray(input_ids.value
+                         if isinstance(input_ids, Tensor)
+                         else input_ids, np.int32).reshape(-1)
+        if deadline_ms is None:
+            deadline_ms = float(get_flag("serve_default_deadline_ms")
+                                or 0.0)
+        deadline = (time.monotonic() + float(deadline_ms) / 1e3) \
+            if deadline_ms and deadline_ms > 0 else None
+        gid = self._next_gid
+        self._next_gid += 1
+        rr = _RouterReq(gid, ids, int(max_new_tokens), slo, deadline,
+                        self._arrival, on_token)
+        self._arrival += 1
+        self._reqs[gid] = rr
+        t0 = time.perf_counter()
+        views = self._views(ids)
+        idx = pick_replica(views, slo=slo)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self._decision_ms.append(dt_ms)
+        if idx is None:
+            # nothing routable (whole fleet draining): terminal no-
+            # service, accounted like a batcher-side drain shed — the
+            # no-leak contract holds fleet-wide
+            self._shed_router(rr, "drain")
+            return gid
+        chosen = next(v for v in views if v["replica"] == idx)
+        hit = int(chosen.get("prefix_hit_tokens") or 0)
+        self._routes += 1
+        if hit > 0:
+            self._prefix_routed += 1
+        rep = self._reps[idx]
+        rep.routed += 1
+        self._place(rr, rep)
+        from .. import telemetry as _tel
+        _tel.counter("router.routed").inc()
+        if _tel.active():
+            _tel.emit("router.route", req=gid, slo=slo, replica=idx,
+                      prefix_hit=hit,
+                      queued=int(chosen.get("queued") or 0),
+                      decision_ms=round(dt_ms, 4))
+        return gid
+
+    def _shed_router(self, rr: _RouterReq, reason: str):
+        rr.done = True
+        rr.shed = True
+        rr.shed_reason = reason
+        self._results[rr.gid] = np.asarray(rr.delivered, np.int32)
+        self._shed_count += 1
+        if rr.on_token is not None:
+            try:
+                rr.on_token(rr.gid, [], True)
+            except Exception:
+                from .. import telemetry as _tel
+                _tel.counter("serve.callback_errors").inc()
+        from .. import telemetry as _tel
+        _tel.counter("router.shed").inc()
+        if _tel.active():
+            _tel.emit("router.shed", req=rr.gid, slo=rr.slo,
+                      reason=reason)
+
+    def _make_cb(self, rr: _RouterReq, incarnation: int):
+        """Streaming dedup wrapper for one PLACEMENT of a request: the
+        replica replays the request's whole output stream (a migrated
+        request re-decodes from scratch, bit-exactly), and only tokens
+        past the globally-delivered frontier are forwarded — the
+        consumer never sees a duplicate across requeues.  A stale
+        incarnation (a replica flushing after its request migrated)
+        is ignored outright."""
+        def cb(_local_id, burst, done):
+            if rr.incarnation != incarnation:
+                return
+            new = []
+            for t in burst:
+                rr.seen += 1
+                if rr.seen > len(rr.delivered):
+                    rr.delivered.append(int(t))
+                    new.append(int(t))
+            if not new and not done:
+                return
+            rr.on_token(rr.gid, new, done)
+        return cb
+
+    def _place(self, rr: _RouterReq, rep: _Replica):
+        """Submit `rr` to `rep` and rewrite the created Request to the
+        router's GLOBAL coordinates: arrival number (re-sorted to its
+        arrival position — fleet-wide FIFO within a class survives
+        migrations) and the ABSOLUTE deadline (a migrated request's
+        clock never restarts)."""
+        bat = rep.bat
+        cb = None
+        if rr.on_token is not None:
+            cb = self._make_cb(rr, rr.incarnation)
+        # ONE critical section for the enqueue AND the global-arrival/
+        # absolute-deadline rewrite (the queue lock is reentrant, so
+        # submit's own acquisition nests): a run() thread's admit()
+        # must never pop the request in between — it would keep its
+        # batcher-local arrival (fleet FIFO broken) and a freshly
+        # restarted deadline clock
+        with bat._qlock:
+            lid = bat.submit(rr.prompt, rr.max_new, slo=rr.slo,
+                             deadline_ms=None, on_token=cb)
+            rep.local2g[lid] = rr.gid
+            rr.replica, rr.local_id = rep.idx, lid
+            rr.seen = 0
+            q = bat._queues[rr.slo]
+            req = next((r for r in q if r.req_id == lid), None)
+            if req is not None:
+                q.remove(req)
+                req.arrival = rr.arrival
+                req.deadline = rr.deadline
+                if rr.deadline is not None:
+                    bat._has_deadlines = True
+                i = 0
+                while i < len(q) and q[i].arrival <= rr.arrival:
+                    i += 1
+                q.insert(i, req)
+        # shed on arrival (replica-side bounded queue / drain): the
+        # terminal state is harvested like any other finish
+
+    # -- scheduling --------------------------------------------------------
+    def _harvest(self, rep: _Replica) -> List[int]:
+        """Collect `rep`'s newly-terminal requests into the router's
+        results (completed and shed both — the no-leak contract)."""
+        out = []
+        for lid, gid in list(rep.local2g.items()):
+            req = rep.bat._finished.get(lid)
+            if req is None:
+                continue
+            rr = self._reqs[gid]
+            rr.done = True
+            self._results[gid] = req.output()
+            if req.shed:
+                rr.shed, rr.shed_reason = True, req.shed_reason
+                self._shed_count += 1
+            else:
+                self._completed += 1
+            del rep.local2g[lid]
+            out.append(gid)
+        return out
+
+    def step(self) -> List[int]:
+        """One scheduling round across the fleet: every live replica
+        with work runs one batcher round; newly-terminal global ids
+        are returned.  A replica whose own drain protocol engaged
+        (process-level SIGTERM) marks the router drained; a
+        gracefully-draining replica with nothing left is retired."""
+        finished: List[int] = []
+        for rep in self._live():
+            bat = rep.bat
+            if bat.queued or bat.active:
+                bat.step()
+            finished += self._harvest(rep)
+            if bat.drained:
+                self._draining = True
+            if rep.draining and not bat.queued and not bat.active:
+                rep.dead = True
+        self._maybe_rebalance()
+        self._publish()
+        return finished
+
+    def run(self) -> Dict[int, np.ndarray]:
+        """Drive the fleet until every replica's queue and slots drain;
+        returns {gid: tokens} for EVERY submitted request (shed ones
+        included — empty or partial outputs), exactly the batcher's
+        run() contract lifted fleet-wide."""
+        while any(r.bat.queued or r.bat.active for r in self._live()):
+            self.step()
+        for rep in self._live():
+            self._harvest(rep)
+        return dict(self._results)
+
+    # -- drain-and-requeue (the r13 contract, fleet-wide) ------------------
+    def kill_replica(self, idx: int, reason: str = "kill") -> int:
+        """Replica `idx` died (SIGTERM'd subprocess, poisoned host):
+        harvest what it finished, collect its queued AND non-terminal
+        in-flight requests, retire it, and requeue the collected
+        requests onto survivors at their ARRIVAL POSITIONS.  Greedy
+        re-decode is bit-exact, and streaming requests keep their
+        delivered prefix (the dedup wrapper never re-sends it).
+        Returns the number of migrated requests."""
+        rep = self._reps[idx]
+        if rep.dead:
+            return 0
+        self._harvest(rep)
+        bat = rep.bat
+        pending = []
+        with bat._qlock:
+            for cls in SLO_CLASSES:
+                q = bat._queues[cls]
+                while q:
+                    pending.append(q.popleft())
+            for i, req in enumerate(bat._slots):
+                if req is not None:
+                    pending.append(req)
+                    bat._slots[i] = None    # host detach only: the
+                    #                         replica is dead, its
+                    #                         device state unreachable
+        rep.dead = True
+        self._kills += 1
+        migs = []
+        for req in pending:
+            gid = rep.local2g.pop(req.req_id, None)
+            if gid is not None:
+                migs.append(self._reqs[gid])
+            else:
+                # not router-managed (submitted straight to the
+                # batcher): the router cannot re-place it, but it must
+                # not vanish — shed it through the batcher so ITS
+                # no-leak accounting (and any direct caller's run())
+                # stays whole
+                bat._shed(req, "drain")
+        migs.sort(key=lambda r: r.arrival)
+        from .. import telemetry as _tel
+        _tel.counter("router.kills").inc()
+        if _tel.active():
+            _tel.emit("router.kill", replica=idx, reason=reason,
+                      migrated=len(migs))
+        for rr in migs:
+            self._migrate(rr, frm=idx)
+        return len(migs)
+
+    def drain_replica(self, idx: int) -> int:
+        """Graceful replica drain (the planned-maintenance half):
+        queued requests migrate to survivors NOW, in-flight decodes
+        finish on the replica (it stops receiving routes), and the
+        replica retires once empty — nothing is lost, nothing
+        re-decoded.  Returns the number of migrated requests."""
+        rep = self._reps[idx]
+        if rep.dead or rep.draining:
+            return 0
+        rep.draining = True
+        bat = rep.bat
+        pending = []
+        with bat._qlock:
+            for cls in SLO_CLASSES:
+                q = bat._queues[cls]
+                while q:
+                    pending.append(q.popleft())
+        migs = []
+        unmapped = []
+        for req in pending:
+            gid = rep.local2g.pop(req.req_id, None)
+            if gid is not None:
+                migs.append(self._reqs[gid])
+            else:
+                unmapped.append(req)
+        if unmapped:
+            # not router-managed: leave them queued on the draining
+            # replica — it keeps stepping until empty, so they finish
+            # there (unlike a kill, nothing is lost by waiting)
+            with bat._qlock:
+                for req in unmapped:
+                    q = bat._queues[req.slo]
+                    i = 0
+                    while i < len(q) and q[i].arrival < req.arrival:
+                        i += 1
+                    q.insert(i, req)
+        migs.sort(key=lambda r: r.arrival)
+        from .. import telemetry as _tel
+        _tel.counter("router.drains").inc()
+        if _tel.active():
+            _tel.emit("router.drain", replica=idx,
+                      migrated=len(migs))
+        for rr in migs:
+            self._migrate(rr, frm=idx)
+        return len(migs)
+
+    def _migrate(self, rr: _RouterReq, frm: int):
+        rr.requeues += 1
+        rr.incarnation += 1         # invalidates the old placement's
+        rr.seen = 0                 # streaming wrapper
+        views = self._views(rr.prompt, exclude=frm)
+        idx = pick_replica(views, slo=rr.slo)
+        if idx is None:
+            self._shed_router(rr, "drain")
+            return
+        rep = self._reps[idx]
+        rep.requeued_in += 1
+        self._requeued += 1
+        self._place(rr, rep)
+        from .. import telemetry as _tel
+        _tel.counter("router.requeues").inc()
+        if _tel.active():
+            _tel.emit("router.requeue", req=rr.gid, slo=rr.slo,
+                      frm=frm, to=idx,
+                      delivered=len(rr.delivered))
+
+    # -- periodic rebalance ------------------------------------------------
+    def _pop_newest_queued(self, rep: _Replica) -> Optional[_RouterReq]:
+        """Detach `rep`'s lowest-SLO newest-arrival QUEUED request (the
+        one that would wait longest — the shed-victim rank, reused for
+        the opposite purpose: it migrates instead of dying)."""
+        order = {c: i for i, c in enumerate(SLO_CLASSES)}
+        bat = rep.bat
+        with bat._qlock:
+            victim = None
+            for cls in SLO_CLASSES:
+                for r in bat._queues[cls]:
+                    # only router-managed requests are movable — one
+                    # submitted straight to the batcher has no global
+                    # record and must stay where its caller put it
+                    if r.req_id not in rep.local2g:
+                        continue
+                    if victim is None or (order[r.slo], r.arrival) \
+                            > (order[victim.slo], victim.arrival):
+                        victim = r
+            if victim is None:
+                return None
+            bat._queues[victim.slo].remove(victim)
+        return self._reqs[rep.local2g.pop(victim.req_id)]
+
+    def _maybe_rebalance(self):
+        """FLAGS_router_rebalance_ms sweep: while some replica has
+        queued work and another sits idle with a free slot, migrate
+        the overloaded replica's newest queued request — lossless
+        (only never-started requests move; their streaming state is
+        empty) and bounded per sweep."""
+        ms = float(get_flag("router_rebalance_ms") or 0.0)
+        if ms <= 0:
+            return
+        now = time.monotonic()
+        if (now - self._last_rebalance) * 1e3 < ms:
+            return
+        self._last_rebalance = now
+        moved = 0
+        while moved < 64:
+            live = [r for r in self._live()
+                    if not r.draining and not r.bat.drained]
+            donors = [r for r in live if r.bat.queued > 0]
+            takers = [r for r in live if r.bat.queued == 0
+                      and r.bat.active < r.bat.B]
+            if not donors or not takers:
+                break
+            donor = max(donors, key=lambda r: (r.bat.queued, -r.idx))
+            taker = min(takers, key=lambda r: (r.bat.active, r.idx))
+            if donor is taker:
+                break
+            rr = self._pop_newest_queued(donor)
+            if rr is None:
+                break
+            rr.incarnation += 1
+            rr.seen = 0
+            taker.requeued_in += 1
+            self._place(rr, taker)
+            moved += 1
+        if moved:
+            self._rebalanced += moved
+            from .. import telemetry as _tel
+            _tel.counter("router.rebalances").inc(moved)
+            if _tel.active():
+                _tel.emit("router.rebalance", moved=moved)
+
+    # -- KV-plane publication ----------------------------------------------
+    def _publish(self):
+        if not self._pubs:
+            return
+        for rep, pub in zip(self._reps, self._pubs):
+            if rep.dead or pub is None:
+                continue
+            pub.publish(rep.bat.router_view())
+
+    # -- stats -------------------------------------------------------------
+    def stats(self) -> Dict[str, object]:
+        """Fleet-level counters: the no-leak partition
+        (submitted == completed + shed), routing/requeue accounting
+        per replica, the prefix-route hit rate (fraction of routes
+        whose chosen replica held a resident prefix) and the routing
+        decision-time percentiles — what the `llama_serve_fleet`
+        bench and telemetry_report's fleet section consume."""
+        from ..telemetry import summary_of
+        per = []
+        for rep in self._reps:
+            rec: Dict[str, object] = {
+                "replica": rep.idx, "dead": rep.dead,
+                "routed": rep.routed, "requeued_in": rep.requeued_in}
+            if not rep.dead:
+                rec.update(rep.bat.router_view())
+            per.append(rec)
+        dec = summary_of(list(self._decision_ms))
+        return {
+            "replicas": len(self._reps),
+            "live_replicas": self.live_replicas,
+            "requests_submitted": self._next_gid,
+            "requests_completed": self._completed,
+            "requests_shed": self._shed_count,
+            "requests_requeued": self._requeued,
+            "rebalanced": self._rebalanced,
+            "kills": self._kills,
+            "routes": self._routes,
+            "prefix_routed": self._prefix_routed,
+            "prefix_route_hit_rate": round(
+                self._prefix_routed / self._routes, 4)
+            if self._routes else 0.0,
+            "routed_by_replica": {r.idx: r.routed for r in self._reps},
+            "requeued_by_replica": {r.idx: r.requeued_in
+                                    for r in self._reps},
+            "decision_ms": {"count": dec["count"],
+                            "p50": round(dec["p50"], 4),
+                            "p99": round(dec["p99"], 4),
+                            "max": round(dec["max"], 4)},
+            "per_replica": per,
+        }
+
+
+# ---------------------------------------------------------------------------
+# replica-per-rank mode: discovery/heartbeat/stats over the launch KV plane
+# ---------------------------------------------------------------------------
+
+class ReplicaPublisher:
+    """Worker-side publication for the replica-per-rank mode — the r14
+    FleetSink key schema on the same `launch.master` KVClient/KVServer
+    store that carries train-fleet summaries:
+
+        ``<job>/serve/<replica>/latest``  the replica's router_view()
+        ``<job>/serve/<replica>/hb``      master-clock heartbeat stamp
+
+    A subprocess replica calls ``publish(bat.router_view())`` at chunk
+    boundaries (one JSON PUT + one stamp — KVClient retries transient
+    blips with bounded backoff and never raises); the coordinator's
+    `discover_replicas` + `pick_replica` then run the routing policy
+    over the fleet without sharing a process with any replica.  The
+    replica id defaults to the launcher's PADDLE_TRAINER_ID."""
+
+    def __init__(self, kv, job_id: str = "serve",
+                 replica: Optional[int] = None):
+        if isinstance(kv, str):
+            from ..distributed.launch.master import KVClient
+            kv = KVClient(kv)
+        self._kv = kv
+        self._job = job_id
+        if replica is None:
+            replica = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.replica = int(replica)
+
+    def publish(self, view: dict) -> bool:
+        rec = dict(view, replica=self.replica)
+        pre = f"{self._job}/serve/{self.replica}"
+        ok = self._kv.put(f"{pre}/latest", json.dumps(rec))
+        self._kv.stamp(f"{pre}/hb")
+        return bool(ok)
+
+
+def discover_replicas(kv, job_id: str = "serve") -> Dict[int, dict]:
+    """{replica: latest router_view} discovered from the KV plane —
+    the coordinator-side read of ReplicaPublisher's schema.  Records
+    that fail to parse are skipped (a torn PUT must not poison the
+    fleet view); feed the values straight to `pick_replica` (each
+    carries its ``replica`` id)."""
+    if isinstance(kv, str):
+        from ..distributed.launch.master import KVClient
+        kv = KVClient(kv)
+    out: Dict[int, dict] = {}
+    for key, raw in kv.prefix(f"{job_id}/serve").items():
+        if not key.endswith("/latest"):
+            continue
+        try:
+            rec = json.loads(raw)
+            out[int(rec["replica"])] = rec
+        except (ValueError, KeyError, TypeError):
+            continue
+    return out

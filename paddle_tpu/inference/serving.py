@@ -95,6 +95,7 @@ per-sequence outputs are testable against isolated `generate()` runs.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -291,7 +292,14 @@ class ContinuousBatcher:
         self._spec_steps = 0
         self._spec_emit_window: deque = deque(maxlen=4096)
         # one FIFO per SLO class (admission walks SLO_CLASSES in
-        # priority order; within a class strictly by arrival)
+        # priority order; within a class strictly by arrival).  The
+        # lock makes queue STRUCTURE atomic against a submit() racing
+        # the run()/step() thread (and the router's balance reads):
+        # without it stats()["queued"] / the per-class snapshot could
+        # see a torn count mid-append (ISSUE 15 satellite).  Reentrant
+        # because a shed inside submit() fires the user's on_token
+        # callback, which may itself submit()
+        self._qlock = threading.RLock()
         self._queues: Dict[str, deque] = {c: deque()
                                           for c in SLO_CLASSES}
         self._slots: List[Optional[Request]] = [None] * self.B
@@ -539,19 +547,29 @@ class ContinuousBatcher:
             # admissions are closed: the request is accounted, shed
             self._shed(req, "drain")
             return rid
-        depth = int(get_flag("serve_queue_depth") or 0)
-        if depth > 0 and self._queued_count() >= depth:
-            victim = self._shed_victim(req)
-            if victim is req:
-                self._shed(req, "queue_full")
-                return rid
-            self._queues[victim.slo].remove(victim)
-            self._shed(victim, "queue_full")
-        self._queues[slo].append(req)
+        with self._qlock:
+            depth = int(get_flag("serve_queue_depth") or 0)
+            if depth > 0 and self._queued_count() >= depth:
+                victim = self._shed_victim(req)
+                if victim is req:
+                    self._shed(req, "queue_full")
+                    return rid
+                self._queues[victim.slo].remove(victim)
+                self._shed(victim, "queue_full")
+            self._queues[slo].append(req)
         return rid
 
     def _queued_count(self) -> int:
-        return sum(len(q) for q in self._queues.values())
+        with self._qlock:
+            return sum(len(q) for q in self._queues.values())
+
+    def queue_snapshot(self) -> Dict[str, int]:
+        """Atomic {slo_class: queued count} snapshot — one consistent
+        view of every class queue (the lock orders it against a
+        concurrent submit/admit), so a router balancing on per-class
+        depth (or telemetry_report) can never see a torn count."""
+        with self._qlock:
+            return {c: len(q) for c, q in self._queues.items()}
 
     @property
     def queued(self) -> int:
@@ -679,32 +697,35 @@ class ContinuousBatcher:
             return
         now = self._now()
         from .. import telemetry as _tel
-        for cls in SLO_CLASSES:
-            q = self._queues[cls]
-            survivors = deque()
-            while q:
-                req = q.popleft()
-                if req.deadline is not None and now > req.deadline:
-                    self._deadline_misses += 1
-                    _tel.counter("serve.deadline_miss").inc()
-                    if _tel.active():
-                        _tel.emit("serve.deadline_miss",
-                                  req=req.req_id, slo=req.slo,
-                                  late_ms=round(
-                                      (now - req.deadline) * 1e3, 3))
-                    self._shed(req, "deadline")
-                else:
-                    survivors.append(req)
-            self._queues[cls] = survivors
+        with self._qlock:
+            for cls in SLO_CLASSES:
+                q = self._queues[cls]
+                survivors = deque()
+                while q:
+                    req = q.popleft()
+                    if req.deadline is not None and now > req.deadline:
+                        self._deadline_misses += 1
+                        _tel.counter("serve.deadline_miss").inc()
+                        if _tel.active():
+                            _tel.emit("serve.deadline_miss",
+                                      req=req.req_id, slo=req.slo,
+                                      late_ms=round(
+                                          (now - req.deadline) * 1e3,
+                                          3))
+                        self._shed(req, "deadline")
+                    else:
+                        survivors.append(req)
+                self._queues[cls] = survivors
 
     def _requeue(self, req: Request):
         """Put a faulted-slot request back into its class queue AT ITS
         ARRIVAL POSITION (strict FIFO by arrival survives requeues)."""
-        q = self._queues[req.slo]
-        idx = 0
-        while idx < len(q) and q[idx].arrival < req.arrival:
-            idx += 1
-        q.insert(idx, req)
+        with self._qlock:
+            q = self._queues[req.slo]
+            idx = 0
+            while idx < len(q) and q[idx].arrival < req.arrival:
+                idx += 1
+            q.insert(idx, req)
         self._requeue_count += 1
         from .. import telemetry as _tel
         _tel.counter("serve.requeue").inc()
@@ -836,10 +857,11 @@ class ContinuousBatcher:
         grace = float(os.environ.get("PADDLE_DRAIN_GRACE", "60"))
         self._drain_deadline = self._now() + grace
         n_shed = 0
-        for q in self._queues.values():
-            while q:
-                self._shed(q.popleft(), "drain")
-                n_shed += 1
+        with self._qlock:
+            for q in self._queues.values():
+                while q:
+                    self._shed(q.popleft(), "drain")
+                    n_shed += 1
         from .. import telemetry as _tel
         _tel.counter("serve.drains").inc()
         if _tel.active():
@@ -903,6 +925,61 @@ class ContinuousBatcher:
         return int(sum(int(np.prod(a.shape)) * a.dtype.itemsize
                        for a in leaves))
 
+    def _attainment_of(self, cls: str) -> Optional[float]:
+        """Per-SLO-class attainment, THE derivation stats() and the
+        router's balance view share: deadline-bearing traffic reports
+        admitted-in-time / deadlined; deadline-free traffic reports
+        the served fraction; None with no signal yet (a fresh replica
+        is 'headroom', not 'failing')."""
+        rec = self._slo_lat[cls]
+        shed = self._shed_by_class[cls]
+        if rec["with_deadline"]:
+            return rec["deadline_met"] / rec["with_deadline"]
+        if rec["completed"] or shed:
+            return rec["completed"] / (rec["completed"] + shed)
+        return None
+
+    def prefix_match_len(self, input_ids) -> int:
+        """Prompt tokens of `input_ids` already resident in THIS
+        batcher's prefix cache — the prefill work an admission here
+        would skip (ISSUE 15 satellite).  A pure read-only trie probe
+        (PageAllocator.prefix_match_len): no page is pinned, no LRU
+        order perturbed, nothing admitted.  0 for the dense layout or
+        with prefix sharing off."""
+        if self.kv_layout != "paged" or not self.prefix_sharing:
+            return 0
+        ids = np.asarray(input_ids.value
+                         if isinstance(input_ids, Tensor)
+                         else input_ids, np.int32).reshape(-1)
+        return self._alloc.prefix_match_len(ids)
+
+    def router_view(self, prompt=None) -> Dict[str, object]:
+        """Compact host-plane policy view for the serve-fleet router
+        (inference/router.py) — everything pick_replica() weighs, and
+        the record a replica-per-rank worker publishes to the KV plane
+        (router.ReplicaPublisher, the r14 FleetSink key schema).  Much
+        cheaper than stats(): no latency summaries, no device reads.
+        With `prompt` the view carries this replica's
+        prefix_hit_tokens for it (read-only probe)."""
+        qbc = self.queue_snapshot()
+        view: Dict[str, object] = {
+            "queued": sum(qbc.values()),
+            "queued_by_class": qbc,
+            "active": self.active,
+            "slots": self.B,
+            "draining": self._draining,
+            "shed_rate": round(self._shed_count / self._submitted, 4)
+            if self._submitted else 0.0,
+            "attainment": {c: self._attainment_of(c)
+                           for c in SLO_CLASSES},
+        }
+        if self.kv_layout == "paged":
+            view["kv_pages_free"] = self._alloc.pages_free
+            view["kv_pages_cached"] = self._alloc.pages_cached
+        if prompt is not None:
+            view["prefix_hit_tokens"] = self.prefix_match_len(prompt)
+        return view
+
     def stats(self) -> Dict[str, object]:
         """Scheduler counters for the serve bench: slot occupancy,
         prefill-vs-decode token split, per-chunk wall times (p50 over
@@ -916,6 +993,9 @@ class ContinuousBatcher:
         n = self._chunk_count
         occ = (self._occupancy_total / (n * self.B)) if n else 0.0
         times = sorted(self._chunk_times)
+        qbc = self.queue_snapshot()     # ONE atomic view: "queued"
+        #                                 and the per-class counts can
+        #                                 never disagree (ISSUE 15)
         out = {
             "chunks": n,
             "decode_chunks": self._chunk_kind_counts["decode"],
@@ -946,7 +1026,8 @@ class ContinuousBatcher:
             "chunk_retries": self._chunk_retries,
             "hung_chunks": self._hung_chunks,
             "callback_errors": self._cb_errors,
-            "queued": self._queued_count(),
+            "queued": sum(qbc.values()),
+            "queued_by_class": qbc,
             "drained": self._draining,
         }
         wo = getattr(self.model, "_weight_only", None)
@@ -992,16 +1073,9 @@ class ContinuousBatcher:
         for cls in SLO_CLASSES:
             rec = dict(self._slo_lat[cls])
             rec["shed"] = self._shed_by_class[cls]
-            if rec["with_deadline"]:
-                # deadline-bearing traffic: admitted in time / deadlined
-                rec["attainment"] = round(
-                    rec["deadline_met"] / rec["with_deadline"], 4)
-            elif rec["completed"] or rec["shed"]:
-                # best-effort notion for deadline-free traffic: the
-                # served fraction
-                rec["attainment"] = round(
-                    rec["completed"] / (rec["completed"] + rec["shed"]),
-                    4)
+            att = self._attainment_of(cls)
+            if att is not None:
+                rec["attainment"] = round(att, 4)
             attain[cls] = rec
         out["slo_attainment"] = attain
         if self.kv_layout == "paged":
@@ -1069,7 +1143,15 @@ class ContinuousBatcher:
         running, which means the pool can never serve this request:
         that raises.  Injected faults (`serve.admit` /
         `serve.kv_alloc`) retry FIFO-in-place, bounded by
-        FLAGS_serve_retry_budget."""
+        FLAGS_serve_retry_budget.
+
+        Runs under the queue lock: admission pops heads while a
+        concurrent submit() may be appending — the router's balance
+        snapshots must order against both."""
+        with self._qlock:
+            return self._admit_locked()
+
+    def _admit_locked(self):
         from ..distributed import fault
         free = [i for i in range(self.B) if self._slots[i] is None]
 

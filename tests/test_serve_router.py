@@ -1,0 +1,642 @@
+"""Serve-fleet router (ISSUE 15): prefix-aware, SLO-aware routing
+across N ContinuousBatcher replicas with lossless drain-and-requeue.
+
+The contracts under test:
+
+  * POLICY — pick_replica() in isolation over synthetic views: prefix
+    hit beats a shorter queue, the interactive SLO-attainment floor
+    overrides prefix affinity, a draining replica is never picked,
+    ties break deterministically.
+  * PROBE — PageAllocator.prefix_match_len is a pure read-only trie
+    walk: no page pinned, no LRU clock tick, the eviction order
+    byte-identical with or without a probe in between.
+  * ATOMIC QUEUES — the batcher's per-class queue snapshot is one
+    consistent view against a concurrent submit storm (the ISSUE 15
+    torn-read bugfix).
+  * FLEET — a 2-replica router serves the workload bit-exact vs a
+    single-replica reference; replica kill migrates queued AND
+    mid-decode requests losslessly (no duplicate streamed tokens,
+    survivor KV pools leak-free) — `chaos_check --serve
+    --replica-kill` wired tier-1 through run_router_kill.
+  * HOST-PLANE — per-replica compiled serve programs stay exactly 2
+    per shape (shared through the model program cache), program keys
+    untouched by routing.
+  * KV PLANE — ReplicaPublisher/discover_replicas round-trip the
+    router views through a real launch KVServer (the r14 FleetSink
+    key schema).
+"""
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.inference import (ContinuousBatcher, ServeRouter,
+                                  fleet_serve, pick_replica)
+from paddle_tpu.inference.paged_kv import PageAllocator
+from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                     llama_tiny_config)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(7)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            intermediate_size=128,
+                            num_attention_heads=4,
+                            num_key_value_heads=2, vocab_size=128)
+    return LlamaForCausalLM(cfg)
+
+
+def _bat(model, **kw):
+    geom = dict(max_batch_size=1, max_len=64, chunk=4, prefill_chunk=4)
+    geom.update(kw)
+    return ContinuousBatcher(model, **geom)
+
+
+def _view(replica, hit=0, queued=0, active=0, slots=1, draining=False,
+          shed_rate=0.0, interactive_att=None):
+    return {"replica": replica, "prefix_hit_tokens": hit,
+            "queued": queued, "active": active, "slots": slots,
+            "draining": draining, "shed_rate": shed_rate,
+            "attainment": {"interactive": interactive_att,
+                           "batch": None, "best_effort": None}}
+
+
+# ---------------------------------------------------------------------------
+# routing policy in isolation (no batcher construction)
+# ---------------------------------------------------------------------------
+
+def test_pick_prefix_hit_beats_shorter_queue():
+    # replica 0 idle but cold; replica 1 queues 1 deep but holds a
+    # 64-token resident prefix — the skipped prefill outweighs the wait
+    views = [_view(0, hit=0, queued=0), _view(1, hit=64, queued=1)]
+    assert pick_replica(views, prefix_weight=1.0) == 1
+
+
+def test_pick_load_wins_when_prefix_small():
+    # a 4-token hit does not buy a 3-deep queue
+    views = [_view(0, hit=0, queued=0), _view(1, hit=4, queued=3)]
+    assert pick_replica(views, prefix_weight=1.0) == 0
+
+
+def test_pick_prefix_weight_zero_disables_affinity():
+    views = [_view(0, hit=0, queued=0), _view(1, hit=512, queued=1)]
+    assert pick_replica(views, prefix_weight=0.0) == 0
+
+
+def test_pick_attainment_floor_overrides_prefix():
+    # interactive traffic never lands on a replica missing its floor
+    # while another has headroom — even against a huge prefix hit
+    views = [_view(0, hit=256, interactive_att=0.3),
+             _view(1, hit=0, interactive_att=0.99)]
+    assert pick_replica(views, slo="interactive",
+                        attainment_floor=0.9) == 1
+    # batch traffic is not floored: the prefix wins
+    assert pick_replica(views, slo="batch",
+                        attainment_floor=0.9) == 0
+    # no attainment signal yet = headroom, not failure
+    views = [_view(0, hit=256, interactive_att=None),
+             _view(1, hit=0, interactive_att=0.99)]
+    assert pick_replica(views, slo="interactive",
+                        attainment_floor=0.9) == 0
+
+
+def test_pick_floor_waived_when_everyone_below():
+    # degraded service beats no service: all below floor -> best score
+    views = [_view(0, hit=32, interactive_att=0.2),
+             _view(1, hit=0, interactive_att=0.1)]
+    assert pick_replica(views, slo="interactive",
+                        attainment_floor=0.9) == 0
+
+
+def test_pick_draining_never_picked():
+    views = [_view(0, hit=512, draining=True), _view(1, queued=5)]
+    assert pick_replica(views) == 1
+    assert pick_replica([_view(0, draining=True),
+                         _view(1, draining=True)]) is None
+
+
+def test_pick_deterministic_tie_break():
+    # identical scores -> lowest replica id, every time
+    views = [_view(2), _view(0), _view(1)]
+    assert all(pick_replica(list(views)) == 0 for _ in range(8))
+    # fewer queued breaks a score tie before the id does (hit pays
+    # exactly for the queue difference at queue_cost=16)
+    views = [_view(0, hit=16, queued=1), _view(1, hit=0, queued=0)]
+    assert pick_replica(views, prefix_weight=1.0, queue_cost=16.0) == 1
+
+
+def test_pick_shed_rate_penalized():
+    views = [_view(0, shed_rate=0.5), _view(1, shed_rate=0.0)]
+    assert pick_replica(views) == 1
+
+
+# ---------------------------------------------------------------------------
+# the read-only prefix probe (satellite 1)
+# ---------------------------------------------------------------------------
+
+def _filled_alloc():
+    """An allocator with one 3-page prompt registered + completed."""
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    prompt = list(range(100, 112))          # 3 full pages
+    plan = alloc.admit(prompt + [1], covered_pages=4)
+    assert plan is not None
+    for node in plan.nodes:
+        alloc.complete_node(node)
+    alloc.release_plan(plan)                # pages go cached
+    return alloc, prompt
+
+
+def test_prefix_match_len_counts_full_and_partial():
+    alloc, prompt = _filled_alloc()
+    assert alloc.prefix_match_len(prompt + [1, 2]) == 12
+    # mid-page divergence: 2 full pages + 2 partial tokens
+    assert alloc.prefix_match_len(prompt[:8] + [108, 109, 7, 7]) == 10
+    assert alloc.prefix_match_len([9, 9, 9, 9, 9]) == 0
+    # the cap mirrors admit(): the final token always prefills, so a
+    # prompt that IS the cached chunk matches len-1
+    assert alloc.prefix_match_len(prompt[:4]) == 3
+    assert alloc.prefix_match_len([]) == 0
+    assert alloc.prefix_match_len([5]) == 0
+
+
+def test_prefix_probe_is_pure():
+    """Probing pins nothing and never perturbs eviction order."""
+    alloc, prompt = _filled_alloc()
+    ref = dict(alloc._ref)
+    clock = alloc._clock
+    lru = {n.page: n.lru for n in alloc._node_of.values()}
+    for _ in range(16):
+        alloc.prefix_match_len(prompt + [3])
+        alloc.prefix_match_len(prompt[:6])
+    assert dict(alloc._ref) == ref          # no page pinned
+    assert alloc._clock == clock            # no LRU touch
+    assert {n.page: n.lru
+            for n in alloc._node_of.values()} == lru
+    # and the accounting counters never move: a probe is not a hit
+    assert alloc.cow_copies == 0 and alloc.prefix_hit_tokens == 0
+
+
+def test_prefix_probe_does_not_change_eviction_order():
+    # two identical allocators; one is probed between admissions —
+    # pressure must evict the SAME victim pages in the same order
+    def scenario(probe):
+        alloc = PageAllocator(num_pages=6, page_size=2)
+        order = []
+        for base in (10, 20):               # two cached 2-page chains
+            plan = alloc.admit([base, base + 1, base + 2, base + 3,
+                                base + 9], covered_pages=2)
+            for node in plan.nodes:
+                alloc.complete_node(node)
+            alloc.release_plan(plan)
+        if probe:
+            alloc.prefix_match_len([10, 11, 12, 13, 99])
+            alloc.prefix_match_len([20, 21, 99])
+        evicted_before = alloc.evictions
+        got = alloc.alloc(4)                # forces evictions
+        order.append((sorted(got), alloc.evictions - evicted_before))
+        return order
+    assert scenario(False) == scenario(True)
+
+
+def test_batcher_prefix_match_len(model):
+    bat = _bat(model, page_size=8)
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(1, 128, 20).astype(np.int32)
+    assert bat.prefix_match_len(prompt) == 0
+    bat.submit(prompt, 4)
+    bat.run()
+    got = bat.prefix_match_len(prompt)
+    assert got == 16                        # 2 complete 8-token pages
+    dense = _bat(model, kv_layout="dense")
+    assert dense.prefix_match_len(prompt) == 0
+
+
+# ---------------------------------------------------------------------------
+# atomic queue snapshot (satellite 2)
+# ---------------------------------------------------------------------------
+
+def test_queue_snapshot_consistent_under_submit_storm(model):
+    bat = _bat(model, max_batch_size=2)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 128, 5).astype(np.int32)
+               for _ in range(60)]
+    stop = threading.Event()
+    torn = []
+
+    def reader():
+        while not stop.is_set():
+            snap = bat.queue_snapshot()
+            st_q = bat.queued
+            # the snapshot itself is internally consistent, and the
+            # aggregate property can never run AHEAD of a later
+            # snapshot (submissions only grow the queue here)
+            snap2 = bat.queue_snapshot()
+            if sum(snap.values()) > sum(snap2.values()):
+                torn.append((snap, snap2))
+            if st_q > sum(snap2.values()):
+                torn.append((st_q, snap2))
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        for i, p in enumerate(prompts):
+            bat.submit(p, 2, slo=("interactive", "batch",
+                                  "best_effort")[i % 3])
+    finally:
+        stop.set()
+        t.join()
+    assert not torn, torn[:3]
+    snap = bat.queue_snapshot()
+    assert sum(snap.values()) == 60
+    st = bat.stats()
+    assert st["queued"] == 60
+    assert st["queued_by_class"] == snap
+    bat.run()
+
+
+def test_router_view_schema(model):
+    bat = _bat(model)
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(1, 128, 6).astype(np.int32)
+    bat.submit(prompt, 3)
+    v = bat.router_view(prompt)
+    for k in ("queued", "queued_by_class", "active", "slots",
+              "draining", "shed_rate", "attainment",
+              "prefix_hit_tokens"):
+        assert k in v, (k, v)
+    assert v["queued"] == 1 and v["slots"] == 1
+    assert not v["draining"]
+    bat.run()
+    v2 = bat.router_view()
+    assert v2["queued"] == 0 and "prefix_hit_tokens" not in v2
+    assert v2["attainment"]["batch"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# the fleet: routing, kill, requeue (tentpole + satellite 5 wiring)
+# ---------------------------------------------------------------------------
+
+def _workload(rng, n=6):
+    lens = (6, 11, 4, 9, 13, 5)[:n]
+    news = (6, 5, 7, 4, 6, 5)[:n]
+    return [rng.randint(1, 128, L).astype(np.int32) for L in lens], news
+
+
+def test_fleet_bit_exact_vs_single_replica(model):
+    rng = np.random.RandomState(5)
+    prompts, news = _workload(rng)
+    ref_bat = ContinuousBatcher(model, max_batch_size=2, max_len=64,
+                                chunk=4, prefill_chunk=4)
+    rids = [ref_bat.submit(p, n) for p, n in zip(prompts, news)]
+    ref_outs = ref_bat.run()
+
+    router = ServeRouter(batchers=[_bat(model) for _ in range(2)])
+    gids = [router.submit(p, n) for p, n in zip(prompts, news)]
+    outs = router.run()
+    st = router.stats()
+    for rid, gid in zip(rids, gids):
+        assert (outs[gid] == ref_outs[rid]).all()
+    assert st["requests_completed"] == len(gids)
+    assert st["requests_shed"] == 0
+    assert all(v > 0 for v in st["routed_by_replica"].values()), st
+    assert st["decision_ms"]["count"] == len(gids)
+
+
+def test_fleet_two_programs_per_shape(model):
+    """Acceptance pin (ISSUE 15): N same-geometry replicas share the
+    model-level program cache — a whole 3-replica fleet run at a FRESH
+    shape compiles exactly 2 serve-step programs total
+    (recompile_guard raises with avals past the bound), each batcher
+    reports <= 2, and no key beyond the single-batcher pair exists."""
+    from paddle_tpu.analysis import recompile_guard
+    rng = np.random.RandomState(6)
+    prompts, news = _workload(rng, 4)
+    bats = [_bat(model, max_len=56) for _ in range(3)]   # fresh shape
+    keys = {bats[0]._program_key(1, bats[0].chunk),
+            bats[0]._program_key(bats[0].prefill_chunk,
+                                 bats[0].admit_steps)}
+    router = ServeRouter(batchers=bats)
+    for p, n in zip(prompts, news):
+        router.submit(p, n)
+    with recompile_guard(max_programs=2, match="serve_step"):
+        router.run()
+    for b in bats:
+        assert b.compiled_programs <= 2
+        assert b._programs_used <= keys, b._programs_used
+
+
+def test_kill_replica_requeues_queued_lossless(model):
+    rng = np.random.RandomState(5)
+    prompts, news = _workload(rng)
+    router = ServeRouter(batchers=[_bat(model) for _ in range(2)])
+    gids = [router.submit(p, n, slo=s) for p, n, s in
+            zip(prompts, news, ("interactive", "batch", "best_effort",
+                                "interactive", "batch", "batch"))]
+    victim = max(range(2), key=lambda i: router._reps[i].bat.queued)
+    assert router._reps[victim].bat.queued > 0
+    migrated = router.kill_replica(victim)
+    assert migrated > 0
+    outs = router.run()
+    st = router.stats()
+    assert st["requests_requeued"] == migrated
+    assert st["requests_shed"] == 0
+    assert st["requests_completed"] == len(gids)
+    assert st["live_replicas"] == 1
+    ref2 = ContinuousBatcher(model, max_batch_size=2, max_len=64,
+                             chunk=4, prefill_chunk=4)
+    rids = [ref2.submit(p, n) for p, n in zip(prompts, news)]
+    ref_outs = ref2.run()
+    for rid, gid in zip(rids, gids):
+        assert (outs[gid] == ref_outs[rid]).all()
+
+
+def test_kill_mid_decode_no_duplicate_streamed_tokens(model):
+    rng = np.random.RandomState(5)
+    prompts, news = _workload(rng)
+    streams = {}
+
+    def cb(gid, toks, done):
+        streams.setdefault(gid, []).extend(toks)
+
+    router = ServeRouter(batchers=[_bat(model) for _ in range(2)])
+    gids = [router.submit(p, n, on_token=cb)
+            for p, n in zip(prompts, news)]
+    victim = None
+    for _ in range(32):
+        router.step()
+        for i, rep in enumerate(router._reps):
+            live = [r for r in rep.bat._slots if r is not None]
+            if any(r.delivered for r in live):
+                victim = i
+                break
+        if victim is not None:
+            break
+    assert victim is not None
+    migrated = router.kill_replica(victim)
+    assert migrated > 0
+    outs = router.run()
+    for gid in gids:
+        got = list(map(int, outs[gid]))
+        assert streams.get(gid, []) == got, \
+            f"gid {gid}: streamed {streams.get(gid)} vs output {got}"
+    # survivor pools leak-free: slots freed, only cached prefix pages
+    for rep in router._reps:
+        if not rep.dead:
+            assert rep.bat._alloc.pages_used \
+                == rep.bat._alloc.pages_cached
+
+
+def test_requeue_preserves_arrival_order_and_deadline(model):
+    router = ServeRouter(batchers=[_bat(model) for _ in range(2)])
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(1, 128, 6).astype(np.int32)
+               for _ in range(4)]
+    gids = [router.submit(p, 3, deadline_ms=60000.0) for p in prompts]
+    victim = max(range(2), key=lambda i: router._reps[i].bat.queued)
+    rr_deadlines = {g: router._reqs[g].deadline for g in gids}
+    router.kill_replica(victim)
+    survivor = next(r for r in router._reps if not r.dead)
+    with survivor.bat._qlock:
+        arrivals = [r.arrival for q in survivor.bat._queues.values()
+                    for r in q]
+        deadlines = {survivor.local2g[r.req_id]: r.deadline
+                     for q in survivor.bat._queues.values() for r in q}
+    assert arrivals == sorted(arrivals)     # global FIFO survived
+    for g, dl in deadlines.items():
+        assert dl == rr_deadlines[g]        # absolute deadline kept
+    router.run()
+
+
+def test_drain_replica_graceful(model):
+    """drain_replica migrates only QUEUED work; in-flight finishes on
+    the replica, which then retires — nothing re-decoded or lost."""
+    rng = np.random.RandomState(8)
+    prompts, news = _workload(rng, 4)
+    router = ServeRouter(batchers=[_bat(model) for _ in range(2)])
+    gids = [router.submit(p, n) for p, n in zip(prompts, news)]
+    router.step()
+    victim = max(range(2), key=lambda i: router._reps[i].bat.queued)
+    in_flight = router._reps[victim].bat.active
+    migrated = router.drain_replica(victim)
+    outs = router.run()
+    st = router.stats()
+    assert st["requests_completed"] == len(gids)
+    assert router._reps[victim].dead          # retired once empty
+    assert st["requests_requeued"] == migrated
+    if in_flight:
+        # the in-flight decode finished on the draining replica
+        assert st["requests_requeued"] < len(gids)
+    assert sorted(outs) == sorted(gids)
+
+
+def test_all_replicas_draining_sheds_with_no_leak(model):
+    router = ServeRouter(batchers=[_bat(model)])
+    router.drain_replica(0)
+    rng = np.random.RandomState(9)
+    gid = router.submit(rng.randint(1, 128, 5).astype(np.int32), 3)
+    outs = router.run()
+    st = router.stats()
+    assert gid in outs and len(outs[gid]) == 0
+    assert st["requests_shed"] == 1
+    assert st["requests_submitted"] == st["requests_completed"] \
+        + st["requests_shed"]
+
+
+def test_rebalance_moves_queued_to_idle(model):
+    set_flags({"FLAGS_router_rebalance_ms": 0.001})
+    try:
+        router = ServeRouter(batchers=[_bat(model) for _ in range(2)])
+        rng = np.random.RandomState(4)
+        # pin every submit onto replica 0 by faking replica 1 as
+        # draining during submission, then un-drain it: the rebalance
+        # sweep must move queued work across
+        router._reps[1].draining = True
+        prompts, news = _workload(rng, 4)
+        gids = [router.submit(p, n) for p, n in zip(prompts, news)]
+        assert router.stats()["routed_by_replica"][1] == 0
+        router._reps[1].draining = False
+        outs = router.run()
+        st = router.stats()
+        assert st["rebalanced"] > 0
+        assert st["requests_completed"] == len(gids)
+        assert sorted(outs) == sorted(gids)
+    finally:
+        set_flags({"FLAGS_router_rebalance_ms": 0.0})
+
+
+def test_direct_batcher_request_survives_kill_and_rebalance(model):
+    """A request submitted STRAIGHT to an underlying batcher (not
+    through the router) is not router-managed: rebalance must never
+    move it, a graceful drain leaves it to finish in place, and a
+    kill sheds it through the batcher so the batcher's own no-leak
+    accounting stays whole — it can never silently vanish."""
+    set_flags({"FLAGS_router_rebalance_ms": 0.001})
+    try:
+        rng = np.random.RandomState(12)
+        bats = [_bat(model) for _ in range(2)]
+        router = ServeRouter(batchers=bats)
+        direct = bats[0].submit(rng.randint(1, 128, 6)
+                                .astype(np.int32), 3)
+        gids = [router.submit(rng.randint(1, 128, 5).astype(np.int32),
+                              3) for _ in range(3)]
+        router.run()
+        assert direct in bats[0]._finished          # finished in place
+        assert not bats[0]._finished[direct].shed
+        # and under a kill: the direct request sheds ON the batcher
+        bats2 = [_bat(model) for _ in range(2)]
+        router2 = ServeRouter(batchers=bats2)
+        direct2 = bats2[0].submit(rng.randint(1, 128, 6)
+                                  .astype(np.int32), 3)
+        g = router2.submit(rng.randint(1, 128, 5).astype(np.int32), 3)
+        router2.kill_replica(0)
+        outs = router2.run()
+        assert g in outs
+        st0 = bats2[0].stats()
+        assert st0["requests_submitted"] \
+            == st0["requests_completed"] + st0["requests_shed"]
+        assert bats2[0]._finished[direct2].shed
+    finally:
+        set_flags({"FLAGS_router_rebalance_ms": 0.0})
+
+
+def test_prefix_probe_skipped_when_weight_zero(model, monkeypatch):
+    """FLAGS_router_prefix_weight=0 disables prefix affinity — the
+    routing hot path must not pay the O(replicas x prompt) trie
+    probes whose result it would multiply by zero."""
+    calls = []
+    orig = ContinuousBatcher.prefix_match_len
+
+    def counting(self, ids):
+        calls.append(1)
+        return orig(self, ids)
+
+    monkeypatch.setattr(ContinuousBatcher, "prefix_match_len",
+                        counting)
+    router = ServeRouter(batchers=[_bat(model) for _ in range(2)])
+    rng = np.random.RandomState(13)
+    prompt = rng.randint(1, 128, 6).astype(np.int32)
+    set_flags({"FLAGS_router_prefix_weight": 0.0})
+    try:
+        router.submit(prompt, 3)
+        assert not calls
+    finally:
+        set_flags({"FLAGS_router_prefix_weight": 1.0})
+    router.submit(prompt, 3)
+    assert len(calls) == 2          # flag back on: one probe/replica
+    router.run()
+
+
+def test_fleet_serve_helper_reads_flag(model):
+    set_flags({"FLAGS_serve_replicas": 3})
+    try:
+        router = fleet_serve(model, max_batch_size=1, max_len=64,
+                             chunk=4, prefill_chunk=4)
+        assert router.replicas == 3
+    finally:
+        set_flags({"FLAGS_serve_replicas": 0})
+    router = fleet_serve(model, replicas=2, max_batch_size=1,
+                         max_len=64, chunk=4, prefill_chunk=4)
+    assert router.replicas == 2
+
+
+# ---------------------------------------------------------------------------
+# KV-plane discovery (replica-per-rank mode)
+# ---------------------------------------------------------------------------
+
+def test_kv_publish_discover_roundtrip(model):
+    from paddle_tpu.distributed.launch.master import KVServer, KVClient
+    from paddle_tpu.inference.router import (ReplicaPublisher,
+                                             discover_replicas)
+    srv = KVServer(0).start()
+    try:
+        kv = KVClient(f"127.0.0.1:{srv.port}")
+        router = ServeRouter(batchers=[_bat(model) for _ in range(2)],
+                             kv=kv, job_id="routertest")
+        rng = np.random.RandomState(3)
+        prompts, news = _workload(rng, 4)
+        for p, n in zip(prompts, news):
+            router.submit(p, n)
+        router.run()
+        views = discover_replicas(kv, "routertest")
+        assert sorted(views) == [0, 1]
+        for rid, v in views.items():
+            assert v["replica"] == rid
+            for k in ("queued", "active", "slots", "attainment"):
+                assert k in v, (rid, v)
+        # the discovered views feed the same policy function
+        assert pick_replica(list(views.values())) in (0, 1)
+        # heartbeats stamped with the master clock
+        for rid in (0, 1):
+            assert kv.get(f"routertest/serve/{rid}/hb") is not None
+        # a standalone worker-side publisher (subprocess mode) lands
+        # in the same namespace
+        pub = ReplicaPublisher(kv, job_id="routertest", replica=7)
+        bat = _bat(model)
+        assert pub.publish(bat.router_view())
+        assert 7 in discover_replicas(kv, "routertest")
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos CLI wiring (satellite 5) + host-plane contract
+# ---------------------------------------------------------------------------
+
+def test_chaos_replica_kill_specs():
+    """The two chaos_check --serve replica-kill specs pass: queued
+    requeue and mid-decode requeue, both bit-exact vs the fault-free
+    single-replica reference."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_check as cli
+    finally:
+        sys.path.pop(0)
+    for mode in ("queued", "mid_decode"):
+        rep = cli.run_router_kill(mode)
+        assert rep["fired"], rep
+        assert rep["ok"], rep
+
+
+def test_router_emits_telemetry_events(model):
+    from paddle_tpu import telemetry
+
+    class Probe:
+        def __init__(self):
+            self.records = []
+
+        def record(self, rec):
+            self.records.append(rec)
+
+    probe = Probe()
+    telemetry.add_sink(probe)
+    try:
+        router = ServeRouter(batchers=[_bat(model) for _ in range(2)])
+        rng = np.random.RandomState(1)
+        prompts, news = _workload(rng, 3)
+        for p, n in zip(prompts, news):
+            router.submit(p, n)
+        victim = max(range(2),
+                     key=lambda i: router._reps[i].bat.queued)
+        router.kill_replica(victim)
+        router.run()
+    finally:
+        telemetry.remove_sink(probe)
+    kinds = {}
+    for r in probe.records:
+        kinds.setdefault(r.get("event"), []).append(r)
+    assert len(kinds.get("router.route", [])) == 3
+    for e in kinds["router.route"]:
+        for k in ("req", "slo", "replica", "prefix_hit",
+                  "decision_ms"):
+            assert k in e, e
+    assert kinds.get("router.kill"), kinds.keys()
+    assert kinds["router.kill"][0]["replica"] == victim
+    for e in kinds.get("router.requeue", []):
+        assert e["frm"] == victim and "delivered" in e

@@ -57,6 +57,16 @@ Serve-plane modes (ISSUE 9):
       completed + shed; every submitted id present in the results;
       requeued requests completed exactly once).
 
+  python tools/chaos_check.py --serve --replica-kill queued|mid_decode
+      Serve-FLEET chaos (ISSUE 15): the mixed-SLO workload through a
+      2-replica ServeRouter, one replica killed while it still queues
+      (queued) or once an in-flight decode has streamed tokens
+      (mid_decode).  Passes iff the kill migrated work onto the
+      survivor, every request completed with outputs BIT-EXACT vs a
+      fault-free single-replica reference, no streamed token was
+      delivered twice, and the survivors' KV pools are leak-free
+      (pages_used == pages_cached after the drain).
+
   python tools/chaos_check.py --serve --selftest
       One planted fault per serve injection point (admission fault
       retried, admission rejected->shed, KV-alloc fault deferred,
@@ -416,6 +426,99 @@ def run_serve(spec, stop_check_timeout=None, speculative=False):
             "programs": st["compiled_programs"], "ok": ok}
 
 
+def run_router_kill(mode="queued"):
+    """Serve-fleet replica-kill chaos (ISSUE 15): the mixed-SLO
+    workload through a 2-replica ServeRouter (1 slot each, so queues
+    form), one replica killed mid-run — `mode="queued"` while it still
+    holds QUEUED requests, `mode="mid_decode"` once it holds an
+    in-flight decode with streamed tokens out the door.  Passes iff
+    the kill migrated work (requeued > 0; mid_decode additionally
+    migrated a request that had already streamed tokens), EVERY
+    request completed (nothing shed), every output is BIT-EXACT vs a
+    fault-free single-replica reference, no streamed token was ever
+    delivered twice, and the surviving replicas' KV pools are
+    leak-free after the drain (pages_used == pages_cached — only
+    cached prefix pages remain once every slot frees)."""
+    import numpy as np
+    from paddle_tpu.inference import ContinuousBatcher
+    from paddle_tpu.inference.router import ServeRouter
+
+    model = _serve_model()
+    prompts = _serve_prompts()
+    # fault-free single-replica reference of the same workload
+    _, ref_rids, ref_outs = _run_serve_workload(model)
+    ref = {i: list(map(int, ref_outs[r])) for i, r in enumerate(ref_rids)}
+
+    streams = {}
+
+    def cb(gid, toks, done):
+        streams.setdefault(gid, []).extend(toks)
+
+    bats = [ContinuousBatcher(model, max_batch_size=1, max_len=64,
+                              chunk=4, prefill_chunk=4)
+            for _ in range(2)]
+    router = ServeRouter(batchers=bats)
+    gids = []
+    for p, (_, n, slo) in zip(prompts[:2], _SERVE_WORKLOAD[:2]):
+        gids.append(router.submit(p, n, slo=slo, on_token=cb))
+    router.step()
+    for p, (_, n, slo) in zip(prompts[2:], _SERVE_WORKLOAD[2:]):
+        gids.append(router.submit(p, n, slo=slo, on_token=cb))
+
+    victim = None
+    delivered_at_kill = 0
+    if mode == "queued":
+        # kill the replica holding the deeper queue, while it queues
+        victim = max(range(2), key=lambda i: bats[i].queued)
+        assert bats[victim].queued > 0, "workload never queued"
+    else:
+        # step until some replica's in-flight request has streamed
+        # tokens — the kill then lands mid-decode with a delivered
+        # prefix the requeue must never re-send
+        for _ in range(32):
+            router.step()
+            for i, bat in enumerate(bats):
+                live = [r for r in bat._slots if r is not None]
+                if any(r.delivered for r in live):
+                    victim = i
+                    delivered_at_kill = max(r.delivered for r in live)
+                    break
+            if victim is not None:
+                break
+        assert victim is not None, "no mid-decode stream to kill"
+    migrated = router.kill_replica(victim)
+    outs = router.run()
+    st = router.stats()
+
+    mismatches = [i for i, g in enumerate(gids)
+                  if list(map(int, outs[g])) != ref[i]]
+    dup_streams = [g for g in gids
+                   if streams.get(g, []) != list(map(int, outs[g]))]
+    survivors = [r for r in router._reps if not r.dead]
+    leaks = [r.idx for r in survivors
+             if r.bat.kv_layout == "paged"
+             and r.bat._alloc.pages_used != r.bat._alloc.pages_cached]
+    accounting = (
+        sorted(outs) == sorted(gids)
+        and st["requests_submitted"] == len(gids)
+        and st["requests_completed"] == len(gids)
+        and st["requests_shed"] == 0
+        and st["requests_requeued"] == migrated)
+    fired = migrated > 0 and (mode != "mid_decode"
+                              or delivered_at_kill > 0)
+    programs_ok = all(b.compiled_programs <= 2 for b in bats)
+    ok = (fired and not mismatches and not dup_streams and not leaks
+          and accounting and programs_ok)
+    return {"mode": mode, "victim": victim, "migrated": migrated,
+            "fired": fired, "delivered_at_kill": delivered_at_kill,
+            "completed": st["requests_completed"],
+            "requeued": st["requests_requeued"],
+            "routed_by_replica": st["routed_by_replica"],
+            "mismatches": mismatches, "dup_streams": dup_streams,
+            "kv_leaks": leaks, "accounting_ok": accounting,
+            "programs_ok": programs_ok, "ok": ok}
+
+
 _DRAIN_WORKER = r'''
 import json, os, signal, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -516,6 +619,19 @@ def _serve_selftest():
         speculative=True)
     ok, detail = _serve_drain_check()
     record("serve.drain-sigterm-elastic-exit", ok, ok, detail)
+    # serve-fleet replica-kill specs (ISSUE 15): one replica of a
+    # 2-replica router fleet killed while it queues / mid-decode —
+    # lossless requeue onto the survivor, outputs bit-exact vs the
+    # single-replica fault-free reference, no duplicate streamed
+    # tokens, survivor KV pool leak-free
+    for mode in ("queued", "mid_decode"):
+        rep = run_router_kill(mode)
+        record(f"router.kill-{mode.replace('_', '-')}-requeue",
+               rep["fired"], rep["ok"],
+               json.dumps({k: rep[k] for k in
+                           ("victim", "migrated", "completed",
+                            "requeued", "mismatches", "dup_streams",
+                            "kv_leaks")}))
     return checks
 
 
@@ -919,6 +1035,10 @@ def main(argv=None):
                     help="exercise the SERVE plane (ContinuousBatcher "
                          "under serve.* specs / the serve selftest) "
                          "instead of the train loop")
+    ap.add_argument("--replica-kill", choices=["queued", "mid_decode"],
+                    help="with --serve: kill one replica of a "
+                         "2-replica router fleet (while it queues / "
+                         "mid-decode) and verify the lossless requeue")
     ap.add_argument("--fleet", action="store_true",
                     help="exercise the FLEET plane: an N-proc elastic "
                          "job, one rank killed mid-run, gang re-forms "
@@ -967,8 +1087,23 @@ def main(argv=None):
             if not rep["ok"]:
                 print(rep["tail"])
         return 0 if rep["ok"] else 1
+    if args.replica_kill:
+        if not args.serve:
+            ap.error("--replica-kill needs --serve")
+        rep = run_router_kill(args.replica_kill)
+        if args.as_json:
+            print(json.dumps(rep, indent=2))
+        else:
+            verdict = "RECOVERED" if rep["ok"] else "FAILED"
+            print(f"{verdict}: replica {rep['victim']} killed "
+                  f"({rep['mode']}), migrated={rep['migrated']}, "
+                  f"completed={rep['completed']}, "
+                  f"mismatches={rep['mismatches']}, "
+                  f"dup_streams={rep['dup_streams']}, "
+                  f"kv_leaks={rep['kv_leaks']}")
+        return 0 if rep["ok"] else 1
     if args.serve and not (args.selftest or args.spec):
-        ap.error("--serve needs --spec or --selftest")
+        ap.error("--serve needs --spec, --selftest or --replica-kill")
     if args.serve and args.spec and not args.selftest:
         rep = run_serve(args.spec)
         if args.as_json:

@@ -203,6 +203,46 @@ def analyze(events, peak=None):
             "verify_steps": steps,
         }
 
+    # serve-fleet router (ISSUE 15): per-replica routed/requeued
+    # counts, the prefix-route hit rate (routes whose chosen replica
+    # held a resident prefix) and the router's decision-time
+    # percentiles, from the router.* events ServeRouter emits
+    routes = [e for e in events if e.get("event") == "router.route"]
+    rreq = [e for e in events if e.get("event") == "router.requeue"]
+    rkill = [e for e in events if e.get("event") == "router.kill"]
+    rdrain = [e for e in events if e.get("event") == "router.drain"]
+    rshed = [e for e in events if e.get("event") == "router.shed"]
+    rreb = [e for e in events if e.get("event") == "router.rebalance"]
+    if routes or rreq or rkill or rdrain:
+        routed_by, hit, dec = {}, 0, []
+        for e in routes:
+            r = str(e.get("replica"))
+            routed_by[r] = routed_by.get(r, 0) + 1
+            if (e.get("prefix_hit") or 0) > 0:
+                hit += 1
+            if isinstance(e.get("decision_ms"), (int, float)):
+                dec.append(e["decision_ms"])
+        req_by = {}
+        for e in rreq:
+            r = str(e.get("to"))
+            req_by[r] = req_by.get(r, 0) + 1
+        fleet = {
+            "routed": len(routes),
+            "routed_by_replica": routed_by,
+            "prefix_route_hit_rate": round(hit / len(routes), 4)
+            if routes else 0.0,
+            "requeues": len(rreq),
+            "requeued_by_replica": req_by,
+            "kills": len(rkill),
+            "drains": len(rdrain),
+            "shed": len(rshed),
+            "rebalances": sum(e.get("moved", 1) for e in rreb),
+        }
+        if dec:
+            fleet["decision_ms_p50"] = round(_pct(dec, 50), 4)
+            fleet["decision_ms_p99"] = round(_pct(dec, 99), 4)
+        out.setdefault("serve", {})["fleet"] = fleet
+
     # per-request latency spans (ISSUE 10): queue/TTFT/TPOT/e2e
     # percentiles + per-SLO-class deadline attainment from the
     # serve.request events the batcher emits per delivered request
@@ -386,6 +426,19 @@ def render(rep):
                 f"{sp['verify_steps']} verify steps), "
                 f"accepted/step p50={sp['accepted_per_step_p50']} "
                 f"p99={sp['accepted_per_step_p99']}")
+        if "fleet" in s:
+            f = s["fleet"]
+            by = ", ".join(f"r{k}={v}" for k, v
+                           in sorted(f["routed_by_replica"].items()))
+            line = (f"  fleet     routed {f['routed']}"
+                    f"{' (' + by + ')' if by else ''}, prefix-hit "
+                    f"{f['prefix_route_hit_rate']}, requeues "
+                    f"{f['requeues']}, kills {f['kills']}, drains "
+                    f"{f['drains']}, rebalances {f['rebalances']}")
+            if "decision_ms_p50" in f:
+                line += (f", decide p50={f['decision_ms_p50']}/"
+                         f"p99={f['decision_ms_p99']}ms")
+            lines.append(line)
         if "robustness" in s:
             r = s["robustness"]
             by_cls = ", ".join(f"{c}={n}" for c, n
@@ -637,6 +690,54 @@ def _selftest():
                   and spec["accepted_per_step_p50"] > 1.0):
             problems.append(f"speculation section wrong: {spec}")
         print(render(prep))
+
+        # serve-fleet router leg (ISSUE 15): a 2-replica staggered
+        # shared-prefix workload must surface router.route events
+        # (replica + decision time) and a "fleet serve" report
+        # section with per-replica routed counts and a real
+        # prefix-route hit
+        rlog = os.path.join(d, "router.jsonl")
+        from paddle_tpu.inference.router import ServeRouter
+        sink = telemetry.attach_jsonl(rlog)
+        try:
+            bats = [ContinuousBatcher(model, max_batch_size=1,
+                                      max_len=32, chunk=4,
+                                      prefill_chunk=4, page_size=8)
+                    for _ in range(2)]
+            router = ServeRouter(batchers=bats)
+            shared = rng.randint(1, 64, 12).astype(np.int32)
+            tails = [rng.randint(1, 64, t).astype(np.int32)
+                     for t in (3, 4, 5, 6)]
+            for t in tails[:2]:
+                router.submit(np.concatenate([shared, t]), 4)
+            for _ in range(8):      # let the shared prefix land
+                router.step()
+            for t in tails[2:]:
+                router.submit(np.concatenate([shared, t]), 4)
+            router.run()
+        finally:
+            telemetry.remove_sink(sink)
+        revents = load_events(rlog)
+        routes = [e for e in revents
+                  if e.get("event") == "router.route"]
+        if len(routes) != 4:
+            problems.append(f"expected 4 router.route events, got "
+                            f"{len(routes)}")
+        for e in routes:
+            for k in ("req", "replica", "prefix_hit", "decision_ms"):
+                if k not in e:
+                    problems.append(f"router.route missing {k!r}: {e}")
+        rrep = analyze(revents)
+        fleet = rrep.get("serve", {}).get("fleet")
+        if not fleet:
+            problems.append(f"report missing fleet serve section: "
+                            f"{rrep}")
+        elif not (fleet["routed"] == 4
+                  and sum(fleet["routed_by_replica"].values()) == 4
+                  and fleet["prefix_route_hit_rate"] > 0
+                  and "decision_ms_p50" in fleet):
+            problems.append(f"fleet serve section wrong: {fleet}")
+        print(render(rrep))
     return problems
 
 
