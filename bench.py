@@ -348,6 +348,118 @@ def _timed_train_tokens(step, x, batch, seq, steps):
     return tokens_per_sec, spread, vals, final_loss[0]
 
 
+def bench_llama_overlap():
+    """llama_sharded_overlap (ISSUE 16): the ZeRO-3 sharded trainer
+    with bucketed gradient collectives overlapped with the backward
+    (FLAGS_comm_overlap / parallel/comm_overlap.py).
+
+    On TPU the step shards over every chip with the overlap engine
+    armed; the exposed-comm column comes from the trainer's own plan
+    through the cost ledger.  The CPU smoke run has one device (the
+    plan is inactive by design — nothing to overlap), so the column is
+    quoted from an 8-way MODELED plan over the same parameter list —
+    the same estimator, same ledger path, no chip time.  Either way
+    the leg emits `exposed_comm.on_ms` / `off_ms`, and perf_report.py
+    gates on_ms < off_ms: the overlap engine must never PREDICT more
+    exposed communication than the monolithic baseline."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM, LlamaConfig
+    from paddle_tpu.parallel import ShardedTrainStep
+    from paddle_tpu.parallel.comm_overlap import CommOverlapPlan
+    from paddle_tpu.distributed.topology import build_mesh
+    from paddle_tpu import telemetry
+    from paddle_tpu.telemetry import costledger
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=8192, hidden_size=2560,
+                          intermediate_size=6912,
+                          num_hidden_layers=14,
+                          num_attention_heads=20,
+                          num_key_value_heads=4,
+                          max_position_embeddings=2048,
+                          dtype="bfloat16", param_dtype="float32",
+                          recompute=True, recompute_layers=3,
+                          recompute_granularity="selective")
+        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        seq, steps = 2048, 8
+        bucket_mb = float(os.environ.get("BENCH_BUCKET_MB", "32"))
+        n_shard = len(jax.devices())
+    else:  # CPU smoke: tiny model, small buckets so the modeled plan
+        #    still exercises the multi-bucket (n>=2) overlap shape
+        cfg = LlamaConfig(vocab_size=256, hidden_size=128,
+                          intermediate_size=384, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=4,
+                          max_position_embeddings=256, dtype="float32")
+        batch, seq, steps = 2, 128, 3
+        bucket_mb = float(os.environ.get("BENCH_BUCKET_MB", "0.25"))
+        n_shard = len(jax.devices())
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    n_params = sum(int(np.prod(p.value.shape))
+                   for p in model.parameters())
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters(),
+                                 weight_decay=0.1)
+    mesh = build_mesh(sharding=n_shard) if n_shard > 1 \
+        else build_mesh(devices=jax.devices()[:1])
+    step = ShardedTrainStep(model, opt, mesh, sharding_stage=3,
+                            rematerialize=False, comm_overlap=True,
+                            comm_bucket_mb=bucket_mb)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    tokens_per_sec, spread, vals, floss = _timed_train_tokens(
+        step, x, batch, seq, steps)
+
+    label = "ShardedTrainStep.step.s3"
+    plan = step._overlap_plan
+    if plan is None:
+        # single-device smoke: model the 8-way plan over the same
+        # param list and attach it to the ledger exactly as the
+        # trainer would (verify() first — same static pre-flight)
+        names = [n for n, _ in model.named_parameters()]
+        shapes = [tuple(p.value.shape)
+                  for _, p in model.named_parameters()]
+        dts = [str(p.value.dtype) for _, p in model.named_parameters()]
+        plan = CommOverlapPlan.modeled(
+            names, shapes, dts, world=8, stage=3, bucket_mb=bucket_mb)
+        plan.verify()
+        costledger.note_comm(label, plan.comm_profile())
+
+    exposed = {}
+    try:
+        rec = telemetry.cost_report()["programs"].get(label) or {}
+        if "exposed_comm_ms" in rec:
+            exposed = {
+                "on_ms": rec["exposed_comm_ms"],
+                "off_ms": rec["exposed_comm_ms_monolithic"],
+                "comm_ms": rec["comm_ms"],
+                "buckets": rec["comm_buckets"],
+                "bytes": rec["comm_bytes"],
+                "overlap_efficiency": rec["overlap_efficiency"],
+                "modeled": step._overlap_plan is None,
+            }
+    except Exception as e:  # the column is telemetry, not the metric
+        exposed = {"error": str(e)[:120]}
+
+    from paddle_tpu.telemetry.costledger import model_train_flops
+    mfu = model_train_flops(n_params, tokens_per_sec) \
+        / chip_peak_flops()
+    unit = (f"tokens/s/chip (mfu={mfu:.3f}, "
+            f"params={n_params / 1e6:.0f}M, loss={floss:.3f}, "
+            f"buckets={len(plan.buckets)}, shard={n_shard})")
+    extra = {"exposed_comm": exposed,
+             "comm_overlap": step._overlap_plan is not None,
+             "bucket_mb": bucket_mb}
+    extra.update(_peak_hbm_fields())
+    extra.update(_cost_fields())
+    _emit("llama_sharded_overlap_tokens_per_sec_per_chip",
+          tokens_per_sec, unit, mfu / 0.40, spread, vals, extra=extra)
+
+
 def bench_longctx():
     """Long-context training (SURVEY §5.7): the same 1.0B llama at
     seq 16384 (8x the headline config), batch 1, through the Pallas
@@ -1085,6 +1197,7 @@ def bench_serve_all():
 CONFIGS = {
     "llama": bench_llama,
     "offload": lambda: bench_llama(offload=True),
+    "overlap": bench_llama_overlap,
     "bert": bench_bert,
     "resnet": bench_resnet,
     "unet": bench_unet,
@@ -1115,6 +1228,9 @@ _ALIASES = {
     "llama_decode_tokens_per_sec_per_chip": "decode",
     "llama_train_tokens_per_sec_per_chip": "llama",
     "llama_offload_train_tokens_per_sec_per_chip": "offload",
+    "comm_overlap": "overlap",
+    "llama_sharded_overlap": "overlap",
+    "llama_sharded_overlap_tokens_per_sec_per_chip": "overlap",
     "bert_base_train_tokens_per_sec_per_chip": "bert",
     "resnet50_cifar_images_per_sec": "resnet",
     "sd_unet_train_samples_per_sec": "unet",
@@ -1290,6 +1406,56 @@ def _assert_mfu_fusion_zero_overhead():
     assert on != off1, "MFU-fusion flags changed nothing in the program"
     assert "ef" not in keys_off and "ef" in keys_on, \
         f"optimizer state keys wrong: off={keys_off}, on={keys_on}"
+
+
+def _assert_comm_overlap_zero_overhead():
+    """FLAGS_comm_overlap is toggle-stable (ISSUE 16): building the
+    same tiny-llama step before, during and after toggling the flag
+    must yield identical flags-off StableHLO text both times — arming
+    and disarming the overlap engine leaves zero residue in the
+    flags-off program.  On a single-device mesh the flag-ON program
+    must ALSO be byte-identical (no cross-rank comm exists to overlap
+    — the plan correctly declines to build); the multi-device
+    "genuinely engages + stays bit-exact" half is tier-1-pinned on the
+    8-virtual-device mesh (tests/test_comm_overlap.py), which this
+    bench process does not have.  Cheap (tiny llama, lowering only),
+    runs before every bench config."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+    from paddle_tpu.parallel import ShardedTrainStep
+    from paddle_tpu.distributed.topology import build_mesh
+
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 512, (2, 16)).astype(np.int32))
+
+    def build(overlap):
+        set_flags({"FLAGS_comm_overlap": overlap})
+        try:
+            paddle.seed(0)
+            m = LlamaForCausalLM(llama_tiny_config())
+            opt = paddle.optimizer.AdamW(
+                1e-3, parameters=m.parameters(), weight_decay=0.1)
+            step = ShardedTrainStep(
+                m, opt, build_mesh(devices=jax.devices()[:1]),
+                sharding_stage=0)
+            hlo = step.compiled_hlo(ids, ids, optimized=False)
+            plan = step._overlap_plan
+        finally:
+            set_flags({"FLAGS_comm_overlap": False})
+        return hlo, plan
+
+    off1, _ = build(False)
+    on, plan_on = build(True)
+    off2, _ = build(False)
+    assert off1 == off2, \
+        "flags-off train step is not byte-identical across comm_overlap toggles"
+    assert plan_on is None, \
+        "comm-overlap plan built on a single-device mesh (no comm to overlap)"
+    assert on == off1, \
+        "comm_overlap changed the single-device program (must be inert)"
 
 
 def _assert_telemetry_zero_overhead():
@@ -1604,6 +1770,7 @@ def main():
     _assert_analysis_zero_overhead()
     _assert_fault_tolerance_zero_overhead()
     _assert_mfu_fusion_zero_overhead()
+    _assert_comm_overlap_zero_overhead()
     _assert_telemetry_zero_overhead()
     which = os.environ.get("BENCH_CONFIG", "all").lower()
     if "--only" in sys.argv:

@@ -41,16 +41,28 @@ from .base import Finding, CollectiveOrderError
 from .lints import as_jaxpr, iter_eqns
 
 __all__ = ["CollectiveEvent", "COLLECTIVE_PRIMS", "collective_schedule",
-           "check_collective_order", "assert_collective_order"]
+           "check_collective_order", "assert_collective_order",
+           "estimate_exposed_comm"]
 
 
 class CollectiveEvent(NamedTuple):
     kind: str
     key: tuple
     domain: tuple
+    # payload accounting (ISSUE 16): bytes moved and the grad-bucket id
+    # the event drains, so order checks AND overlap-efficiency
+    # estimates ride one event stream.  Defaulted so every existing
+    # 3-field construction site and (kind, key)-only order comparison
+    # is untouched — bytes/bucket are cost metadata, not identity.
+    bytes: int = 0
+    bucket: int = -1
 
     def describe(self) -> str:
-        return f"{self.kind}{list(self.key)} on domain {self.domain}"
+        s = f"{self.kind}{list(self.key)} on domain {self.domain}"
+        if self.bytes:
+            s += f" [{self.bytes / 2**20:.2f}MB" + (
+                f", bucket {self.bucket}]" if self.bucket >= 0 else "]")
+        return s
 
 
 # jaxpr primitives that lower to cross-rank communication.  psum2 is
@@ -158,3 +170,51 @@ def assert_collective_order(schedules, title="collective order check "
     findings = check_collective_order(schedules)
     if findings:
         raise CollectiveOrderError(findings, title=title)
+
+
+def estimate_exposed_comm(schedule, compute_ms: float = 0.0, *,
+                          bytes_per_sec: float = None,
+                          overlap: bool = True) -> dict:
+    """Exposed-comm estimate from the SAME event stream the order
+    checker consumes — one walker for deadlock proofs and
+    overlap-efficiency predictions (ISSUE 16 satellite).
+
+    Model: the backward that produces n buckets' grads is split into n
+    equal compute segments; bucket k's collective (bytes_k at the ICI
+    peak) can start once segment k completes — i.e. at (k+1)·s with
+    s = compute_ms / n — and buckets are totally ordered among
+    themselves (the barrier chain), so
+
+        finish_k = max(finish_{k-1}, (k+1)·s) + bytes_k / bw
+        exposed  = max(0, finish_{n-1} − compute_ms)
+
+    With `overlap=False` (the monolithic baseline) nothing hides:
+    exposed = Σ bytes_k / bw.  For n ≥ 2 buckets and compute_ms > 0
+    the overlapped figure is strictly below the monolithic one — the
+    acceptance inequality perf_report gates.
+
+    `schedule` is a sequence of CollectiveEvents (zero-byte events are
+    skipped) or plain per-bucket byte counts.  Returns {"comm_ms",
+    "exposed_ms", "overlap_efficiency", "bytes", "buckets"}."""
+    if bytes_per_sec is None:
+        from ..telemetry.costledger import interconnect_bytes_per_sec
+        bytes_per_sec = interconnect_bytes_per_sec()
+    sizes = [int(getattr(ev, "bytes", ev)) for ev in schedule]
+    sizes = [b for b in sizes if b > 0]
+    total = sum(sizes)
+    comm = [b / bytes_per_sec * 1e3 for b in sizes]
+    comm_ms = sum(comm)
+    if not sizes:
+        return {"comm_ms": 0.0, "exposed_ms": 0.0,
+                "overlap_efficiency": 1.0, "bytes": 0, "buckets": 0}
+    if overlap and compute_ms > 0:
+        seg = compute_ms / len(sizes)
+        t = 0.0
+        for k, c in enumerate(comm):
+            t = max(t, (k + 1) * seg) + c
+        exposed = max(0.0, t - compute_ms)
+    else:
+        exposed = comm_ms
+    return {"comm_ms": comm_ms, "exposed_ms": exposed,
+            "overlap_efficiency": 1.0 - exposed / comm_ms,
+            "bytes": total, "buckets": len(sizes)}

@@ -37,7 +37,8 @@ from .base import Finding, RecompileError
 
 __all__ = ["iter_eqns", "lint_dtype_promotion", "lint_transfers",
            "lint_donation", "lint_materialized_logits",
-           "lint_peak_hbm", "lint_compiled_step", "recompile_guard",
+           "lint_grad_comm_dtype", "lint_peak_hbm",
+           "lint_compiled_step", "recompile_guard",
            "note_program_build"]
 
 
@@ -327,6 +328,84 @@ def lint_materialized_logits(fn_or_jaxpr, *args, vocab_size: int,
                     op_index=i,
                     detail=(eqn.primitive.name, shape)))
     return findings
+
+
+# ---------------------------------------------------------------------------
+# grad-comm wire-width lint (ISSUE 16 satellite: the bf16-upcast audit)
+
+def lint_grad_comm_dtype(fn_or_jaxpr, *args, plan) -> List[Finding]:
+    """Jaxpr proof that the comm-overlap plan's fused grad-bucket
+    collectives run at the requested wire width (FLAGS_grad_comm_dtype).
+
+    Each bucket materializes as a 1-D `sharding_constraint` eqn of
+    exactly `padded_numel` elements — the reduction point the SPMD
+    partitioner lowers to the bucket's all-reduce/reduce-scatter.  A
+    bucket whose constraint carries a WIDER dtype than the plan
+    requested (e.g. bf16 grads silently upcast to fp32 before the
+    reduce) doubles comm bytes — the regression Paddle's
+    fused_allreduce passes guard with their dtype-grouped fusion.
+
+    Stage >= 3 plans emit no fused constraint (layout-neutral by
+    design — see CommOverlapPlan.reduce_grads); there the fused buffer
+    is proven through the `optimization_barrier` chain instead, whose
+    invars carry the flat buffer at the wire dtype.  A single-bucket
+    stage-3 plan has neither eqn (no chain, no constraint) and nothing
+    to prove — it is skipped, not flagged.
+
+    Findings: a bucket with no matching constraint eqn (the fused
+    reduce never materialized), or one whose every matching eqn runs
+    wider than requested."""
+    jaxpr = as_jaxpr(fn_or_jaxpr, *args)
+    findings: List[Finding] = []
+    seen: dict = {b.idx: [] for b in plan.buckets}
+    by_len: dict = {}
+    for b in plan.buckets:
+        by_len.setdefault(int(b.padded_numel), []).append(b)
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name not in ("sharding_constraint",
+                                      "optimization_barrier"):
+            continue
+        for aval in _avals(eqn.invars):
+            shape = tuple(getattr(aval, "shape", ()))
+            if len(shape) != 1:
+                continue
+            for b in by_len.get(int(shape[0]), ()):
+                seen[b.idx].append(str(aval.dtype))
+    for b in plan.buckets:
+        want_size = _itemsize_of(b.comm_dtype)
+        got = seen[b.idx]
+        if not got:
+            if plan.stage >= 3 and len(plan.buckets) == 1:
+                continue
+            findings.append(Finding(
+                "grad-comm-bucket-missing",
+                f"{b.describe()}: no 1-D sharding_constraint of "
+                f"{b.padded_numel} elements in the traced step — the "
+                f"fused reduce for this bucket never materialized",
+                detail=(b.idx, b.padded_numel)))
+            continue
+        if b.comm_dtype in got:
+            continue
+        wider = [d for d in got
+                 if _itemsize_of(d) > want_size]
+        findings.append(Finding(
+            "grad-comm-dtype-upcast" if wider else
+            "grad-comm-dtype-mismatch",
+            f"{b.describe()}: requested wire dtype {b.comm_dtype} but "
+            f"the fused reduce materializes as {sorted(set(got))}"
+            + (" — a silent upcast that multiplies comm bytes"
+               if wider else ""),
+            detail=(b.idx, b.comm_dtype, tuple(sorted(set(got))))))
+    return findings
+
+
+def _itemsize_of(dtype_name: str) -> int:
+    import numpy as _np
+    try:
+        return int(_np.dtype(dtype_name).itemsize)
+    except TypeError:
+        return {"bfloat16": 2, "float8_e4m3fn": 1,
+                "float8_e5m2": 1}.get(dtype_name, 4)
 
 
 # ---------------------------------------------------------------------------

@@ -30,14 +30,26 @@ class DistributedStrategy:
         # most depth+1 layers' params).  offload_cast_dtype: wire dtype
         # for host→HBM parameter transfers (None = storage dtype).
         # Plumbed by ShardedTrainStep.from_strategy.
+        # comm_overlap (reference: sharding comm-overlap pass): bucket
+        # gradient collectives and issue them with the backward —
+        # bucket size comes from fuse_grad_size_in_MB below (the same
+        # field Paddle's fused_allreduce passes read).  Plumbed by
+        # ShardedTrainStep.from_strategy; docs/PARALLELISM.md maps
+        # every knob to engine behavior.
         self.sharding_configs = {"sharding_degree": 1, "stage": 1,
                                  "offload": False,
                                  "offload_prefetch_depth": 1,
-                                 "offload_cast_dtype": "bfloat16"}
+                                 "offload_cast_dtype": "bfloat16",
+                                 "comm_overlap": False}
         self.pipeline = False
+        # overlap_p2p_comm (reference: pp_configs): the PipelineEngine
+        # drains grad buckets inside the schedule bubble ("r" ops)
+        # instead of after the dispatch loop.  None = follow
+        # FLAGS_comm_overlap.
         self.pipeline_configs = {"accumulate_steps": 1,
                                  "micro_batch_size": 1,
-                                 "schedule_mode": "1F1B"}
+                                 "schedule_mode": "1F1B",
+                                 "overlap_p2p_comm": None}
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
         self.lamb = False

@@ -376,6 +376,10 @@ class PipelineParallel(_MetaParallelBase):
         cfg = getattr(strategy, "pipeline_configs", None) or {}
         self._accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self._schedule = cfg.get("schedule_mode", "1F1B")
+        # Paddle's pp_configs overlap knob: drain grad buckets inside
+        # the schedule bubble (engine "r" ops) instead of after it.
+        # None keeps FLAGS_comm_overlap as the default.
+        self._overlap = cfg.get("overlap_p2p_comm", None)
 
     def _get_engine(self):
         if self._engine is None:
@@ -390,7 +394,8 @@ class PipelineParallel(_MetaParallelBase):
     def forward_backward_pipeline(self, data, scaler=None):
         engine = self._get_engine()
         return engine.train_batch(data, self._accumulate_steps,
-                                  schedule=self._schedule)
+                                  schedule=self._schedule,
+                                  comm_overlap=self._overlap)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         loss = self.forward_backward_pipeline(data, scaler)

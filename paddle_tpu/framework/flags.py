@@ -114,6 +114,29 @@ define_flag("skip_nonfinite_steps", False,
 define_flag("max_consecutive_bad_steps", 8,
             "abort training after this many CONSECUTIVE nonfinite "
             "steps (a persistent divergence, not a transient spike)")
+# comm/compute overlap engine (ISSUE 16, parallel/comm_overlap.py): all
+# read at trainer BUILD time.  Off by default — the flags-off sharded
+# step must compile to a byte-identical program (bench-asserted).
+define_flag("comm_overlap", False,
+            "bucket gradient collectives and issue them with the "
+            "backward (Paddle sharding_configs comm_overlap): bucket "
+            "k's all_reduce/reduce_scatter is ordered before bucket "
+            "k+1's and free to overlap later buckets' backward "
+            "compute; bit-exact vs the monolithic path at "
+            "FLAGS_grad_comm_dtype=auto")
+define_flag("comm_bucket_mb", 32.0,
+            "size target in MB for one fused gradient bucket "
+            "(Paddle's DistributedStrategy.fuse_grad_size_in_MB); "
+            "params are bucketed in reverse-topological order so "
+            "first-ready grads communicate first; a single larger "
+            "param gets its own bucket")
+define_flag("grad_comm_dtype", "auto",
+            "wire dtype for fused gradient collectives: 'auto' keeps "
+            "each grad's own width (bf16 grads are NEVER silently "
+            "upcast to fp32, which would double comm bytes — "
+            "lint_grad_comm_dtype asserts this on the jaxpr); an "
+            "explicit narrower dtype is an opt-in approximation that "
+            "breaks the bit-exactness contract")
 # MFU-gap kernel fusions (ISSUE 5): both off by default — the flags-off
 # train step must compile to a byte-identical program (bench-asserted).
 define_flag("fused_ce", False,

@@ -1,0 +1,415 @@
+"""Comm/compute overlap engine — bucketed gradient collectives issued
+with the backward (ROADMAP item 1: "gradient-bucket collectives
+overlapped with the backward scan", the one clause of the
+hybrid-parallel compute engine r6-r19 never built).
+
+Reference: Paddle's `fused_allreduce_gradients` +
+`DistributedStrategy.fuse_grad_size_in_MB` + the comm-overlap passes
+(sharding_configs `comm_overlap`, pp_configs `overlap_p2p_comm`).
+Today every `ShardedTrainStep` grad psum / reduce-scatter is ONE
+monolithic collective the SPMD partitioner materializes after the full
+backward, so DP/ZeRO comm time is 100% exposed.  This module replaces
+scheduler luck with structure, the same move offload_pipeline.py made
+for host DMA:
+
+  * **Size-targeted buckets** (`FLAGS_comm_bucket_mb`, default 32MB —
+    Paddle's fuse_grad_size_in_MB): parameters are grouped in
+    REVERSE-TOPOLOGICAL order (reverse registration order — the
+    backward produces last-layer grads first), so bucket 0 holds the
+    first-ready grads and communicates first.
+  * **Dtype-safe fusion**: each bucket's grads are raveled, cast to the
+    bucket's comm dtype (`FLAGS_grad_comm_dtype`; "auto" keeps the
+    grad's own width — a bf16 grad is NEVER silently upcast to fp32,
+    which would double comm bytes), concatenated into one flat buffer,
+    and unflattened per-leaf after the collective.  Params of different
+    comm dtypes never share a buffer.
+  * **Issue-order chaining**: each bucket's fused buffer carries a
+    sharding constraint (replicated for the stage-0/1 all-reduce;
+    sharded on the flat dim for the stage-2 reduce-scatter; stage 3
+    stays layout-neutral — see reduce_grads) and is
+    `optimization_barrier`-chained behind the PREVIOUS bucket's — the
+    collectives are totally ordered among themselves (bucket k before
+    k+1 on every rank, the property `check_collective_order` proves)
+    while each stays free to overlap with the backward compute that
+    produces LATER buckets' grads.  The same chain runs the stage-3
+    param all-gather prefetch in FORWARD order, one bucket ahead of
+    the compute that consumes it.
+
+Correctness contract (tier-1-pinned):
+
+  * bit-exact: at `grad_comm_dtype="auto"` the bucketed path computes
+    bit-identical gradients to the monolithic path — flatten/concat/
+    unflatten is exact, and the per-element reduction runs over the
+    same participants in the same order whether fused or not.  An
+    explicit NARROWER comm dtype is an opt-in approximation.
+  * static: `CommOverlapPlan.verify()` proves the per-rank bucket
+    collective order identical across the mesh via
+    `analysis.collectives.check_collective_order` BEFORE any chip
+    time; `ShardedTrainStep` runs it at build.
+  * zero-overhead: `FLAGS_comm_overlap` off (default), the compiled
+    step is byte-identical to a pre-overlap build (bench-asserted) —
+    the flag is read at trainer BUILD time like
+    FLAGS_skip_nonfinite_steps.
+
+Observability: `plan.comm_profile()` registers byte volumes with the
+cost ledger (`telemetry.costledger.note_comm`), whose report grows an
+exposed-comm column — comm bytes at the calibrated ICI peak vs the
+backward compute available to hide them under
+(`analysis.collectives.estimate_exposed_comm`) — so the overlap win is
+a ledger number on CPU today and a gated BENCH number on the chip.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["GradBucket", "build_buckets", "resolve_comm_dtype",
+           "CommOverlapPlan"]
+
+# optimization_barrier has no differentiation rule; the prefetch chain
+# runs INSIDE the differentiated forward, so wrap it in a custom_vjp
+# identity — the barrier is a scheduling hint, not a math op, and its
+# gradient is exactly the identity (lazily built: plan construction
+# must not import jax)
+_DIFF_BARRIER = None
+
+
+def _diff_barrier():
+    global _DIFF_BARRIER
+    if _DIFF_BARRIER is None:
+        import jax
+
+        @jax.custom_vjp
+        def barrier(*xs):
+            return jax.lax.optimization_barrier(xs)
+
+        def _fwd(*xs):
+            return jax.lax.optimization_barrier(xs), None
+
+        def _bwd(_, cts):
+            return cts
+
+        barrier.defvjp(_fwd, _bwd)
+        _DIFF_BARRIER = barrier
+    return _DIFF_BARRIER
+
+
+class GradBucket(NamedTuple):
+    """One fused communication unit: a contiguous run of parameters
+    (in reverse-topological order) whose grads ravel into one flat
+    buffer of `comm_dtype`, padded to `padded_numel` for even sharding
+    on the reduce axis."""
+    idx: int                # issue order: 0 communicates first
+    indices: Tuple[int, ...]   # positions into the trainer's param list
+    names: Tuple[str, ...]
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[str, ...]    # original grad dtypes (unflatten casts back)
+    comm_dtype: str
+    numel: int              # payload elements (sum of leaf sizes)
+    padded_numel: int       # numel + pad so the reduce axis divides
+    nbytes: int             # payload bytes at comm_dtype (pad excluded)
+
+    def describe(self) -> str:
+        return (f"bucket {self.idx}: {len(self.indices)} param(s), "
+                f"{self.nbytes / 2**20:.2f}MB {self.comm_dtype}")
+
+
+def resolve_comm_dtype(grad_dtype, requested: str = "auto") -> str:
+    """The wire dtype for one grad: "auto" keeps the grad's own width
+    (the satellite-1 audit — a bf16 grad must not silently widen to
+    fp32 before the reduce); an explicit name wins."""
+    if not requested or requested == "auto":
+        return str(np.dtype(grad_dtype) if not hasattr(grad_dtype, "name")
+                   else grad_dtype)
+    return requested
+
+
+def _itemsize(dtype_name: str) -> int:
+    try:
+        return int(np.dtype(dtype_name).itemsize)
+    except TypeError:
+        # numpy has no bfloat16; jax's ml_dtypes registers it, but keep
+        # this table-driven so plan construction never needs jax
+        return {"bfloat16": 2, "float8_e4m3fn": 1, "float8_e5m2": 1}.get(
+            dtype_name, 4)
+
+
+def build_buckets(names: Sequence[str], shapes: Sequence[Tuple[int, ...]],
+                  dtypes: Sequence, bucket_mb: float = 32.0,
+                  comm_dtype: str = "auto", reverse: bool = True,
+                  divisor: int = 1) -> List[GradBucket]:
+    """Assemble size-targeted buckets over the parameter list.
+
+    Walks params in reverse registration order (reverse-topological:
+    the backward materializes last-layer grads first) and closes a
+    bucket when adding the next param would exceed `bucket_mb`.  A
+    single param larger than the target gets a bucket of its own (the
+    giant-embedding case); params whose resolved comm dtype differs
+    never share a fused buffer.  `divisor` pads each bucket's flat
+    length to a multiple (the reduce-scatter shard count)."""
+    target = max(1, int(float(bucket_mb) * 2**20))
+    order = range(len(names) - 1, -1, -1) if reverse \
+        else range(len(names))
+    buckets: List[GradBucket] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_dtype: Optional[str] = None
+
+    def close():
+        nonlocal cur, cur_bytes, cur_dtype
+        if not cur:
+            return
+        numel = sum(int(np.prod(shapes[i])) for i in cur)
+        pad = (-numel) % max(1, divisor)
+        buckets.append(GradBucket(
+            idx=len(buckets), indices=tuple(cur),
+            names=tuple(names[i] for i in cur),
+            shapes=tuple(tuple(shapes[i]) for i in cur),
+            dtypes=tuple(str(dtypes[i]) for i in cur),
+            comm_dtype=cur_dtype, numel=numel,
+            padded_numel=numel + pad, nbytes=cur_bytes))
+        cur, cur_bytes, cur_dtype = [], 0, None
+
+    for i in order:
+        cd = resolve_comm_dtype(dtypes[i], comm_dtype)
+        nb = int(np.prod(shapes[i])) * _itemsize(cd)
+        if cur and (cd != cur_dtype or cur_bytes + nb > target):
+            close()
+        cur.append(i)
+        cur_bytes += nb
+        cur_dtype = cd
+        if cur_bytes >= target:
+            close()
+    close()
+    return buckets
+
+
+class CommOverlapPlan:
+    """The built-once-at-trainer-build bucket plan: owns the traced
+    reduce/prefetch transforms, the static per-rank event schedule,
+    and the exposed-comm profile the cost ledger ingests.
+
+    stage <= 1 → one fused all-reduce per bucket (replicated
+    constraint); stage 2 → one fused reduce-scatter per bucket (flat
+    dim sharded on `reduce_axis`), with per-leaf shardings re-applied
+    after unflatten (the sharded-grad materialization).  stage 3 →
+    layout-neutral barrier chain only (the update's shard_map boundary
+    already materializes the reduce-scatter; see reduce_grads) plus
+    the param all-gather prefetched one bucket ahead in forward
+    order."""
+
+    def __init__(self, names, shapes, dtypes, *, axes: Tuple[str, ...],
+                 stage: int = 0, bucket_mb: float = 32.0,
+                 comm_dtype: str = "auto",
+                 reduce_axis: Optional[str] = None,
+                 reduce_axis_size: int = 1):
+        self.names = list(names)
+        self.stage = int(stage)
+        self.axes = tuple(axes)          # the collective's ordering domain
+        self.comm_dtype_req = comm_dtype or "auto"
+        self.bucket_mb = float(bucket_mb)
+        self.reduce_axis = reduce_axis if stage >= 2 else None
+        self.reduce_axis_size = max(1, int(reduce_axis_size))
+        divisor = self.reduce_axis_size if self.reduce_axis else 1
+        self.buckets = build_buckets(
+            names, shapes, dtypes, bucket_mb=bucket_mb,
+            comm_dtype=self.comm_dtype_req, reverse=True,
+            divisor=divisor)
+
+    @classmethod
+    def for_trainer(cls, names, shapes, dtypes, mesh, stage,
+                    bucket_mb=32.0, comm_dtype="auto",
+                    batch_axes=("dp", "sharding")):
+        """Plan for a ShardedTrainStep over `mesh`: the reduce domain
+        is the data axes the batch shards over; stage>=2 scatters on
+        the 'sharding' axis."""
+        axes = tuple(a for a in batch_axes if a in mesh.axis_names
+                     and mesh.shape[a] > 1)
+        shard_n = mesh.shape.get("sharding", 1)
+        return cls(names, shapes, dtypes, axes=axes, stage=stage,
+                   bucket_mb=bucket_mb, comm_dtype=comm_dtype,
+                   reduce_axis="sharding" if (stage >= 2 and shard_n > 1
+                                              and "sharding" in axes)
+                   else None,
+                   reduce_axis_size=shard_n)
+
+    @classmethod
+    def modeled(cls, names, shapes, dtypes, *, world=8, stage=3,
+                bucket_mb=32.0, comm_dtype="auto"):
+        """A mesh-free plan for ledger estimates: models a
+        `world`-way data/sharding domain without touching devices —
+        what the bench leg uses to quote exposed-comm on CPU."""
+        return cls(names, shapes, dtypes, axes=("sharding",),
+                   stage=stage, bucket_mb=bucket_mb,
+                   comm_dtype=comm_dtype,
+                   reduce_axis="sharding" if stage >= 2 else None,
+                   reduce_axis_size=world)
+
+    @property
+    def active(self) -> bool:
+        """Whether any cross-rank communication exists to overlap."""
+        return bool(self.axes) and bool(self.buckets)
+
+    # -- traced transforms -------------------------------------------------
+    def _fused_sharding(self, mesh):
+        import jax  # noqa: F401
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if self.reduce_axis:
+            return NamedSharding(mesh, P(self.reduce_axis))
+        return NamedSharding(mesh, P())
+
+    def _fuse(self, leaves, bucket):
+        import jax.numpy as jnp
+        flat = [jnp.ravel(g).astype(bucket.comm_dtype) for g in leaves]
+        buf = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
+        pad = bucket.padded_numel - bucket.numel
+        if pad:
+            buf = jnp.pad(buf, (0, pad))
+        return buf
+
+    def _split(self, buf, bucket):
+        out = []
+        off = 0
+        for shape, dt in zip(bucket.shapes, bucket.dtypes):
+            n = int(np.prod(shape))
+            out.append(buf[off:off + n].reshape(shape).astype(dt))
+            off += n
+        return out
+
+    def reduce_grads(self, grads, mesh, leaf_shardings=None):
+        """The traced bucketed-reduction pass: for each bucket in issue
+        order, fuse → constrain (all-reduce / reduce-scatter
+        materialization point) → chain behind the previous bucket →
+        unflatten.  `leaf_shardings` (stage 2) re-applies the per-leaf
+        sharded-grad constraint after unflatten, exactly like the
+        monolithic path.
+
+        Stage >= 3 skips the fused-buffer constraint: the monolithic
+        stage-3 program materializes the grad reduce-scatter at the
+        update's shard_map boundary, and forcing a DIFFERENT
+        materialization point reassociates the reduction (one-ulp
+        scattered diffs, measured on the host mesh) — the same
+        tradeoff prefetch_params documents.  The barrier chain alone
+        still totally orders bucket k's grads before bucket k+1's,
+        which is the property the scheduler (and the static order
+        check) needs."""
+        import jax
+        if not self.active:
+            return grads
+        grads = list(grads)
+        fused_sh = self._fused_sharding(mesh) if self.stage < 3 else None
+        token = None
+        for b in self.buckets:
+            buf = self._fuse([grads[i] for i in b.indices], b)
+            if fused_sh is not None:
+                buf = jax.lax.with_sharding_constraint(buf, fused_sh)
+            if token is not None:
+                buf, _ = jax.lax.optimization_barrier((buf, token))
+            token = buf
+            for i, g in zip(b.indices, self._split(buf, b)):
+                if leaf_shardings is not None:
+                    g = jax.lax.with_sharding_constraint(
+                        g, leaf_shardings[i])
+                grads[i] = g
+        return grads
+
+    def prefetch_params(self, param_vals):
+        """Stage-3 forward prologue: barrier-chain the params bucket-
+        by-bucket in FORWARD order (reversed bucket order), so bucket
+        k+1's params materialize behind bucket k's.  The partitioner
+        inserts each sharded param's all-gather at its first use; the
+        chain gives every gather an ordered anchor the latency-hiding
+        scheduler can hoist it up to — ONE bucket ahead of the compute
+        consuming the previous bucket (the offload_pipeline anchor
+        idiom).  Deliberately NO sharding constraint: an explicit
+        gather-layout constraint changes the partitioner's matmul
+        tiling and costs the last-ulp bit-exactness contract (measured
+        on the host mesh); the pure barrier chain is layout-neutral
+        and bit-exact."""
+        if not self.active:
+            return param_vals
+        out = list(param_vals)
+        token = None
+        for b in reversed(self.buckets):
+            vals = [out[i] for i in b.indices]
+            if token is not None and vals:
+                res = _diff_barrier()(*vals, token)
+                vals = list(res[:-1])
+            if vals:
+                token = vals[0]
+            for i, v in zip(b.indices, vals):
+                out[i] = v
+        return out
+
+    # -- static schedule ---------------------------------------------------
+    def events(self) -> list:
+        """The per-rank collective-event list (identical on every mesh
+        rank — SPMD): one reduce event per bucket in issue order, plus
+        (stage 3) one all-gather prefetch event per bucket in forward
+        order.  Same event type `check_collective_order` and
+        `estimate_exposed_comm` consume — one walker for order proofs
+        and overlap-efficiency estimates."""
+        from ..analysis.collectives import CollectiveEvent
+        kind = "reduce_scatter" if self.reduce_axis else "psum"
+        # the bucket idx is part of the KEY: every bucket is a distinct
+        # collective, and the order check must see two equal-sized
+        # buckets swapping places as a divergence
+        evs = []
+        if self.stage >= 3:
+            for b in reversed(self.buckets):
+                evs.append(CollectiveEvent(
+                    "all_gather", (self.axes, b.idx, b.padded_numel,
+                                   b.comm_dtype), self.axes,
+                    bytes=b.nbytes, bucket=b.idx))
+        for b in self.buckets:
+            evs.append(CollectiveEvent(
+                kind, (self.axes, b.idx, b.padded_numel, b.comm_dtype),
+                self.axes, bytes=b.nbytes, bucket=b.idx))
+        return evs
+
+    def schedules(self, world: Optional[int] = None) -> Dict[int, list]:
+        """{rank: events} for the whole reduce domain — what
+        check_collective_order consumes.  SPMD traces one program for
+        every rank, so the lists are identical BY CONSTRUCTION; the
+        check still proves the composition with any per-rank host
+        logic consistent."""
+        n = world if world is not None else self.reduce_axis_size
+        evs = self.events()
+        return {r: list(evs) for r in range(max(1, n))}
+
+    def verify(self, world: Optional[int] = None):
+        """Static pre-flight (the acceptance gate): prove the bucket
+        collective order identical across ranks BEFORE any chip time.
+        Raises CollectiveOrderError on divergence."""
+        from ..analysis.collectives import assert_collective_order
+        assert_collective_order(
+            self.schedules(world),
+            title=f"comm-overlap bucket schedule (stage {self.stage}, "
+                  f"{len(self.buckets)} buckets) fails the static "
+                  f"collective-order check")
+        return self
+
+    # -- ledger profile ----------------------------------------------------
+    def comm_bytes(self) -> int:
+        return sum(b.nbytes for b in self.buckets)
+
+    def comm_profile(self) -> dict:
+        """What telemetry.costledger.note_comm ingests: byte volumes in
+        issue order + the overlap shape, from which the report derives
+        the exposed-comm column."""
+        return {"bytes": self.comm_bytes(),
+                "bucket_bytes": [b.nbytes for b in self.buckets],
+                "buckets": len(self.buckets),
+                "overlap": True,
+                "stage": self.stage,
+                "axes": list(self.axes),
+                "comm_dtype": self.comm_dtype_req,
+                "world": self.reduce_axis_size}
+
+    def describe(self) -> str:
+        mb = self.comm_bytes() / 2**20
+        return (f"CommOverlapPlan(stage={self.stage}, "
+                f"{len(self.buckets)} bucket(s) <= {self.bucket_mb}MB, "
+                f"{mb:.2f}MB total, axes={self.axes}, "
+                f"comm_dtype={self.comm_dtype_req})")

@@ -332,10 +332,20 @@ class PipelineEngine:
                 st = self.chunks[s]
                 st.local_overrides[i] = st._placed(master._value)
 
-    def train_batch(self, data, num_micro: int, schedule: str = "1F1B"):
+    def train_batch(self, data, num_micro: int, schedule: str = "1F1B",
+                    comm_overlap=None):
         """Run the full pipeline over `data=[x, y]` split into `num_micro`
         micro-batches; leaves averaged grads on each Parameter.grad and
-        returns the averaged loss."""
+        returns the averaged loss.
+
+        comm_overlap (None -> FLAGS_comm_overlap): interleave per-chunk
+        grad-bucket DRAIN ops ("r") into the schedule's cooldown — each
+        chunk's accumulated grads are finalized and written back bucket
+        by bucket inside the bubble, as soon as its last backward
+        retires, instead of in one monolithic pass after the whole
+        schedule drains (ISSUE 16: the pp-side of the overlap engine;
+        what a multi-host fleet hangs its per-bucket DP all-reduces
+        on).  Bit-exact: same per-param g/m math, just earlier."""
         x, y = data
         xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
         yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
@@ -343,11 +353,10 @@ class PipelineEngine:
         if xv.shape[0] % m:
             raise ValueError(
                 f"batch {xv.shape[0]} not divisible by micro-batches {m}")
-        sched = schedule.upper().replace("-", "").replace("_", "")
-        self._split_bwd = sched in ("ZB", "ZBH1", "ZEROBUBBLE",
-                                    "ZBVPP", "ZBV", "ZEROBUBBLEVPP")
         from ..distributed.watchdog import watched
         from ..framework.flags import get_flag
+        self._comm_overlap_on = bool(get_flag("comm_overlap")) \
+            if comm_overlap is None else bool(comm_overlap)
         order = self._orders(m, schedule)
         if get_flag("check_collective_order"):
             # static deadlock detector (FLAGS-gated: costs nothing when
@@ -385,10 +394,15 @@ class PipelineEngine:
 
         # write back grads (avg over micro-batches); a tied param seen in
         # several chunks gets the SUM of its per-chunk grads, placed like
-        # the master (first-seen) copy
+        # the master (first-seen) copy.  Params already finalized by an
+        # in-schedule drain op skip this pass (drains never touch tied
+        # params, so the summing semantics are untouched).
         grad_by_param = {}
-        for st in chunks:
-            for p, g in zip(st.params, st.grad_acc or []):
+        for ci, st in enumerate(chunks):
+            for idx, (p, g) in enumerate(zip(st.params,
+                                             st.grad_acc or [])):
+                if (ci, idx) in self._drained:
+                    continue
                 g = g / m
                 if id(p) in grad_by_param:
                     prev = grad_by_param[id(p)][1]
@@ -421,23 +435,103 @@ class PipelineEngine:
 
     # -- schedules ---------------------------------------------------------
     def _orders(self, m, schedule):
-        """Per-physical-stage op lists [(kind, chunk, micro), ...]."""
+        """Per-physical-stage op lists [(kind, chunk, micro), ...].
+        Sets the schedule-derived dispatch state (_split_bwd, _last_m,
+        drain bookkeeping) so standalone verify_schedule/
+        collective_events see exactly what train_batch dispatches."""
         sched = schedule.upper().replace("-", "").replace("_", "")
+        self._split_bwd = sched in ("ZB", "ZBH1", "ZEROBUBBLE",
+                                    "ZBVPP", "ZBV", "ZEROBUBBLEVPP")
+        self._last_m = m
+        self._drained = set()
         if sched in ("VPP", "INTERLEAVE", "INTERLEAVED") \
                 or (sched == "1F1B" and self.vpp > 1):
-            return [self._interleaved_order(s, m) for s in range(self.pp)]
-        if sched in ("ZBVPP", "ZBV", "ZEROBUBBLEVPP"):
-            return [self._zb_vpp_order(s, m) for s in range(self.pp)]
-        if self.vpp > 1 and sched != "FTHENB":
+            orders = [self._interleaved_order(s, m)
+                      for s in range(self.pp)]
+        elif sched in ("ZBVPP", "ZBV", "ZEROBUBBLEVPP"):
+            orders = [self._zb_vpp_order(s, m) for s in range(self.pp)]
+        elif self.vpp > 1 and sched != "FTHENB":
             raise ValueError(
                 f"schedule {schedule} does not support vpp={self.vpp}")
-        if sched == "FTHENB":
-            return [self._fthenb_order(s, m) for s in range(self.pp)]
-        if sched in ("ZB", "ZBH1", "ZEROBUBBLE"):
-            return [self._zb_h1_order(s, m) for s in range(self.pp)]
-        if sched == "1F1B":
-            return [self._1f1b_order(s, m) for s in range(self.pp)]
-        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+        elif sched == "FTHENB":
+            orders = [self._fthenb_order(s, m) for s in range(self.pp)]
+        elif sched in ("ZB", "ZBH1", "ZEROBUBBLE"):
+            orders = [self._zb_h1_order(s, m) for s in range(self.pp)]
+        elif sched == "1F1B":
+            orders = [self._1f1b_order(s, m) for s in range(self.pp)]
+        else:
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
+        if getattr(self, "_comm_overlap_on", False):
+            orders = [self._with_grad_drains(o, m) for o in orders]
+        self._drain_needs = {}
+        if getattr(self, "_comm_overlap_on", False):
+            for v in range(self.num_chunks):
+                need = [("b", v, i) for i in range(m)]
+                if self._split_bwd:
+                    need += [("w", v, i) for i in range(m)]
+                self._drain_needs[v] = tuple(need)
+        return orders
+
+    def _chunk_buckets(self, v):
+        """The chunk's grad-bucket plan (comm_overlap.build_buckets
+        over its param list at FLAGS_comm_bucket_mb, reverse
+        registration order), built once per chunk."""
+        st = self.chunks[v]
+        buckets = getattr(st, "_grad_buckets", None)
+        if buckets is None:
+            from ..framework.flags import get_flag
+            from .comm_overlap import build_buckets
+            names = [getattr(p, "name", None) or f"chunk{v}.p{i}"
+                     for i, p in enumerate(st.params)]
+            shapes = [tuple(p.value.shape) for p in st.params]
+            dtypes = [str(p.value.dtype) for p in st.params]
+            buckets = build_buckets(
+                names, shapes, dtypes,
+                bucket_mb=float(get_flag("comm_bucket_mb") or 32.0))
+            st._grad_buckets = buckets
+        return buckets
+
+    def _shared_param_ids(self):
+        """ids of params owned by MORE than one chunk (tied weights):
+        their grads must be summed across chunks, so in-schedule
+        drains leave them for the post-dispatch write-back."""
+        ids = getattr(self, "_shared_ids_cache", None)
+        if ids is None:
+            count = {}
+            for st in self.chunks:
+                for p in st.params:
+                    count[id(p)] = count.get(id(p), 0) + 1
+            ids = {k for k, n in count.items() if n > 1}
+            self._shared_ids_cache = ids
+        return ids
+
+    def _with_grad_drains(self, order, m):
+        """Weave per-chunk drain ops ("r", chunk, bucket) into one
+        stage's op list: a chunk's drains queue up the moment its last
+        backward-ish op (b, plus w when the schedule splits the
+        backward) appears, then interleave one-per-subsequent-op — so
+        the buckets retire INSIDE the cooldown bubble, overlapping the
+        remaining b/w work of other chunks/micro-batches, first-ready
+        bucket first."""
+        from collections import deque
+        split = self._split_bwd
+        need = {}
+        for kind, v, i in order:
+            if kind == "b" or (split and kind == "w"):
+                need[v] = need.get(v, 0) + 1
+        out, queued = [], deque()
+        for op in order:
+            out.append(op)
+            kind, v, i = op
+            if kind == "b" or (split and kind == "w"):
+                need[v] -= 1
+                if need[v] == 0:
+                    for j in range(len(self._chunk_buckets(v))):
+                        queued.append(("r", v, j))
+            if queued:
+                out.append(queued.popleft())
+        out.extend(queued)
+        return out
 
     def _local_chunks(self, s):
         return [c * self.pp + s for c in range(self.vpp)]
@@ -542,7 +636,8 @@ class PipelineEngine:
         return order
 
     # -- static schedule verification (analysis.collectives) ---------------
-    def collective_events(self, num_micro, schedule="1F1B", orders=None):
+    def collective_events(self, num_micro, schedule="1F1B", orders=None,
+                          comm_overlap=None):
         """Per-physical-stage communication event lists derived from the
         schedule — the pipeline's answer to "extract the collective eqn
         sequence per rank".  Each cross-stage activation/grad transfer
@@ -552,6 +647,8 @@ class PipelineEngine:
         order.  Appears once in the sender's list (at its producing op)
         and once in the receiver's (at its consuming op)."""
         from ..analysis.collectives import CollectiveEvent
+        if comm_overlap is not None:
+            self._comm_overlap_on = bool(comm_overlap)
         orders = orders if orders is not None \
             else self._orders(num_micro, schedule)
         last = self.num_chunks - 1
@@ -577,6 +674,17 @@ class PipelineEngine:
                         dst = stage_of(v - 1)
                         events[s].append(CollectiveEvent(
                             "grad", (v, v - 1, i), ("grad", s, dst)))
+                elif kind == "r":
+                    # grad-bucket drain (comm_overlap on): the slot a
+                    # multi-host fleet issues this bucket's DP
+                    # all-reduce in.  Domain is per-stage (every stage
+                    # drains only its own chunks), so the order proof
+                    # is about the per-stage drain sequence — and the
+                    # bytes ride into estimate_exposed_comm's walker.
+                    b = self._chunk_buckets(v)[i]
+                    events[s].append(CollectiveEvent(
+                        "grad_rs", (v, i), ("gradrs", s),
+                        bytes=b.nbytes, bucket=i))
                 # "w" (deferred weight grad) has no cross-stage traffic
         return events
 
@@ -613,7 +721,8 @@ class PipelineEngine:
         runtime, caught before any compute."""
         return self._dispatch(orders)
 
-    def verify_schedule(self, num_micro, schedule="1F1B", orders=None):
+    def verify_schedule(self, num_micro, schedule="1F1B", orders=None,
+                        comm_overlap=None):
         """Statically prove the schedule deadlock-free: (1) every
         directed cross-stage channel carries its transfers in the SAME
         order on sender and receiver (check_collective_order — the
@@ -624,6 +733,8 @@ class PipelineEngine:
         self."""
         from ..analysis.base import Finding, CollectiveOrderError
         from ..analysis.collectives import check_collective_order
+        if comm_overlap is not None:
+            self._comm_overlap_on = bool(comm_overlap)
         orders = orders if orders is not None \
             else self._orders(num_micro, schedule)
         findings = check_collective_order(
@@ -650,6 +761,11 @@ class PipelineEngine:
             return v == 0 or ("f", v - 1, i) in done
         if kind == "w":
             return ("b", v, i) in done
+        if kind == "r":
+            # a grad-bucket drain needs every backward of ITS chunk
+            # retired (the chunk's grad_acc is final); other chunks may
+            # still be mid-backward — that is the overlap
+            return all(op in done for op in self._drain_needs.get(v, ()))
         deps_ok = ("f", v, i) in done
         if v < self.num_chunks - 1:
             deps_ok = deps_ok and ("b", v + 1, i) in done
@@ -690,7 +806,7 @@ class PipelineEngine:
                 prev.dy_inbox[i] = jax.tree_util.tree_map(
                     prev.place_activation, dx)
             st.inbox.pop(i, None)
-        else:  # "w": deferred weight grad (zero-bubble)
+        elif kind == "w":  # deferred weight grad (zero-bubble)
             x = st.saved_x.pop(i)
             if st.is_last:
                 dparams = st._loss_bwd_dw(st.param_vals, st.buf_vals, x,
@@ -699,6 +815,27 @@ class PipelineEngine:
                 dy = st.saved_dy.pop(i)
                 dparams = st._bwd_dw(st.param_vals, st.buf_vals, x, dy)
             st.accumulate(dparams)
+        else:  # "r": drain one grad bucket inside the bubble
+            self._drain_bucket(v, i)
+
+    def _drain_bucket(self, v, j):
+        """Finalize bucket `j` of chunk `v`: average its accumulated
+        grads over the micro-batches and write Parameter.grad — the
+        host-side analog of the bucket's DP collective, run while
+        OTHER chunks are still in their backwards.  Tied (multi-chunk)
+        params are left for the post-dispatch pass, which sums across
+        chunks."""
+        st = self.chunks[v]
+        if not st.grad_acc:
+            return
+        shared = self._shared_param_ids()
+        m = self._last_m
+        for idx in self._chunk_buckets(v)[j].indices:
+            p = st.params[idx]
+            if id(p) in shared:
+                continue
+            p.grad = Tensor(st.grad_acc[idx] / m)
+            self._drained.add((v, idx))
 
     def _last_bwd(self, st, i, labels):
         if self._split_bwd:
@@ -709,4 +846,9 @@ class PipelineEngine:
                                          st.saved_x.pop(i), labels[i])
         return loss, dparams, dx
 
-    _split_bwd = False  # set per-train_batch by the schedule
+    # schedule-derived dispatch state, (re)set by _orders each batch
+    _split_bwd = False
+    _comm_overlap_on = False
+    _last_m = 1
+    _drain_needs: dict = {}
+    _drained: set = frozenset()

@@ -137,7 +137,9 @@ class ShardedTrainStep:
                  batch_axes=("dp", "sharding"), donate: bool = True,
                  seq_axis: Optional[str] = None, seq_dim: int = 1,
                  offload=False, offload_prefetch_depth: int = 1,
-                 offload_cast_dtype="bfloat16", grad_scaler=None):
+                 offload_cast_dtype="bfloat16", grad_scaler=None,
+                 comm_overlap=None, comm_bucket_mb=None,
+                 grad_comm_dtype=None):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
@@ -148,6 +150,20 @@ class ShardedTrainStep:
         # built; the optional GradScaler gets backoff() on bad steps
         self._guard = None
         self._scaler = grad_scaler
+        # comm/compute overlap engine (ISSUE 16): bucketed gradient
+        # collectives issued with the backward.  None -> the FLAGS
+        # (read once HERE, at build time — the flags-off step program
+        # is byte-identical, bench-asserted).  Ignored by the
+        # offload="stream" pipeline, which owns its own scheduling.
+        from ..framework.flags import get_flag as _gf
+        self._comm_overlap = bool(_gf("comm_overlap")) \
+            if comm_overlap is None else bool(comm_overlap)
+        self._comm_bucket_mb = float(_gf("comm_bucket_mb") or 32.0) \
+            if comm_bucket_mb is None else float(comm_bucket_mb)
+        self._grad_comm_dtype = (_gf("grad_comm_dtype") or "auto") \
+            if grad_comm_dtype is None else str(grad_comm_dtype)
+        self._overlap_plan = None
+        self._comm_profile = None
         # offload="stream": the explicit double-buffered per-layer
         # streaming pipeline (offload_pipeline.py) — forward/backward
         # prefetch windows + in-backward optimizer, replacing the
@@ -218,6 +234,16 @@ class ShardedTrainStep:
                       sc.get("offload_prefetch_depth", 1))
         kw.setdefault("offload_cast_dtype",
                       sc.get("offload_cast_dtype", "bfloat16"))
+        # comm-overlap knobs (ISSUE 16), Paddle names:
+        # sharding_configs.comm_overlap gates the engine;
+        # strategy.fuse_grad_size_in_MB sizes the buckets (the same
+        # field Paddle's fused_allreduce passes read).  None keeps the
+        # FLAGS defaults.
+        if "comm_overlap" in sc:
+            kw.setdefault("comm_overlap", bool(sc["comm_overlap"]))
+        fuse_mb = getattr(strategy, "fuse_grad_size_in_MB", None)
+        if fuse_mb:
+            kw.setdefault("comm_bucket_mb", float(fuse_mb))
         return cls(model, optimizer, mesh, **kw)
 
     # -- sharding policy ---------------------------------------------------
@@ -244,9 +270,14 @@ class ShardedTrainStep:
         self._param_shardings = {}
         self._param_store_shardings = {}
         self._dev_param_shardings = {}
+        # the PRE-ZeRO placement of each param (TP spec without the
+        # stacked 'sharding' axis) — what a stage-3 all-gather restores
+        # and the overlap plan's prefetch constrains to
+        self._gather_shardings = {}
         for n in self._names:
             p = sd[n]
             spec = _current_spec(p.value)
+            self._gather_shardings[n] = NamedSharding(mesh, P(*spec))
             # only matrix-shaped params join ZeRO-3: sharding 1-D params
             # (norm scales, biases) along the hidden dim makes GSPMD
             # propagate hidden-dim shardings into every activation that
@@ -403,8 +434,39 @@ class ShardedTrainStep:
         stream_names = {id(sd[n]): n
                         for i, n in enumerate(names) if streamed[i]}
 
+        # comm/compute overlap (ISSUE 16): build the bucket plan once,
+        # statically verify its cross-rank collective order BEFORE any
+        # chip time, and swap the monolithic grad reduction for the
+        # bucketed barrier-chained one.  Bit-exact vs the monolithic
+        # path at grad_comm_dtype="auto" (tier-1-pinned).
+        overlap_plan = None
+        prefetch_on = False
+        if self._comm_overlap and self.mesh.size > 1 \
+                and not stream_params and not self.offload:
+            from .comm_overlap import CommOverlapPlan
+            plan = CommOverlapPlan.for_trainer(
+                names, [tuple(sd[n].value.shape) for n in names],
+                [str(sd[n].value.dtype) for n in names],
+                self.mesh, self.stage,
+                bucket_mb=self._comm_bucket_mb,
+                comm_dtype=self._grad_comm_dtype,
+                batch_axes=self.batch_axes)
+            if plan.active:
+                plan.verify()
+                overlap_plan = plan
+                prefetch_on = self.stage >= 3 \
+                    and self.mesh.shape.get("sharding", 1) > 1
+        self._overlap_plan = overlap_plan
+        self._comm_profile = overlap_plan.comm_profile() \
+            if overlap_plan is not None else None
+
         def loss_of(param_vals, buf_vals, key, batch):
             def fwd(param_vals):
+                if overlap_plan is not None and prefetch_on:
+                    # stage-3 param all-gather anchors, one bucket
+                    # ahead in forward order (layout-neutral chain)
+                    param_vals = overlap_plan.prefetch_params(
+                        param_vals)
                 if stream_params:
                     param_vals = [
                         v if streamed[i]
@@ -503,7 +565,16 @@ class ShardedTrainStep:
         def step(param_vals, opt_states, buf_vals, lr, step_i, key, batch):
             (loss, new_bufs), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(param_vals, buf_vals, key, batch)
-            if grad_shardings is not None:
+            if overlap_plan is not None:
+                # bucketed reduction: fused all-reduce (stage 0/1) or
+                # reduce-scatter (stage 2) per bucket, barrier-chained
+                # in reverse-topological issue order; stage 2
+                # re-applies the per-leaf sharded-grad constraint;
+                # stage 3 chains layout-neutrally (grad_shardings is
+                # None there — shard_map materializes the RS)
+                grads = overlap_plan.reduce_grads(
+                    grads, self.mesh, leaf_shardings=grad_shardings)
+            elif grad_shardings is not None:
                 grads = [jax.lax.with_sharding_constraint(g, gs)
                          for g, gs in zip(grads, grad_shardings)]
             if fused_ok and not offload and not stream_params:
@@ -643,6 +714,35 @@ class ShardedTrainStep:
             _tel.emit("collective.schedule", trainer="sharded",
                       total=len(events), kinds=kinds)
         return events
+
+    def overlap_schedule(self):
+        """The comm-overlap plan's static per-rank event lists
+        ({rank: [CollectiveEvent, ...]}), or None when overlap is off —
+        what `assert_collective_order` proves identical across the
+        mesh before any chip time (the plan already ran the proof at
+        build; this re-exposes it for composition with pipeline
+        schedules)."""
+        if self._compiled is None and self._overlap_plan is None:
+            # plan is built with the step; force it without running
+            if self._opt_states is None:
+                self._opt_states = self._init_opt_states()
+            self._build()
+        plan = self._overlap_plan
+        return plan.schedules() if plan is not None else None
+
+    def lint_comm_dtype(self, *batch):
+        """Satellite-1 audit (analysis.lints.lint_grad_comm_dtype):
+        jaxpr proof that every fused grad bucket's collective runs at
+        the plan's requested wire width — a bf16 grad silently upcast
+        to fp32 before the reduce (doubling comm bytes) is a finding.
+        Empty list when overlap is off (nothing fused to audit)."""
+        args = self._trace_args(batch)
+        if self._overlap_plan is None:
+            return []
+        from ..analysis.lints import lint_grad_comm_dtype
+        with self.mesh:
+            return lint_grad_comm_dtype(self._compiled, *args,
+                                        plan=self._overlap_plan)
 
     def lint(self, *batch, dtype: bool = False,
              transfers: Optional[bool] = None, donation: bool = True,
@@ -792,6 +892,13 @@ class ShardedTrainStep:
                      f"ShardedTrainStep.multi.s{self.stage}",
                      mesh=self.mesh,
                      sig=tuple(b.shape for b in stacked))
+        if self._comm_profile is not None:
+            # (re)attach the grad-comm profile — registration above
+            # clears per-program cost state, and the profile is a
+            # build-time property of THIS program
+            from ..telemetry import costledger as _cl
+            _cl.note_comm(f"ShardedTrainStep.multi.s{self.stage}",
+                          self._comm_profile)
         fn = _cc.aot_for(self._aot, "multi", self._compiled_multi, args,
                          stacked, f"ShardedTrainStep.multi.s{self.stage}",
                          mesh=self.mesh)
@@ -944,6 +1051,10 @@ class ShardedTrainStep:
                      f"ShardedTrainStep.step.s{self.stage}",
                      mesh=self.mesh,
                      sig=tuple(b.shape for b in batch_vals))
+        if self._comm_profile is not None:
+            from ..telemetry import costledger as _cl
+            _cl.note_comm(f"ShardedTrainStep.step.s{self.stage}",
+                          self._comm_profile)
         fn = _cc.aot_for(self._aot, "step", self._compiled, args,
                          batch_vals, f"ShardedTrainStep.step.s{self.stage}",
                          mesh=self.mesh)

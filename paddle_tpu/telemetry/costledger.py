@@ -55,10 +55,12 @@ from typing import Dict, List, Optional
 __all__ = ["cost_of", "model_train_flops", "backend_peaks",
            "chip_peak_flops", "configure_peaks", "ingest", "observe",
            "measured_ms", "program_changed", "cost_report", "snapshot",
-           "reset", "scope_census"]
+           "reset", "scope_census", "note_comm",
+           "interconnect_bytes_per_sec"]
 
 _lock = threading.Lock()
 _costs: Dict[str, dict] = {}        # label -> entry (insertion-ordered)
+_comm: Dict[str, dict] = {}         # label -> grad-comm profile (ISSUE 16)
 _measured: Dict[str, deque] = {}    # label -> warm wall_ms window
 _measured_total: Dict[str, int] = {}
 _drifted: set = set()               # labels currently below the floor
@@ -78,9 +80,14 @@ PEAK_HBM_BPS = {"v4": 1.23e12, "v5e": 0.82e12, "v5p": 2.77e12,
 # CALIBRATION_r05 anchor: predictions at mfu_assumption 0.6 landed
 # within 0.88-1.04x of measured full steps on the real chip
 CALIBRATED_EFFICIENCY = 0.6
+# per-chip ICI all-reduce bandwidth (bytes/s per device, the
+# bidirectional-ring figure the exposed-comm column divides by);
+# PEAK_ICI_GBPS env overrides for other fabrics (DCN, PCIe hosts)
+PEAK_ICI_BPS = {"v4": 300e9, "v5e": 160e9, "v5p": 600e9, "v6e": 400e9}
 # CPU placeholder peaks: tier-1 exercises the plumbing, not the
 # numbers (tests pin behavior through configure_peaks)
-_CPU_PEAKS = {"flops_per_sec": 100e9, "hbm_bytes_per_sec": 50e9}
+_CPU_PEAKS = {"flops_per_sec": 100e9, "hbm_bytes_per_sec": 50e9,
+              "ici_bytes_per_sec": 10e9}
 
 
 def _chip_name() -> Optional[str]:
@@ -124,7 +131,8 @@ def chip_peak_flops(default: Optional[str] = "v5e") -> float:
 
 def configure_peaks(flops_per_sec: Optional[float] = None,
                     hbm_bytes_per_sec: Optional[float] = None,
-                    efficiency: Optional[float] = None):
+                    efficiency: Optional[float] = None,
+                    ici_bytes_per_sec: Optional[float] = None):
     """Override the calibrated peaks (tools/tests; calibration runs
     feed their implied mfu back through `efficiency`).  Passing None
     for a field leaves it on the chip-table default; `reset()` clears
@@ -136,7 +144,27 @@ def configure_peaks(flops_per_sec: Optional[float] = None,
             _peaks_override["hbm_bytes_per_sec"] = float(hbm_bytes_per_sec)
         if efficiency is not None:
             _peaks_override["efficiency"] = float(efficiency)
+        if ici_bytes_per_sec is not None:
+            _peaks_override["ici_bytes_per_sec"] = float(
+                ici_bytes_per_sec)
     return backend_peaks()
+
+
+def interconnect_bytes_per_sec() -> float:
+    """Calibrated interconnect bandwidth for collective payloads (the
+    denominator of the exposed-comm column): PEAK_ICI_GBPS env wins,
+    then a configure_peaks override, then the sniffed chip's ICI peak
+    scaled by the calibration efficiency, else the CPU placeholder."""
+    if "PEAK_ICI_GBPS" in os.environ:
+        return float(os.environ["PEAK_ICI_GBPS"]) * 1e9
+    with _lock:
+        ov = _peaks_override.get("ici_bytes_per_sec")
+        eff = _peaks_override.get("efficiency", CALIBRATED_EFFICIENCY)
+    if ov is not None:
+        return ov
+    chip = _chip_name()
+    raw = PEAK_ICI_BPS.get(chip, _CPU_PEAKS["ici_bytes_per_sec"])
+    return raw * eff
 
 
 def backend_peaks() -> dict:
@@ -265,6 +293,18 @@ def ingest(label: str, compiled, meta: Optional[dict] = None):
     return entry
 
 
+def note_comm(label: str, profile: dict):
+    """Attach a gradient-communication profile to `label`'s program
+    (ISSUE 16): byte volumes per bucket in issue order plus the
+    overlap shape, as produced by CommOverlapPlan.comm_profile().
+    The report derives the exposed-comm column from it — comm time at
+    the calibrated ICI peak vs the backward compute available to hide
+    it under — so the overlap win is a ledger number before any chip
+    time.  Registered at trainer BUILD (zero steady-state cost)."""
+    with _lock:
+        _comm[label] = dict(profile)
+
+
 def _publish(entry: dict):
     """cost.program event + counter — a fleet JSONL log carries the
     cost ledger the way it carries mem.program records."""
@@ -290,6 +330,7 @@ def program_changed(label: str):
         _measured.pop(label, None)
         _measured_total.pop(label, None)
         _costs.pop(label, None)
+        _comm.pop(label, None)
         _drifted.discard(label)
 
 
@@ -363,6 +404,8 @@ def _report(resolve: bool, measured, emit_drift: bool) -> dict:
     floor = _floor()
     with _lock:
         entries = [dict(e) for e in _costs.values()]
+        comm_profiles = {lbl: dict(p) for lbl, p in _comm.items()}
+    ici_bps = interconnect_bytes_per_sec() if comm_profiles else None
     programs: Dict[str, dict] = {}
     drifts: List[str] = []
     for e in entries:
@@ -404,6 +447,28 @@ def _report(resolve: bool, measured, emit_drift: bool) -> dict:
                 if floor > 0 and attained < floor:
                     rec["drift"] = True
                     drifts.append(e["label"])
+            cp = comm_profiles.get(e["label"])
+            if cp is not None:
+                # the exposed-comm column (ISSUE 16): per-bucket comm
+                # at the ICI peak vs the backward compute available to
+                # hide it.  Backward ≈ 2/3 of a fwd+bwd step (4N of 6N
+                # FLOPs) — the window the bucket chain overlaps into.
+                from ..analysis.collectives import estimate_exposed_comm
+                bwd_ms = predicted_ms * (2.0 / 3.0)
+                sizes = cp.get("bucket_bytes") or [cp.get("bytes", 0)]
+                on = estimate_exposed_comm(
+                    sizes, bwd_ms, bytes_per_sec=ici_bps, overlap=True)
+                off = estimate_exposed_comm(
+                    sizes, bwd_ms, bytes_per_sec=ici_bps, overlap=False)
+                rec["comm_bytes"] = on["bytes"]
+                rec["comm_buckets"] = on["buckets"]
+                rec["comm_ms"] = round(on["comm_ms"], 4)
+                rec["exposed_comm_ms"] = round(on["exposed_ms"], 4)
+                rec["exposed_comm_ms_monolithic"] = round(
+                    off["exposed_ms"], 4)
+                rec["overlap_efficiency"] = round(
+                    on["overlap_efficiency"], 4)
+                rec["comm_overlap"] = bool(cp.get("overlap", True))
         programs[e["label"]] = rec
     if emit_drift:
         from .registry import counter as _counter, emit as _emit
@@ -445,6 +510,7 @@ def _report(resolve: bool, measured, emit_drift: bool) -> dict:
 def reset():
     with _lock:
         _costs.clear()
+        _comm.clear()
         _measured.clear()
         _measured_total.clear()
         _peaks_override.clear()

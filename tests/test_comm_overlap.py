@@ -1,0 +1,387 @@
+"""Comm/compute overlap engine (parallel/comm_overlap.py): bucketed
+gradient collectives issued with the backward.
+
+Correctness contract pinned here (ISSUE 16 acceptance criteria):
+
+  * bucket assembly is size-targeted, reverse-topological, dtype-safe
+    (the boundary-case zoo: giant param, many tiny params, mixed
+    dtypes, empty list);
+  * with comm_overlap=True the per-step losses and updated params are
+    BIT-EXACT vs the monolithic path, for ZeRO stages 1/2/3 on the
+    8-device host mesh — flatten/concat/unflatten is exact and the
+    reduction runs over the same participants either way;
+  * every supported (stage, pp-schedule) combination passes the static
+    collective-order check before any chip time;
+  * estimate_exposed_comm predicts overlap-on strictly below
+    overlap-off whenever there are >= 2 buckets and compute to hide
+    under (the perf_report bench gate's model);
+  * the grad-comm dtype lint proves the reduce runs at the requested
+    width (no silent bf16 -> fp32 upcast).
+"""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.analysis.collectives import (
+    CollectiveEvent, CollectiveOrderError, assert_collective_order,
+    estimate_exposed_comm)
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc, PipelineLayer)
+from paddle_tpu.distributed.topology import (
+    HybridCommunicateGroup, build_mesh, set_hybrid_communicate_group)
+from paddle_tpu.parallel import ShardedTrainStep
+from paddle_tpu.parallel.comm_overlap import (
+    CommOverlapPlan, build_buckets, resolve_comm_dtype)
+from paddle_tpu.parallel.pipeline import PipelineEngine
+
+
+def _need8():
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 (virtual) devices")
+
+
+# ---------------------------------------------------------------------------
+# bucket assembly
+# ---------------------------------------------------------------------------
+
+class TestBucketAssembly:
+    def test_size_target_closes_buckets(self):
+        # 8 params x 1KB at a 2KB target -> 4 buckets of 2 params
+        names = [f"p{i}" for i in range(8)]
+        shapes = [(256,)] * 8          # 256 * 4B = 1KB each
+        dtypes = ["float32"] * 8
+        bs = build_buckets(names, shapes, dtypes, bucket_mb=2 / 1024)
+        assert len(bs) == 4
+        assert all(len(b.indices) == 2 for b in bs)
+        assert sum(b.nbytes for b in bs) == 8 * 1024
+
+    def test_reverse_topological_order(self):
+        # the backward produces last-layer grads first: bucket 0 must
+        # hold the LAST registered params
+        names = ["first", "mid", "last"]
+        bs = build_buckets(names, [(4,)] * 3, ["float32"] * 3,
+                           bucket_mb=1.0)
+        assert bs[0].names == ("last", "mid", "first")
+        bs = build_buckets(names, [(300,)] * 3, ["float32"] * 3,
+                           bucket_mb=1 / 1024)
+        assert [b.names for b in bs] == [("last",), ("mid",), ("first",)]
+
+    def test_giant_param_gets_own_bucket(self):
+        # a single param over the target (the embedding case) closes
+        # the running bucket and takes one of its own
+        names = ["small_a", "giant", "small_b"]
+        shapes = [(8,), (1 << 20,), (8,)]
+        bs = build_buckets(names, shapes, ["float32"] * 3,
+                           bucket_mb=0.5)
+        assert [b.names for b in bs] == [
+            ("small_b",), ("giant",), ("small_a",)]
+        assert bs[1].nbytes == (1 << 20) * 4
+
+    def test_many_tiny_params_fuse(self):
+        names = [f"t{i}" for i in range(100)]
+        bs = build_buckets(names, [(2,)] * 100, ["float32"] * 100,
+                           bucket_mb=32.0)
+        assert len(bs) == 1
+        assert bs[0].numel == 200
+
+    def test_dtype_separation(self):
+        # bf16 and fp32 params never share a fused buffer
+        names = ["a", "b", "c", "d"]
+        dtypes = ["float32", "bfloat16", "bfloat16", "float32"]
+        bs = build_buckets(names, [(4,)] * 4, dtypes, bucket_mb=32.0)
+        assert [b.comm_dtype for b in bs] == [
+            "float32", "bfloat16", "float32"]
+        assert bs[1].names == ("c", "b")
+
+    def test_empty_param_list(self):
+        assert build_buckets([], [], [], bucket_mb=32.0) == []
+
+    def test_divisor_pads_for_reduce_scatter(self):
+        bs = build_buckets(["p"], [(10,)], ["float32"], bucket_mb=1.0,
+                           divisor=8)
+        assert bs[0].numel == 10 and bs[0].padded_numel == 16
+        # payload bytes exclude the pad
+        assert bs[0].nbytes == 40
+
+    def test_resolve_comm_dtype(self):
+        assert resolve_comm_dtype("float32", "auto") == "float32"
+        assert resolve_comm_dtype("bfloat16", "auto") == "bfloat16"
+        assert resolve_comm_dtype("float32", "bfloat16") == "bfloat16"
+
+
+# ---------------------------------------------------------------------------
+# static schedule + event model
+# ---------------------------------------------------------------------------
+
+class TestStaticSchedule:
+    def _plan(self, stage, n_params=6, bucket_mb=0.001):
+        names = [f"p{i}" for i in range(n_params)]
+        return CommOverlapPlan.modeled(
+            names, [(128,)] * n_params, ["float32"] * n_params,
+            world=8, stage=stage, bucket_mb=bucket_mb)
+
+    @pytest.mark.parametrize("stage", [0, 1, 2, 3])
+    def test_plan_verifies_per_stage(self, stage):
+        plan = self._plan(stage)
+        assert plan.active
+        plan.verify()                      # raises on divergence
+        evs = plan.events()
+        reduces = [e for e in evs if e.kind in ("psum", "reduce_scatter")]
+        assert len(reduces) == len(plan.buckets)
+        # issue order: bucket 0 reduces first
+        assert [e.bucket for e in reduces] == list(
+            range(len(plan.buckets)))
+        if stage >= 2:
+            assert all(e.kind == "reduce_scatter" for e in reduces)
+        else:
+            assert all(e.kind == "psum" for e in reduces)
+        if stage >= 3:
+            gathers = [e for e in evs if e.kind == "all_gather"]
+            # prefetch in FORWARD order = reversed bucket issue order
+            assert [e.bucket for e in gathers] == list(
+                range(len(plan.buckets) - 1, -1, -1))
+
+    def test_order_divergence_is_caught(self):
+        plan = self._plan(2)
+        sched = plan.schedules(world=4)
+        sched[2] = list(reversed(sched[2]))    # rank 2 swaps buckets
+        with pytest.raises(CollectiveOrderError):
+            assert_collective_order(sched)
+
+    def test_collective_event_back_compat(self):
+        # pre-existing 3-positional-arg call sites must keep working
+        ev = CollectiveEvent("psum", ("k",), ("dp",))
+        assert ev.bytes == 0 and ev.bucket == -1
+        rich = CollectiveEvent("psum", ("k",), ("dp",), bytes=1 << 20,
+                               bucket=2)
+        assert "bucket 2" in rich.describe()
+
+    @pytest.mark.parametrize("schedule,vpp", [
+        ("FThenB", 1), ("1F1B", 1), ("ZB", 1), ("VPP", 2),
+        ("ZB-VPP", 2)])
+    def test_pipeline_schedules_verify_with_overlap(self, schedule, vpp):
+        """Every supported pp schedule passes the static order check
+        with grad-bucket drains woven in, and emits grad_rs events
+        carrying bytes + bucket ids."""
+        _need8()
+        paddle.set_flags({"FLAGS_comm_bucket_mb": 0.0001})
+        try:
+            paddle.seed(42)
+            pl = PipelineLayer(
+                [LayerDesc(nn.Linear, 8, 8) for _ in range(4)],
+                loss_fn=lambda o, y: ((o - y) ** 2).mean())
+            hcg = HybridCommunicateGroup(pp_degree=2)
+            set_hybrid_communicate_group(hcg)
+            kw = {"num_virtual_stages": vpp} if vpp > 1 else {}
+            eng = PipelineEngine(pl, mesh=hcg.mesh, **kw)
+            eng.verify_schedule(4, schedule, comm_overlap=True)
+            evs = eng.collective_events(4, schedule, comm_overlap=True)
+            rs = [e for es in evs.values() for e in es
+                  if e.kind == "grad_rs"]
+            assert rs and all(e.bytes > 0 and e.bucket >= 0 for e in rs)
+        finally:
+            paddle.set_flags({"FLAGS_comm_bucket_mb": 32.0})
+
+
+# ---------------------------------------------------------------------------
+# exposed-comm estimator (the perf_report gate's model)
+# ---------------------------------------------------------------------------
+
+class TestExposedCommEstimate:
+    def test_overlap_strictly_below_monolithic(self):
+        sizes = [1 << 20] * 4
+        on = estimate_exposed_comm(sizes, compute_ms=50.0,
+                                   bytes_per_sec=1e9)
+        off = estimate_exposed_comm(sizes, compute_ms=50.0,
+                                    bytes_per_sec=1e9, overlap=False)
+        assert on["exposed_ms"] < off["exposed_ms"]
+        assert off["exposed_ms"] == pytest.approx(off["comm_ms"])
+        assert 0.0 <= on["overlap_efficiency"] <= 1.0
+
+    def test_single_bucket_gains_nothing(self):
+        # n=1: the lone collective still waits for the full backward
+        on = estimate_exposed_comm([1 << 20], compute_ms=50.0,
+                                   bytes_per_sec=1e9)
+        off = estimate_exposed_comm([1 << 20], compute_ms=50.0,
+                                    bytes_per_sec=1e9, overlap=False)
+        assert on["exposed_ms"] == pytest.approx(off["exposed_ms"])
+
+    def test_zero_compute_fully_exposed(self):
+        on = estimate_exposed_comm([1 << 20] * 4, compute_ms=0.0,
+                                   bytes_per_sec=1e9)
+        assert on["exposed_ms"] == pytest.approx(on["comm_ms"])
+
+    def test_accepts_events_and_ints(self):
+        evs = [CollectiveEvent("psum", ("k",), ("dp",), bytes=1000,
+                               bucket=i) for i in range(3)]
+        a = estimate_exposed_comm(evs, compute_ms=1.0,
+                                  bytes_per_sec=1e9)
+        b = estimate_exposed_comm([1000] * 3, compute_ms=1.0,
+                                  bytes_per_sec=1e9)
+        assert a == b
+        assert a["bytes"] == 3000 and a["buckets"] == 3
+
+
+# ---------------------------------------------------------------------------
+# bit-exactness on the 8-device host mesh (the tier-1 pin)
+# ---------------------------------------------------------------------------
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.l1 = nn.Linear(16, 32)
+        self.l2 = nn.Linear(32, 16)
+        self.l3 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        h = nn.functional.relu(self.l1(x))
+        h = nn.functional.relu(self.l2(h))
+        return self.l3(h)
+
+
+class TestBitExact:
+    def _run(self, stage, overlap, steps=3):
+        paddle.seed(42)
+        m = _MLP()
+        opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+        mesh = build_mesh(sharding=8)
+        st = ShardedTrainStep(
+            m, opt, mesh, sharding_stage=stage,
+            loss_fn=lambda o, y: nn.functional.cross_entropy(o, y),
+            comm_overlap=overlap, comm_bucket_mb=0.001)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 16).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (16, 1)).astype(np.int64))
+        losses = [float(np.asarray(st(x, y).value)) for _ in range(steps)]
+        params = {n: np.asarray(v.value).copy()
+                  for n, v in m.state_dict().items()}
+        return losses, params, st
+
+    @pytest.mark.parametrize("stage", [1, 2, 3])
+    def test_bucketed_matches_monolithic_bitwise(self, stage):
+        _need8()
+        l_off, p_off, _ = self._run(stage, False)
+        l_on, p_on, st = self._run(stage, True)
+        assert l_on == l_off                      # exact, not allclose
+        for n in p_off:
+            np.testing.assert_array_equal(p_on[n], p_off[n])
+        # the plan was built, split the grads, and passed its static
+        # pre-flight at build time
+        assert st._overlap_plan is not None
+        assert len(st._overlap_plan.buckets) >= 2
+        sched = st.overlap_schedule()
+        assert sched and len(sched) == 8
+
+    @pytest.mark.parametrize("stage", [2, 3])
+    def test_dtype_lint_clean_at_auto(self, stage):
+        """Satellite 1: the jaxpr-level audit proves every bucket's
+        reduce runs at the requested wire width (stage 2 via the fused
+        constraint, stage 3 via the layout-neutral barrier chain)."""
+        _need8()
+        _, _, st = self._run(stage, True, steps=1)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 16).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (16, 1)).astype(np.int64))
+        assert st.lint_comm_dtype(x, y) == []
+
+    def test_pipeline_drain_bit_exact(self):
+        """Grad-bucket drains inside the schedule bubble change WHEN
+        Parameter.grad is written, never its value."""
+        _need8()
+        paddle.set_flags({"FLAGS_comm_bucket_mb": 0.0001})
+        try:
+            rng = np.random.RandomState(7)
+            x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+            y = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+
+            def run(overlap):
+                paddle.seed(42)
+                pl = PipelineLayer(
+                    [LayerDesc(nn.Linear, 8, 8) for _ in range(4)],
+                    loss_fn=lambda o, t: ((o - t) ** 2).mean())
+                hcg = HybridCommunicateGroup(pp_degree=2)
+                set_hybrid_communicate_group(hcg)
+                eng = PipelineEngine(pl, mesh=hcg.mesh)
+                opt = paddle.optimizer.SGD(
+                    0.05, parameters=pl.parameters())
+                out = []
+                for _ in range(2):
+                    loss = eng.train_batch([x, y], 4, schedule="1F1B",
+                                           comm_overlap=overlap)
+                    opt.step()
+                    opt.clear_grad()
+                    out.append(float(np.asarray(loss.value)))
+                return out, eng
+
+            l_off, _ = run(False)
+            l_on, eng = run(True)
+            assert l_on == l_off
+            assert eng._drained        # drains actually executed
+        finally:
+            paddle.set_flags({"FLAGS_comm_bucket_mb": 32.0})
+
+
+# ---------------------------------------------------------------------------
+# fleet plane: the bucketed host reduce (tools/chaos_check.py --comm-overlap)
+# ---------------------------------------------------------------------------
+
+class TestFleetBucketedReduce:
+    """`chaos_check --fleet --comm-overlap` swaps the monolithic host
+    all_reduce for one call per grad bucket in issue order.  Pin the
+    reassembly math here (cheap, in-process): the bucketed exchange is
+    element-for-element identical to the monolithic one — the property
+    that makes the elastic kill/shrink-resume bit-exact with buckets
+    in flight (no torn bucket state can reach a checkpoint)."""
+
+    def _cli(self):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "chaos_check", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(
+                    __file__))), "tools", "chaos_check.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_bucketed_reduce_matches_monolithic(self):
+        cli = self._cli()
+        model, _ = cli.fleet_model()
+
+        calls = []
+
+        class _HC:
+            def all_reduce(self, v):   # a fake 2-rank sum of twins
+                calls.append(len(v))
+                return np.asarray(v, np.float32) * 2.0
+
+        fn = cli.fleet_bucketed_reduce(_HC(), model, bucket_mb=0.0005)
+        n = 1 + sum(int(np.prod(p.value.shape))
+                    for _, p in model.named_parameters())
+        flat = np.random.RandomState(3).randn(n).astype(np.float32)
+        got = fn(flat)
+        np.testing.assert_array_equal(got, flat * 2.0)
+        # one collective per bucket, never per-param, never monolithic
+        assert len(calls) == len(fn.buckets) >= 2
+        # every element rides exactly one bucket; the loss scalar too
+        assert sum(calls) == n
+        assert calls[0] == 1 + sum(
+            int(np.prod(s)) for s in fn.buckets[0].shapes)
+
+    def test_bucket_issue_order_is_rank_invariant(self):
+        # the deadlock guard: every rank must derive the SAME bucket
+        # sequence from its local model clone
+        cli = self._cli()
+        m1, _ = cli.fleet_model()
+        m2, _ = cli.fleet_model()
+
+        class _HC:
+            def all_reduce(self, v):
+                return v
+
+        b1 = cli.fleet_bucketed_reduce(_HC(), m1).buckets
+        b2 = cli.fleet_bucketed_reduce(_HC(), m2).buckets
+        assert [(b.idx, b.names) for b in b1] \
+            == [(b.idx, b.names) for b in b2]

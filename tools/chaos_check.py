@@ -25,6 +25,7 @@ Train-plane modes:
 Fleet-plane modes (ISSUE 13 — the elastic shrink loop):
 
   python tools/chaos_check.py --fleet [--ranks N] [--steps T] [--kill-step K]
+                              [--comm-overlap]
       Run a REAL N-process data-parallel job (N launcher pods on
       localhost sharing one KV master, JAX_PLATFORMS=cpu, grads
       all-reduced over the host-collective plane, every rank saving its
@@ -38,7 +39,12 @@ Fleet-plane modes (ISSUE 13 — the elastic shrink loop):
       to an uninterrupted N−1 run restored from the same checkpoint,
       and the consumed global sample indices per step exactly match the
       world-independent schedule — zero samples lost or duplicated
-      across the shrink.
+      across the shrink.  With --comm-overlap the grad exchange runs
+      through the ISSUE-16 bucketed reduce (one host all_reduce per
+      grad bucket, in bucket issue order) instead of a single
+      monolithic call — the same contract must hold with buckets in
+      flight, i.e. no torn (partially reduced) bucket state can ever
+      reach a saved checkpoint.
 
   python tools/chaos_check.py --fleet --selftest
       The killed-rank e2e above (2 pods → 1) plus `fleet.elastic`
@@ -701,6 +707,53 @@ def fleet_apply_state(model, opt, arrays):
                 st[k] = jnp.asarray(arrays[f"opt.{n}.{k}"])
 
 
+def fleet_bucketed_reduce(hc, model, bucket_mb=0.0005):
+    """ISSUE 16 × r17: the comm-overlap engine's bucket assembly on
+    the host-collective plane.  Instead of ONE monolithic all_reduce
+    of the flat [loss|grads] vector, reduce per grad bucket in ISSUE
+    order (reverse-topological, `comm_overlap.build_buckets` — the
+    exact unit the jit engine fuses), the loss scalar riding the
+    first bucket.  Every rank walks the same deterministic bucket
+    list, so the per-bucket collectives match across the gang by
+    construction (the property CommOverlapPlan.verify proves for the
+    jit path).
+
+    The elastic contract under test: a checkpoint commits only after
+    the LAST bucket drains (fleet_train_step returns → save), so a
+    rank killed with buckets in flight can never persist torn
+    (partially reduced) state — run_fleet's bit-exact reference
+    (monolithic world-1 reduce) proves the resumed trajectory
+    identical."""
+    import numpy as np
+    from paddle_tpu.parallel.comm_overlap import build_buckets
+
+    params = list(model.named_parameters())
+    names = [n for n, _ in params]
+    shapes = [tuple(p.value.shape) for _, p in params]
+    dtypes = [str(p.value.dtype) for _, p in params]
+    buckets = build_buckets(names, shapes, dtypes, bucket_mb=bucket_mb)
+    sizes = [int(np.prod(s)) for s in shapes]
+    starts = np.concatenate([[1], 1 + np.cumsum(sizes)])  # flat[0]=loss
+
+    def reduce_fn(flat):
+        out = np.array(flat, dtype=np.float32, copy=True)
+        for b in buckets:
+            spans = [(int(starts[i]), int(starts[i] + sizes[i]))
+                     for i in b.indices]
+            if b.idx == 0:
+                spans.insert(0, (0, 1))        # the loss rides bucket 0
+            fused = np.concatenate([out[a:z] for a, z in spans])
+            fused = np.asarray(hc.all_reduce(fused), np.float32)
+            off = 0
+            for a, z in spans:
+                out[a:z] = fused[off:off + (z - a)]
+                off += z - a
+        return out
+
+    reduce_fn.buckets = buckets
+    return reduce_fn
+
+
 def fleet_train_step(model, opt, x, y, gbs, reduce_fn=None):
     """One dp step on this rank's slice: local per-sample SUM loss +
     grads, cross-rank sum via `reduce_fn` (None = single rank), then
@@ -769,7 +822,13 @@ def fleet_worker_main():
                                   seed=FLEET_SAMPLE_SEED)
     X, Y = fleet_data(n)
     hc = get_host_collectives()
-    reduce_fn = (lambda v: hc.all_reduce(v)) if hc is not None else None
+    if hc is None:
+        reduce_fn = None
+    elif cfg.get("comm_overlap"):
+        reduce_fn = fleet_bucketed_reduce(
+            hc, model, bucket_mb=cfg.get("bucket_mb", 0.0005))
+    else:
+        reduce_fn = lambda v: hc.all_reduce(v)  # noqa: E731
 
     log = open(os.path.join(dump, f"losses.e{eepoch}.r{rank}.jsonl"),
                "a", buffering=1)
@@ -815,7 +874,7 @@ def fleet_worker_main():
 
 
 def run_fleet(ranks=2, steps=8, kill_step=4, kill_rank=1, gbs=12,
-              workdir=None):
+              workdir=None, comm_overlap=False):
     """Drive the N-proc elastic shrink chaos scenario; returns a report
     dict with report["ok"] the pass verdict (see module docstring)."""
     import subprocess
@@ -829,7 +888,8 @@ def run_fleet(ranks=2, steps=8, kill_step=4, kill_rank=1, gbs=12,
     os.makedirs(dump, exist_ok=True)
     cfg = {"steps": steps, "gbs": gbs, "n_samples": steps * gbs + 3,
            "ckpt": root, "dump": dump, "kill_rank": kill_rank,
-           "kill_spec": f"step.begin:step={kill_step}:mode=kill"}
+           "kill_spec": f"step.begin:step={kill_step}:mode=kill",
+           "comm_overlap": bool(comm_overlap)}
 
     from paddle_tpu.distributed.launch.master import KVServer
     srv = KVServer(0).start()
@@ -968,6 +1028,7 @@ def run_fleet(ranks=2, steps=8, kill_step=4, kill_rank=1, gbs=12,
           and not cross_rank_mismatch and not coverage_bad
           and not mismatch)
     return {"ranks": ranks, "steps": steps, "kill_step": kill_step,
+            "comm_overlap": bool(comm_overlap),
             "launcher_rcs": rcs, "fired": fired, "shrank": shrank,
             "completed": len(completed), "resume_step": resume_step,
             "resumes": len(resumes),
@@ -1050,6 +1111,12 @@ def main(argv=None):
     ap.add_argument("--kill-step", type=int, default=4,
                     help="global step whose entry kills the victim "
                          "rank (--fleet)")
+    ap.add_argument("--comm-overlap", action="store_true",
+                    help="run the fleet's grad exchange through the "
+                         "ISSUE-16 bucketed reduce (one host "
+                         "all_reduce per grad bucket, issue order) — "
+                         "the kill/shrink-resume must stay bit-exact "
+                         "with buckets in flight (--fleet)")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
     if args.fleet_worker:
@@ -1074,12 +1141,15 @@ def main(argv=None):
                       f"/{len(checks)} checks passed")
             return 1 if bad else 0
         rep = run_fleet(ranks=args.ranks, steps=args.steps,
-                        kill_step=args.kill_step)
+                        kill_step=args.kill_step,
+                        comm_overlap=args.comm_overlap)
         if args.as_json:
             print(json.dumps(rep, indent=2))
         else:
             verdict = "RECOVERED" if rep["ok"] else "FAILED"
-            print(f"{verdict}: {rep['ranks']}-proc job, kill at step "
+            print(f"{verdict}: {rep['ranks']}-proc job"
+                  f"{' (comm_overlap)' if rep['comm_overlap'] else ''}, "
+                  f"kill at step "
                   f"{rep['kill_step']}, completed {rep['completed']}/"
                   f"{rep['steps']} steps, resume_step="
                   f"{rep['resume_step']}, coverage_bad="

@@ -147,6 +147,40 @@ def compare(current, trajectory, k: float = DEFAULT_K,
                 or not isinstance(rec.get("value"), (int, float)):
             continue
         seen.add(metric)
+        # within-capture exposed-comm gate (ISSUE 16): a leg carrying
+        # an `exposed_comm` block promises the overlap engine's
+        # predicted exposed communication is STRICTLY below the
+        # monolithic baseline's — no trajectory needed, the capture
+        # judges itself.  An `error` in the block means the leg failed
+        # to produce the column at all, which fails too.
+        ec = rec.get("exposed_comm")
+        if isinstance(ec, dict):
+            on, off = ec.get("on_ms"), ec.get("off_ms")
+            if "error" in ec or on is None or off is None:
+                findings.append({
+                    "code": "exposed-comm-missing", "metric": metric,
+                    "message": f"{metric}: exposed_comm block is "
+                               f"incomplete ({ec.get('error', ec)!s})",
+                })
+                rows.append({"metric": f"{metric}.exposed_comm",
+                             "verdict": "EXPOSED-COMM MISSING"})
+            elif off > 0 and not on < off:
+                findings.append({
+                    "code": "exposed-comm-regression", "metric": metric,
+                    "message": f"{metric}: overlap-on predicts "
+                               f"{on}ms exposed comm, not strictly "
+                               f"below the overlap-off {off}ms — the "
+                               f"bucket chain is not hiding anything "
+                               f"({ec.get('buckets')} bucket(s))",
+                    "on_ms": on, "off_ms": off,
+                })
+                rows.append({"metric": f"{metric}.exposed_comm",
+                             "value": on,
+                             "verdict": "EXPOSED-COMM REGRESSION"})
+            else:
+                rows.append({"metric": f"{metric}.exposed_comm",
+                             "value": on,
+                             "verdict": f"ok (on {on}ms < off {off}ms)"})
         row = {"metric": metric, "value": rec["value"]}
         cands = baselines.get(metric)
         if not cands:
@@ -326,6 +360,28 @@ def _selftest(repo_root: str):
     if not any(r["verdict"].startswith("missing")
                and r["metric"] == "m_serve" for r in rep["rows"]):
         problems.append(f"vanished metric not surfaced: {rep['rows']}")
+
+    # 8b. the exposed-comm gate (ISSUE 16): a healthy block passes, a
+    # planted on>=off block fails with a named finding, a broken block
+    # fails as missing — all judged within the capture itself
+    ok_ec = _mk("m_overlap", 100.0,
+                exposed_comm={"on_ms": 1.0, "off_ms": 4.0})
+    rep = compare([ok_ec], base)
+    if rep["findings"]:
+        problems.append(f"healthy exposed-comm block fired: {rep}")
+    bad_ec = _mk("m_overlap", 100.0,
+                 exposed_comm={"on_ms": 4.0, "off_ms": 4.0,
+                               "buckets": 1})
+    rep = compare([bad_ec], base)
+    if len(rep["findings"]) != 1 \
+            or rep["findings"][0]["code"] != "exposed-comm-regression":
+        problems.append(f"planted exposed-comm regression not "
+                        f"caught: {rep}")
+    err_ec = _mk("m_overlap", 100.0, exposed_comm={"error": "boom"})
+    rep = compare([err_ec], base)
+    if len(rep["findings"]) != 1 \
+            or rep["findings"][0]["code"] != "exposed-comm-missing":
+        problems.append(f"broken exposed-comm block not caught: {rep}")
 
     # 9. the REAL committed trajectory passes (legacy captures skip on
     # the fingerprint rule; nothing may raise or false-fire)
