@@ -121,7 +121,7 @@ def _domain_participants(domain, all_ranks):
 
 def check_collective_order(
         schedules: Dict[object, Sequence[CollectiveEvent]],
-        participants=None) -> List[Finding]:
+        participants=None, composed: bool = False) -> List[Finding]:
     """Statically prove an identical per-domain total order across all
     participating ranks.  Returns findings (empty == deadlock-free
     ordering); each finding names the domain, the diverging ranks, and
@@ -130,7 +130,17 @@ def check_collective_order(
     the classic one-rank-never-enters-the-collective hang.
 
     participants: optional callable domain -> set(ranks) overriding
-    `_domain_participants`."""
+    `_domain_participants`.
+
+    composed=True additionally proves the CROSS-domain issue order
+    (the hybrid-engine contract): ranks that touch the same SET of
+    domains — e.g. every rank of one SPMD stage program, which issues
+    all of its mesh axes' collectives in one program order — must
+    interleave those domains identically.  Per-domain checking alone
+    cannot see a sharding reduce-scatter swapped with an mp
+    all-gather on one rank (each domain still holds a consistent
+    order of ONE event); with every rank blocking on its first
+    collective, the swap is still a rendezvous deadlock."""
     findings: List[Finding] = []
     all_ranks = list(schedules)
     part = participants or (
@@ -162,12 +172,44 @@ def check_collective_order(
                 f"(lengths {len(ref)} vs {len(seq)})",
                 op_index=pos,
                 detail=(domain, ref_rank, rank, pos)))
+    if composed:
+        groups: Dict[frozenset, List] = {}
+        for rank in all_ranks:
+            sig = frozenset(ev.domain for ev in schedules[rank])
+            groups.setdefault(sig, []).append(rank)
+        for sig, ranks in groups.items():
+            if len(ranks) < 2:
+                continue
+            ref_rank = ranks[0]
+            ref = [(ev.kind, ev.key, ev.domain)
+                   for ev in schedules[ref_rank]]
+            for rank in ranks[1:]:
+                seq = [(ev.kind, ev.key, ev.domain)
+                       for ev in schedules[rank]]
+                if seq == ref:
+                    continue
+                pos = next((i for i, (a, b) in enumerate(zip(ref, seq))
+                            if a != b), min(len(ref), len(seq)))
+                a = ref[pos] if pos < len(ref) \
+                    else "<nothing — sequence ends>"
+                b = seq[pos] if pos < len(seq) \
+                    else "<nothing — sequence ends>"
+                findings.append(Finding(
+                    "composed-order-divergence",
+                    f"composed issue order: rank {ref_rank!r} and rank "
+                    f"{rank!r} share domains {sorted(sig, key=repr)} "
+                    f"but interleave them differently at position "
+                    f"{pos}: {a!r} vs {b!r} — one program order per "
+                    f"SPMD group, or the first divergent collective "
+                    f"rendezvous hangs the mesh",
+                    op_index=pos,
+                    detail=(sorted(sig, key=repr), ref_rank, rank, pos)))
     return findings
 
 
 def assert_collective_order(schedules, title="collective order check "
-                            "failed"):
-    findings = check_collective_order(schedules)
+                            "failed", composed: bool = False):
+    findings = check_collective_order(schedules, composed=composed)
     if findings:
         raise CollectiveOrderError(findings, title=title)
 

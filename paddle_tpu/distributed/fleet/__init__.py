@@ -22,6 +22,8 @@ from . import meta_parallel  # noqa: F401
 from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
                             VocabParallelEmbedding, ParallelCrossEntropy,
                             get_rng_state_tracker)
+from .meta_parallel import (HybridParallel, HybridParallelEngine,  # noqa: F401
+                            HybridConfigError, validate_hybrid_configs)
 from .recompute import recompute, recompute_sequential  # noqa: F401
 
 _fleet_state = {"initialized": False, "strategy": None, "hcg": None,
@@ -42,7 +44,14 @@ def init(role_maker=None, is_collective=False, strategy=None, log_level=2):
     from ..env import init_parallel_env
     init_parallel_env()
     strategy = strategy or DistributedStrategy()
-    hp = strategy.hybrid_configs
+    # validate degrees against the real device count HERE, where the
+    # mesh is about to exist (ISSUE 17 satellite): unknown keys and a
+    # non-dividing degree product raise HybridConfigError by name
+    # instead of building a silently wrong mesh
+    import jax as _jax
+    from ...parallel.hybrid_engine import validate_hybrid_configs
+    hp = validate_hybrid_configs(strategy.hybrid_configs,
+                                 device_count=len(_jax.devices()))
     hcg = HybridCommunicateGroup(
         dp_degree=hp.get("dp_degree", 1),
         mp_degree=hp.get("mp_degree", 1),

@@ -9,13 +9,16 @@ from __future__ import annotations
 __all__ = ["DistributedStrategy"]
 
 
+_HYBRID_DEFAULTS = {
+    "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+    "sep_degree": 1, "sharding_degree": 1,
+    "mp_configs": {}, "pp_configs": {}, "sharding_configs": {},
+}
+
+
 class DistributedStrategy:
     def __init__(self):
-        self.hybrid_configs = {
-            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
-            "sep_degree": 1, "sharding_degree": 1,
-            "mp_configs": {}, "pp_configs": {}, "sharding_configs": {},
-        }
+        self.hybrid_configs = dict(_HYBRID_DEFAULTS)
         self.amp = False
         self.amp_configs = {"init_loss_scaling": 32768.0,
                             "use_pure_fp16": False, "use_bf16": True}
@@ -62,6 +65,30 @@ class DistributedStrategy:
         self.tensor_parallel = False
         self.tensor_parallel_configs = {}
         self.without_graph_optimization = True
+
+    # hybrid_configs is a VALIDATING property (ISSUE 17 satellite):
+    # assignment merges a (possibly partial) dict into the defaults —
+    # the reference allows `strategy.hybrid_configs = {"pp_degree": 2}`
+    # — and rejects unknown keys / malformed degrees immediately with
+    # HybridConfigError, instead of a typo silently building a wrong
+    # mesh.  In-place mutation of the returned dict stays legal (the
+    # established test idiom); the degree-product-vs-device-count check
+    # runs where a mesh is about to exist: fleet.init and
+    # HybridParallelEngine.from_strategy.
+    @property
+    def hybrid_configs(self):
+        return self._hybrid_configs
+
+    @hybrid_configs.setter
+    def hybrid_configs(self, value):
+        from ....parallel.hybrid_engine import validate_hybrid_configs
+        merged = dict(_HYBRID_DEFAULTS)
+        cur = getattr(self, "_hybrid_configs", None)
+        if cur:
+            merged.update(cur)
+        merged.update(dict(value or {}))
+        object.__setattr__(self, "_hybrid_configs",
+                           validate_hybrid_configs(merged))
 
     def __setattr__(self, k, v):
         object.__setattr__(self, k, v)

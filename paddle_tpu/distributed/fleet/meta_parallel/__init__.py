@@ -422,3 +422,23 @@ class PipelineParallel(_MetaParallelBase):
         # mix devices
         engine = self._get_engine()
         return engine.eval_batch(data, compute_loss=compute_loss)
+
+
+# -- the composed N-D engine (ISSUE 17) -------------------------------------
+# Exported here under the Paddle-equivalent names so
+# `fleet.distributed_model`-style imports resolve: the reference's
+# meta_parallel package is where the composed hybrid wrappers
+# (PipelineParallel / TensorParallel / ShardingParallel) live, and the
+# HybridParallelEngine is their N-D composition.  The engine itself lives
+# in paddle_tpu.parallel (it composes ShardedTrainStep + PipelineEngine);
+# this import is the API surface, not the implementation.
+from ....parallel.hybrid_engine import (HybridParallelEngine,  # noqa: E402,F401
+                                        HybridConfigError,
+                                        validate_hybrid_configs)
+
+# Paddle-family alias: the composed trainer under the reference's
+# naming idiom (one class per *Parallel mode; this one is all of them)
+HybridParallel = HybridParallelEngine
+
+__all__ += ["HybridParallelEngine", "HybridParallel",
+            "HybridConfigError", "validate_hybrid_configs"]

@@ -130,6 +130,14 @@ define_flag("comm_bucket_mb", 32.0,
             "params are bucketed in reverse-topological order so "
             "first-ready grads communicate first; a single larger "
             "param gets its own bucket")
+define_flag("sep_ring_attention", False,
+            "route attention through the sep-axis ring kernel "
+            "(ops/ring_attention.py) when tracing inside an "
+            "activation-sharding scope with a live sequence axis: "
+            "K/V blocks rotate by ppermute instead of all-gathering "
+            "the sequence.  Read at TRACE time — off, the composed "
+            "step program is byte-identical to the dense-attention "
+            "one (hybrid-engine bench-asserted)")
 define_flag("grad_comm_dtype", "auto",
             "wire dtype for fused gradient collectives: 'auto' keeps "
             "each grad's own width (bf16 grads are NEVER silently "

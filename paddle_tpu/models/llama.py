@@ -218,7 +218,28 @@ class LlamaAttention(nn.Layer):
             q = checkpoint_name(q, "flash_q")
             k = checkpoint_name(k, "flash_k")
             val = checkpoint_name(val, "flash_v")
-            out = tpu_ops.attention(q, k, val, causal=True)
+            from ..framework.flags import get_flag
+            out = None
+            if get_flag("sep_ring_attention"):
+                # sequence-parallel composition (hybrid engine): inside
+                # an activation-sharding scope with a live 'sep' axis
+                # the K/V blocks rotate around the ring instead of the
+                # partitioner all-gathering the sequence.  Flag read at
+                # trace time — off, this branch leaves the program
+                # byte-identical.
+                from ..parallel.sharded_trainer import current_act_scope
+                scope = current_act_scope()
+                if scope is not None:
+                    mesh_, _, seq_axis, _ = scope
+                    if seq_axis and seq_axis in mesh_.axis_names \
+                            and mesh_.shape[seq_axis] > 1 \
+                            and s % mesh_.shape[seq_axis] == 0:
+                        from ..ops.ring_attention import ring_attention
+                        out = ring_attention(q, k, val, mesh_,
+                                             seq_axis=seq_axis,
+                                             causal=True)
+            if out is None:
+                out = tpu_ops.attention(q, k, val, causal=True)
             out = checkpoint_name(out, "attn_out")
             return out.reshape(b, s, -1) @ wo.astype(cd)
         return run(_fn, x, self.q_proj, self.k_proj, self.v_proj,

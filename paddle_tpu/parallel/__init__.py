@@ -19,3 +19,5 @@ group_sharded_stage{2,3}.py, fleet/utils/hybrid_parallel_util.py.
 from .sharded_trainer import ShardedTrainStep, make_batch_sharding  # noqa: F401
 from .pipeline import PipelineEngine  # noqa: F401
 from .offload_pipeline import OffloadPipelineStep  # noqa: F401
+from .hybrid_engine import (HybridParallelEngine, HybridConfigError,  # noqa: F401
+                            validate_hybrid_configs)

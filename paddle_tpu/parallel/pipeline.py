@@ -132,7 +132,11 @@ class _Chunk:
 
     def place_activation(self, arr):
         """'P2P recv': move an activation (or label) onto this submesh,
-        batch dim sharded over the stage's data axes."""
+        batch dim sharded over the stage's data axes and — hybrid-engine
+        composition — the sequence dim over a live 'sep' axis (same
+        divisibility guard as shard_batch: a ragged seq replicates
+        rather than errors).  Integer arrays (token ids / labels) keep
+        the seq replication off 1-D shapes automatically via ndim."""
         if self.submesh is None:
             return arr
         axes = tuple(a for a in ("dp", "sharding")
@@ -142,6 +146,10 @@ class _Chunk:
         if axes and arr.ndim >= 1 and arr.shape[0] % max(
                 1, int(np.prod([self.submesh.shape[a] for a in axes]))) == 0:
             spec[0] = axes if len(axes) > 1 else axes[0]
+        if "sep" in self.submesh.axis_names \
+                and self.submesh.shape["sep"] > 1 and arr.ndim > 1 \
+                and arr.shape[1] % self.submesh.shape["sep"] == 0:
+            spec[1] = "sep"
         return jax.device_put(arr, NamedSharding(self.submesh, P(*spec)))
 
     # -- programs ---------------------------------------------------------

@@ -26,10 +26,20 @@ from ..framework.tensor import Tensor
 from ..framework import random as prandom
 
 __all__ = ["ShardedTrainStep", "make_batch_sharding",
-           "activation_sharding_scope", "constrain_activation"]
+           "activation_sharding_scope", "constrain_activation",
+           "current_act_scope"]
 
 
 _ACT_SCOPE: list = []
+
+
+def current_act_scope():
+    """The ambient (mesh, batch_axes, seq_axis, seq_dim) pushed by the
+    innermost `activation_sharding_scope`, or None outside one.  Lets
+    ops deep inside a model (e.g. attention routing to the sep-axis
+    ring kernel) discover the live sequence axis without threading the
+    mesh through every call signature."""
+    return _ACT_SCOPE[-1] if _ACT_SCOPE else None
 
 
 class activation_sharding_scope:

@@ -60,7 +60,10 @@ __all__ = ["cost_of", "model_train_flops", "backend_peaks",
 
 _lock = threading.Lock()
 _costs: Dict[str, dict] = {}        # label -> entry (insertion-ordered)
-_comm: Dict[str, dict] = {}         # label -> grad-comm profile (ISSUE 16)
+_comm: Dict[str, dict] = {}         # label -> {axes: comm profile} —
+#                                     one profile per comm axis so a
+#                                     composed (hybrid) program's
+#                                     columns add instead of replacing
 _measured: Dict[str, deque] = {}    # label -> warm wall_ms window
 _measured_total: Dict[str, int] = {}
 _drifted: set = set()               # labels currently below the floor
@@ -294,15 +297,25 @@ def ingest(label: str, compiled, meta: Optional[dict] = None):
 
 
 def note_comm(label: str, profile: dict):
-    """Attach a gradient-communication profile to `label`'s program
-    (ISSUE 16): byte volumes per bucket in issue order plus the
-    overlap shape, as produced by CommOverlapPlan.comm_profile().
-    The report derives the exposed-comm column from it — comm time at
-    the calibrated ICI peak vs the backward compute available to hide
-    it under — so the overlap win is a ledger number before any chip
-    time.  Registered at trainer BUILD (zero steady-state cost)."""
+    """Attach a communication profile to `label`'s program (ISSUE 16):
+    byte volumes per bucket in issue order plus the overlap shape, as
+    produced by CommOverlapPlan.comm_profile().  The report derives
+    the exposed-comm column from it — comm time at the calibrated ICI
+    peak vs the backward compute available to hide it under — so the
+    overlap win is a ledger number before any chip time.  Registered
+    at trainer BUILD (zero steady-state cost).
+
+    Profiles are keyed PER COMM AXIS (the `axes` field, e.g.
+    ["dp", "sharding"] for the joint grad reduce, ["mp"] for the TP
+    activation exchange): a composed hybrid program registers one
+    profile per mesh axis under the same label and the report's
+    columns ADD across axes — each bucket is counted exactly once,
+    under the one axis whose collective drains it.  Re-noting the
+    same (label, axes) replaces that axis's profile (a rebuild), never
+    duplicates it.  Single-axis callers are unchanged."""
+    key = tuple(profile.get("axes") or ())
     with _lock:
-        _comm[label] = dict(profile)
+        _comm.setdefault(label, {})[key] = dict(profile)
 
 
 def _publish(entry: dict):
@@ -404,7 +417,8 @@ def _report(resolve: bool, measured, emit_drift: bool) -> dict:
     floor = _floor()
     with _lock:
         entries = [dict(e) for e in _costs.values()]
-        comm_profiles = {lbl: dict(p) for lbl, p in _comm.items()}
+        comm_profiles = {lbl: {k: dict(v) for k, v in p.items()}
+                         for lbl, p in _comm.items()}
     ici_bps = interconnect_bytes_per_sec() if comm_profiles else None
     programs: Dict[str, dict] = {}
     drifts: List[str] = []
@@ -447,28 +461,60 @@ def _report(resolve: bool, measured, emit_drift: bool) -> dict:
                 if floor > 0 and attained < floor:
                     rec["drift"] = True
                     drifts.append(e["label"])
-            cp = comm_profiles.get(e["label"])
-            if cp is not None:
-                # the exposed-comm column (ISSUE 16): per-bucket comm
-                # at the ICI peak vs the backward compute available to
-                # hide it.  Backward ≈ 2/3 of a fwd+bwd step (4N of 6N
-                # FLOPs) — the window the bucket chain overlaps into.
+            cp_map = comm_profiles.get(e["label"])
+            if cp_map:
+                # the exposed-comm columns (ISSUE 16/17): per-bucket
+                # comm at the ICI peak vs the backward compute
+                # available to hide it.  Backward ≈ 2/3 of a fwd+bwd
+                # step (4N of 6N FLOPs) — the window the bucket chain
+                # overlaps into.  One column PER COMM AXIS, summed
+                # additively into the program totals: each axis's
+                # buckets drain over their own links, and a bucket
+                # belongs to exactly one axis profile, so a composed
+                # dp×mp×sharding program never double-counts an
+                # overlapped bucket.
                 from ..analysis.collectives import estimate_exposed_comm
                 bwd_ms = predicted_ms * (2.0 / 3.0)
-                sizes = cp.get("bucket_bytes") or [cp.get("bytes", 0)]
-                on = estimate_exposed_comm(
-                    sizes, bwd_ms, bytes_per_sec=ici_bps, overlap=True)
-                off = estimate_exposed_comm(
-                    sizes, bwd_ms, bytes_per_sec=ici_bps, overlap=False)
-                rec["comm_bytes"] = on["bytes"]
-                rec["comm_buckets"] = on["buckets"]
-                rec["comm_ms"] = round(on["comm_ms"], 4)
-                rec["exposed_comm_ms"] = round(on["exposed_ms"], 4)
+                by_axis = {}
+                tot = {"bytes": 0, "buckets": 0, "comm_ms": 0.0,
+                       "on": 0.0, "off": 0.0}
+                overlap_all = True
+                for axes_key in sorted(cp_map, key=repr):
+                    cp = cp_map[axes_key]
+                    sizes = cp.get("bucket_bytes") \
+                        or [cp.get("bytes", 0)]
+                    on = estimate_exposed_comm(
+                        sizes, bwd_ms, bytes_per_sec=ici_bps,
+                        overlap=True)
+                    off = estimate_exposed_comm(
+                        sizes, bwd_ms, bytes_per_sec=ici_bps,
+                        overlap=False)
+                    name = "+".join(axes_key) if axes_key else "all"
+                    by_axis[name] = {
+                        "bytes": on["bytes"],
+                        "buckets": on["buckets"],
+                        "comm_ms": round(on["comm_ms"], 4),
+                        "exposed_ms": round(on["exposed_ms"], 4),
+                        "exposed_ms_monolithic": round(
+                            off["exposed_ms"], 4)}
+                    tot["bytes"] += on["bytes"]
+                    tot["buckets"] += on["buckets"]
+                    tot["comm_ms"] += on["comm_ms"]
+                    tot["on"] += on["exposed_ms"]
+                    tot["off"] += off["exposed_ms"]
+                    overlap_all = overlap_all \
+                        and bool(cp.get("overlap", True))
+                rec["comm_bytes"] = tot["bytes"]
+                rec["comm_buckets"] = tot["buckets"]
+                rec["comm_ms"] = round(tot["comm_ms"], 4)
+                rec["exposed_comm_ms"] = round(tot["on"], 4)
                 rec["exposed_comm_ms_monolithic"] = round(
-                    off["exposed_ms"], 4)
+                    tot["off"], 4)
+                rec["exposed_comm_by_axis"] = by_axis
                 rec["overlap_efficiency"] = round(
-                    on["overlap_efficiency"], 4)
-                rec["comm_overlap"] = bool(cp.get("overlap", True))
+                    1.0 - tot["on"] / tot["comm_ms"], 4) \
+                    if tot["comm_ms"] else 1.0
+                rec["comm_overlap"] = overlap_all
         programs[e["label"]] = rec
     if emit_drift:
         from .registry import counter as _counter, emit as _emit

@@ -33,6 +33,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+import numpy as np
+
 __all__ = ["register", "note_jit", "capture", "memory_report",
            "snapshot", "device_hbm_bytes", "reset"]
 
@@ -104,14 +106,27 @@ def note_jit(owner, kind: str, jitfn, args: tuple, label: str,
     # cost ledger's measured walls by looking the label up here
     owner.__dict__.setdefault("_memledger_labels", {})[kind] = label
     import jax
-    try:
+    mesh_devs = None if mesh is None else set(np.asarray(mesh.devices).flat)
+
+    def _aval_sharding(a):
         # carry each argument's sharding AND memory kind: a host-
         # offloaded trainer's pinned_host stacks must lower exactly as
-        # placed, or the analysis counts them as device HBM
+        # placed, or the analysis counts them as device HBM — but an
+        # UNCOMMITTED scalar (lr, step count) materialized on device 0
+        # must NOT pin the aval there: under a size>1 mesh the live
+        # call auto-places it, while a pinned aval makes the provider's
+        # re-lower fail with incompatible-devices
+        s = getattr(a, "sharding", None)
+        if s is None or mesh_devs is None:
+            return s
+        try:
+            return s if set(s.device_set) == mesh_devs else None
+        except Exception:
+            return None
+    try:
         avals = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(
-                a.shape, a.dtype,
-                sharding=getattr(a, "sharding", None)), args)
+                a.shape, a.dtype, sharding=_aval_sharding(a)), args)
     except Exception:
         return                      # odd leaf: skip, never break a step
 

@@ -1,0 +1,466 @@
+"""ISSUE 17: HybridParallelEngine — ONE composable strategy point over
+the dp × mp × pp × sharding × sep mesh.
+
+Parity contract (reference: test/collective/fleet/hybrid_parallel_*):
+every composed strategy point on the 8-virtual-device CPU mesh matches
+the single-device run to fp32 tolerance; the pure-dp / pure-sharding
+points are byte-identical to a directly-built ShardedTrainStep.  The
+static pre-flight (composed collective-order check), the hybrid_configs
+validation, the Paddle-equivalent exports and the cost ledger's
+per-axis exposed-comm columns are pinned here too.
+"""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework import flags
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.parallel import (HybridParallelEngine, HybridConfigError,
+                                 ShardedTrainStep, validate_hybrid_configs)
+from paddle_tpu.parallel.hybrid_engine import modeled_axis_profiles
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ColumnParallelLinear, RowParallelLinear, LayerDesc, PipelineLayer)
+from paddle_tpu.distributed.topology import (
+    HybridCommunicateGroup, build_mesh, set_hybrid_communicate_group)
+from paddle_tpu.analysis.collectives import (CollectiveEvent,
+                                             check_collective_order)
+
+
+def _need8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hcg():
+    set_hybrid_communicate_group(None)
+    yield
+    set_hybrid_communicate_group(None)
+
+
+# ---------------------------------------------------------------------------
+# llama helpers: the pp==1 SPMD strategy points
+
+def _llama(seed=0):
+    paddle.seed(seed)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            intermediate_size=128, num_attention_heads=4,
+                            num_key_value_heads=4, vocab_size=128,
+                            dtype="float32")
+    m = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, (8, 16)).astype(np.int32)
+    return m, ids
+
+
+def _base_losses(n=3):
+    m, ids = _llama()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+    step = ShardedTrainStep(m, opt, build_mesh(devices=jax.devices()[:1]))
+    return [float(np.asarray(step(paddle.to_tensor(ids),
+                                  paddle.to_tensor(ids)).value))
+            for _ in range(n)]
+
+
+def _engine_losses(n=3, **kw):
+    m, ids = _llama()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+    eng = HybridParallelEngine(m, opt, **kw)
+    losses = [float(np.asarray(eng(paddle.to_tensor(ids),
+                                   paddle.to_tensor(ids)).value))
+              for _ in range(n)]
+    return eng, losses, ids
+
+
+class TestSPMDParity:
+    """Composed pp==1 strategy points vs the single-device trainer."""
+
+    def test_dp2_sharding4_matches_single(self):
+        _need8()
+        eng, losses, _ = _engine_losses(dp_degree=2, sharding_degree=4)
+        assert eng.sharding_stage == 1          # default with sharding>1
+        assert dict(eng.mesh.shape)["dp"] == 2 \
+            and dict(eng.mesh.shape)["sharding"] == 4
+        np.testing.assert_allclose(_base_losses(), losses,
+                                   rtol=5e-4, atol=5e-4)
+
+    def test_dp2_mp2_sharding2_matches_single(self):
+        _need8()
+        eng, losses, ids = _engine_losses(dp_degree=2, mp_degree=2,
+                                          sharding_degree=2)
+        np.testing.assert_allclose(_base_losses(), losses,
+                                   rtol=5e-4, atol=5e-4)
+        # static pre-flight holds on the composed point
+        eng.verify(paddle.to_tensor(ids), paddle.to_tensor(ids))
+
+    def test_mp2_sep2_dp2_ring_matches_single(self):
+        """The sep axis with the ring-attention kernel live: explicit
+        ppermute/psum collectives enter the schedule, parity holds,
+        and the composed-order pre-flight proves the issue order."""
+        _need8()
+        flags.set_flags({"FLAGS_sep_ring_attention": True})
+        try:
+            eng, losses, ids = _engine_losses(dp_degree=2, mp_degree=2,
+                                              sep_degree=2)
+            np.testing.assert_allclose(_base_losses(), losses,
+                                       rtol=5e-4, atol=5e-4)
+            x = paddle.to_tensor(ids)
+            sched = eng.collective_schedule(x, x)
+            assert len(sched) == 8
+            kinds = {ev.kind for ev in sched[0]}
+            assert "ppermute" in kinds or "psum" in kinds, kinds
+            eng.verify(x, x)
+            lint = eng.lint(x, x)
+            assert lint["donation"] == []
+        finally:
+            flags.set_flags({"FLAGS_sep_ring_attention": False})
+
+
+# ---------------------------------------------------------------------------
+# pipeline strategy points: pp composed with mp / sep / dp
+
+def _mse(out, y):
+    return ((out - y) ** 2).mean()
+
+
+class TPBlock(nn.Layer):
+    """Megatron pair: column-parallel up (sharded activations) into
+    row-parallel down — real mp collectives inside each pp stage."""
+
+    def __init__(self, d):
+        super().__init__()
+        self.up = ColumnParallelLinear(d, 2 * d, gather_output=False)
+        self.down = RowParallelLinear(2 * d, d, input_is_parallel=True)
+        self.norm = nn.LayerNorm(d)
+
+    def forward(self, x):
+        return self.norm(x + self.down(nn.functional.gelu(self.up(x))))
+
+
+def _pp_model(d, depth):
+    return PipelineLayer(
+        [LayerDesc(TPBlock, d) for _ in range(depth)]
+        + [LayerDesc(nn.Linear, d, d)], loss_fn=_mse)
+
+
+def _eager_ref(d, depth, data, steps, lr=0.05):
+    """Single-device eager baseline: degree-1 hcg makes the TP layers
+    plain linears (full params, replicated)."""
+    set_hybrid_communicate_group(
+        HybridCommunicateGroup(devices=jax.devices()[:1]))
+    paddle.seed(42)
+    model = _pp_model(d, depth)
+    opt = paddle.optimizer.SGD(lr, parameters=model.parameters())
+    x, y = data
+    losses = []
+    for _ in range(steps):
+        loss = _mse(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss.value)))
+    return losses
+
+
+class TestPipelineParity:
+    def _run(self, degrees, data, d=8, depth=3, steps=3, micro=4):
+        hcg = HybridCommunicateGroup(**degrees)
+        set_hybrid_communicate_group(hcg)
+        paddle.seed(42)
+        pl = _pp_model(d, depth)
+        opt = paddle.optimizer.SGD(0.05, parameters=pl.parameters())
+        eng = HybridParallelEngine(
+            pl, opt, accumulate_steps=micro,
+            **{k: v for k, v in degrees.items()})
+        x, y = data
+        losses = [float(np.asarray(eng(x, y).value))
+                  for _ in range(steps)]
+        return eng, losses
+
+    def test_dp2_mp2_pp2_matches_single(self):
+        _need8()
+        d = 8
+        rng = np.random.RandomState(7)
+        data = (paddle.to_tensor(rng.randn(8, d).astype(np.float32)),
+                paddle.to_tensor(rng.randn(8, d).astype(np.float32)))
+        ref = _eager_ref(d, 3, data, 3)
+        eng, losses = self._run(
+            dict(dp_degree=2, mp_degree=2, pp_degree=2), data)
+        np.testing.assert_allclose(ref, losses, rtol=5e-4, atol=5e-4)
+        # each stage's submesh kept the non-pp axes
+        sub = eng._engine.chunks[0].submesh
+        assert dict(sub.shape).get("dp") == 2 \
+            and dict(sub.shape).get("mp") == 2
+        eng.verify(data[0], data[1])
+
+    def test_mp2_sep2_pp2_matches_single(self):
+        _need8()
+        d = 8
+        rng = np.random.RandomState(7)
+        # 3-D activations: the sep axis shards the seq dim (8 % 2 == 0)
+        data = (paddle.to_tensor(rng.randn(4, 8, d).astype(np.float32)),
+                paddle.to_tensor(rng.randn(4, 8, d).astype(np.float32)))
+        ref = _eager_ref(d, 3, data, 3)
+        eng, losses = self._run(
+            dict(mp_degree=2, sep_degree=2, pp_degree=2), data, micro=2)
+        np.testing.assert_allclose(ref, losses, rtol=5e-4, atol=5e-4)
+
+    def test_pp_requires_pipeline_layer(self):
+        _need8()
+        m, _ = _llama()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        with pytest.raises(HybridConfigError, match="PipelineLayer"):
+            HybridParallelEngine(m, opt, pp_degree=2)
+
+    def test_pp_rejects_zero23(self):
+        _need8()
+        m, _ = _llama()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        with pytest.raises(HybridConfigError, match="stage"):
+            HybridParallelEngine(m, opt, pp_degree=2, sharding_degree=2,
+                                 sharding_stage=2)
+
+
+# ---------------------------------------------------------------------------
+# bit-exactness: the trivial and pure points ARE the single-axis trainer
+
+class TestBitExact:
+    def _pair(self, degrees, stage_direct, mesh_direct):
+        m, ids = _llama()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        eng = HybridParallelEngine(m, opt, **degrees)
+        m2, _ = _llama()
+        opt2 = paddle.optimizer.AdamW(1e-2, parameters=m2.parameters())
+        direct = ShardedTrainStep(m2, opt2, mesh_direct,
+                                  sharding_stage=stage_direct)
+        x = paddle.to_tensor(ids)
+        return eng, direct, x
+
+    def test_pure_dp_bit_exact(self):
+        _need8()
+        eng, direct, x = self._pair({"dp_degree": 8}, 0,
+                                    build_mesh(dp=8))
+        assert eng.step.compiled_hlo(x, x, optimized=False) \
+            == direct.compiled_hlo(x, x, optimized=False)
+        a = [float(np.asarray(eng(x, x).value)) for _ in range(3)]
+        b = [float(np.asarray(direct(x, x).value)) for _ in range(3)]
+        assert a == b            # same program, bit-exact trajectories
+
+    def test_pure_sharding_bit_exact(self):
+        _need8()
+        eng, direct, x = self._pair({"sharding_degree": 8}, 1,
+                                    build_mesh(sharding=8))
+        assert eng.sharding_stage == 1
+        assert eng.step.compiled_hlo(x, x, optimized=False) \
+            == direct.compiled_hlo(x, x, optimized=False)
+        a = [float(np.asarray(eng(x, x).value)) for _ in range(3)]
+        b = [float(np.asarray(direct(x, x).value)) for _ in range(3)]
+        assert a == b
+
+    def test_trivial_point_flags_off_hlo_identical(self):
+        """All-degrees-1 engine == plain single-device trainer, and
+        FLAGS_sep_ring_attention with no sep axis leaves the program
+        byte-identical (trace-time flag, inert off the sep mesh)."""
+        m, ids = _llama()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        x = paddle.to_tensor(ids)
+        m2, _ = _llama()
+        opt2 = paddle.optimizer.AdamW(1e-2, parameters=m2.parameters())
+        direct = ShardedTrainStep(
+            m2, opt2, build_mesh(devices=jax.devices()[:1]))
+        hlo_direct = direct.compiled_hlo(x, x, optimized=False)
+        eng = HybridParallelEngine(
+            m, opt, devices=list(jax.devices())[:1])
+        assert eng.step.compiled_hlo(x, x, optimized=False) == hlo_direct
+        flags.set_flags({"FLAGS_sep_ring_attention": True})
+        try:
+            m3, _ = _llama()
+            opt3 = paddle.optimizer.AdamW(1e-2,
+                                          parameters=m3.parameters())
+            eng3 = HybridParallelEngine(
+                m3, opt3, devices=list(jax.devices())[:1])
+            assert eng3.step.compiled_hlo(x, x, optimized=False) \
+                == hlo_direct
+        finally:
+            flags.set_flags({"FLAGS_sep_ring_attention": False})
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: hybrid_configs validation — named error, at config time
+
+class TestValidation:
+    def test_unknown_key_rejected_at_strategy_set(self):
+        strategy = fleet.DistributedStrategy()
+        with pytest.raises(HybridConfigError, match="dp_degre"):
+            strategy.hybrid_configs = {"dp_degre": 2}     # the typo case
+
+    def test_partial_assignment_merges_defaults(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"pp_degree": 2}
+        assert strategy.hybrid_configs["pp_degree"] == 2
+        assert strategy.hybrid_configs["dp_degree"] == 1
+
+    @pytest.mark.parametrize("bad", [True, 0, -1, 2.5, "2"])
+    def test_malformed_degree_rejected(self, bad):
+        with pytest.raises(HybridConfigError):
+            validate_hybrid_configs({"mp_degree": bad})
+
+    def test_product_exceeding_devices_rejected_at_from_strategy(self):
+        _need8()
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 4}
+        m, _ = _llama()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        with pytest.raises(HybridConfigError, match="exceeds"):
+            HybridParallelEngine.from_strategy(m, opt, strategy)
+
+    def test_non_dividing_product_rejected_at_fleet_init(self):
+        _need8()
+        strategy = fleet.DistributedStrategy()
+        # in-place mutation bypasses the setter — fleet.init (where the
+        # mesh is about to exist) still validates
+        strategy.hybrid_configs["dp_degree"] = 5
+        with pytest.raises(HybridConfigError, match="divide"):
+            fleet.init(is_collective=True, strategy=strategy)
+
+    def test_from_strategy_composes_point(self):
+        _need8()
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": 2, "sharding_degree": 2,
+            "sharding_configs": {"stage": 2}}
+        m, ids = _llama()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        eng = HybridParallelEngine.from_strategy(m, opt, strategy)
+        assert eng.degrees == {"dp": 2, "mp": 1, "pp": 1, "sep": 1,
+                               "sharding": 2}
+        assert eng.sharding_stage == 2
+        x = paddle.to_tensor(ids)
+        np.testing.assert_allclose(
+            _base_losses(),
+            [float(np.asarray(eng(x, x).value)) for _ in range(3)],
+            rtol=5e-4, atol=5e-4)
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: a misordered two-axis schedule is caught STATICALLY
+
+class TestComposedOrderCheck:
+    def test_sharding_rs_swapped_with_mp_ag_caught(self):
+        """Rank 1 issues the mp all-gather before the sharding
+        reduce-scatter; per-domain order is still consistent (one
+        event per domain), so only the composed check can see the
+        deadlock."""
+        rs = CollectiveEvent("reduce_scatter", ("grads", (64,)),
+                             ("sharding",))
+        ag = CollectiveEvent("all_gather", ("w0", (64, 64)), ("mp",))
+        good = {0: [rs, ag], 1: [rs, ag]}
+        bad = {0: [rs, ag], 1: [ag, rs]}
+        assert check_collective_order(good, composed=True) == []
+        per_domain = check_collective_order(bad)      # composed=False
+        assert per_domain == []                        # blind to it
+        findings = check_collective_order(bad, composed=True)
+        assert [f.code for f in findings] == ["composed-order-divergence"]
+        assert "sharding" in findings[0].message \
+            and "mp" in findings[0].message
+
+    def test_engine_schedule_one_order_per_group(self):
+        _need8()
+        flags.set_flags({"FLAGS_sep_ring_attention": True})
+        try:
+            m, ids = _llama()
+            opt = paddle.optimizer.AdamW(1e-2,
+                                         parameters=m.parameters())
+            eng = HybridParallelEngine(m, opt, mp_degree=2, sep_degree=2,
+                                       dp_degree=2)
+            x = paddle.to_tensor(ids)
+            sched = eng.collective_schedule(x, x)
+            assert check_collective_order(sched, composed=True) == []
+        finally:
+            flags.set_flags({"FLAGS_sep_ring_attention": False})
+
+
+# ---------------------------------------------------------------------------
+# satellite 6: per-axis additive exposed-comm columns in the cost ledger
+
+class TestPerAxisLedger:
+    def test_modeled_profiles_attribute_each_bucket_once(self):
+        m, _ = _llama()
+        params = [(tuple(p.value.shape), str(p.value.dtype))
+                  for _, p in m.named_parameters()]
+        profs = modeled_axis_profiles(
+            params, m.config, {"dp": 2, "mp": 2, "sharding": 2},
+            (8, 16), stage=1)
+        axes = [tuple(p["axes"]) for p in profs]
+        assert sorted(axes) == [("dp",), ("mp",), ("sharding",)]
+        assert len(set(axes)) == len(axes)       # disjoint attribution
+        for p in profs:
+            assert sum(p["bucket_bytes"]) == p["bytes"] > 0
+        by = {tuple(p["axes"]): p for p in profs}
+        # dp all-reduces the already-scattered shard: half the grads
+        assert by[("dp",)]["bytes"] == by[("sharding",)]["bytes"] // 2
+
+    def test_two_axis_columns_add_to_program_totals(self):
+        from paddle_tpu import telemetry
+        from paddle_tpu.telemetry import costledger
+        paddle.seed(0)
+        m = paddle.nn.Sequential(paddle.nn.Linear(8, 8))
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        step = ShardedTrainStep(
+            m, opt, build_mesh(devices=jax.devices()[:1]),
+            loss_fn=lambda o, y: paddle.nn.functional.mse_loss(o, y))
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        step(x, x)
+        label = f"ShardedTrainStep.step.s{step.stage}"
+        costledger.note_comm(label, {
+            "bytes": 1000, "bucket_bytes": [500, 500], "buckets": 2,
+            "overlap": True, "stage": 1, "axes": ["sharding"],
+            "comm_dtype": "auto", "world": 8})
+        costledger.note_comm(label, {
+            "bytes": 600, "bucket_bytes": [600], "buckets": 1,
+            "overlap": True, "stage": 1, "axes": ["mp"],
+            "comm_dtype": "auto", "world": 8})
+        rec = telemetry.cost_report()["programs"][label]
+        by_axis = rec["exposed_comm_by_axis"]
+        assert set(by_axis) == {"sharding", "mp"}
+        assert rec["comm_bytes"] == 1600          # additive, no double
+        assert rec["comm_buckets"] == 3
+        assert rec["exposed_comm_ms"] == pytest.approx(
+            sum(a["exposed_ms"] for a in by_axis.values()), abs=1e-3)
+        assert rec["exposed_comm_ms_monolithic"] == pytest.approx(
+            sum(a["exposed_ms_monolithic"] for a in by_axis.values()),
+            abs=1e-3)
+        # re-noting one axis REPLACES that column, never accumulates
+        costledger.note_comm(label, {
+            "bytes": 800, "bucket_bytes": [800], "buckets": 1,
+            "overlap": True, "stage": 1, "axes": ["mp"],
+            "comm_dtype": "auto", "world": 8})
+        rec = telemetry.cost_report()["programs"][label]
+        assert rec["comm_bytes"] == 1800
+
+    def test_engine_registers_axis_profiles(self):
+        _need8()
+        from paddle_tpu import telemetry
+        eng, _, ids = _engine_losses(n=1, dp_degree=2, mp_degree=2,
+                                     sharding_degree=2)
+        rec = telemetry.cost_report()["programs"][eng.cost_label()]
+        by_axis = rec.get("exposed_comm_by_axis") or {}
+        assert {"dp", "mp", "sharding"} <= set(by_axis)
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: Paddle-equivalent export surface
+
+class TestExports:
+    def test_fleet_and_meta_parallel_names(self):
+        from paddle_tpu.distributed.fleet import meta_parallel as mp
+        assert mp.HybridParallel is HybridParallelEngine
+        assert fleet.HybridParallel is HybridParallelEngine
+        assert fleet.HybridParallelEngine is HybridParallelEngine
+        assert fleet.HybridConfigError is HybridConfigError
+        assert fleet.validate_hybrid_configs is validate_hybrid_configs
+        from paddle_tpu import parallel as par
+        assert par.HybridParallelEngine is HybridParallelEngine

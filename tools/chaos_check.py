@@ -754,21 +754,89 @@ def fleet_bucketed_reduce(hc, model, bucket_mb=0.0005):
     return reduce_fn
 
 
-def fleet_train_step(model, opt, x, y, gbs, reduce_fn=None):
+def fleet_hybrid_fwd_bwd():
+    """ISSUE 17: the local fwd/bwd of the dp×mp fleet job — ONE
+    jit-compiled SPMD program over an in-process 2-device "mp" mesh
+    (fc1 column-parallel, fc2 row-parallel; GSPMD inserts the mp
+    all-reduce on the fc2 contraction), while the dp plane stays the
+    host-collective gang this harness kills and shrinks.  Returns a
+    closure with the fleet_train_step `fwd_bwd` signature producing
+    the same flat [loss_sum|grads] wire layout, so every other piece
+    of the elastic/checkpoint plumbing is shared verbatim; the
+    bit-exact reference reruns THIS program in a world-1 subprocess
+    with the same 2-device mesh.  `.mp_allreduce()` reports whether
+    the compiled program genuinely carries the mp collective (the
+    worker logs it; run_fleet asserts it)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise RuntimeError(
+            "hybrid fleet worker needs >= 2 devices for the mp plane "
+            "(XLA_FLAGS=--xla_force_host_platform_device_count=2)")
+    mesh = Mesh(np.asarray(devs[:2]), ("mp",))
+
+    def fwd(params, xx, yy):
+        h = jnp.maximum(xx @ params["fc1.weight"] + params["fc1.bias"],
+                        0.0)
+        o = h @ params["fc2.weight"] + params["fc2.bias"]
+        d = o - yy
+        return jnp.sum(d * d)
+
+    specs = {"fc1.weight": P(None, "mp"), "fc1.bias": P("mp"),
+             "fc2.weight": P("mp", None), "fc2.bias": P()}
+    shardings = {k: NamedSharding(mesh, v) for k, v in specs.items()}
+    rep = NamedSharding(mesh, P())
+    jit = jax.jit(jax.value_and_grad(fwd),
+                  in_shardings=(shardings, rep, rep),
+                  out_shardings=(rep, shardings))
+    state = {"mp_allreduce": None}
+
+    def fwd_bwd(model, x, y):
+        names = [n for n, _ in model.named_parameters()]
+        params = {n: jnp.asarray(np.asarray(p.value))
+                  for n, p in model.named_parameters()}
+        xj, yj = jnp.asarray(x), jnp.asarray(y)
+        if state["mp_allreduce"] is None:
+            txt = jit.lower(params, xj, yj).compile().as_text()
+            state["mp_allreduce"] = "all-reduce" in txt \
+                or "all_reduce" in txt
+        loss, grads = jit(params, xj, yj)
+        return np.concatenate(
+            [np.asarray(loss, np.float32).reshape(1)]
+            + [np.asarray(grads[n], np.float32).ravel()
+               for n in names])
+
+    fwd_bwd.mp_allreduce = lambda: state["mp_allreduce"]
+    return fwd_bwd
+
+
+def fleet_train_step(model, opt, x, y, gbs, reduce_fn=None,
+                     fwd_bwd=None):
     """One dp step on this rank's slice: local per-sample SUM loss +
     grads, cross-rank sum via `reduce_fn` (None = single rank), then
     normalize by the GLOBAL batch and update.  Identical math on every
-    rank; deterministic for a fixed world size."""
+    rank; deterministic for a fixed world size.  `fwd_bwd` swaps the
+    local compute (hybrid mode: the in-process mp-sharded program) —
+    it must return the same flat [loss_sum|grads] layout the paddle
+    autograd path builds."""
     import numpy as np
     import paddle_tpu as paddle
-    out = model(paddle.to_tensor(x))
-    diff = out - paddle.to_tensor(y)
-    loss_sum = paddle.sum(diff * diff)
-    loss_sum.backward()
-    params = list(model.named_parameters())
-    flat = np.concatenate(
-        [np.asarray(loss_sum.value).reshape(1)]
-        + [np.asarray(p.grad.value).ravel() for _, p in params])
+    if fwd_bwd is not None:
+        flat = np.asarray(fwd_bwd(model, x, y), np.float32)
+        params = list(model.named_parameters())
+    else:
+        out = model(paddle.to_tensor(x))
+        diff = out - paddle.to_tensor(y)
+        loss_sum = paddle.sum(diff * diff)
+        loss_sum.backward()
+        params = list(model.named_parameters())
+        flat = np.concatenate(
+            [np.asarray(loss_sum.value).reshape(1)]
+            + [np.asarray(p.grad.value).ravel() for _, p in params])
     if reduce_fn is not None:
         flat = np.asarray(reduce_fn(flat), np.float32)
     scale = np.float32(gbs)
@@ -816,6 +884,7 @@ def fleet_worker_main():
         paddle.set_flags({"FLAGS_fault_injection": cfg["kill_spec"]})
 
     model, opt = fleet_model()
+    fwd_bwd = fleet_hybrid_fwd_bwd() if cfg.get("hybrid") else None
     cursor = ElasticDataCursor()
     sampler = ElasticBatchSampler(n, gbs, cursor=cursor, rank=rank,
                                   world=world, shuffle=True,
@@ -850,6 +919,7 @@ def fleet_worker_main():
              "world": world, "old_world": meta.get("world"),
              "epoch": eepoch}) + "\n")
 
+    marker_done = False
     while opt._step_count < steps:
         i = opt._step_count + 1
         fault.hit("step.begin", key=f"step{i}")
@@ -858,11 +928,20 @@ def fleet_worker_main():
             raise RuntimeError("fleet worker: sample stream exhausted "
                                f"at step {i} (cursor {cursor})")
         loss = fleet_train_step(model, opt, X[local], Y[local], gbs,
-                                reduce_fn)
+                                reduce_fn, fwd_bwd=fwd_bwd)
         cursor.advance(gbs)
         log.write(json.dumps(
             {"step": i, "loss": loss, "world": world, "epoch": eepoch,
              "indices": [int(s) for s in local]}) + "\n")
+        if fwd_bwd is not None and not marker_done:
+            # the mp plane must be REAL: log (once per incarnation)
+            # whether the compiled local program carries the mp
+            # all-reduce — run_fleet fails the hybrid verdict if not
+            log.write(json.dumps(
+                {"hybrid_mp": 2,
+                 "mp_allreduce": bool(fwd_bwd.mp_allreduce()),
+                 "epoch": eepoch, "rank": rank}) + "\n")
+            marker_done = True
         arrays = {k: ShardSlice.of(v, rank, world)
                   for k, v in fleet_state(model, opt).items()}
         meta = ckpt.optimizer_meta(opt)
@@ -874,9 +953,13 @@ def fleet_worker_main():
 
 
 def run_fleet(ranks=2, steps=8, kill_step=4, kill_rank=1, gbs=12,
-              workdir=None, comm_overlap=False):
+              workdir=None, comm_overlap=False, hybrid=False):
     """Drive the N-proc elastic shrink chaos scenario; returns a report
-    dict with report["ok"] the pass verdict (see module docstring)."""
+    dict with report["ok"] the pass verdict (see module docstring).
+    `hybrid` (ISSUE 17): each rank is one dp slice of a dp×mp job —
+    its local compute runs mp2-sharded over an in-process 2-device
+    mesh (fleet_hybrid_fwd_bwd) — and the kill/shrink-resume must stay
+    bit-exact with BOTH planes live."""
     import subprocess
 
     if gbs % ranks:
@@ -889,7 +972,8 @@ def run_fleet(ranks=2, steps=8, kill_step=4, kill_rank=1, gbs=12,
     cfg = {"steps": steps, "gbs": gbs, "n_samples": steps * gbs + 3,
            "ckpt": root, "dump": dump, "kill_rank": kill_rank,
            "kill_spec": f"step.begin:step={kill_step}:mode=kill",
-           "comm_overlap": bool(comm_overlap)}
+           "comm_overlap": bool(comm_overlap),
+           "hybrid": bool(hybrid)}
 
     from paddle_tpu.distributed.launch.master import KVServer
     srv = KVServer(0).start()
@@ -910,6 +994,16 @@ def run_fleet(ranks=2, steps=8, kill_step=4, kill_rank=1, gbs=12,
                PADDLE_ELASTIC_HEARTBEAT_TTL="15",
                PADDLE_ELASTIC_SETTLE="0.5",
                PADDLE_ELASTIC_SCALE_CHECK="1")
+    if hybrid:
+        # each worker needs its own 2-device runtime for the mp plane
+        # (strip any inherited device-count forcing, e.g. the test
+        # suite's 8, so the worker mesh is exactly mp2)
+        xla = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f)
+        env["XLA_FLAGS"] = (xla +
+                            " --xla_force_host_platform_device_count=2"
+                            ).strip()
     for stale in ("FLAGS_fault_injection", "PADDLE_TRAINER_ID",
                   "PADDLE_TRAINERS_NUM", "PADDLE_ELASTIC_EPOCH",
                   "PADDLE_MASTER", "PADDLE_KV_MASTER", "PADDLE_NNODES",
@@ -940,7 +1034,7 @@ def run_fleet(ranks=2, steps=8, kill_step=4, kill_rank=1, gbs=12,
         srv.stop()
 
     # ---- collect the per-(epoch, rank) loss logs: later epochs win
-    records, resumes = {}, []
+    records, resumes, markers = {}, [], []
     import glob as _glob
     for path in sorted(_glob.glob(os.path.join(dump, "losses.e*.jsonl"))):
         with open(path) as f:
@@ -948,6 +1042,9 @@ def run_fleet(ranks=2, steps=8, kill_step=4, kill_rank=1, gbs=12,
                 rec = json.loads(line)
                 if "resumed_from" in rec:
                     resumes.append(rec)
+                    continue
+                if "hybrid_mp" in rec:
+                    markers.append(rec)
                     continue
                 key = (rec["step"],)
                 cur = records.setdefault(key, [])
@@ -993,7 +1090,33 @@ def run_fleet(ranks=2, steps=8, kill_step=4, kill_rank=1, gbs=12,
                        if r.get("world") == ranks - 1), default=None)
     mismatch = []
     ref_applicable = ranks - 1 == 1
-    if ref_applicable and resume_step is not None:
+    if hybrid and ref_applicable and resume_step is not None:
+        # the hybrid reference must rerun the SAME mp2-sharded local
+        # program, which needs its own 2-device runtime — run it as a
+        # world-1 subprocess (--fleet-reference) and diff the losses
+        rcfg = dict(cfg, resume_step=resume_step)
+        renv = dict(env, FLEET_CFG=json.dumps(rcfg))
+        this_ = os.path.abspath(__file__)
+        rp = subprocess.run(
+            [sys.executable, this_, "--fleet-reference"], env=renv,
+            capture_output=True, timeout=180)
+        ref_path = os.path.join(dump, "reference.jsonl")
+        ref = {}
+        if rp.returncode == 0 and os.path.exists(ref_path):
+            with open(ref_path) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    ref[rec["step"]] = rec["loss"]
+        else:
+            mismatch.append({"reference_rc": rp.returncode,
+                             "tail": rp.stdout.decode(
+                                 errors="replace")[-400:]})
+        for s in range(resume_step + 1, steps + 1):
+            got_loss = by_step.get(s, {}).get("loss")
+            if s in ref and got_loss != ref[s]:
+                mismatch.append({"step": s, "fleet": got_loss,
+                                 "reference": ref[s]})
+    elif ref_applicable and resume_step is not None:
         import numpy as np
         import jax.numpy as jnp
         from paddle_tpu.distributed import checkpoint as ckpt
@@ -1024,11 +1147,14 @@ def run_fleet(ranks=2, steps=8, kill_step=4, kill_rank=1, gbs=12,
                 mismatch.append({"step": s, "fleet": got_loss,
                                  "reference": loss})
 
+    mp_ok = (not hybrid) or any(m.get("mp_allreduce") for m in markers)
     ok = (fired and all_steps and shrank and resume_step is not None
           and not cross_rank_mismatch and not coverage_bad
-          and not mismatch)
+          and not mismatch and mp_ok)
     return {"ranks": ranks, "steps": steps, "kill_step": kill_step,
             "comm_overlap": bool(comm_overlap),
+            "hybrid": bool(hybrid), "mp_allreduce": mp_ok if hybrid
+            else None,
             "launcher_rcs": rcs, "fired": fired, "shrank": shrank,
             "completed": len(completed), "resume_step": resume_step,
             "resumes": len(resumes),
@@ -1037,6 +1163,49 @@ def run_fleet(ranks=2, steps=8, kill_step=4, kill_rank=1, gbs=12,
             "coverage_bad": coverage_bad, "mismatch": mismatch,
             "workdir": workdir, "ok": ok,
             "tail": "" if ok else "\n".join(o[-800:] for o in outs)}
+
+
+def fleet_reference_main():
+    """Internal (`--fleet-reference`): the uninterrupted world-1
+    reference leg of the HYBRID fleet verdict, run as a subprocess so
+    the mp plane gets its own 2-device runtime.  Restores from
+    cfg["resume_step"] exactly as the resumed gang did, runs to
+    cfg["steps"] with the same local program, dumps the losses to
+    dump/reference.jsonl for run_fleet's bit-exact diff."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.io import ElasticBatchSampler, ElasticDataCursor
+
+    cfg = json.loads(os.environ["FLEET_CFG"])
+    resume_step, root = cfg["resume_step"], cfg["ckpt"]
+    model, opt = fleet_model()
+    skel = {k: Tensor(jnp.asarray(v))
+            for k, v in fleet_state(model, opt).items()}
+    cand = os.path.join(root, f"step_{resume_step:08d}")
+    got = ckpt.load_checkpoint(skel, root, candidate=cand)
+    assert got is not None, "reference restore found no checkpoint"
+    _, meta = got
+    fleet_apply_state(
+        model, opt, {k: np.asarray(t.value) for k, t in skel.items()})
+    ckpt.apply_optimizer_meta(opt, meta)
+    cursor = ElasticDataCursor()
+    cursor.load_state_dict(dict(meta.get("data_cursor") or {}))
+    sampler = ElasticBatchSampler(
+        cfg["n_samples"], cfg["gbs"], cursor=cursor, rank=0, world=1,
+        shuffle=True, seed=FLEET_SAMPLE_SEED)
+    X, Y = fleet_data(cfg["n_samples"])
+    fwd_bwd = fleet_hybrid_fwd_bwd() if cfg.get("hybrid") else None
+    with open(os.path.join(cfg["dump"], "reference.jsonl"), "w",
+              buffering=1) as out:
+        for s in range(resume_step + 1, cfg["steps"] + 1):
+            local = next(iter(sampler))
+            loss = fleet_train_step(model, opt, X[local], Y[local],
+                                    cfg["gbs"], fwd_bwd=fwd_bwd)
+            cursor.advance(cfg["gbs"])
+            out.write(json.dumps({"step": s, "loss": loss}) + "\n")
+    return 0
 
 
 def _fleet_selftest():
@@ -1051,6 +1220,20 @@ def _fleet_selftest():
                                           "resume_step",
                                           "cross_rank_mismatch",
                                           "coverage_bad", "mismatch")})})
+    # ISSUE 17: the same kill/shrink with the mp plane live — one dp
+    # rank of a dp2×mp2 job dies, the gang re-forms at dp1×mp2 and the
+    # resumed trajectory is bit-exact vs an uninterrupted world-1 run
+    # of the SAME mp2-sharded program
+    hrep = run_fleet(ranks=2, steps=6, kill_step=4, hybrid=True)
+    checks.append({"check": "fleet.hybrid-kill-shrink-resume",
+                   "fired": hrep["fired"],
+                   "recovered": hrep["ok"],
+                   "detail": json.dumps({k: hrep[k] for k in
+                                         ("launcher_rcs", "completed",
+                                          "resume_step", "mp_allreduce",
+                                          "cross_rank_mismatch",
+                                          "coverage_bad",
+                                          "mismatch")})})
     # the shrink must be observable: a fleet.elastic event in the
     # resumed rank's telemetry log, rendered by tools/fleet_report.py
     import glob as _glob
@@ -1117,10 +1300,20 @@ def main(argv=None):
                          "all_reduce per grad bucket, issue order) — "
                          "the kill/shrink-resume must stay bit-exact "
                          "with buckets in flight (--fleet)")
+    ap.add_argument("--hybrid", action="store_true",
+                    help="make the fleet job dp×mp (ISSUE 17): each "
+                         "rank's local compute runs mp2-sharded over "
+                         "an in-process 2-device mesh; killing one dp "
+                         "rank must shrink-resume bit-exact with both "
+                         "planes live (--fleet)")
+    ap.add_argument("--fleet-reference", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: world-1 ref leg
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
     if args.fleet_worker:
         return fleet_worker_main()
+    if args.fleet_reference:
+        return fleet_reference_main()
     if args.fleet:
         if args.selftest:
             checks = _fleet_selftest()
@@ -1142,13 +1335,15 @@ def main(argv=None):
             return 1 if bad else 0
         rep = run_fleet(ranks=args.ranks, steps=args.steps,
                         kill_step=args.kill_step,
-                        comm_overlap=args.comm_overlap)
+                        comm_overlap=args.comm_overlap,
+                        hybrid=args.hybrid)
         if args.as_json:
             print(json.dumps(rep, indent=2))
         else:
             verdict = "RECOVERED" if rep["ok"] else "FAILED"
             print(f"{verdict}: {rep['ranks']}-proc job"
-                  f"{' (comm_overlap)' if rep['comm_overlap'] else ''}, "
+                  f"{' (comm_overlap)' if rep['comm_overlap'] else ''}"
+                  f"{' (hybrid dpxmp2)' if rep['hybrid'] else ''}, "
                   f"kill at step "
                   f"{rep['kill_step']}, completed {rep['completed']}/"
                   f"{rep['steps']} steps, resume_step="

@@ -181,6 +181,45 @@ def compare(current, trajectory, k: float = DEFAULT_K,
                 rows.append({"metric": f"{metric}.exposed_comm",
                              "value": on,
                              "verdict": f"ok (on {on}ms < off {off}ms)"})
+            # per-axis additivity gate (ISSUE 17): a composed-mesh leg
+            # carrying `per_axis` columns promises each bucket is
+            # attributed to exactly ONE axis — the columns must sum to
+            # the program totals (bytes exactly; ms to rounding).  A
+            # double-counted bucket inflates both sides and reads as
+            # more comm hidden than exists.
+            pa = ec.get("per_axis")
+            if isinstance(pa, dict) and pa and "error" not in ec:
+                s_on = sum(a.get("exposed_ms", 0.0) for a in pa.values())
+                s_off = sum(a.get("exposed_ms_monolithic", 0.0)
+                            for a in pa.values())
+                s_bytes = sum(a.get("bytes", 0) for a in pa.values())
+                tol = 1e-2 * max(1.0, len(pa))
+                bad = []
+                if ec.get("bytes") is not None \
+                        and s_bytes != ec["bytes"]:
+                    bad.append(f"bytes {s_bytes} != {ec['bytes']}")
+                if on is not None and abs(s_on - on) > tol:
+                    bad.append(f"on_ms {s_on:.4f} != {on}")
+                if off is not None and abs(s_off - off) > tol:
+                    bad.append(f"off_ms {s_off:.4f} != {off}")
+                if bad:
+                    findings.append({
+                        "code": "exposed-comm-axis-mismatch",
+                        "metric": metric,
+                        "message": f"{metric}: per-axis exposed-comm "
+                                   f"columns do not sum to the program "
+                                   f"totals ({'; '.join(bad)}) — an "
+                                   f"axis is double-counted or dropped",
+                    })
+                    rows.append({"metric": f"{metric}.exposed_comm"
+                                           f".per_axis",
+                                 "verdict": "EXPOSED-COMM AXIS "
+                                            "MISMATCH"})
+                else:
+                    rows.append({"metric": f"{metric}.exposed_comm"
+                                           f".per_axis",
+                                 "verdict": f"ok ({len(pa)} axis "
+                                            f"column(s) additive)"})
         row = {"metric": metric, "value": rec["value"]}
         cands = baselines.get(metric)
         if not cands:
@@ -382,6 +421,32 @@ def _selftest(repo_root: str):
     if len(rep["findings"]) != 1 \
             or rep["findings"][0]["code"] != "exposed-comm-missing":
         problems.append(f"broken exposed-comm block not caught: {rep}")
+
+    # 8c. the per-axis additivity gate (ISSUE 17): additive columns
+    # pass; a double-counted axis (columns sum past the program
+    # totals) fails with a named finding
+    ok_pa = _mk("m_hybrid", 100.0, exposed_comm={
+        "on_ms": 3.0, "off_ms": 5.0, "bytes": 300,
+        "per_axis": {
+            "dp": {"bytes": 100, "exposed_ms": 1.0,
+                   "exposed_ms_monolithic": 2.0},
+            "mp": {"bytes": 200, "exposed_ms": 2.0,
+                   "exposed_ms_monolithic": 3.0}}})
+    rep = compare([ok_pa], base)
+    if rep["findings"]:
+        problems.append(f"additive per-axis columns fired: {rep}")
+    dup_pa = _mk("m_hybrid", 100.0, exposed_comm={
+        "on_ms": 3.0, "off_ms": 5.0, "bytes": 300,
+        "per_axis": {
+            "dp": {"bytes": 300, "exposed_ms": 3.0,
+                   "exposed_ms_monolithic": 5.0},
+            "mp": {"bytes": 200, "exposed_ms": 2.0,
+                   "exposed_ms_monolithic": 3.0}}})
+    rep = compare([dup_pa], base)
+    if len(rep["findings"]) != 1 or rep["findings"][0]["code"] \
+            != "exposed-comm-axis-mismatch":
+        problems.append(f"double-counted per-axis column not "
+                        f"caught: {rep}")
 
     # 9. the REAL committed trajectory passes (legacy captures skip on
     # the fingerprint rule; nothing may raise or false-fire)
