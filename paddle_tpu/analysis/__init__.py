@@ -25,8 +25,25 @@ same discipline is applied to this framework's two program forms:
     static deadlock detector for the NCCL-hang-equivalent failure
     mode (a collective misorder across mesh ranks).
 
+  * the **Program Sentinel** (`passes.py` + `sharding_census.py`) —
+    the PIR-equivalent registered pass manager unifying the lints as
+    passes (severity ladder, per-pass flags, baseline suppression)
+    plus two whole-program analyzers: the HLO **collective census**
+    (parse `compiled.as_text()` for every all-reduce / all-gather /
+    reduce-scatter / all-to-all / collective-permute with replica
+    groups and byte counts, diff per traffic class against the modeled
+    `CollectiveEvent` schedule — an implicit resharding XLA inserted
+    is a named error finding) and the **replication audit** (large
+    tensors the strategy shards but the partitioned module holds at
+    full global shape).  Wired behind FLAGS_static_sentinel into the
+    build paths of ShardedTrainStep / PipelineEngine /
+    HybridParallelEngine / ContinuousBatcher (build-level), with the
+    full catalog on each engine's `.preflight(...)`.
+
 CLI: `python tools/verify_program.py` (JSON mode + non-zero exit on
-findings, like tools/op_audit.py).  All checks are cold-path: with the
+findings, like tools/op_audit.py) and `python tools/static_check.py`
+(the sentinel catalog over the standard program zoo, diffed against
+tools/static_baseline.json).  All checks are cold-path: with the
 flags off the replay hot path pays one dict lookup, and bench.py
 asserts the replay-cache keys are byte-identical with the subsystem
 loaded.
@@ -41,6 +58,10 @@ from .lints import lint_dtype_promotion, lint_transfers, lint_donation, \
     lint_serve_programs, recompile_guard, note_program_build
 from .collectives import CollectiveEvent, collective_schedule, \
     check_collective_order
+from .passes import Pass, PassContext, PassManager, SentinelError, \
+    SentinelReport, register_pass, registered_passes, sentinel_preflight
+from .sharding_census import HloCollective, parse_hlo_collectives, \
+    census_diff, replication_audit
 
 __all__ = [
     "Finding", "ProgramVerifyError", "LintError", "CollectiveOrderError",
@@ -51,4 +72,9 @@ __all__ = [
     "lint_serve_programs",
     "recompile_guard", "note_program_build",
     "CollectiveEvent", "collective_schedule", "check_collective_order",
+    "Pass", "PassContext", "PassManager", "SentinelError",
+    "SentinelReport", "register_pass", "registered_passes",
+    "sentinel_preflight",
+    "HloCollective", "parse_hlo_collectives", "census_diff",
+    "replication_audit",
 ]

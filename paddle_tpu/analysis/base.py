@@ -13,6 +13,11 @@ __all__ = ["Finding", "ProgramVerifyError", "LintError",
            "CollectiveOrderError", "RecompileError", "format_findings"]
 
 
+#: severity ladder for pass-manager findings.  Plain lints that predate
+#: the pass manager default to "error" (they were always raise-worthy).
+SEVERITIES = ("info", "warning", "error")
+
+
 class Finding:
     """One diagnostic: a stable machine code + a human message.
 
@@ -20,19 +25,31 @@ class Finding:
     message   human-readable description with names/avals
     op_index  tape index / eqn index the finding anchors to (or None)
     detail    check-specific payload (vid, dtype pair, aval list, ...)
+    severity  "info" | "warning" | "error" (pass-manager ladder)
+    pass_name pass that produced this finding (set by PassManager)
     """
 
-    __slots__ = ("code", "message", "op_index", "detail")
+    __slots__ = ("code", "message", "op_index", "detail", "severity",
+                 "pass_name")
 
     def __init__(self, code: str, message: str,
-                 op_index: Optional[int] = None, detail: Any = None):
+                 op_index: Optional[int] = None, detail: Any = None,
+                 severity: str = "error", pass_name: Optional[str] = None):
+        if severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {severity!r}; "
+                             f"expected one of {SEVERITIES}")
         self.code = code
         self.message = message
         self.op_index = op_index
         self.detail = detail
+        self.severity = severity
+        self.pass_name = pass_name
 
     def to_dict(self):
-        d = {"code": self.code, "message": self.message}
+        d = {"code": self.code, "message": self.message,
+             "severity": self.severity}
+        if self.pass_name is not None:
+            d["pass"] = self.pass_name
         if self.op_index is not None:
             d["op_index"] = self.op_index
         if self.detail is not None:
@@ -41,7 +58,8 @@ class Finding:
 
     def __repr__(self):
         loc = f" @op[{self.op_index}]" if self.op_index is not None else ""
-        return f"Finding({self.code}{loc}: {self.message})"
+        sev = "" if self.severity == "error" else f" {self.severity}"
+        return f"Finding({self.code}{loc}{sev}: {self.message})"
 
 
 def format_findings(findings, title="program verification failed"):

@@ -105,6 +105,19 @@ def collective_schedule(fn_or_jaxpr, *args) -> List[CollectiveEvent]:
             if eqn.primitive.name in COLLECTIVE_PRIMS]
 
 
+def _degenerate_domain(domain) -> bool:
+    """True for a domain carrying no real communication axis: the empty
+    tuple (a CommOverlapPlan over zero live axes — every mesh axis size
+    1) or an all-None tuple (a psum whose axis collapsed to size 1 and
+    traced as an unnamed/device-local reduction).  Such events are
+    device-local copies, not rendezvous — the order checker must treat
+    them as no-ops, never as a divergence between the one rank that
+    lists them and a peer that doesn't."""
+    if not isinstance(domain, tuple):
+        return domain is None
+    return all(x is None for x in domain)
+
+
 def _domain_participants(domain, all_ranks):
     """Ranks expected to take part in `domain`.  Pipeline channels
     encode their endpoints as the ints in the domain tuple (("act", 0,
@@ -143,14 +156,33 @@ def check_collective_order(
     collective, the swap is still a rendezvous deadlock."""
     findings: List[Finding] = []
     all_ranks = list(schedules)
-    part = participants or (
-        lambda d: _domain_participants(d, all_ranks))
+    if participants is None:
+        raw_part = lambda d: _domain_participants(d, all_ranks)  # noqa: E731
+    elif callable(participants):
+        raw_part = participants
+    else:                       # a mapping domain -> ranks
+        raw_part = participants.__getitem__
+
+    def part(d):
+        # a participants mapping (dict / __getitem__) may not know
+        # degenerate/one-off domains — a size-1 axis's domain is a
+        # no-op, not a KeyError
+        try:
+            return raw_part(d)
+        except (KeyError, LookupError):
+            return _domain_participants(d, all_ranks)
+
     domains = {ev.domain for events in schedules.values()
-               for ev in events}
+               for ev in events if not _degenerate_domain(ev.domain)}
     by_domain: Dict[tuple, List] = {}
     for d in sorted(domains, key=repr):
+        members = part(d)
+        if len(members) < 2:
+            # single-rank domain: one participant can't diverge from a
+            # peer — nothing to prove (the size-1-axis no-op contract)
+            continue
         for rank in all_ranks:
-            if rank not in part(d):
+            if rank not in members:
                 continue
             seq = [(ev.kind, ev.key) for ev in schedules[rank]
                    if ev.domain == d]
@@ -173,19 +205,25 @@ def check_collective_order(
                 op_index=pos,
                 detail=(domain, ref_rank, rank, pos)))
     if composed:
+        # degenerate (size-1 / unnamed-axis) events are device-local:
+        # they neither define a rank's domain signature nor participate
+        # in the cross-domain issue order
         groups: Dict[frozenset, List] = {}
         for rank in all_ranks:
-            sig = frozenset(ev.domain for ev in schedules[rank])
+            sig = frozenset(ev.domain for ev in schedules[rank]
+                            if not _degenerate_domain(ev.domain))
             groups.setdefault(sig, []).append(rank)
         for sig, ranks in groups.items():
-            if len(ranks) < 2:
+            if len(ranks) < 2 or not sig:
                 continue
             ref_rank = ranks[0]
             ref = [(ev.kind, ev.key, ev.domain)
-                   for ev in schedules[ref_rank]]
+                   for ev in schedules[ref_rank]
+                   if not _degenerate_domain(ev.domain)]
             for rank in ranks[1:]:
                 seq = [(ev.kind, ev.key, ev.domain)
-                       for ev in schedules[rank]]
+                       for ev in schedules[rank]
+                       if not _degenerate_domain(ev.domain)]
                 if seq == ref:
                     continue
                 pos = next((i for i, (a, b) in enumerate(zip(ref, seq))

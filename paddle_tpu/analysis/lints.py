@@ -55,15 +55,29 @@ def _sub_jaxprs(params):
                     yield v
 
 
-def iter_eqns(jaxpr):
+def iter_eqns(jaxpr, _seen=None):
     """Yield every eqn of `jaxpr` (Jaxpr or ClosedJaxpr) depth-first in
-    program order, recursing into scan/while/cond/pjit sub-jaxprs."""
+    program order, recursing into scan/while/cond/pjit sub-jaxprs.
+
+    Each distinct sub-jaxpr OBJECT is visited once: jax caches the
+    traced jaxpr of a jitted layer, so N calls to one layer produce N
+    pjit eqns all referencing the SAME ClosedJaxpr — without the dedupe
+    a scanned/stacked layer reports every dtype-promotion finding once
+    per reference, flooding the output with copies of one defect (and
+    the collective-order checker would count one program's collectives
+    N times; the per-iteration order is what rendezvous matching
+    depends on, same as the one-scan-iteration convention)."""
+    if _seen is None:
+        _seen = set()
     if isinstance(jaxpr, jax.core.ClosedJaxpr):
         jaxpr = jaxpr.jaxpr
+    if id(jaxpr) in _seen:
+        return
+    _seen.add(id(jaxpr))
     for eqn in jaxpr.eqns:
         yield eqn
         for sub in _sub_jaxprs(eqn.params):
-            yield from iter_eqns(sub)
+            yield from iter_eqns(sub, _seen)
 
 
 def as_jaxpr(fn_or_jaxpr, *args, **kw):

@@ -1,0 +1,513 @@
+"""Program Sentinel — a PIR-equivalent static pass manager.
+
+Paddle's PIR layer runs registered analyses over the IR before any
+chip time; this repo's equivalent was a loose bag of lints invoked
+inconsistently per engine.  This module unifies them:
+
+  @register_pass("donation", level="full", ...)   a catalog of named
+      passes, each with a severity, a LEVEL, and an ``applies``
+      predicate over the program context.
+
+  PassContext    one program under analysis — which engine built it
+      (trainer / pipeline / hybrid / serve), its mesh, a trace-args
+      thunk, and LAZY artifacts (``ctx.compiled_text()`` compiles at
+      most once, shared by the census and replication passes).
+
+  PassManager.run(ctx, level) -> List[Finding]   runs every enabled,
+      applicable pass at or below the level, stamps ``pass_name`` on
+      findings, and drops (program, pass, code) triples listed in the
+      baseline-suppression file — pre-existing findings are tracked,
+      not silenced, and never block.
+
+  sentinel_preflight(ctx, ...)   the engine entry point, gated on
+      FLAGS_static_sentinel (default on): severity=error findings
+      raise SentinelError; warnings/infos are reported on the result.
+
+Two levels keep the default path cheap:
+
+  build   structural checks on already-built artifacts (overlap-plan
+          coherence, modeled schedule order, recompile hygiene) — runs
+          automatically at engine build time.
+  full    checks that need ``jax.jit(...).lower()`` or a compile
+          (donation aliasing, dtype lints, the HLO collective census,
+          the replication audit) — run via ``engine.preflight(...)``,
+          ``tools/static_check.py``, and CI, where paying one extra
+          compile is the point.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .base import Finding, SEVERITIES, format_findings
+
+__all__ = ["Pass", "PassContext", "PassManager", "SentinelError",
+           "SentinelReport", "register_pass", "registered_passes",
+           "sentinel_preflight", "load_baseline"]
+
+LEVELS = ("build", "full")
+
+
+class SentinelError(RuntimeError):
+    """Severity=error sentinel findings on a default-on preflight."""
+
+    def __init__(self, findings, label="<program>"):
+        self.findings = list(findings)
+        super().__init__(format_findings(
+            self.findings, f"static sentinel failed for {label}"))
+
+
+class Pass:
+    """One registered analysis.
+
+    name      stable kebab-case id (also the enable-flag key)
+    level     "build" (cheap, auto) | "full" (needs lower/compile)
+    doc       one line: what a clean run PROVES about the program
+    applies   ctx -> bool (engine kinds this pass understands)
+    run       ctx -> List[Finding]
+    default   whether the pass runs unless explicitly disabled
+    """
+
+    def __init__(self, name: str, run: Callable, *, level: str = "build",
+                 doc: str = "", applies: Optional[Callable] = None,
+                 default: bool = True):
+        if level not in LEVELS:
+            raise ValueError(f"unknown pass level {level!r}")
+        self.name = name
+        self.level = level
+        self.doc = doc
+        self.applies = applies or (lambda ctx: True)
+        self.default = default
+        self._run = run
+
+    def run(self, ctx: "PassContext") -> List[Finding]:
+        findings = list(self._run(ctx) or ())
+        for f in findings:
+            if f.pass_name is None:
+                f.pass_name = self.name
+        return findings
+
+    def __repr__(self):
+        return f"Pass({self.name}, level={self.level})"
+
+
+_REGISTRY: Dict[str, Pass] = {}
+
+
+def register_pass(name: str, *, level: str = "build", doc: str = "",
+                  applies: Optional[Callable] = None,
+                  default: bool = True):
+    """Decorator: add a ``ctx -> List[Finding]`` function to the pass
+    catalog.  Re-registering a name replaces the pass (tests use this
+    to plant probes)."""
+    def deco(fn):
+        _REGISTRY[name] = Pass(name, fn, level=level, doc=doc,
+                               applies=applies, default=default)
+        return fn
+    return deco
+
+
+def registered_passes() -> Dict[str, Pass]:
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+
+class PassContext:
+    """One program under the sentinel.
+
+    kind       "trainer" | "pipeline" | "hybrid" | "serve" | "fn"
+    label      stable program name — the baseline key ("trainer:zero2")
+    engine     the owning ShardedTrainStep / PipelineEngine /
+               HybridParallelEngine / ContinuousBatcher (or None)
+    fn, args   for kind="fn": a bare jittable + example args
+    mesh       the program's Mesh (axes inference for the census)
+    modeled_events  thunk -> List[CollectiveEvent]; defaults to the
+               strategy model for the engine kind
+    sharded_params  thunk -> [(name, gshape, dtype, lshape)] for the
+               replication audit
+    donate_argnums  what the program is EXPECTED to donate
+
+    Artifacts are lazy and cached: ``compiled_text()`` triggers at most
+    one lower+compile however many passes consume the HLO.
+    """
+
+    def __init__(self, kind: str, label: str, *, engine=None, fn=None,
+                 args: Sequence = (), mesh=None,
+                 modeled_events: Optional[Callable] = None,
+                 sharded_params: Optional[Callable] = None,
+                 donate_argnums: Tuple[int, ...] = (),
+                 extra: Optional[Dict[str, Any]] = None):
+        self.kind = kind
+        self.label = label
+        self.engine = engine
+        self.fn = fn
+        self.args = tuple(args)
+        self.mesh = mesh
+        self._modeled_events = modeled_events
+        self._sharded_params = sharded_params
+        self.donate_argnums = tuple(donate_argnums)
+        self.extra = dict(extra or {})
+        self._cache: Dict[str, Any] = {}
+
+    # -- lazy artifacts ----------------------------------------------------
+
+    def _memo(self, key, thunk):
+        if key not in self._cache:
+            self._cache[key] = thunk()
+        return self._cache[key]
+
+    def lowered(self):
+        """jax.stages.Lowered for the program (full-level passes)."""
+        def build():
+            import jax
+            if self.kind == "trainer":
+                step = self.engine
+                targs = step._trace_args(self.args)  # builds lazily
+                with step.mesh:
+                    return step._compiled.lower(*targs)
+            if self.fn is not None:
+                if hasattr(self.fn, "lower"):   # already jitted
+                    return self.fn.lower(*self.args)
+                return jax.jit(
+                    self.fn,
+                    donate_argnums=self.donate_argnums).lower(*self.args)
+            raise ValueError(f"no lowerable program in ctx {self.label!r}")
+        return self._memo("lowered", build)
+
+    def compiled_text(self) -> str:
+        """Post-SPMD optimized HLO text (census + replication audit)."""
+        def build():
+            if self.kind == "trainer":
+                return self.engine.compiled_hlo(*self.args, optimized=True)
+            return self.lowered().compile().as_text()
+        return self._memo("compiled_text", build)
+
+    def modeled_events(self) -> list:
+        def build():
+            if self._modeled_events is not None:
+                return list(self._modeled_events() or ())
+            if self.kind == "trainer":
+                from .sharding_census import modeled_trainer_events
+                return modeled_trainer_events(self.engine)
+            return []
+        return self._memo("modeled_events", build)
+
+    def sharded_params(self) -> list:
+        def build():
+            if self._sharded_params is not None:
+                return list(self._sharded_params() or ())
+            if self.kind == "trainer":
+                return _trainer_sharded_params(self.engine)
+            return []
+        return self._memo("sharded_params", build)
+
+
+def _trainer_sharded_params(step) -> list:
+    """(name, global_shape, dtype, intended_local_shape) rows for a
+    ShardedTrainStep — local shape derived from the param's
+    NamedSharding spec over the trainer mesh."""
+    rows = []
+    sd = step.model.state_dict()
+    for name in step._names:
+        sharding = step._param_shardings.get(name) \
+            if hasattr(step._param_shardings, "get") else None
+        v = sd[name].value
+        spec = getattr(sharding, "spec", None)
+        lshape = list(v.shape)
+        if spec is not None:
+            for dim, entry in enumerate(tuple(spec)[:len(lshape)]):
+                if entry is None:
+                    continue
+                axes = entry if isinstance(entry, tuple) else (entry,)
+                n = 1
+                for a in axes:
+                    n *= step.mesh.shape.get(a, 1)
+                if n > 1 and lshape[dim] % n == 0:
+                    lshape[dim] //= n
+        rows.append((name, tuple(v.shape), str(v.dtype), tuple(lshape)))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# baseline suppression
+
+def load_baseline(path: Optional[str] = None) -> set:
+    """(program-label, pass, code) triples from the committed baseline
+    file — pre-existing findings tracked there don't block.  Default
+    path: tools/static_baseline.json next to the repo root, overridable
+    via FLAGS_sentinel_baseline."""
+    if path is None:
+        from ..framework.flags import get_flag
+        path = get_flag("sentinel_baseline", "") or None
+    if path is None:
+        here = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        path = os.path.join(here, "tools", "static_baseline.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return set()
+    out = set()
+    for row in data.get("suppressions", []):
+        out.add((row.get("program", "*"), row.get("pass", "*"),
+                 row.get("code", "*")))
+    return out
+
+
+def _suppressed(baseline: set, label: str, pass_name: str,
+                code: str) -> bool:
+    for prog in (label, "*"):
+        for pn in (pass_name, "*"):
+            for c in (code, "*"):
+                if (prog, pn, c) in baseline:
+                    return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+
+class SentinelReport:
+    """Outcome of one sentinel run: surviving findings by severity,
+    plus what the baseline suppressed."""
+
+    def __init__(self, label, findings, suppressed, passes_run):
+        self.label = label
+        self.findings = list(findings)
+        self.suppressed = list(suppressed)
+        self.passes_run = list(passes_run)
+
+    @property
+    def errors(self):
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self):
+        return [f for f in self.findings if f.severity == "warning"]
+
+    def raise_on_error(self):
+        if self.errors:
+            raise SentinelError(self.errors, self.label)
+        return self
+
+    def to_dict(self):
+        return {"program": self.label,
+                "passes": self.passes_run,
+                "findings": [f.to_dict() for f in self.findings],
+                "suppressed": [f.to_dict() for f in self.suppressed]}
+
+    def __repr__(self):
+        return (f"SentinelReport({self.label}: "
+                f"{len(self.errors)} errors, {len(self.warnings)} "
+                f"warnings, {len(self.suppressed)} suppressed)")
+
+
+class PassManager:
+    """Runs the registered catalog over one PassContext.
+
+    enable/disable: explicit per-pass switches; unspecified passes fall
+    back to their registration default AND the per-pass flag
+    ``sentinel_pass_<name>`` (dashes -> underscores), so a deployment
+    can switch any single pass off without code.
+    """
+
+    def __init__(self, passes: Optional[Sequence[Pass]] = None, *,
+                 enable: Sequence[str] = (), disable: Sequence[str] = (),
+                 baseline: Optional[set] = None,
+                 use_baseline: bool = True):
+        self.passes = list(passes) if passes is not None \
+            else list(_REGISTRY.values())
+        self.enable = set(enable)
+        self.disable = set(disable)
+        if baseline is None and use_baseline:
+            baseline = load_baseline()
+        self.baseline = baseline or set()
+
+    def _enabled(self, p: Pass) -> bool:
+        if p.name in self.disable:
+            return False
+        if p.name in self.enable:
+            return True
+        from ..framework.flags import get_flag
+        flag = get_flag("sentinel_pass_" + p.name.replace("-", "_"), None)
+        if flag is not None:
+            return bool(flag)
+        return p.default
+
+    def run(self, ctx: PassContext, level: str = "full",
+            collect_errors: bool = True) -> SentinelReport:
+        """Run every enabled, applicable pass at or below ``level``
+        ("build" runs only build passes; "full" runs both).  A pass
+        that itself crashes becomes a ``pass-crashed`` error finding
+        rather than aborting the catalog (unless collect_errors=False,
+        for debugging)."""
+        want = ("build",) if level == "build" else LEVELS
+        findings, suppressed, ran = [], [], []
+        for p in self.passes:
+            if p.level not in want or not self._enabled(p):
+                continue
+            try:
+                if not p.applies(ctx):
+                    continue
+                got = p.run(ctx)
+            except Exception as e:  # noqa: BLE001 — catalog must finish
+                if not collect_errors:
+                    raise
+                got = [Finding("pass-crashed",
+                               f"pass {p.name!r} crashed on "
+                               f"{ctx.label}: {type(e).__name__}: {e}",
+                               severity="error", pass_name=p.name)]
+            ran.append(p.name)
+            for f in got:
+                if _suppressed(self.baseline, ctx.label, p.name, f.code):
+                    suppressed.append(f)
+                else:
+                    findings.append(f)
+        order = {s: i for i, s in enumerate(SEVERITIES)}
+        findings.sort(key=lambda f: -order[f.severity])
+        return SentinelReport(ctx.label, findings, suppressed, ran)
+
+
+def sentinel_preflight(ctx: PassContext, *, level: str = "build",
+                       raise_errors: Optional[bool] = None,
+                       manager: Optional[PassManager] = None
+                       ) -> Optional[SentinelReport]:
+    """Engine entry point.  Returns None (no-op) when
+    FLAGS_static_sentinel is off; otherwise runs the catalog and — by
+    default — raises SentinelError on severity=error findings."""
+    from ..framework.flags import get_flag
+    if not get_flag("static_sentinel", True):
+        return None
+    report = (manager or PassManager()).run(ctx, level=level)
+    if raise_errors or raise_errors is None:
+        report.raise_on_error()
+    return report
+
+
+# ---------------------------------------------------------------------------
+# the catalog: existing lints unified as passes + the two new analyzers
+
+def _is_kind(*kinds):
+    return lambda ctx: ctx.kind in kinds
+
+
+@register_pass(
+    "collective-order", level="build",
+    doc="modeled collective schedules agree in order across every rank "
+        "of every ordering domain — no static deadlock image",
+    applies=_is_kind("trainer", "hybrid", "pipeline"))
+def _pass_collective_order(ctx) -> List[Finding]:
+    from .collectives import check_collective_order
+    eng = ctx.engine
+    if ctx.kind == "trainer":
+        plan = getattr(eng, "_overlap_plan", None)
+        if plan is None or not plan.active:
+            return []
+        return check_collective_order(plan.schedules())
+    if ctx.kind == "hybrid":
+        scheds = eng.collective_schedule(*ctx.args) if ctx.args else None
+        if not scheds:
+            return []
+        return check_collective_order(scheds, composed=True)
+    if ctx.kind == "pipeline":
+        m = ctx.extra.get("num_micro", 2 * eng.pp)
+        sched = ctx.extra.get("schedule", "1F1B")
+        from .base import CollectiveOrderError
+        try:
+            eng.verify_schedule(m, sched)
+        except CollectiveOrderError as e:
+            return list(e.findings)
+        return []
+    return []
+
+
+@register_pass(
+    "overlap-plan", level="build",
+    doc="gradient buckets tile the parameter list exactly once with "
+        "consistent comm dtypes (CommOverlapPlan.verify as findings)",
+    applies=_is_kind("trainer"))
+def _pass_overlap_plan(ctx) -> List[Finding]:
+    plan = getattr(ctx.engine, "_overlap_plan", None)
+    if plan is None or not plan.active:
+        return []
+    try:
+        plan.verify()
+    except Exception as e:  # plan.verify raises on violation
+        return [Finding("overlap-plan-invalid", str(e), severity="error")]
+    return []
+
+
+@register_pass(
+    "donation", level="full",
+    doc="every donate_argnums buffer is actually aliased to an output "
+        "in the lowered program — donated HBM is really reused",
+    applies=lambda ctx: (ctx.kind in ("trainer", "fn", "serve")
+                         and (ctx.kind != "trainer"
+                              or ctx.engine._donate)))
+def _pass_donation(ctx) -> List[Finding]:
+    from .lints import lint_donation, lint_serve_programs
+    if ctx.kind == "serve":
+        return list(lint_serve_programs(ctx.engine))
+    if ctx.kind == "trainer":
+        return lint_donation(ctx.lowered(), donate_argnums=(0, 1, 2))
+    return lint_donation(ctx.lowered(),
+                         donate_argnums=ctx.donate_argnums)
+
+
+@register_pass(
+    "dtype-promotion", level="full", default=False,
+    doc="no f32 upcasts of bf16 activations and no x64 creep in the "
+        "traced program (noisy on mixed-precision masters: opt-in)",
+    applies=_is_kind("trainer", "fn"))
+def _pass_dtype(ctx) -> List[Finding]:
+    from .lints import lint_dtype_promotion
+    if ctx.kind == "trainer":
+        step = ctx.engine
+        targs = step._trace_args(ctx.args)
+        return lint_dtype_promotion(step._step_fn, *targs)
+    return lint_dtype_promotion(ctx.fn, *ctx.args)
+
+
+@register_pass(
+    "grad-comm-dtype", level="full",
+    doc="every gradient leaf is covered by exactly one comm bucket and "
+        "reduced in the declared comm dtype (no silent fp32 wire)",
+    applies=lambda ctx: (ctx.kind == "trainer"
+                         and getattr(ctx.engine, "_overlap_plan", None)
+                         is not None
+                         and ctx.engine._overlap_plan.active))
+def _pass_grad_comm_dtype(ctx) -> List[Finding]:
+    return ctx.engine.lint_comm_dtype(*ctx.args)
+
+
+@register_pass(
+    "collective-census", level="full",
+    doc="per-class collective traffic of the compiled HLO stays within "
+        "slack of the modeled CollectiveEvent schedule — no implicit "
+        "resharding, and the cost ledger's comm model is proven "
+        "against the emitted program",
+    applies=_is_kind("trainer", "pipeline", "hybrid", "fn"))
+def _pass_census(ctx) -> List[Finding]:
+    from .sharding_census import parse_hlo_collectives, census_diff
+    emitted = parse_hlo_collectives(ctx.compiled_text(), ctx.mesh)
+    return census_diff(emitted, ctx.modeled_events(),
+                       min_bytes=ctx.extra.get("census_min_bytes"),
+                       slack=ctx.extra.get("census_slack"),
+                       label=ctx.label)
+
+
+@register_pass(
+    "replication-audit", level="full",
+    doc="no large tensor the strategy shards is lowered at full global "
+        "shape (silently replicated, world x the intended HBM)",
+    applies=_is_kind("trainer", "fn"))
+def _pass_replication(ctx) -> List[Finding]:
+    from .sharding_census import replication_audit
+    params = ctx.sharded_params()
+    if not params:
+        return []
+    return replication_audit(ctx.compiled_text(), params,
+                             min_bytes=ctx.extra.get("census_min_bytes"),
+                             label=ctx.label)

@@ -1,0 +1,630 @@
+"""HLO collective census — prove the compiled program matches the model.
+
+The r20/r21 engines MODEL their collective traffic (`CommOverlapPlan`
+events, `modeled_axis_profiles` per-axis byte columns) but nothing
+statically checked that the collectives XLA actually emits agree with
+that model.  An unintended all-gather of an mp-sharded weight, or a
+large tensor silently lowered fully-replicated, today only shows up as
+a slow step or an OOM on real hardware.  This module closes the loop:
+
+  parse_hlo_collectives(text)   every all-reduce / all-gather /
+      reduce-scatter / all-to-all / collective-permute instruction in
+      the SPMD-partitioned module, with replica groups (explicit
+      ``{{0,1},{2,3}}`` and iota ``[2,4]<=[8]`` forms), participating
+      mesh AXES inferred from the group partition, and a canonical
+      ``global_bytes`` — the full logical tensor's bytes, the same
+      scale the modeled `CollectiveEvent.bytes` carries.
+
+  census_diff(emitted, modeled)  per-CLASS byte-budget comparison.
+      XLA freely decomposes collectives (the CPU backend lowers a
+      reduce-scatter to all-to-all / collective-permute / all-gather +
+      all-reduce mixes), so an op-for-op bijection against the model is
+      unsound; what IS stable is the traffic per class:
+
+          reduce  = all-reduce, reduce-scatter, all-to-all
+          gather  = all-gather
+          permute = collective-permute
+
+      Emitted traffic beyond ``slack`` x the modeled class budget is a
+      `census-unmodeled-collective` finding naming the biggest
+      offending ops (instruction, source op_name, axes, bytes); a
+      modeled budget with no emitted traffic to account for it is a
+      `census-missing-collective` warning.
+
+  replication_audit(text, params)  large tensors the strategy says are
+      sharded but the partitioned module holds at FULL global shape —
+      the "silently replicated" half of the resharding failure mode.
+
+  modeled_trainer_events(step) / modeled_chunk_events(...)  the
+      strategy-algebra event model for a ShardedTrainStep /
+      PipelineEngine chunk program — what census_diff budgets against.
+
+Caveats (by design): instruction counting is per-module-text, so a
+collective inside a while-body counts once per program, not per
+iteration — budgets are per-step-shaped programs; the slack factor
+absorbs decomposition overhead and the double-gather patterns ZeRO-3
+rematerialization legitimately emits.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .base import Finding
+
+__all__ = ["HloCollective", "parse_hlo_collectives", "census_diff",
+           "replication_audit", "modeled_trainer_events",
+           "modeled_chunk_events", "modeled_hybrid_events",
+           "modeled_budgets", "COLLECTIVE_CLASS", "EVENT_CLASS"]
+
+
+# HLO op -> traffic class (see module docstring: classes, not ops, are
+# stable under XLA's decompositions)
+COLLECTIVE_CLASS = {
+    "all-reduce": "reduce",
+    "reduce-scatter": "reduce",
+    "all-to-all": "reduce",
+    "all-gather": "gather",
+    "collective-permute": "permute",
+}
+
+# modeled CollectiveEvent.kind -> traffic class
+EVENT_CLASS = {
+    "psum": "reduce", "pmax": "reduce", "pmin": "reduce",
+    "reduce_scatter": "reduce", "all_to_all": "reduce",
+    "all_gather": "gather", "pgather": "gather",
+    "ppermute": "permute",
+}
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1, "f8e5m2fnuz": 1,
+    "f8e4m3fnuz": 1,
+}
+
+
+class HloCollective(NamedTuple):
+    """One collective instruction of the partitioned module."""
+    op: str               # HLO opcode ("all-reduce", ...)
+    name: str             # instruction name ("all-reduce.12")
+    cls: str              # traffic class ("reduce"|"gather"|"permute")
+    result_bytes: int     # result bytes on ONE participant
+    global_bytes: int     # canonical full-logical-tensor traffic
+    num_groups: int
+    group_size: int
+    axes: Tuple[str, ...]  # inferred mesh axes ((), when no mesh given)
+    op_name: str          # metadata op_name (jax source attribution)
+
+    def describe(self) -> str:
+        ax = f" axes={list(self.axes)}" if self.axes else ""
+        src = f" from {self.op_name!r}" if self.op_name else ""
+        return (f"%{self.name} {self.op} "
+                f"[{self.num_groups}x{self.group_size}]{ax} "
+                f"{self.global_bytes / 2**20:.3f}MB{src}")
+
+
+# instruction head: optional ROOT, %name = <type> <op>(  — the type is
+# either a tuple "(f32[4]{0}, ...)" (variadic collectives) or one token
+_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<type>\([^)]*\)|\S+)\s+"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)"
+    r"(?P<suffix>-start|-done)?\(")
+
+_SHAPE_RE = re.compile(r"(?P<dt>[a-z]+[0-9]*[a-z0-9]*)\[(?P<dims>[0-9,]*)\]")
+_GROUPS_EXPL_RE = re.compile(
+    r"replica_groups=\{(\{[0-9,]*\}(?:,\{[0-9,]*\})*)\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]"
+    r"(?:T\(([0-9,]+)\))?")
+_PAIRS_RE = re.compile(
+    r"source_target_pairs=\{(\{[0-9,]+\}(?:,\{[0-9,]+\})*)\}")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def _shape_bytes(type_text: str) -> int:
+    """Total bytes of one result type (sum over a tuple's elements).
+    Layout suffixes ("{1,0}") never match the shape pattern."""
+    total = 0
+    for m in _SHAPE_RE.finditer(type_text):
+        dt = m.group("dt")
+        if dt not in _DTYPE_BYTES:
+            continue
+        dims = m.group("dims")
+        numel = 1
+        for d in dims.split(","):
+            if d:
+                numel *= int(d)
+        total += numel * _DTYPE_BYTES[dt]
+    return total
+
+
+def _parse_groups(line: str) -> Tuple[List[Tuple[int, ...]], int, int]:
+    """-> (groups, num_groups, group_size).  Handles the explicit
+    ``{{0,1},{2,3}}`` form and the iota ``[G,S]<=[dims]T(perm)`` form
+    (iota over prod(dims), reshape, transpose, regroup)."""
+    m = _GROUPS_EXPL_RE.search(line)
+    if m:
+        groups = []
+        for g in re.findall(r"\{([0-9,]*)\}", m.group(1)):
+            ids = tuple(int(x) for x in g.split(",") if x != "")
+            if ids:
+                groups.append(ids)
+        if groups:
+            return groups, len(groups), max(len(g) for g in groups)
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        ng, gs = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        arr = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            perm = [int(x) for x in m.group(4).split(",")]
+            arr = arr.transpose(perm)
+        grid = arr.reshape(ng, gs)
+        return [tuple(int(x) for x in row) for row in grid], ng, gs
+    return [], 1, 1
+
+
+def _mesh_coords(mesh) -> Dict[int, Tuple[int, ...]]:
+    """device id -> coordinate tuple in the mesh's device grid."""
+    coords = {}
+    devs = np.asarray(mesh.devices)
+    for idx in np.ndindex(devs.shape):
+        coords[int(devs[idx].id)] = idx
+    return coords
+
+
+def _infer_axes(groups, mesh) -> Tuple[str, ...]:
+    """Mesh axes a replica-group partition communicates over: within
+    any group, the coordinates of its members vary exactly along the
+    collective's axes (and are fixed along the others).  Group ids are
+    global device ids under ``use_global_device_ids`` — the form jax's
+    SPMD lowering emits."""
+    if mesh is None or not groups:
+        return ()
+    coords = _mesh_coords(mesh)
+    names = tuple(mesh.axis_names)
+    varying = set()
+    for g in groups:
+        cs = [coords[d] for d in g if d in coords]
+        if len(cs) < 2:
+            continue
+        for i in range(len(names)):
+            if len({c[i] for c in cs}) > 1:
+                varying.add(names[i])
+    return tuple(a for a in names if a in varying)
+
+
+def parse_hlo_collectives(text: str, mesh=None) -> List[HloCollective]:
+    """All collective instructions of an HLO module text (use
+    ``compiled.as_text()`` — the SPMD-partitioned module, where GSPMD's
+    implicit reshards exist as real instructions).  Async pairs count
+    once (the ``-start`` op carries the groups; ``-done`` is skipped)."""
+    out: List[HloCollective] = []
+    for line in text.splitlines():
+        m = _OP_RE.match(line)
+        if m is None or m.group("suffix") == "-done":
+            continue
+        op = m.group("op")
+        result_bytes = _shape_bytes(m.group("type"))
+        if op in ("all-reduce", "all-gather") \
+                and m.group("suffix") == "-start":
+            # the start op's result repeats the operand buffers
+            # (in-flight double buffer) — halve back to one copy
+            result_bytes //= 2
+        groups, ng, gs = _parse_groups(line)
+        if op == "collective-permute":
+            pm = _PAIRS_RE.search(line)
+            pairs = re.findall(r"\{([0-9]+),([0-9]+)\}",
+                               pm.group(1)) if pm else []
+            groups = [tuple(int(x) for x in p) for p in pairs]
+            ng, gs = max(1, len(groups)), 2
+            global_bytes = result_bytes * max(1, len(groups))
+        elif op in ("reduce-scatter", "all-to-all"):
+            # result is the per-participant shard; the full tensor is
+            # group_size shards, once per group
+            global_bytes = result_bytes * gs * ng
+        else:
+            # all-reduce / all-gather results carry the full tensor
+            global_bytes = result_bytes * ng
+        nm = _OPNAME_RE.search(line)
+        out.append(HloCollective(
+            op=op, name=m.group("name"), cls=COLLECTIVE_CLASS[op],
+            result_bytes=result_bytes, global_bytes=global_bytes,
+            num_groups=ng, group_size=gs,
+            axes=_infer_axes(groups, mesh),
+            op_name=nm.group(1) if nm else ""))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# modeled budgets + the diff
+
+def _flag(name, default):
+    from ..framework.flags import get_flag
+    v = get_flag(name, default)
+    return default if v is None else v
+
+
+def _is_allowance(ev) -> bool:
+    """Events keyed ("allowance", ...) are budget CEILINGS — traffic
+    the strategy permits (ZeRO param regathers, decomposition permutes)
+    that XLA may legitimately optimize below; they raise the excess
+    threshold but never trigger census-missing-collective."""
+    key = getattr(ev, "key", ())
+    return bool(key) and key[0] == "allowance"
+
+
+def modeled_budgets(modeled: Sequence,
+                    firm_only: bool = False) -> Dict[str, int]:
+    """Per-class byte budgets from a modeled CollectiveEvent list
+    (events of unknown kind or zero bytes contribute nothing).
+    firm_only drops allowance events — the missing-side baseline."""
+    budgets = {"reduce": 0, "gather": 0, "permute": 0}
+    for ev in modeled:
+        if firm_only and _is_allowance(ev):
+            continue
+        cls = EVENT_CLASS.get(getattr(ev, "kind", None))
+        if cls is not None:
+            budgets[cls] += int(getattr(ev, "bytes", 0) or 0)
+    return budgets
+
+
+def census_diff(emitted: Sequence[HloCollective], modeled: Sequence, *,
+                min_bytes: Optional[int] = None,
+                slack: Optional[float] = None,
+                label: str = "<program>") -> List[Finding]:
+    """Diff the emitted collective census against the modeled schedule.
+
+    Per traffic class: emitted global bytes beyond
+    ``modeled * slack + min_bytes`` is an error finding naming the
+    largest emitted ops of that class (instruction name, jax op_name,
+    inferred axes, byte count — the implicit reshard GSPMD inserted);
+    a modeled budget ≥ min_bytes with emitted bytes below
+    ``modeled / slack`` is a warning (the model predicts communication
+    the program does not perform — the model drifted, or XLA optimized
+    the collective away and the cost ledger overcharges).
+
+    min_bytes defaults to FLAGS_census_min_bytes, slack to
+    FLAGS_census_slack — the tolerance that absorbs decomposition
+    overhead (CPU lowers reduce-scatter to all-to-all/permute/gather
+    mixes) and ZeRO-3's legitimate double param-gather."""
+    if min_bytes is None:
+        min_bytes = int(_flag("census_min_bytes", 1 << 20))
+    if slack is None:
+        slack = float(_flag("census_slack", 4.0))
+    budgets = modeled_budgets(modeled)
+    firm = modeled_budgets(modeled, firm_only=True)
+    emitted_tot = {"reduce": 0, "gather": 0, "permute": 0}
+    by_cls: Dict[str, List[HloCollective]] = {
+        "reduce": [], "gather": [], "permute": []}
+    for c in emitted:
+        emitted_tot[c.cls] += c.global_bytes
+        by_cls[c.cls].append(c)
+    findings: List[Finding] = []
+    for cls in ("reduce", "gather", "permute"):
+        e, m = emitted_tot[cls], budgets[cls]
+        if e > m * slack + min_bytes:
+            culprits = sorted(by_cls[cls], key=lambda c: -c.global_bytes)
+            named = [c for c in culprits if c.global_bytes >= min_bytes] \
+                or culprits[:1]
+            tops = "; ".join(c.describe() for c in named[:4])
+            findings.append(Finding(
+                "census-unmodeled-collective",
+                f"{label}: emitted {cls}-class collective traffic "
+                f"{e / 2**20:.2f}MB exceeds the modeled budget "
+                f"{m / 2**20:.2f}MB (x{slack:g} slack + "
+                f"{min_bytes / 2**20:.2f}MB floor) — XLA inserted "
+                f"communication the strategy model did not predict "
+                f"(an implicit resharding).  Largest: {tops}",
+                severity="error",
+                detail={"class": cls, "emitted_bytes": e,
+                        "modeled_bytes": m,
+                        "ops": [c._asdict() for c in named[:8]]}))
+        elif firm[cls] > e * slack + min_bytes:
+            findings.append(Finding(
+                "census-missing-collective",
+                f"{label}: modeled {cls}-class budget "
+                f"{firm[cls] / 2**20:.2f}MB but the compiled module emits only "
+                f"{e / 2**20:.2f}MB — the comm model predicts traffic "
+                f"the program does not perform (model drift, or XLA "
+                f"optimized the collective away and the cost ledger "
+                f"overcharges this program)",
+                severity="warning",
+                detail={"class": cls, "emitted_bytes": e,
+                        "modeled_bytes": firm[cls]}))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# replication / resharding audit
+
+_PARAM_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*"
+    r"(?P<type>[a-z]+[0-9]*[a-z0-9]*\[[0-9,]*\](?:\{[0-9,]*\})?)\s+"
+    r"parameter\(\d+\)")
+
+
+def _entry_text(text: str) -> str:
+    """The ENTRY computation's body (parameters of called computations
+    are partition-local scratch, not program inputs)."""
+    m = re.search(r"^ENTRY\b[^\n]*\{", text, re.M)
+    if not m:
+        return text
+    start = m.end()
+    depth = 1
+    for i in range(start, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start:i]
+    return text[start:]
+
+
+def replication_audit(text: str, params: Sequence, *,
+                      min_bytes: Optional[int] = None,
+                      label: str = "<program>") -> List[Finding]:
+    """Flag large tensors the strategy shards but the partitioned
+    module takes at FULL global shape — the silently-replicated
+    failure mode (HBM cost: world x the intended footprint).
+
+    ``params`` is ``[(name, global_shape, dtype_str, local_shape)]``
+    with ``local_shape`` the INTENDED per-device shape under the
+    strategy's sharding (== global_shape for intentionally replicated
+    params, which are never flagged).  The check is multiset-based over
+    the ENTRY parameters of ``compiled.as_text()`` (post-SPMD, so
+    parameter shapes are per-device): every intended local shape is
+    matched off first; an intended-SHARDED param whose local shape is
+    absent while its GLOBAL shape remains in the pool was lowered
+    replicated."""
+    if min_bytes is None:
+        min_bytes = int(_flag("census_min_bytes", 1 << 20))
+    from collections import Counter
+    import jax.numpy as jnp
+
+    def _key(shape, dtype):
+        return (tuple(int(d) for d in shape), str(np.dtype(dtype))
+                if not str(dtype).startswith("bf") else "bfloat16")
+
+    pool = Counter()
+    for line in _entry_text(text).splitlines():
+        m = _PARAM_RE.match(line)
+        if not m:
+            continue
+        sm = _SHAPE_RE.search(m.group("type"))
+        if not sm:
+            continue
+        dims = tuple(int(d) for d in sm.group("dims").split(",") if d)
+        pool[(dims, sm.group("dt"))] += 1
+
+    _JAX2HLO = {"float32": "f32", "bfloat16": "bf16", "float16": "f16",
+                "float64": "f64", "int32": "s32", "int64": "s64",
+                "int8": "s8", "uint8": "u8", "uint32": "u32",
+                "bool": "pred"}
+
+    def hlo_key(shape, dtype):
+        return (tuple(int(d) for d in shape),
+                _JAX2HLO.get(str(dtype), str(dtype)))
+
+    sharded = []
+    # pass 1: account for every intended local shape
+    for name, gshape, dtype, lshape in params:
+        k = hlo_key(lshape, dtype)
+        if pool.get(k, 0) > 0:
+            pool[k] -= 1
+        elif tuple(lshape) != tuple(gshape):
+            sharded.append((name, gshape, dtype, lshape))
+    findings: List[Finding] = []
+    for name, gshape, dtype, lshape in sharded:
+        nbytes = int(np.prod(gshape)) * jnp.dtype(dtype).itemsize
+        if nbytes < min_bytes:
+            continue
+        gk = hlo_key(gshape, dtype)
+        if pool.get(gk, 0) > 0:
+            pool[gk] -= 1
+            findings.append(Finding(
+                "replicated-large-tensor",
+                f"{label}: param {name!r} {tuple(gshape)} {dtype} "
+                f"({nbytes / 2**20:.2f}MB) should lower to per-device "
+                f"shape {tuple(lshape)} but the partitioned module "
+                f"takes it at FULL global shape — lowered fully "
+                f"replicated, paying world x the intended HBM "
+                f"footprint",
+                severity="error",
+                detail=(name, tuple(gshape), tuple(lshape), nbytes)))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# strategy models: the modeled event lists census_diff budgets against
+
+def _param_grad_bytes(step):
+    import jax.numpy as jnp
+    sd = step.model.state_dict()
+    total = 0
+    for n in step._names:
+        v = sd[n].value
+        total += int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize
+    return total
+
+
+def modeled_trainer_events(step) -> list:
+    """The strategy-algebra collective model of one ShardedTrainStep
+    program — what the census budgets against.
+
+      data axes live  -> grad reduction: the overlap plan's own bucket
+                         events when live (bytes included), else one
+                         psum (stage<=1) / reduce_scatter (stage>=2)
+                         of the full grad bytes
+      stage>=1, sharding>1 -> all_gather of param bytes: the ZeRO
+                         update computes on state shards and
+                         reassembles the replicated (stage 1/2) params
+      stage 3          -> params live sharded; all_gather x2 (forward
+                         use + backward rematerialization)
+      stage>=2         -> a permute allowance of the grad bytes: the
+                         backend decomposes reduce-scatter into
+                         all-to-all / collective-permute mixes
+      mp live          -> megatron activation all-reduces are NOT
+                         modeled here (no config knowledge); hybrid
+                         callers extend with modeled_axis_profiles
+
+    plus the scalar loss all-reduce.  All events carry bytes at the
+    full-logical-tensor scale `HloCollective.global_bytes` uses."""
+    from .collectives import CollectiveEvent
+    mesh = step.mesh
+    data_axes = tuple(a for a in step.batch_axes
+                      if a in mesh.axis_names and mesh.shape[a] > 1)
+    shard_n = mesh.shape.get("sharding", 1)
+    stage = step.stage
+    pbytes = _param_grad_bytes(step)
+    events = []
+    if not data_axes:
+        return events
+    events.append(CollectiveEvent("psum", ("loss",), data_axes, bytes=4))
+    plan = getattr(step, "_overlap_plan", None)
+    if plan is not None:
+        events.extend(plan.events())
+    else:
+        kind = "reduce_scatter" if (stage >= 2 and shard_n > 1) \
+            else "psum"
+        events.append(CollectiveEvent(
+            kind, ("grads",), data_axes, bytes=pbytes))
+    if shard_n > 1 and "sharding" in data_axes:
+        # allowances: ceilings XLA may optimize below (never "missing")
+        if stage >= 1:
+            events.append(CollectiveEvent(
+                "all_gather", ("allowance", "params", "update"),
+                ("sharding",), bytes=pbytes))
+        if stage >= 3:
+            events.append(CollectiveEvent(
+                "all_gather", ("allowance", "params", "bwd-remat"),
+                ("sharding",), bytes=pbytes))
+        if stage >= 2:
+            events.append(CollectiveEvent(
+                "ppermute", ("allowance", "rs-decomposition"),
+                ("sharding",), bytes=pbytes))
+    return events
+
+
+def modeled_hybrid_events(engine, batch_shape, seq_len=None) -> list:
+    """Collective model of an SPMD (pp==1) HybridParallelEngine step:
+    the inner trainer's model (grad reduce / ZeRO gathers / loss psum)
+    plus the per-axis strategy algebra's mp and sep activation legs as
+    ALLOWANCES (comm_profiles models transformer blocks; other models
+    fall back to a matmul-width ceiling from the mp-sharded params)."""
+    from .collectives import CollectiveEvent
+    events = list(modeled_trainer_events(engine.step))
+    profiles = []
+    try:
+        profiles = engine.comm_profiles(tuple(batch_shape), seq_len)
+    except Exception:  # noqa: BLE001 — the model leg must not block
+        pass
+    mp_modeled = 0
+    for prof in profiles:
+        axes = tuple(prof.get("axes", ()))
+        nbytes = int(prof.get("bytes", 0) or 0)
+        if "mp" in axes and nbytes:
+            events.append(CollectiveEvent(
+                "psum", ("allowance", "mp-activations"), axes,
+                bytes=nbytes))
+            mp_modeled += nbytes
+        elif "sep" in axes and nbytes:
+            events.append(CollectiveEvent(
+                "ppermute", ("allowance", "sep-ring"), axes,
+                bytes=nbytes))
+    if engine.degrees.get("mp", 1) > 1 and not mp_modeled:
+        # configless fallback: every mp-sharded matmul may psum/gather
+        # one [rows, width] activation fwd + bwd (x2 each, ceiling)
+        import jax.numpy as jnp
+        rows = 1
+        for dim in tuple(batch_shape)[:1] + (
+                (int(seq_len),) if seq_len else tuple(batch_shape)[1:2]):
+            rows *= max(1, int(dim))
+        width = 0
+        shardings = getattr(engine.step, "_param_shardings", {})
+        sd = engine.step.model.state_dict()
+        for name in engine.step._names:
+            spec = getattr(shardings.get(name), "spec", None)
+            if spec is None or not any(
+                    "mp" in ((e,) if not isinstance(e, tuple) else e)
+                    for e in tuple(spec) if e is not None):
+                continue
+            v = sd[name].value
+            width += int(v.shape[-1]) * jnp.dtype(v.dtype).itemsize
+        if width:
+            for kind in ("psum", "all_gather"):
+                events.append(CollectiveEvent(
+                    kind, ("allowance", "mp-matmul-" + kind), ("mp",),
+                    bytes=4 * rows * width))
+    live = [a for a, n in engine.mesh.shape.items()
+            if int(n) > 1 and a != "pp"]
+    if len(live) > 1:
+        # on composed meshes XLA freely restructures the grad reduce
+        # into gather/scatter mixes across the joint tiling — keep its
+        # budget as a ceiling, not a firm (missing-checked) prediction
+        events = [ev._replace(key=("allowance",) + tuple(ev.key))
+                  if ev.key and ev.key[0] in ("grads",) else ev
+                  for ev in events]
+        # composed points reshard activations and the ZeRO update's
+        # grad/opt-state bundles across the joint batch axes (GSPMD
+        # picks different tilings fwd vs update) — a ceiling of the
+        # param+state bytes plus a fwd+bwd activation pass
+        import jax.numpy as jnp
+        step = engine.step
+        sd = step.model.state_dict()
+        pbytes = _param_grad_bytes(step)
+        rows = int(batch_shape[0]) if batch_shape else 1
+        if seq_len:
+            rows *= int(seq_len)
+        elif len(batch_shape) > 2:
+            rows *= int(batch_shape[1])
+        width = sum(int(sd[n].value.shape[-1])
+                    * jnp.dtype(sd[n].value.dtype).itemsize
+                    for n in step._names)
+        act = 2 * rows * width
+        dom = tuple(live)
+        events.append(CollectiveEvent(
+            "all_gather", ("allowance", "composed-reshard"), dom,
+            bytes=2 * pbytes + act))
+        events.append(CollectiveEvent(
+            "ppermute", ("allowance", "composed-reshard"), dom,
+            bytes=pbytes + act))
+        events.append(CollectiveEvent(
+            "psum", ("allowance", "composed-reshard"), dom, bytes=act))
+    return events
+
+
+def modeled_chunk_events(chunk, submesh, *, backward: bool) -> list:
+    """Collective model of one PipelineEngine chunk program on its
+    stage submesh: the backward's grad psum over the live data axes
+    (forward programs emit none — activations stay batch-sharded; the
+    cross-stage hop is a host-driven device_put, not a collective).
+    mp activation all-reduces inside a chunk are left to the slack —
+    chunk programs are per-stage slices without config knowledge."""
+    from .collectives import CollectiveEvent
+    import jax.numpy as jnp
+    if submesh is None:
+        return []
+    data_axes = tuple(a for a in ("dp", "sharding")
+                      if a in submesh.axis_names
+                      and submesh.shape[a] > 1)
+    if not data_axes or not backward:
+        return []
+    pbytes = 0
+    for p in chunk.params:
+        v = p.value
+        pbytes += int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize
+    evs = [CollectiveEvent("psum", ("chunk-grads", chunk.idx),
+                           data_axes, bytes=pbytes)]
+    if chunk.is_last:
+        evs.append(CollectiveEvent("psum", ("chunk-loss", chunk.idx),
+                                   data_axes, bytes=4))
+    return evs

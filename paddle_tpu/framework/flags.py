@@ -307,3 +307,30 @@ define_flag("serve_retry_budget", 3,
             "faulted-slot requeues): past the budget the request is "
             "shed instead of retried — a poisoned request cannot spin "
             "the batch forever")
+
+# --- r22: program sentinel (analysis.passes) --------------------------------
+define_flag("static_sentinel", True,
+            "master switch for the static pass manager "
+            "(analysis.passes).  On (default), engines run the "
+            "build-level pass catalog when they build programs and "
+            "raise on severity=error findings; full-level passes "
+            "(donation, HLO collective census, replication audit — "
+            "anything needing an extra lower/compile) stay behind "
+            "explicit engine.preflight(...) / tools/static_check.py.  "
+            "Per-pass override: sentinel_pass_<name>")
+define_flag("census_min_bytes", 1 << 20,
+            "collective-census noise floor in bytes: per-class "
+            "emitted-vs-modeled traffic deltas below this never "
+            "produce findings, and the replication audit ignores "
+            "smaller tensors.  Tests drop it to exercise tiny models")
+define_flag("census_slack", 4.0,
+            "collective-census tolerance factor: emitted per-class "
+            "traffic up to slack x the modeled budget is accepted "
+            "(XLA decomposes reduce-scatter into all-to-all/permute/"
+            "gather mixes and ZeRO-3 legitimately double-gathers "
+            "params); beyond it is census-unmodeled-collective")
+define_flag("sentinel_baseline", "",
+            "path to the baseline-suppression JSON for the pass "
+            "manager (empty = tools/static_baseline.json).  Triples "
+            "listed there are tracked as suppressed, not reported — "
+            "pre-existing findings don't block")

@@ -441,6 +441,27 @@ class ContinuousBatcher:
             return provider
         _ml.register("serve_step.decode", _provider(False), meta=_meta)
         _ml.register("serve_step.admit", _provider(True), meta=_meta)
+        # build-level static sentinel (analysis.passes): structural
+        # passes over the serve build path.  The full catalog (donation
+        # aliasing over the paged carries — costs a lower per program)
+        # runs via .preflight() / tools/static_check.py.
+        from ..analysis.passes import PassContext, sentinel_preflight
+        sentinel_preflight(
+            PassContext("serve", f"serve:B{self.B}", engine=self),
+            level="build")
+
+    def preflight(self, *, level: str = "full", manager=None):
+        """Full static sentinel over the serve step programs: the
+        donation lint proves every donated paged carry (KV pool,
+        caches, cursors) is really aliased in both the decode and
+        mixed admission programs — an unaliased carry silently doubles
+        the KV pool's HBM.  Uses the side-effect-free lower_step probe;
+        returns a SentinelReport (None when FLAGS_static_sentinel is
+        off).  Error findings raise SentinelError."""
+        from ..analysis.passes import PassContext, sentinel_preflight
+        return sentinel_preflight(
+            PassContext("serve", f"serve:B{self.B}", engine=self),
+            level=level, manager=manager)
 
     # -- pool geometry -----------------------------------------------------
     @staticmethod

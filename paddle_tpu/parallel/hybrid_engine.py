@@ -357,6 +357,40 @@ class HybridParallelEngine:
                 comm_overlap=self._comm_overlap_pp)
         return self
 
+    def preflight(self, *data, level: str = "full", manager=None,
+                  census_min_bytes=None, census_slack=None,
+                  seq_len=None):
+        """Full static sentinel (analysis.passes) over the composed
+        program.  pp==1: the inner SPMD step's pass catalog with the
+        HYBRID collective model (trainer grad/ZeRO legs plus the
+        per-axis strategy algebra's mp/sep activation allowances) —
+        the census proves the emitted HLO stays within it.  pp>1:
+        delegates to PipelineEngine.preflight over every chunk
+        program.  Costs one extra compile per program; returns a
+        SentinelReport (pp: list of per-chunk reports), or None when
+        FLAGS_static_sentinel is off.  Error findings raise."""
+        if self._engine is not None:
+            return self._engine.preflight(
+                tuple(data), level=level, manager=manager,
+                label=f"hybrid:{self.describe()}",
+                census_min_bytes=census_min_bytes,
+                census_slack=census_slack)
+        from ..analysis.passes import PassContext, sentinel_preflight
+        from ..analysis.sharding_census import modeled_hybrid_events
+        shape = tuple(np.shape(
+            data[0].value if hasattr(data[0], "value") else data[0]))
+        extra = {}
+        if census_min_bytes is not None:
+            extra["census_min_bytes"] = census_min_bytes
+        if census_slack is not None:
+            extra["census_slack"] = census_slack
+        ctx = PassContext(
+            "trainer", f"hybrid:{self.describe()}:s{self.sharding_stage}",
+            engine=self.step, args=data, mesh=self.mesh, extra=extra,
+            modeled_events=lambda: modeled_hybrid_events(
+                self, shape, seq_len))
+        return sentinel_preflight(ctx, level=level, manager=manager)
+
     def lint(self, *data, **kw):
         """analysis lints over the composed program: donation aliasing
         + (overlap on) the grad wire-dtype proof.  pp delegates the jit

@@ -297,6 +297,13 @@ class PipelineEngine:
             st = self.chunks[ms]
             st.params[mi]._value = st._placed(st.params[mi]._value)
         self._sync_shared_values()
+        # build-level sentinel: prove the default schedule's channel
+        # order consistent and the dispatcher drains, before any batch
+        from ..analysis.passes import PassContext, sentinel_preflight
+        sentinel_preflight(
+            PassContext("pipeline", f"pipeline:pp{self.pp}v{self.vpp}",
+                        engine=self, mesh=mesh),
+            level="build")
 
     # old name kept for introspection/tests
     @property
@@ -440,6 +447,62 @@ class PipelineEngine:
             return self.layer.loss_fn(out, Tensor(
                 last.place_activation(yv)))
         return out
+
+    def preflight(self, data, *, level: str = "full", manager=None,
+                  label: str = None, census_min_bytes=None,
+                  census_slack=None):
+        """Static sentinel (analysis.passes) over every CHUNK program:
+        walks one micro-batch's activations through the stage chain and
+        runs the full pass catalog — donation aliasing plus the HLO
+        collective census against the modeled chunk events (backward
+        grad psum over the submesh data axes) — on each chunk's forward
+        and backward programs.  Costs one extra compile per program;
+        returns the list of per-program SentinelReports (empty when
+        FLAGS_static_sentinel is off).  Severity=error findings raise."""
+        from ..analysis.passes import PassContext, sentinel_preflight
+        from ..analysis.sharding_census import modeled_chunk_events
+        x, y = data
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        self._sync_shared_values()
+        for st in self.chunks:
+            st.begin_batch()
+        label = label or f"pipeline:pp{self.pp}v{self.vpp}"
+        extra = {}
+        if census_min_bytes is not None:
+            extra["census_min_bytes"] = census_min_bytes
+        if census_slack is not None:
+            extra["census_slack"] = census_slack
+        reports = []
+
+        def run(name, fn, args, st, backward):
+            ctx = PassContext(
+                "fn", f"{label}:chunk{st.idx}:{name}", fn=fn, args=args,
+                mesh=st.submesh, extra=extra,
+                modeled_events=lambda: modeled_chunk_events(
+                    st, st.submesh, backward=backward))
+            rep = sentinel_preflight(ctx, level=level, manager=manager)
+            if rep is not None:
+                reports.append(rep)
+
+        a = self.chunks[0].place_activation(xv)
+        for st in self.chunks:
+            a = jax.tree_util.tree_map(st.place_activation, a)
+            fargs = (st.param_vals, st.buf_vals, a)
+            run("fwd", st._fwd, fargs, st, backward=False)
+            out = st._fwd(*fargs)
+            if st.is_last and st.loss_fn is not None:
+                lb = st.place_activation(yv)
+                run("bwd", st._loss_bwd,
+                    (st.param_vals, st.buf_vals, a, lb), st,
+                    backward=True)
+            else:
+                dy = jax.tree_util.tree_map(jnp.ones_like, out)
+                run("bwd", st._bwd,
+                    (st.param_vals, st.buf_vals, a, dy), st,
+                    backward=True)
+            a = out
+        return reports
 
     # -- schedules ---------------------------------------------------------
     def _orders(self, m, schedule):

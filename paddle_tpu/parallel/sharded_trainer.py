@@ -677,6 +677,42 @@ class ShardedTrainStep:
             self._compiled = jax.jit(
                 step, donate_argnums=donate,
                 out_shardings=self._out_shardings)
+        # build-level sentinel (analysis.passes): structural passes over
+        # the just-built artifacts — overlap-plan coherence, modeled
+        # collective order.  Full-level (census/donation — an extra
+        # compile) stays behind explicit .preflight().
+        from ..analysis.passes import PassContext, sentinel_preflight
+        sentinel_preflight(
+            PassContext("trainer", self._sentinel_label(), engine=self,
+                        mesh=self.mesh),
+            level="build")
+
+    def _sentinel_label(self) -> str:
+        axes = "x".join(f"{a}{n}" for a, n in self.mesh.shape.items()
+                        if n > 1) or "single"
+        return f"trainer:stage{self.stage}:{axes}"
+
+    def preflight(self, *batch, level: str = "full", manager=None,
+                  census_min_bytes=None, census_slack=None):
+        """Run the FULL static-sentinel catalog over this step's
+        program (analysis.passes): the build-level structural passes
+        plus donation aliasing, the HLO collective census diffed
+        against the modeled CollectiveEvent schedule, and the
+        replication audit.  Costs one extra lower+compile of the step
+        — call it once per program shape (CI, tools/static_check.py,
+        or before a long run), not per step.
+
+        Returns a SentinelReport (None when FLAGS_static_sentinel is
+        off); severity=error findings raise SentinelError."""
+        from ..analysis.passes import PassContext, sentinel_preflight
+        extra = {}
+        if census_min_bytes is not None:
+            extra["census_min_bytes"] = census_min_bytes
+        if census_slack is not None:
+            extra["census_slack"] = census_slack
+        ctx = PassContext("trainer", self._sentinel_label(), engine=self,
+                          args=batch, mesh=self.mesh, extra=extra)
+        return sentinel_preflight(ctx, level=level, manager=manager)
 
     def compiled_hlo(self, *batch, optimized: bool = True) -> str:
         """Compile the step for `batch` (without executing) and return the

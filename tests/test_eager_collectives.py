@@ -197,3 +197,56 @@ class TestMpOpsEager:
         x = paddle.to_tensor(np.ones((2, 8), np.float32))
         out = split(x, (8, 6), "linear", axis=1, gather_out=True)
         assert tuple(out.shape) == (2, 6)
+
+
+class TestComposedOrderEdgeCases:
+    """check_collective_order(composed=True) satellite: degenerate
+    domains (a size-1 axis traces as a (None,)/empty domain) and
+    single-rank domains are no-ops — not KeyErrors, not divergences."""
+
+    @staticmethod
+    def _ev(kind, key, domain):
+        from paddle_tpu.analysis.collectives import CollectiveEvent
+        return CollectiveEvent(kind, key, domain)
+
+    def test_size1_axis_domain_is_noop(self):
+        from paddle_tpu.analysis.collectives import check_collective_order
+        ev = self._ev("psum", ("g",), (None,))
+        # rank 1 never traced the degenerate collective: still clean
+        assert check_collective_order({0: [ev], 1: []},
+                                      composed=True) == []
+
+    def test_empty_and_all_none_domains_are_noops(self):
+        from paddle_tpu.analysis.collectives import check_collective_order
+        evs = [self._ev("psum", ("a",), ()),
+               self._ev("ppermute", ("b",), (None, None))]
+        assert check_collective_order({0: evs, 1: [], 2: []},
+                                      composed=True) == []
+
+    def test_single_rank_domain_is_noop(self):
+        from paddle_tpu.analysis.collectives import check_collective_order
+        ev = self._ev("psum", ("g",), ("dp",))
+        # only one rank participates in the 'dp' domain: nothing to
+        # cross-check (and no KeyError from the participants lookup)
+        assert check_collective_order(
+            {0: [ev]}, participants={("dp",): [0]}, composed=True) == []
+
+    def test_dict_participants_missing_degenerate_domain(self):
+        from paddle_tpu.analysis.collectives import check_collective_order
+        good = self._ev("psum", ("g",), ("dp",))
+        degen = self._ev("psum", ("skip",), (None,))
+        # participants dict only knows the real domain: the degenerate
+        # one must fall back instead of raising KeyError
+        out = check_collective_order(
+            {0: [degen, good], 1: [good]},
+            participants={("dp",): [0, 1]}, composed=True)
+        assert out == []
+
+    def test_real_divergence_still_caught_composed(self):
+        from paddle_tpu.analysis.collectives import check_collective_order
+        a = self._ev("psum", ("a",), ("dp",))
+        b = self._ev("psum", ("b",), ("dp",))
+        out = check_collective_order({0: [a, b], 1: [b, a]},
+                                     composed=True)
+        assert out, "misordered composed schedules must be flagged"
+        assert any("divergence" in f.code for f in out)

@@ -63,6 +63,38 @@ class TestDtypeLint:
             ignore_prims=("convert_element_type", "reduce_sum")) == []
 
 
+class TestIterEqnsDedupe:
+    def test_shared_subjaxpr_walked_once(self):
+        # two pjit call sites of one jitted fn reference the SAME
+        # ClosedJaxpr: the walk must yield its body once (r22 dedupe)
+        from paddle_tpu.analysis.lints import iter_eqns
+        inner = jax.jit(lambda x: jnp.sin(x) * 2.0)
+
+        def outer(x):
+            return inner(x) + inner(x)
+
+        jaxpr = jax.make_jaxpr(outer)(jnp.float32(1.0))
+        eqns = list(iter_eqns(jaxpr))
+        assert len([e for e in eqns
+                    if e.primitive.name == "pjit"]) == 2
+        assert len([e for e in eqns
+                    if e.primitive.name == "sin"]) == 1
+
+    def test_lint_reports_shared_body_findings_once(self):
+        inner = jax.jit(lambda x: x * np.float32(2.0))  # bf16 upcast
+        x = jnp.ones((4,), jnp.bfloat16)
+        once = lint_dtype_promotion(lambda v: inner(v), x)
+        twice = lint_dtype_promotion(lambda v: inner(v) + inner(v), x)
+        assert "fp32-upcast" in _codes(once)
+        # each pjit CALL SITE is still its own finding, but the shared
+        # body's convert_element_type must not double
+        def body_hits(findings):
+            return [g for g in findings
+                    if "convert_element_type" in g.message]
+        assert len(body_hits(once)) == 1
+        assert len(body_hits(twice)) == 1
+
+
 class TestTransferLint:
     def test_in_step_device_put_flagged(self):
         def step(x):

@@ -1,0 +1,644 @@
+"""Program Sentinel tests (r22): the pass manager, the HLO collective
+census parser, census_diff / replication_audit, and the engine
+preflights — including the planted-defect acceptance test (a dropped
+sharding constraint MUST be caught by the census, naming the op, the
+axis, and the byte count).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.flags import set_flags, get_flag
+from paddle_tpu.analysis.base import Finding
+from paddle_tpu.analysis.passes import (
+    Pass, PassContext, PassManager, SentinelError, register_pass,
+    registered_passes, sentinel_preflight)
+from paddle_tpu.analysis.sharding_census import (
+    HloCollective, parse_hlo_collectives, census_diff,
+    replication_audit, modeled_budgets)
+from paddle_tpu.analysis.collectives import CollectiveEvent
+from paddle_tpu.distributed.topology import (
+    build_mesh, set_hybrid_communicate_group)
+
+
+def _need8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+
+
+def _codes(findings):
+    return {f.code for f in findings}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hcg():
+    set_hybrid_communicate_group(None)
+    yield
+    set_hybrid_communicate_group(None)
+
+
+# ---------------------------------------------------------------------------
+# pass-manager mechanics (no compiles)
+
+def _probe_pass(name, findings, **kw):
+    return Pass(name, lambda ctx: list(findings), **kw)
+
+
+def _fn_ctx(label="probe:prog", **kw):
+    return PassContext("fn", label, fn=lambda x: x + 1,
+                       args=(jnp.ones(()),), **kw)
+
+
+class TestPassManager:
+    def test_severity_ordering_and_pass_name_stamp(self):
+        pm = PassManager(passes=[
+            _probe_pass("warns", [Finding("w", "warn", severity="warning")]),
+            _probe_pass("errs", [Finding("e", "err", severity="error")]),
+        ], use_baseline=False)
+        rep = pm.run(_fn_ctx(), level="build")
+        assert [f.severity for f in rep.findings] == ["error", "warning"]
+        assert rep.findings[0].pass_name == "errs"
+        assert rep.findings[1].pass_name == "warns"
+        assert rep.passes_run == ["warns", "errs"]
+
+    def test_enable_disable_switches(self):
+        p = _probe_pass("probe", [Finding("x", "m")])
+        off = _probe_pass("off-by-default", [Finding("y", "m")],
+                          default=False)
+        rep = PassManager(passes=[p, off], disable=("probe",),
+                          use_baseline=False).run(_fn_ctx())
+        assert rep.passes_run == []          # default-off stays off
+        rep = PassManager(passes=[p, off], enable=("off-by-default",),
+                          use_baseline=False).run(_fn_ctx())
+        assert set(rep.passes_run) == {"probe", "off-by-default"}
+
+    def test_per_pass_flag_switch(self):
+        p = _probe_pass("flagged-probe", [Finding("x", "m")])
+        try:
+            set_flags({"FLAGS_sentinel_pass_flagged_probe": False})
+            rep = PassManager(passes=[p],
+                              use_baseline=False).run(_fn_ctx())
+            assert rep.passes_run == []
+        finally:
+            set_flags({"FLAGS_sentinel_pass_flagged_probe": None})
+        rep = PassManager(passes=[p], use_baseline=False).run(_fn_ctx())
+        assert rep.passes_run == ["flagged-probe"]
+
+    def test_level_filtering(self):
+        b = _probe_pass("b", [Finding("b", "m")], level="build")
+        f = _probe_pass("f", [Finding("f", "m")], level="full")
+        pm = PassManager(passes=[b, f], use_baseline=False)
+        assert pm.run(_fn_ctx(), level="build").passes_run == ["b"]
+        assert pm.run(_fn_ctx(), level="full").passes_run == ["b", "f"]
+
+    def test_applies_predicate(self):
+        p = _probe_pass("trainer-only", [Finding("x", "m")],
+                        applies=lambda ctx: ctx.kind == "trainer")
+        rep = PassManager(passes=[p], use_baseline=False).run(_fn_ctx())
+        assert rep.passes_run == []
+
+    def test_baseline_suppression_exact_and_wildcard(self):
+        p = _probe_pass("probe", [Finding("boom", "m")])
+        for base in ({("probe:prog", "probe", "boom")},
+                     {("*", "probe", "*")},
+                     {("probe:prog", "*", "boom")}):
+            rep = PassManager(passes=[p], baseline=base).run(_fn_ctx())
+            assert rep.findings == []
+            assert [f.code for f in rep.suppressed] == ["boom"]
+        # a non-matching triple does not suppress
+        rep = PassManager(passes=[p], baseline={
+            ("other:prog", "probe", "boom")}).run(_fn_ctx())
+        assert [f.code for f in rep.findings] == ["boom"]
+
+    def test_pass_crash_becomes_error_finding(self):
+        def explode(ctx):
+            raise RuntimeError("kaput")
+        pm = PassManager(passes=[Pass("bad", explode)],
+                         use_baseline=False)
+        rep = pm.run(_fn_ctx())
+        assert [f.code for f in rep.findings] == ["pass-crashed"]
+        assert rep.findings[0].severity == "error"
+        assert "kaput" in rep.findings[0].message
+        with pytest.raises(RuntimeError, match="kaput"):
+            pm.run(_fn_ctx(), collect_errors=False)
+
+    def test_raise_on_error(self):
+        pm = PassManager(passes=[
+            _probe_pass("errs", [Finding("e", "bad", severity="error")]),
+        ], use_baseline=False)
+        rep = pm.run(_fn_ctx())
+        with pytest.raises(SentinelError) as ei:
+            rep.raise_on_error()
+        assert ei.value.findings[0].code == "e"
+        # warnings alone never raise
+        pm = PassManager(passes=[
+            _probe_pass("warns", [Finding("w", "m", severity="warning")]),
+        ], use_baseline=False)
+        pm.run(_fn_ctx()).raise_on_error()
+
+    def test_register_pass_decorator_and_replacement(self):
+        try:
+            @register_pass("zz-test-probe", level="build", doc="probe")
+            def _probe(ctx):
+                return [Finding("zz", "m")]
+            assert "zz-test-probe" in registered_passes()
+
+            @register_pass("zz-test-probe", level="full")
+            def _probe2(ctx):
+                return []
+            assert registered_passes()["zz-test-probe"].level == "full"
+        finally:
+            from paddle_tpu.analysis import passes as passes_mod
+            passes_mod._REGISTRY.pop("zz-test-probe", None)
+
+    def test_sentinel_preflight_flag_gate(self):
+        calls = []
+
+        def record(ctx):
+            calls.append(ctx.label)
+            return []
+        pm = PassManager(passes=[Pass("rec", record)],
+                         use_baseline=False)
+        try:
+            set_flags({"FLAGS_static_sentinel": False})
+            assert sentinel_preflight(_fn_ctx(), manager=pm) is None
+            assert calls == []
+        finally:
+            set_flags({"FLAGS_static_sentinel": True})
+        rep = sentinel_preflight(_fn_ctx(), manager=pm)
+        assert calls and rep is not None
+
+    def test_report_to_dict_shape(self):
+        pm = PassManager(passes=[
+            _probe_pass("p", [Finding("c", "m", severity="warning")]),
+        ], use_baseline=False)
+        d = pm.run(_fn_ctx()).to_dict()
+        assert d["program"] == "probe:prog"
+        assert d["findings"][0]["code"] == "c"
+        assert d["findings"][0]["pass"] == "p"
+        assert d["suppressed"] == []
+
+    def test_catalog_registered(self):
+        cat = registered_passes()
+        for name in ("collective-order", "overlap-plan", "donation",
+                     "grad-comm-dtype", "collective-census",
+                     "replication-audit"):
+            assert name in cat, name
+        assert cat["collective-census"].level == "full"
+        assert cat["collective-order"].level == "build"
+        assert cat["dtype-promotion"].default is False
+
+
+# ---------------------------------------------------------------------------
+# HLO census parser (pure text)
+
+_AR = ('  %all-reduce.1 = f32[128,64]{1,0} all-reduce(%p0), '
+       'replica_groups={{0,1,2,3},{4,5,6,7}}, '
+       'use_global_device_ids=true, to_apply=%add, '
+       'metadata={op_name="jit(step)/psum" source_file="x.py"}')
+_AG_IOTA = ('  %all-gather.2 = f32[64,64]{1,0} all-gather(%p1), '
+            'channel_id=1, replica_groups=[2,4]<=[4,2]T(1,0), '
+            'dimensions={0}, use_global_device_ids=true')
+_RS_TUPLE = ('  %reduce-scatter.3 = (f32[16]{0}, f32[16]{0}) '
+             'reduce-scatter(%a, %b), replica_groups={{0,1,2,3}}, '
+             'dimensions={0}, to_apply=%add')
+_CP = ('  %collective-permute.4 = f32[32]{0} collective-permute(%x), '
+       'source_target_pairs={{0,1},{1,2},{2,3},{3,0}}')
+_AR_START = ('  %all-reduce-start.5 = (f32[64]{0}, f32[64]{0}) '
+             'all-reduce-start(%p2), replica_groups={{0,1}}, '
+             'to_apply=%add')
+_AR_DONE = ('  %all-reduce-done.5 = f32[64]{0} '
+            'all-reduce-done(%all-reduce-start.5)')
+
+
+class TestHloParser:
+    def test_all_reduce_explicit_groups(self):
+        (c,) = parse_hlo_collectives(_AR)
+        assert c.op == "all-reduce" and c.cls == "reduce"
+        assert c.name == "all-reduce.1"
+        assert c.result_bytes == 128 * 64 * 4
+        assert (c.num_groups, c.group_size) == (2, 4)
+        # all-reduce result carries the full tensor; x num_groups
+        assert c.global_bytes == 128 * 64 * 4 * 2
+        assert c.op_name == "jit(step)/psum"
+
+    def test_all_gather_iota_groups_with_transpose(self):
+        (c,) = parse_hlo_collectives(_AG_IOTA)
+        # [2,4]<=[4,2]T(1,0): iota(8).reshape(4,2).T -> groups
+        # {0,2,4,6},{1,3,5,7}
+        assert (c.num_groups, c.group_size) == (2, 4)
+        assert c.global_bytes == 64 * 64 * 4 * 2
+
+    def test_reduce_scatter_tuple_type(self):
+        (c,) = parse_hlo_collectives(_RS_TUPLE)
+        assert c.cls == "reduce"
+        assert c.result_bytes == 2 * 16 * 4          # tuple summed
+        # result is the per-participant shard: x group_size x groups
+        assert c.global_bytes == 2 * 16 * 4 * 4 * 1
+
+    def test_collective_permute_pairs(self):
+        (c,) = parse_hlo_collectives(_CP)
+        assert c.cls == "permute"
+        assert c.num_groups == 4                      # 4 pairs
+        assert c.global_bytes == 32 * 4 * 4
+
+    def test_async_start_done_counted_once_and_halved(self):
+        out = parse_hlo_collectives(_AR_START + "\n" + _AR_DONE)
+        assert len(out) == 1
+        (c,) = out
+        # -start's tuple result doubles the operand buffer; halved back
+        assert c.result_bytes == 64 * 4
+        assert c.global_bytes == 64 * 4
+
+    def test_non_collective_text_ignored(self):
+        text = ("  %add.1 = f32[4]{0} add(%a, %b)\n"
+                "  %fusion = f32[4]{0} fusion(%c), kind=kLoop\n"
+                "  ROOT %tuple = () tuple()\n")
+        assert parse_hlo_collectives(text) == []
+
+    def test_axes_inference_on_mesh(self):
+        _need8()
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        mesh = Mesh(devs, ("dp", "mp"))
+        ids = {(r, c): int(devs[r, c].id) for r in range(2)
+               for c in range(4)}
+        # groups fixing dp, varying mp
+        mp_groups = "{{%s},{%s}}" % (
+            ",".join(str(ids[(0, c)]) for c in range(4)),
+            ",".join(str(ids[(1, c)]) for c in range(4)))
+        line = ("  %all-reduce.9 = f32[8]{0} all-reduce(%p), "
+                "replica_groups=" + mp_groups + ", to_apply=%add")
+        (c,) = parse_hlo_collectives(line, mesh)
+        assert c.axes == ("mp",)
+        # groups fixing mp, varying dp
+        dp_groups = "{" + ",".join(
+            "{%d,%d}" % (ids[(0, c)], ids[(1, c)]) for c in range(4)) + "}"
+        line = ("  %all-reduce.10 = f32[8]{0} all-reduce(%p), "
+                "replica_groups=" + dp_groups + ", to_apply=%add")
+        (c,) = parse_hlo_collectives(line, mesh)
+        assert c.axes == ("dp",)
+
+
+# ---------------------------------------------------------------------------
+# census_diff / modeled_budgets (pure)
+
+def _hc(op, gbytes, axes=(), name="x", op_name=""):
+    from paddle_tpu.analysis.sharding_census import COLLECTIVE_CLASS
+    return HloCollective(op=op, name=name, cls=COLLECTIVE_CLASS[op],
+                         result_bytes=gbytes, global_bytes=gbytes,
+                         num_groups=1, group_size=2, axes=tuple(axes),
+                         op_name=op_name)
+
+
+class TestCensusDiff:
+    def test_within_budget_is_clean(self):
+        emitted = [_hc("all-reduce", 1 << 20)]
+        modeled = [CollectiveEvent("psum", ("grads",), ("dp",),
+                                   bytes=1 << 20)]
+        assert census_diff(emitted, modeled, min_bytes=1024,
+                           slack=2.0) == []
+
+    def test_excess_traffic_is_error_naming_ops(self):
+        emitted = [_hc("all-gather", 8 << 20, axes=("mp",),
+                       name="all-gather.7",
+                       op_name="jit(step)/dot_general")]
+        f = census_diff(emitted, [], min_bytes=1024, slack=2.0,
+                        label="prog")
+        assert _codes(f) == {"census-unmodeled-collective"}
+        (g,) = f
+        assert g.severity == "error"
+        assert "all-gather.7" in g.message      # instruction named
+        assert "mp" in g.message                # axis named
+        assert "8.000MB" in g.message           # byte count named
+        assert "dot_general" in g.message       # source op named
+        assert g.detail["class"] == "gather"
+        assert g.detail["emitted_bytes"] == 8 << 20
+
+    def test_missing_firm_budget_is_warning(self):
+        modeled = [CollectiveEvent("psum", ("grads",), ("dp",),
+                                   bytes=64 << 20)]
+        f = census_diff([], modeled, min_bytes=1024, slack=2.0)
+        assert _codes(f) == {"census-missing-collective"}
+        assert f[0].severity == "warning"
+
+    def test_allowance_never_missing_but_raises_ceiling(self):
+        allowance = [CollectiveEvent(
+            "all_gather", ("allowance", "params"), ("sharding",),
+            bytes=16 << 20)]
+        # nothing emitted against an allowance: no warning
+        assert census_diff([], allowance, min_bytes=1024, slack=2.0) == []
+        # emitted traffic up to the allowance: no error
+        emitted = [_hc("all-gather", 16 << 20)]
+        assert census_diff(emitted, allowance, min_bytes=1024,
+                           slack=2.0) == []
+
+    def test_min_bytes_floor_absorbs_noise(self):
+        emitted = [_hc("all-reduce", 100)]
+        assert census_diff(emitted, [], min_bytes=1024, slack=2.0) == []
+
+    def test_modeled_budgets_firm_only_drops_allowances(self):
+        events = [
+            CollectiveEvent("psum", ("grads",), ("dp",), bytes=100),
+            CollectiveEvent("all_gather", ("allowance", "p"),
+                            ("sharding",), bytes=50),
+            CollectiveEvent("ppermute", ("ring",), ("sep",), bytes=7),
+        ]
+        assert modeled_budgets(events) == {
+            "reduce": 100, "gather": 50, "permute": 7}
+        assert modeled_budgets(events, firm_only=True) == {
+            "reduce": 100, "gather": 0, "permute": 7}
+
+
+# ---------------------------------------------------------------------------
+# replication audit (pure synthetic ENTRY text)
+
+_HLO_TMPL = """HloModule m
+
+%add (a: f32[], b: f32[]) {
+  %scratch = f32[999,999]{1,0} parameter(0)
+}
+
+ENTRY %main (p0: f32[@W@]) -> f32[] {
+  %p0 = f32[@W@]{1,0} parameter(0)
+  %p1 = f32[256]{0} parameter(1)
+  %c = f32[] constant(0)
+}
+"""
+
+
+def _entry_hlo(w_shape):
+    return _HLO_TMPL.replace(
+        "@W@", ",".join(str(d) for d in w_shape))
+
+
+class TestReplicationAudit:
+    PARAMS = [("w", (64, 2048), "float32", (64, 256)),   # mp-sharded /8
+              ("b", (256,), "float32", (256,))]          # replicated
+
+    def test_sharded_param_at_local_shape_is_clean(self):
+        text = _entry_hlo((64, 256))
+        assert replication_audit(text, self.PARAMS,
+                                 min_bytes=1024) == []
+
+    def test_sharded_param_at_global_shape_flagged(self):
+        text = _entry_hlo((64, 2048))
+        f = replication_audit(text, self.PARAMS, min_bytes=1024,
+                              label="prog")
+        assert _codes(f) == {"replicated-large-tensor"}
+        (g,) = f
+        assert g.severity == "error"
+        assert "'w'" in g.message
+        assert "(64, 2048)" in g.message and "(64, 256)" in g.message
+
+    def test_small_tensors_below_floor_ignored(self):
+        text = _entry_hlo((64, 2048))
+        assert replication_audit(text, self.PARAMS,
+                                 min_bytes=1 << 30) == []
+
+    def test_intentionally_replicated_never_flagged(self):
+        # b has lshape == gshape: even absent from ENTRY, not a finding
+        text = _entry_hlo((64, 256)).replace(
+            "  %p1 = f32[256]{0} parameter(1)\n", "")
+        assert replication_audit(text, self.PARAMS,
+                                 min_bytes=1024) == []
+
+    def test_called_computation_params_ignored(self):
+        # the f32[999,999] parameter lives in %add, not ENTRY
+        text = _entry_hlo((64, 256))
+        params = [("s", (999, 999), "float32", (999, 333))]
+        assert replication_audit(text, params, min_bytes=1024) == []
+
+
+# ---------------------------------------------------------------------------
+# engine preflights: the model matches the metal
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.l1 = nn.Linear(16, 32)
+        self.l2 = nn.Linear(32, 16)
+        self.l3 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        h = nn.functional.relu(self.l1(x))
+        return self.l3(nn.functional.relu(self.l2(h)))
+
+
+def _mse(pred, y):
+    return ((pred - y) ** 2).mean()
+
+
+def _mlp_batch():
+    rng = np.random.RandomState(0)
+    return (rng.randn(8, 16).astype("float32"),
+            rng.randn(8, 4).astype("float32"))
+
+
+class TestTrainerPreflight:
+    @pytest.mark.parametrize("stage", [1, 2, 3])
+    def test_zero_stages_census_clean(self, stage):
+        _need8()
+        from paddle_tpu.parallel import ShardedTrainStep
+        paddle.seed(0)
+        m = _MLP()
+        opt = paddle.optimizer.AdamW(parameters=m.parameters(),
+                                     learning_rate=1e-3)
+        step = ShardedTrainStep(m, opt, build_mesh(sharding=8),
+                                sharding_stage=stage, loss_fn=_mse)
+        x, y = _mlp_batch()
+        rep = step.preflight(x, y, census_min_bytes=64)
+        assert rep is not None
+        assert rep.findings == [], [f.message for f in rep.findings]
+        assert "collective-census" in rep.passes_run
+        assert "replication-audit" in rep.passes_run
+        assert "donation" in rep.passes_run
+
+
+class TestHybridPreflight:
+    def test_composed_point_census_clean(self):
+        _need8()
+        from paddle_tpu.parallel import HybridParallelEngine
+        paddle.seed(0)
+        m = _MLP()
+        opt = paddle.optimizer.AdamW(parameters=m.parameters(),
+                                     learning_rate=1e-3)
+        eng = HybridParallelEngine(m, opt, loss_fn=_mse, dp_degree=2,
+                                   mp_degree=2, sharding_degree=2,
+                                   sharding_stage=1)
+        x, y = _mlp_batch()
+        rep = eng.preflight(x, y, census_min_bytes=64)
+        assert rep is not None
+        assert rep.findings == [], [f.message for f in rep.findings]
+
+
+@pytest.fixture(scope="module")
+def pipeline_engine():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        LayerDesc, PipelineLayer)
+    from paddle_tpu.parallel.pipeline import PipelineEngine
+    set_hybrid_communicate_group(None)
+    d = 8
+    paddle.seed(0)
+    pl = PipelineLayer([LayerDesc(nn.Linear, d, d) for _ in range(4)],
+                       loss_fn=_mse)
+    eng = PipelineEngine(pl, mesh=build_mesh(pp=2, dp=4))
+    rng = np.random.RandomState(7)
+    data = (rng.randn(8, d).astype("float32"),
+            rng.randn(8, d).astype("float32"))
+    yield eng, data
+    set_hybrid_communicate_group(None)
+
+
+class TestPipelinePreflight:
+    def test_chunk_programs_census_clean(self, pipeline_engine):
+        eng, data = pipeline_engine
+        reports = eng.preflight(data, census_min_bytes=64)
+        assert len(reports) == 2 * len(eng.chunks)   # fwd + bwd each
+        for rep in reports:
+            assert rep.findings == [], (
+                rep.label, [f.message for f in rep.findings])
+
+    # satellite (c): lint_donation over PipelineEngine-built programs
+    def test_chunk_programs_declare_no_donation(self, pipeline_engine):
+        from paddle_tpu.analysis import lint_donation
+        eng, data = pipeline_engine
+        st = eng.chunks[0]
+        st.begin_batch()
+        a = st.place_activation(jnp.asarray(data[0]))
+        lowered = st._fwd.lower(st.param_vals, st.buf_vals, a)
+        assert lowered.donate_argnums == ()
+        assert lint_donation(lowered) == []
+
+    def test_chunk_bwd_activation_donation_aliases(self, pipeline_engine):
+        # the activation donated into a backward IS aliasable: dx has
+        # the same shape and the backward consumes x
+        from paddle_tpu.analysis import lint_donation
+        eng, data = pipeline_engine
+        st = eng.chunks[0]
+        st.begin_batch()
+        x = jnp.ones((4, 8), jnp.float32)
+        dy = jnp.ones((4, 8), jnp.float32)
+        lowered = jax.jit(st._bwd_impl, donate_argnums=(2,)).lower(
+            st.param_vals, st.buf_vals, x, dy)
+        assert lint_donation(lowered) == []
+
+    def test_chunk_bwd_dx_param_donation_flagged(self, pipeline_engine):
+        # blanket-donating params into the zero-bubble dx-only half is
+        # a real bug: dx = dy @ W^T never reads the biases, XLA drops
+        # them, and the donation silently keeps both copies live — the
+        # lint must name each dropped donated leaf
+        from paddle_tpu.analysis import lint_donation
+        eng, data = pipeline_engine
+        st = eng.chunks[0]
+        st.begin_batch()
+        x = jnp.ones((4, 8), jnp.float32)
+        dy = jnp.ones((4, 8), jnp.float32)
+        lowered = jax.jit(st._bwd_dx_impl, donate_argnums=(0,)).lower(
+            st.param_vals, st.buf_vals, x, dy)
+        f = lint_donation(lowered)
+        assert _codes(f) == {"donation-unaliased"}
+        assert len(f) == 2                    # the two bias leaves
+        assert all("float32[8]" in g.message for g in f)
+
+
+# ---------------------------------------------------------------------------
+# the planted-defect acceptance test: drop a sharding constraint from a
+# dp x mp program and the census MUST name the implicit all-gather
+
+class TestInjectedDefect:
+    def _run(self, fn, modeled, min_bytes=256):
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        mesh = Mesh(devs, ("dp", "mp"))
+        rng = np.random.RandomState(0)
+        w1 = jax.device_put(rng.randn(64, 256).astype("float32"),
+                            NamedSharding(mesh, P(None, "mp")))
+        w2 = jax.device_put(rng.randn(256, 64).astype("float32"),
+                            NamedSharding(mesh, P("mp", None)))
+        x = jax.device_put(rng.randn(32, 64).astype("float32"),
+                           NamedSharding(mesh, P("dp", None)))
+        ctx = PassContext("fn", "defect:prog", fn=fn(mesh),
+                          args=(x, w1, w2), mesh=mesh,
+                          modeled_events=lambda: modeled,
+                          extra={"census_min_bytes": min_bytes,
+                                 "census_slack": 2.0})
+        return PassManager(use_baseline=False).run(ctx, level="full")
+
+    MODELED = [CollectiveEvent("psum", ("y-partial",), ("mp",),
+                               bytes=32 * 64 * 4)]
+
+    def test_constrained_program_clean(self):
+        _need8()
+
+        def make(mesh):
+            def constrained(x, w1, w2):
+                h = jax.lax.with_sharding_constraint(
+                    x @ w1, NamedSharding(mesh, P("dp", "mp")))
+                return (h @ w2).sum()
+            return constrained
+        rep = self._run(make, self.MODELED)
+        assert rep.findings == [], [f.message for f in rep.findings]
+
+    def test_dropped_constraint_caught_with_op_axis_bytes(self):
+        _need8()
+
+        def make(mesh):
+            def dropped(x, w1, w2):
+                # the mp constraint removed: XLA must all-gather h
+                h = jax.lax.with_sharding_constraint(
+                    x @ w1, NamedSharding(mesh, P("dp", None)))
+                return (h @ w2).sum()
+            return dropped
+        rep = self._run(make, self.MODELED)
+        hits = [f for f in rep.findings
+                if f.code == "census-unmodeled-collective"]
+        assert hits, [f.message for f in rep.findings]
+        (g,) = hits
+        assert g.severity == "error"
+        assert "all-gather" in g.message          # the op
+        assert "mp" in str(g.detail)              # the axis
+        assert "MB" in g.message                  # the byte count
+        ops = g.detail["ops"]
+        assert any("mp" in op["axes"] for op in ops)
+        assert all(op["global_bytes"] > 0 for op in ops)
+
+
+# ---------------------------------------------------------------------------
+# satellite (e): the static_check.py --smoke tier-1 leg
+
+class TestStaticCheckSmoke:
+    def test_smoke_leg_green(self, capsys):
+        _need8()
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        sys.path.insert(0, tools)
+        try:
+            import static_check
+        finally:
+            sys.path.remove(tools)
+        rc = static_check.main(["--smoke", "--json", "--min-bytes",
+                                "512"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0, doc
+        names = {p["program"] for p in doc["programs"]}
+        assert len(names) == len(static_check.SMOKE)
+        for prog in doc["programs"]:
+            assert prog.get("findings") == [], prog
+        assert {c["check"] for c in doc["selftest"]} == {
+            "constrained-program-clean", "dropped-constraint-caught"}
+        assert all(c["ok"] for c in doc["selftest"]), doc["selftest"]
+
+    def test_baseline_file_parses(self):
+        from paddle_tpu.analysis.passes import load_baseline
+        base = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "static_baseline.json")
+        assert isinstance(load_baseline(base), set)

@@ -1,0 +1,300 @@
+"""Program Sentinel CLI — run the static pass catalog over the
+standard program zoo and exit nonzero on NEW findings.
+
+The CI entry point for paddle_tpu.analysis.passes: every zoo program
+(ZeRO trainer stages, the comm-overlap trainer, composed hybrid
+points, a pipeline engine, the serve batcher) gets the FULL catalog —
+donation aliasing, the HLO collective census against the modeled
+CollectiveEvent schedule, the replication audit — on 8 virtual CPU
+devices.  Findings already recorded in tools/static_baseline.json are
+reported as "suppressed" (tracked, not silenced) and do not fail the
+run; anything new exits 1.
+
+  python tools/static_check.py                 full zoo vs baseline
+  python tools/static_check.py --smoke         the fast tier-1 leg
+      (two trainer programs + the planted-defect canary)
+  python tools/static_check.py --selftest      canary only: a dp x mp
+      program with a dropped sharding constraint MUST be caught by the
+      census (names the op, axis, byte count) and the constrained twin
+      must stay clean — a silently broken census is the failure mode
+      this guards
+  python tools/static_check.py --update-baseline
+      rewrite static_baseline.json from the current findings
+  --json       one machine-readable JSON document on stdout
+  --min-bytes  census noise floor for the zoo (default 512: the zoo
+      models are tiny; production default is FLAGS_census_min_bytes)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
+BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "static_baseline.json")
+
+
+# ---------------------------------------------------------------------------
+# the program zoo
+
+def _mlp():
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(16, 32)
+            self.l2 = nn.Linear(32, 16)
+            self.l3 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            h = nn.functional.relu(self.l1(x))
+            return self.l3(nn.functional.relu(self.l2(h)))
+
+    pt.seed(0)
+    m = MLP()
+    opt = pt.optimizer.AdamW(parameters=m.parameters(),
+                             learning_rate=1e-3)
+    return m, opt
+
+
+def _loss(pred, y):
+    return ((pred - y) ** 2).mean()
+
+
+def _batch():
+    import numpy as np
+    rng = np.random.RandomState(0)
+    return (rng.randn(8, 16).astype("float32"),
+            rng.randn(8, 4).astype("float32"))
+
+
+def _trainer_report(stage, min_bytes, **kw):
+    from paddle_tpu.parallel import ShardedTrainStep
+    from paddle_tpu.distributed.topology import build_mesh
+    m, opt = _mlp()
+    step = ShardedTrainStep(m, opt, build_mesh(sharding=8),
+                            sharding_stage=stage, loss_fn=_loss, **kw)
+    x, y = _batch()
+    return [step.preflight(x, y, census_min_bytes=min_bytes)]
+
+
+def _hybrid_report(min_bytes, **degrees):
+    from paddle_tpu.parallel import HybridParallelEngine
+    m, opt = _mlp()
+    eng = HybridParallelEngine(m, opt, loss_fn=_loss, **degrees)
+    x, y = _batch()
+    return [eng.preflight(x, y, census_min_bytes=min_bytes)]
+
+
+def _pipeline_report(min_bytes):
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        LayerDesc, PipelineLayer)
+    from paddle_tpu.distributed.topology import build_mesh
+    from paddle_tpu.parallel.pipeline import PipelineEngine
+    d = 8
+    pt.seed(0)
+    pl = PipelineLayer(
+        [LayerDesc(nn.Linear, d, d) for _ in range(4)], loss_fn=_loss)
+    eng = PipelineEngine(pl, mesh=build_mesh(pp=2, dp=4))
+    rng = np.random.RandomState(7)
+    data = (rng.randn(8, d).astype("float32"),
+            rng.randn(8, d).astype("float32"))
+    return eng.preflight(data, census_min_bytes=min_bytes)
+
+
+def _serve_report():
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import llama_tiny_config, \
+        LlamaForCausalLM
+    from paddle_tpu.inference.serving import ContinuousBatcher
+    pt.seed(0)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=32,
+                            intermediate_size=64, num_attention_heads=2,
+                            num_key_value_heads=2, vocab_size=64,
+                            dtype="float32")
+    bat = ContinuousBatcher(LlamaForCausalLM(cfg), max_batch_size=2,
+                            max_len=32)
+    return [bat.preflight()]
+
+
+ZOO = {
+    "trainer-stage0": lambda mb: _trainer_report(0, mb),
+    "trainer-stage1": lambda mb: _trainer_report(1, mb),
+    "trainer-stage2": lambda mb: _trainer_report(2, mb),
+    "trainer-stage3": lambda mb: _trainer_report(3, mb),
+    "trainer-overlap-s2": lambda mb: _trainer_report(
+        2, mb, comm_overlap=True, comm_bucket_mb=0.001),
+    "hybrid-dp2-sharding4": lambda mb: _hybrid_report(
+        mb, dp_degree=2, sharding_degree=4),
+    "hybrid-dp2-mp2-sharding2": lambda mb: _hybrid_report(
+        mb, dp_degree=2, mp_degree=2, sharding_degree=2,
+        sharding_stage=1),
+    "pipeline-pp2-dp4": lambda mb: _pipeline_report(mb),
+    "serve-batcher": lambda mb: _serve_report(),
+}
+SMOKE = ("trainer-stage0", "trainer-stage2")
+
+
+# ---------------------------------------------------------------------------
+# planted-defect canary
+
+def selftest(min_bytes=256):
+    """The census must catch a dropped sharding constraint (implicit
+    all-gather over mp) and keep the constrained twin clean."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu.analysis.passes import PassContext, PassManager
+    from paddle_tpu.analysis.collectives import CollectiveEvent
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("dp", "mp"))
+    rng = np.random.RandomState(0)
+    w1 = jax.device_put(rng.randn(64, 256).astype("float32"),
+                        NamedSharding(mesh, P(None, "mp")))
+    w2 = jax.device_put(rng.randn(256, 64).astype("float32"),
+                        NamedSharding(mesh, P("mp", None)))
+    x = jax.device_put(rng.randn(32, 64).astype("float32"),
+                       NamedSharding(mesh, P("dp", None)))
+
+    def constrained(x, w1, w2):
+        h = jax.lax.with_sharding_constraint(
+            x @ w1, NamedSharding(mesh, P("dp", "mp")))
+        return (h @ w2).sum()
+
+    def dropped(x, w1, w2):
+        # the mp constraint removed: XLA all-gathers h over mp
+        h = jax.lax.with_sharding_constraint(
+            x @ w1, NamedSharding(mesh, P("dp", None)))
+        return (h @ w2).sum()
+
+    modeled = [CollectiveEvent("psum", ("y-partial",), ("mp",),
+                               bytes=32 * 64 * 4)]
+    pm = PassManager(use_baseline=False)
+    results = {}
+    for name, fn in (("constrained", constrained), ("dropped", dropped)):
+        ctx = PassContext(
+            "fn", f"selftest:{name}", fn=fn, args=(x, w1, w2),
+            mesh=mesh, modeled_events=lambda: modeled,
+            extra={"census_min_bytes": min_bytes, "census_slack": 2.0})
+        results[name] = pm.run(ctx, level="full")
+    ok_clean = not results["constrained"].findings
+    caught = [f for f in results["dropped"].findings
+              if f.code == "census-unmodeled-collective"
+              and "mp" in str(f.detail) and "all-gather" in f.message]
+    checks = [
+        ("constrained-program-clean", ok_clean),
+        ("dropped-constraint-caught", bool(caught)),
+    ]
+    return checks, results
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast tier-1 leg: 2 trainer programs + canary")
+    ap.add_argument("--selftest", action="store_true",
+                    help="planted-defect canary only")
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--min-bytes", type=int, default=512)
+    ap.add_argument("--only", help="comma-separated zoo subset")
+    args = ap.parse_args(argv)
+
+    doc = {"programs": [], "selftest": [], "new_findings": 0,
+           "suppressed": 0}
+    failed = False
+
+    if not args.selftest:
+        names = SMOKE if args.smoke else tuple(ZOO)
+        if args.only:
+            names = tuple(n for n in args.only.split(",") if n in ZOO)
+        for name in names:
+            try:
+                reports = ZOO[name](args.min_bytes) or []
+            except Exception as e:  # noqa: BLE001 — a crash is a finding
+                from paddle_tpu.analysis.passes import SentinelError
+                if isinstance(e, SentinelError):
+                    doc["programs"].append({
+                        "program": name,
+                        "findings": [f.to_dict() for f in e.findings]})
+                else:
+                    doc["programs"].append({
+                        "program": name,
+                        "error": f"{type(e).__name__}: {e}"})
+                failed = True
+                continue
+            for rep in reports:
+                if rep is None:    # FLAGS_static_sentinel off
+                    continue
+                d = rep.to_dict()
+                doc["programs"].append(d)
+                doc["new_findings"] += len(d["findings"])
+                doc["suppressed"] += len(d["suppressed"])
+                if d["findings"]:
+                    failed = True
+
+    if args.smoke or args.selftest:
+        checks, _ = selftest()
+        for name, ok in checks:
+            doc["selftest"].append({"check": name, "ok": ok})
+            if not ok:
+                failed = True
+
+    if args.update_baseline:
+        sups = []
+        for prog in doc["programs"]:
+            for f in prog.get("findings", []):
+                sups.append({"program": prog["program"],
+                             "pass": f.get("pass", "*"),
+                             "code": f["code"]})
+        with open(BASELINE, "w") as fh:
+            json.dump({"_comment":
+                       "Pass-manager baseline: (program, pass, code) "
+                       "triples tracked as pre-existing.  Regenerate "
+                       "with tools/static_check.py --update-baseline.",
+                       "suppressions": sups}, fh, indent=2)
+            fh.write("\n")
+        print(f"baseline updated: {len(sups)} suppressions -> "
+              f"{BASELINE}")
+        return 0
+
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        for prog in doc["programs"]:
+            tag = "ERROR" if prog.get("error") or prog.get("findings") \
+                else "ok"
+            print(f"[{tag}] {prog['program']}"
+                  + (f"  ({len(prog.get('suppressed', []))} suppressed)"
+                     if prog.get("suppressed") else ""))
+            if prog.get("error"):
+                print(f"    {prog['error']}")
+            for f in prog.get("findings", []):
+                print(f"    [{f['severity']}] {f['code']}: "
+                      f"{f['message']}")
+        for c in doc["selftest"]:
+            print(f"[{'ok' if c['ok'] else 'FAIL'}] selftest: "
+                  f"{c['check']}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
