@@ -1311,16 +1311,153 @@ def bench_llama_serve_fleet():
                  **_peak_hbm_fields()})
 
 
+def bench_llama_serve_autoscale():
+    """SLO-driven elastic autoscaler (ISSUE 19): the deterministic
+    diurnal load curve through a ServeRouter fleet with an
+    AutoscalerDaemon closing the loop (start at min_replicas, scale
+    out into the peak, scale back in at the trough) vs a STATIC
+    min-size fleet on the same schedule under the same bounded queue.
+    Reports aggregate tok/s plus the action journal summary and the
+    interactive attainment of both fleets.  The CPU smoke asserts the
+    loop is REAL: >= 1 scale-out and >= 1 scale-in executed, flap
+    count 0, zero requests shed by the autoscaled fleet (the static
+    fleet DOES shed under the same pressure — that's the capacity the
+    autoscaler buys), and interactive attainment >= the static
+    baseline."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    import paddle_tpu as paddle
+    from paddle_tpu.fleet import (AutoscalePolicy, AutoscalerDaemon,
+                                  DiurnalLoadSim)
+    from paddle_tpu.inference import ContinuousBatcher
+    from paddle_tpu.inference.router import ServeRouter
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from autoscale_report import analyze_journal
+
+    model, cfg, batch, n_params, roofline = _serving_model()
+    if on_tpu:
+        ticks, period, low, high = 16, 8, 2, 12
+        plen, n_new, chunk, max_len, pchunk, ps = 48, 64, 32, 384, 32, 32
+        rb, qdepth, steps_per_tick = max(2, batch // 2), 16, 8
+    else:
+        # per-replica throughput = rb slots * steps_per_tick / (2
+        # prefill + 6 decode steps) = 2 req/tick: one replica sits
+        # below the 3.5 req/tick diurnal average (static fleet sheds),
+        # three cover the peak of 6 (autoscaled fleet sheds nothing)
+        ticks, period, low, high = 12, 6, 1, 6
+        plen, n_new, chunk, max_len, pchunk, ps = 6, 6, 4, 48, 4, 8
+        rb, qdepth, steps_per_tick = 2, 6, 8
+    drain_ticks = 4
+    geom = dict(max_batch_size=rb, max_len=max_len, chunk=chunk,
+                prefill_chunk=pchunk, page_size=ps)
+    sim = DiurnalLoadSim(vocab=cfg.vocab_size, seed=3, period=period,
+                         low=low, high=high, prompt_len=plen,
+                         max_new=n_new)
+    policy = AutoscalePolicy(min_replicas=1, max_replicas=3, window=1,
+                             cooldown=2, queue_high=0.75,
+                             queue_low=0.5, lease_ttl_s=0.0)
+
+    def mk():
+        return ContinuousBatcher(model, **geom)
+
+    last = {}
+
+    def run_curve(autoscale):
+        router = ServeRouter(batchers=[mk()])
+        daemon = AutoscalerDaemon(router, policy=policy, spawn=mk) \
+            if autoscale else None
+        paddle.set_flags({"FLAGS_autoscale": bool(autoscale),
+                          "FLAGS_serve_queue_depth": qdepth})
+        gids = []
+        t0 = time.perf_counter()
+        try:
+            # submission ticks, then load-free drain ticks so the
+            # trailing trough gives the daemon room to scale back in
+            for t in range(ticks + drain_ticks):
+                if t < ticks:
+                    for r in sim.requests(t):
+                        gids.append(router.submit(
+                            r["prompt"], r["max_new"], slo=r["slo"]))
+                if daemon is not None:
+                    daemon.tick()
+                for _ in range(steps_per_tick):
+                    router.step()
+            outs = router.run()
+        finally:
+            paddle.set_flags({"FLAGS_autoscale": False,
+                              "FLAGS_serve_queue_depth": 0})
+        dt = time.perf_counter() - t0
+        by_cls = {}
+        for g in gids:
+            rr = router._reqs[g]
+            tot, ok = by_cls.get(rr.slo, (0, 0))
+            by_cls[rr.slo] = (tot + 1, ok + (0 if rr.shed else 1))
+        att = {c: round(ok / tot, 4)
+               for c, (tot, ok) in by_cls.items()}
+        st = router.stats()
+        last.clear()
+        last.update({"stats": st, "attainment": att,
+                     "journal": daemon.journal() if daemon else [],
+                     "tokens": sum(len(v) for v in outs.values())})
+        return last["tokens"] / dt
+
+    run_curve(True)                     # compile (programs shared)
+    tok_s, spread, vals = _measure(lambda: run_curve(True))
+    auto = dict(last)
+    static_tok = _measure(lambda: run_curve(False))[0]
+    static = dict(last)
+    jr = analyze_journal(auto["journal"], cooldown=policy.cooldown)
+    auto_att = auto["attainment"].get("interactive", 1.0)
+    static_att = static["attainment"].get("interactive", 1.0)
+    if not on_tpu:
+        # the loop must be REAL: the curve forced >= 1 scale-out into
+        # the peak and >= 1 scale-in at the trough, without a single
+        # flap; the autoscaled fleet dropped NOTHING while the static
+        # min fleet shed under the same bounded queue; and interactive
+        # attainment is no worse than the static baseline
+        assert jr["executed_by_kind"].get("scale_out", 0) >= 1, jr
+        assert jr["executed_by_kind"].get("scale_in", 0) >= 1, jr
+        assert jr["flaps"] == 0, jr
+        assert not jr["pending"] and jr["epochs_unique"], jr
+        assert auto["stats"]["requests_shed"] == 0, auto["stats"]
+        assert static["stats"]["requests_shed"] > 0, static["stats"]
+        assert auto_att >= static_att, (auto_att, static_att)
+    vs_static = tok_s / max(static_tok, 1e-9)
+    _emit("llama_serve_autoscale_tokens_per_sec", tok_s,
+          f"aggregate tok/s over a {ticks}-tick diurnal curve "
+          f"(rate {low}..{high}/tick), autoscaled 1..3 replicas x "
+          f"{rb} slots; actions={jr['executed_by_kind']}, flaps="
+          f"{jr['flaps']}, shed={auto['stats']['requests_shed']} "
+          f"(static min-fleet shed "
+          f"{static['stats']['requests_shed']}), attainment(int)="
+          f"{auto_att:.2f} vs static {static_att:.2f}, "
+          f"vs_static={vs_static:.2f}x",
+          tok_s / max(roofline, 1e-9), spread, vals,
+          extra={"actions": jr["executed_by_kind"],
+                 "rollbacks": len(jr["rollbacks"]),
+                 "flaps": jr["flaps"],
+                 "shed": auto["stats"]["requests_shed"],
+                 "static_shed": static["stats"]["requests_shed"],
+                 "attainment_interactive": auto_att,
+                 "static_attainment_interactive": static_att,
+                 "replicas_final": auto["stats"]["live_replicas"],
+                 "vs_static_min_fleet": round(vs_static, 3),
+                 "static_tokens_per_sec": round(static_tok, 1),
+                 **_peak_hbm_fields()})
+
+
 def bench_serve_all():
     """BENCH_CONFIG=serve runs the mixed-length leg, the prefix-shared
-    leg, the speculative leg AND the serve-fleet router leg (fresh
-    vs-baseline numbers for all — BENCH_r05 predates the r6 batcher,
-    the r12 paged pool, the r15 draft/verify scan and the r19
-    router)."""
+    leg, the speculative leg, the serve-fleet router leg AND the
+    elastic-autoscaler leg (fresh vs-baseline numbers for all —
+    BENCH_r05 predates the r6 batcher, the r12 paged pool, the r15
+    draft/verify scan, the r19 router and the ISSUE-19 autoscaler)."""
     bench_llama_serve()
     bench_llama_serve_prefix_shared()
     bench_llama_serve_speculative()
     bench_llama_serve_fleet()
+    bench_llama_serve_autoscale()
 
 
 CONFIGS = {
@@ -1354,6 +1491,10 @@ _ALIASES = {
     "fleet_serve": "serve",
     "llama_serve_fleet": "serve",
     "llama_serve_fleet_tokens_per_sec": "serve",
+    "autoscale": "serve",
+    "serve_autoscale": "serve",
+    "llama_serve_autoscale": "serve",
+    "llama_serve_autoscale_tokens_per_sec": "serve",
     "llama_decode": "decode",
     "llama_decode_tokens_per_sec_per_chip": "decode",
     "llama_train_tokens_per_sec_per_chip": "llama",
@@ -1853,6 +1994,82 @@ def _assert_serve_robustness_zero_overhead():
         "serve-step HLO changed after the flag round-trip"
 
 
+def _assert_autoscale_zero_overhead():
+    """ISSUE 19 flags-off contract: the elastic autoscaler is a HOST
+    control loop that must cost NOTHING when off.  With FLAGS_autoscale
+    unset a constructed AutoscalerDaemon's tick() is one flag read —
+    zero KV-plane traffic (no lease, no journal, no recovery scan) —
+    and importing the fleet package + building a daemon leaves the
+    serve-step program-cache keys and lowered HLO byte-identical across
+    the flag round-trip.  Cheap (1-layer tiny llama); runs before
+    every bench config."""
+    import paddle_tpu as paddle
+    from paddle_tpu.fleet import AutoscalerDaemon
+    from paddle_tpu.fleet.autoscaler import _LocalKV
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.inference import ContinuousBatcher
+    from paddle_tpu.inference.router import ServeRouter
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+
+    paddle.seed(3)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=32,
+                            intermediate_size=64,
+                            num_attention_heads=2,
+                            num_key_value_heads=2, vocab_size=64)
+    model = LlamaForCausalLM(cfg)
+    geom = dict(max_batch_size=2, max_len=32, chunk=4, prefill_chunk=4)
+
+    def fingerprint():
+        bat = ContinuousBatcher(model, **geom)
+        keys = (bat._program_key(1, bat.chunk),
+                bat._program_key(bat.prefill_chunk, bat.admit_steps))
+        hlo = (bat.lower_step(mixed=False).as_text(),
+               bat.lower_step(mixed=True).as_text())
+        return bat, keys, hlo
+
+    class _CountingKV:
+        """Every KV verb the daemon could issue, counted."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.calls = 0
+
+        def __getattr__(self, name):
+            attr = getattr(self._inner, name)
+            if not callable(attr):
+                return attr
+
+            def wrapped(*a, **k):
+                self.calls += 1
+                return attr(*a, **k)
+            return wrapped
+
+    _, keys_off, hlo_off = fingerprint()
+    kv = _CountingKV(_LocalKV())
+    router = ServeRouter(batchers=[ContinuousBatcher(model, **geom)])
+    daemon = AutoscalerDaemon(router, kv=kv)
+    for _ in range(4):
+        out = daemon.tick()
+        assert out.get("status") == "disabled", out
+    assert kv.calls == 0, \
+        f"FLAGS_autoscale off but the daemon issued {kv.calls} " \
+        f"KV-plane calls (the zero-overhead gate is the flag check)"
+    set_flags({"FLAGS_autoscale": True})
+    try:
+        _, keys_on, hlo_on = fingerprint()
+    finally:
+        set_flags({"FLAGS_autoscale": False})
+    assert keys_off == keys_on, \
+        f"FLAGS_autoscale leaked into serve program keys: " \
+        f"{keys_off} vs {keys_on}"
+    assert hlo_off == hlo_on, \
+        "FLAGS_autoscale changed the lowered serve-step HLO"
+    _, _, hlo_off2 = fingerprint()
+    assert hlo_off == hlo_off2, \
+        "serve-step HLO changed after the autoscale flag round-trip"
+
+
 def _assert_decode_roofline_zero_overhead():
     """ISSUE 11 flags-off contract: FLAGS_weight_only_dtype and the
     speculation flags leave the flags-off programs byte-identical.
@@ -1953,6 +2170,7 @@ def _assert_decode_roofline_zero_overhead():
 
 def main():
     _assert_serve_robustness_zero_overhead()
+    _assert_autoscale_zero_overhead()
     _assert_decode_roofline_zero_overhead()
     _assert_analysis_zero_overhead()
     _assert_fault_tolerance_zero_overhead()

@@ -175,6 +175,7 @@ from . import parallel  # noqa: E402
 from . import linalg  # noqa: E402
 from . import regularizer  # noqa: E402
 from . import inference  # noqa: E402
+from . import fleet  # noqa: E402
 from . import fft  # noqa: E402
 from . import distribution  # noqa: E402
 from . import quantization  # noqa: E402

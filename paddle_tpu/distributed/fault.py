@@ -74,6 +74,21 @@ can target one request:
                       that slot's decode is poisoned -> pages evicted,
                       request requeued or shed, rest of batch keeps
                       decoding)
+
+Autoscaler points (ISSUE 19, fleet/autoscaler.py; exercised by
+`chaos_check --autoscale --selftest`) — keys carry the daemon tick /
+epoch / target replica (``tick<N>`` / ``epoch<E>:rep<i>``) so `match=`
+can target one decision or one scale action:
+
+    autoscale.decide  one daemon policy evaluation (error = the tick
+                      degrades to a no-op and retries next poll — a
+                      broken metrics read never crashes the daemon)
+    autoscale.drain   the drain_replica call of a scale-in/role-flip
+                      (error = bounded retry with backoff, then
+                      rollback: replica returned to rotation)
+    autoscale.reform  the re-form half: spawning/adding a replica on
+                      scale-out, or the role switch + undrain of a
+                      role-flip (error = bounded retry, then rollback)
 """
 from __future__ import annotations
 
@@ -94,7 +109,8 @@ __all__ = ["Fault", "FaultError", "FaultSpecError", "hit", "is_active",
 POINTS = ("ckpt.write", "ckpt.manifest", "ckpt.latest", "kv.request",
           "launch.heartbeat", "step.begin", "step.data",
           "serve.admit", "serve.kv_alloc", "serve.chunk",
-          "serve.decode")
+          "serve.decode",
+          "autoscale.decide", "autoscale.drain", "autoscale.reform")
 
 MODES = ("error", "truncate", "corrupt", "nan", "skip", "kill", "delay")
 

@@ -1,0 +1,18 @@
+"""Fleet control plane (ROADMAP item 3) — the serving-economics layer
+that CLOSES THE LOOP between what the repo can observe (router stats,
+per-class SLO attainment, fleet telemetry) and how it can change shape
+(elastic drain/undrain/add, role metadata).
+
+`autoscaler` is the first resident: an SLO-driven elastic autoscaler
+whose decisions are pure functions, whose executions are journaled and
+lease-fenced on the launch KV plane, and whose failure modes are chaos
+-checked end to end (`tools/chaos_check.py --autoscale`).
+"""
+from . import autoscaler  # noqa: F401
+from .autoscaler import (Action, AutoscalePolicy, AutoscalerDaemon,  # noqa: F401,E501
+                         DiurnalLoadSim, PolicyState, decide,
+                         fleet_view, observe, after_action)
+
+__all__ = ["autoscaler", "Action", "AutoscalePolicy",
+           "AutoscalerDaemon", "DiurnalLoadSim", "PolicyState",
+           "decide", "fleet_view", "observe", "after_action"]

@@ -301,6 +301,36 @@ define_flag("router_attainment_floor", 0.9,
             "sits below the floor while another candidate has "
             "headroom (at/above it, or no attainment signal yet).  0 "
             "disables the floor")
+# SLO-driven elastic autoscaler (ISSUE 19, fleet/autoscaler.py): the
+# daemon that closes the loop between the serve ledgers (per-class
+# attainment, queue depth, windowed shed rate) and the elastic runtime
+# (drain_replica + re-form).  Pure HOST-plane control flow: with
+# FLAGS_autoscale off (the single-replica default) the daemon's tick()
+# returns before touching the KV plane, and the serve-step HLO +
+# program-cache keys stay byte-identical (bench-asserted).
+define_flag("autoscale", False,
+            "master switch for the SLO-driven elastic autoscaler "
+            "(fleet.autoscaler.AutoscalerDaemon): off (default), "
+            "tick() is a no-op — no decisions, no KV traffic, no "
+            "lease.  On, the lease-holding daemon polls the fleet "
+            "view and executes scale-out/scale-in/role-flip via the "
+            "lossless drain + re-form path")
+define_flag("autoscale_min_replicas", 1,
+            "scale-in floor: the autoscaler never drains the fleet "
+            "below this many routable replicas")
+define_flag("autoscale_max_replicas", 4,
+            "scale-out ceiling: the autoscaler never grows the fleet "
+            "past this many live replicas")
+define_flag("autoscale_window", 2,
+            "hysteresis window in polls: pressure (or idleness) must "
+            "persist for this many CONSECUTIVE daemon ticks before an "
+            "action is taken — a one-tick load spike never moves the "
+            "fleet")
+define_flag("autoscale_cooldown", 4,
+            "per-action-kind cooldown in polls: after an executed "
+            "scale action, the opposite kind is additionally blocked "
+            "for this many ticks — oscillating load can never flap "
+            "the fleet (autoscale_report asserts flap count 0)")
 define_flag("serve_retry_budget", 3,
             "per-request bound on serve-plane fault recoveries "
             "(injected/real admission faults retried FIFO-in-place, "

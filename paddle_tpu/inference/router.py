@@ -102,6 +102,11 @@ def pick_replica(views: List[dict], slo: str = "batch",
                  - queue_cost * queued  - queue_cost * shed_rate,
          ties broken by (fewer queued, fewer active, lowest replica
          id) — byte-for-byte reproducible for a given view list.
+
+    The shed penalty reads the SLIDING-WINDOW rate
+    (``shed_rate_window``, ISSUE 19 satellite) when the view carries
+    one — current pressure, not lifetime history — and falls back to
+    the cumulative ``shed_rate`` for older/synthetic views.
     """
     if prefix_weight is None:
         prefix_weight = float(get_flag("router_prefix_weight") or 0.0)
@@ -121,9 +126,12 @@ def pick_replica(views: List[dict], slo: str = "batch",
             cands = floored
 
     def rank(v):
+        shed = v.get("shed_rate_window")
+        if shed is None:
+            shed = v.get("shed_rate") or 0.0
         score = (prefix_weight * float(v.get("prefix_hit_tokens") or 0)
                  - queue_cost * float(v.get("queued") or 0)
-                 - queue_cost * float(v.get("shed_rate") or 0.0))
+                 - queue_cost * float(shed))
         return (score, -float(v.get("queued") or 0),
                 -float(v.get("active") or 0),
                 -int(v.get("replica", 0)))
@@ -167,15 +175,18 @@ class _RouterReq:
 
 class _Replica:
     """One in-process replica handle: the batcher plus the router's
-    local-id <-> global-id mapping and per-replica route counters."""
-    __slots__ = ("idx", "bat", "dead", "draining", "local2g", "routed",
-                 "requeued_in")
+    local-id <-> global-id mapping, the replica's ROLE (host-plane
+    metadata the autoscaler flips: "serve", or the item-2 disaggregated
+    "prefill"/"decode" split) and per-replica route counters."""
+    __slots__ = ("idx", "bat", "dead", "draining", "role", "local2g",
+                 "routed", "requeued_in")
 
-    def __init__(self, idx, bat):
+    def __init__(self, idx, bat, role: str = "serve"):
         self.idx = idx
         self.bat = bat
         self.dead = False
         self.draining = False
+        self.role = role
         self.local2g: Dict[int, int] = {}
         self.routed = 0
         self.requeued_in = 0
@@ -238,6 +249,8 @@ class ServeRouter:
         self._decision_ms: deque = deque(maxlen=4096)
         self._last_rebalance = time.monotonic()
         self._draining = False
+        self._kv = kv
+        self._job = job_id
         self._pubs: List[Optional["ReplicaPublisher"]] = []
         if kv is not None:
             self._pubs = [ReplicaPublisher(kv, job_id=job_id,
@@ -276,6 +289,7 @@ class ServeRouter:
                 continue
             v = rep.bat.router_view(prompt)
             v["replica"] = rep.idx
+            v["role"] = rep.role
             if rep.draining:
                 v["draining"] = True
             views.append(v)
@@ -380,6 +394,20 @@ class ServeRouter:
         arrival position — fleet-wide FIFO within a class survives
         migrations) and the ABSOLUTE deadline (a migrated request's
         clock never restarts)."""
+        if rep.dead or rep.draining:
+            # the routing decision raced a drain/kill of its chosen
+            # replica (ISSUE 19 satellite: drain_replica landing
+            # between pick_replica and the enqueue): re-pick among the
+            # survivors instead of parking the request on a replica
+            # that stopped accepting routes — it lands on a survivor
+            # or sheds only when the WHOLE fleet is draining, exactly
+            # the submit-path contract
+            views = self._views(rr.prompt, exclude=rep.idx)
+            idx = pick_replica(views, slo=rr.slo)
+            if idx is None:
+                self._shed_router(rr, "drain")
+                return
+            rep = self._reps[idx]
         bat = rep.bat
         cb = None
         if rr.on_token is not None:
@@ -448,6 +476,7 @@ class ServeRouter:
                 self._draining = True
             if rep.draining and not bat.queued and not bat.active:
                 rep.dead = True
+                self._retire_pub(rep)
         self._maybe_rebalance()
         self._publish()
         return finished
@@ -504,6 +533,7 @@ class ServeRouter:
                 # stays whole
                 bat._shed(req, "drain")
         migs.sort(key=lambda r: r.arrival)
+        self._retire_pub(rep)
         from .. import telemetry as _tel
         _tel.counter("router.kills").inc()
         if _tel.active():
@@ -558,6 +588,69 @@ class ServeRouter:
         for rr in migs:
             self._migrate(rr, frm=idx)
         return len(migs)
+
+    def undrain_replica(self, idx: int) -> bool:
+        """Return a DRAINING replica to rotation (the autoscaler's
+        rollback half, ISSUE 19): routes flow to it again and it no
+        longer retires when empty.  Requests already migrated off it
+        stay where they landed (re-migrating them back would re-decode
+        for nothing).  False when the replica already retired — a
+        retired replica's device state is gone, only add_replica can
+        re-grow the fleet."""
+        rep = self._reps[idx]
+        if rep.dead:
+            return False
+        if rep.draining:
+            rep.draining = False
+            from .. import telemetry as _tel
+            _tel.counter("router.undrains").inc()
+            if _tel.active():
+                _tel.emit("router.undrain", replica=idx)
+        return True
+
+    def add_replica(self, bat: ContinuousBatcher,
+                    role: str = "serve") -> int:
+        """Grow the fleet by one live replica (the autoscaler's
+        scale-out half, ISSUE 19): `bat` joins the rotation at the next
+        routing decision under a fresh replica id.  Same-geometry
+        replicas share their 2 compiled serve programs through the
+        model-level program cache, so a scale-out compiles nothing.
+        With a KV plane attached the new replica publishes under the
+        same ``<job>/serve/<idx>`` schema.  Returns the replica id."""
+        idx = len(self._reps)
+        rep = _Replica(idx, bat, role=role)
+        self._reps.append(rep)
+        if self._kv is not None:
+            self._pubs.append(ReplicaPublisher(self._kv,
+                                               job_id=self._job,
+                                               replica=idx))
+        elif self._pubs:
+            self._pubs.append(None)
+        from .. import telemetry as _tel
+        _tel.counter("router.adds").inc()
+        if _tel.active():
+            _tel.emit("router.add", replica=idx, role=role)
+        return idx
+
+    def set_role(self, idx: int, role: str) -> str:
+        """Flip replica `idx`'s role metadata (host-plane only: routing
+        and programs are untouched here — the autoscaler drains before
+        flipping so in-flight work never straddles a role change).
+        Returns the previous role."""
+        rep = self._reps[idx]
+        prev, rep.role = rep.role, role
+        from .. import telemetry as _tel
+        if _tel.active():
+            _tel.emit("router.role", replica=idx, role=role, prev=prev)
+        return prev
+
+    def _retire_pub(self, rep: _Replica):
+        """Tombstone a RETIRED replica's KV presence (ISSUE 19
+        satellite): its stale published view must never read as a live
+        straggling replica to discover_replicas or a fleet
+        aggregator."""
+        if rep.idx < len(self._pubs) and self._pubs[rep.idx] is not None:
+            self._pubs[rep.idx].retire()
 
     def _migrate(self, rr: _RouterReq, frm: int):
         rr.requeues += 1
@@ -669,6 +762,9 @@ class ServeRouter:
                 "routed": rep.routed, "requeued_in": rep.requeued_in}
             if not rep.dead:
                 rec.update(rep.bat.router_view())
+            rec["role"] = rep.role
+            if rep.draining:        # router-level drain wins over the
+                rec["draining"] = True  # batcher's own SIGTERM flag
             per.append(rec)
         dec = summary_of(list(self._decision_ms))
         return {
@@ -733,6 +829,15 @@ class ReplicaPublisher:
         self._kv.stamp(f"{pre}/hb")
         return bool(ok)
 
+    def retire(self) -> bool:
+        """Tombstone this replica on the KV plane (ISSUE 19 satellite):
+        a master-clock stamp under ``<job>/serve/<replica>/tombstone``.
+        A retired/scaled-in replica stops heartbeating, so without the
+        tombstone its last published view would read as a stale live
+        replica forever; `discover_replicas` skips tombstoned ids."""
+        ok = self._kv.stamp(f"{self._job}/serve/{self.replica}/tombstone")
+        return bool(ok)
+
 
 def discover_replicas(kv, job_id: str = "serve") -> Dict[int, dict]:
     """{replica: latest router_view} discovered from the KV plane —
@@ -744,12 +849,23 @@ def discover_replicas(kv, job_id: str = "serve") -> Dict[int, dict]:
         from ..distributed.launch.master import KVClient
         kv = KVClient(kv)
     out: Dict[int, dict] = {}
-    for key, raw in kv.prefix(f"{job_id}/serve").items():
+    got = kv.prefix(f"{job_id}/serve")
+    dead = set()
+    for key in got:
+        if key.endswith("/tombstone"):
+            try:
+                dead.add(int(key.split("/")[-2]))
+            except ValueError:
+                continue
+    for key, raw in got.items():
         if not key.endswith("/latest"):
             continue
         try:
             rec = json.loads(raw)
-            out[int(rec["replica"])] = rec
+            rid = int(rec["replica"])
         except (ValueError, KeyError, TypeError):
             continue
+        if rid in dead:     # retired (ISSUE 19): the stale last view
+            continue        # must not read as a live replica
+        out[rid] = rec
     return out

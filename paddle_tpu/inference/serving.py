@@ -319,6 +319,13 @@ class ContinuousBatcher:
         self._completed = 0
         self._shed_count = 0
         self._shed_by_class = {c: 0 for c in SLO_CLASSES}
+        # sliding-window shed signal (ISSUE 19 satellite): one 0/1
+        # sample per TERMINAL request (shed=1, delivered=0) in a
+        # bounded window — the rate the router/autoscaler policy reads
+        # is CURRENT pressure, not lifetime history (an old shed burst
+        # ages out as later terminals push it off the window).  Same
+        # bounded-window discipline as the latency deques below
+        self._terminal_window: deque = deque(maxlen=256)
         self._deadline_misses = 0
         self._requeue_count = 0
         self._chunk_retries = 0
@@ -703,6 +710,7 @@ class ContinuousBatcher:
         self._deliver(req, done=True)
         self._shed_count += 1
         self._shed_by_class[req.slo] += 1
+        self._terminal_window.append(1.0)
         from .. import telemetry as _tel
         _tel.counter("serve.shed").inc()         # sink or not
         if _tel.active():
@@ -820,6 +828,7 @@ class ContinuousBatcher:
         come through here — no service, no latency sample."""
         now = self._now()
         req.t_done = now
+        self._terminal_window.append(0.0)
         queue_ms = ((req.t_admit if req.t_admit is not None else now)
                     - req.t_submit) * 1e3
         e2e_ms = (now - req.t_submit) * 1e3
@@ -960,6 +969,16 @@ class ContinuousBatcher:
             return rec["completed"] / (rec["completed"] + shed)
         return None
 
+    @property
+    def shed_rate_window(self) -> float:
+        """Shed fraction over the last 256 TERMINAL requests (ISSUE 19
+        satellite) — the sliding-window twin of the cumulative
+        shed_rate: an old shed burst ages out of this one as later
+        requests deliver, so a routing/autoscaling policy reading it
+        sees CURRENT pressure.  0.0 with no terminal signal yet."""
+        w = self._terminal_window
+        return round(sum(w) / len(w), 4) if w else 0.0
+
     def prefix_match_len(self, input_ids) -> int:
         """Prompt tokens of `input_ids` already resident in THIS
         batcher's prefix cache — the prefill work an admission here
@@ -991,6 +1010,7 @@ class ContinuousBatcher:
             "draining": self._draining,
             "shed_rate": round(self._shed_count / self._submitted, 4)
             if self._submitted else 0.0,
+            "shed_rate_window": self.shed_rate_window,
             "attainment": {c: self._attainment_of(c)
                            for c in SLO_CLASSES},
         }
@@ -1043,6 +1063,7 @@ class ContinuousBatcher:
             "requests_shed": self._shed_count,
             "requests_requeued": self._requeue_count,
             "shed_by_class": dict(self._shed_by_class),
+            "shed_rate_window": self.shed_rate_window,
             "deadline_misses": self._deadline_misses,
             "chunk_retries": self._chunk_retries,
             "hung_chunks": self._hung_chunks,

@@ -49,8 +49,8 @@ from .registry import (counter as _counter, emit as _emit,
                        set_rank)
 
 __all__ = ["init_from_env", "FleetSink", "FleetAggregator",
-           "judge_step", "merge_jsonl_traces", "load_jsonl",
-           "log_segments"]
+           "judge_step", "tombstone_rank", "merge_jsonl_traces",
+           "load_jsonl", "log_segments"]
 
 define_flag("straggler_skew_ms", 0.0,
             "cross-rank per-step wall/arrival skew (ms) above which the "
@@ -226,6 +226,29 @@ class FleetSink:
         self._thread.join(timeout=2.0)
         self._drain()
 
+    def retire(self):
+        """Tombstone this rank on the KV plane and close the sink — a
+        replica retired by a scale-in (ISSUE 19) stops heartbeating on
+        purpose, and without the tombstone its stale summaries would
+        read as a straggler forever."""
+        self.close()
+        tombstone_rank(self._kv, self._job, self._rank)
+
+
+def tombstone_rank(kv, job_id: str, rank: int) -> bool:
+    """Mark `rank` as deliberately retired (scaled in / drained) under
+    ``<job>/fleet/<rank>/tombstone`` — a master-clock stamp, so the
+    retirement time is skew-free.  `FleetAggregator.poll()` drops a
+    tombstoned rank from the judged set and shrinks the effective
+    world, so a scale-in never fires a spurious ``fleet.straggler``."""
+    if isinstance(kv, str):
+        from ..distributed.launch.master import KVClient
+        kv = KVClient(kv)
+    try:
+        return bool(kv.stamp(f"{job_id}/fleet/{rank}/tombstone"))
+    except Exception:
+        return False
+
 
 # ---------------------------------------------------------------------------
 # coordinator side
@@ -343,6 +366,16 @@ class FleetAggregator:
     # -- the driver --------------------------------------------------------
     def poll(self) -> dict:
         got = self._kv.prefix(f"{self._job}/fleet")
+        # tombstones first: a rank retired by a scale-in (ISSUE 19)
+        # stopped heartbeating on purpose — its stale summaries must
+        # not enter the judged set or read as a straggler
+        tombstoned: set = set()
+        for key in got:
+            if key.endswith("/tombstone"):
+                try:
+                    tombstoned.add(int(key.split("/")[-2]))
+                except ValueError:
+                    continue
         per_rank: Dict[int, Dict[int, dict]] = {}
         latest: Dict[int, dict] = {}
         for key, raw in got.items():
@@ -351,15 +384,22 @@ class FleetAggregator:
                 rank = int(rec["rank"])
             except (ValueError, KeyError, TypeError):
                 continue
+            if rank in tombstoned:
+                continue
             if key.endswith("/latest"):
                 latest[rank] = rec
             else:
                 per_rank.setdefault(rank, {})[int(rec["step"])] = rec
+        # a tombstoned rank can never age into the watchdog abort path
+        for rank in tombstoned:
+            self._disarm(rank)
+            self.straggler_counts.pop(rank, None)
+        world_eff = max(1, self.world - len(tombstoned))
 
         stragglers_this_poll: set = set()
         judged_this_poll: List[int] = []
         thr = self._threshold()
-        if len(per_rank) >= self.world:
+        if len(per_rank) >= world_eff:
             common = sorted(set.intersection(
                 *[set(d) for d in per_rank.values()]))
             for s in common:
@@ -438,6 +478,8 @@ class FleetAggregator:
 
         return {
             "world": self.world,
+            "world_effective": world_eff,
+            "tombstoned": sorted(tombstoned),
             "ranks": sorted(per_rank) or sorted(latest),
             "steps_judged": self._last_judged,
             "latest_steps": steps_latest,

@@ -1,0 +1,385 @@
+"""SLO-driven elastic autoscaler (ISSUE 19): the policy state machine
+in isolation, the lease/epoch fencing, crash recovery, fault rollback,
+the deterministic load sim, the sliding-window shed-rate satellite, and
+the CLI selftest wiring.
+
+The contracts under test:
+
+  * POLICY — `decide` over synthetic fleet views: the hysteresis
+    window gates a scale-out, oscillating load never produces an
+    action (streaks are CONSECUTIVE), an executed action's
+    stabilization cooldown blocks the opposite kind (no flap by
+    construction), floor repair bypasses every gate, the scale-in
+    victim is least-work/newest-id, role repair flips the least-loaded
+    donor.
+  * FENCING — the lease is per-daemon advisory (second daemon gets
+    no_lease; an expired lease is taken over), the per-epoch `put_new`
+    journal claim is the true fence (a foreign record is stepped past,
+    never rewritten).
+  * RECOVERY — a daemon crashing between execute and commit leaves a
+    pending record; the next incarnation completes it (status done,
+    recovered_by) WITHOUT re-executing the drain.
+  * ROLLBACK — exhausted retries on autoscale.drain / autoscale.reform
+    roll the action back: the target returns to rotation, the fleet
+    shape is unchanged, the journal records the error.
+  * SIM — DiurnalLoadSim is reproducible from (seed, tick) alone,
+    independent of call order.
+  * SHED WINDOW (satellite) — a shed burst ages out of
+    `shed_rate_window` as later terminals push it off, while the
+    cumulative shed_rate keeps the history.
+  * CLI (satellite) — `autoscale_report --selftest` and
+    `chaos_check --autoscale --selftest` exit 0 (tier-1 wiring).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import telemetry
+from paddle_tpu.distributed import fault
+from paddle_tpu.fleet import (Action, AutoscalePolicy, AutoscalerDaemon,
+                              DiurnalLoadSim, PolicyState, after_action,
+                              decide, fleet_view, observe)
+from paddle_tpu.fleet.autoscaler import _LocalKV, _SimulatedCrash
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.inference import ContinuousBatcher, ServeRouter
+from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                     llama_tiny_config)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(7)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            intermediate_size=128,
+                            num_attention_heads=4,
+                            num_key_value_heads=2, vocab_size=128)
+    return LlamaForCausalLM(cfg)
+
+
+def _bat(model, **kw):
+    geom = dict(max_batch_size=1, max_len=64, chunk=4, prefill_chunk=4)
+    geom.update(kw)
+    return ContinuousBatcher(model, **geom)
+
+
+@pytest.fixture()
+def autoscale_on():
+    set_flags({"FLAGS_autoscale": True})
+    try:
+        yield
+    finally:
+        set_flags({"FLAGS_autoscale": False})
+
+
+def _fv(occ, reps=2, draining=(), work=None, att=None, shed=0.0,
+        roles=None):
+    """Synthetic fleet view for the pure-policy tests."""
+    replicas = []
+    for i in range(reps):
+        q = (work or {}).get(i, 0)
+        replicas.append({"replica": i,
+                         "role": (roles or {}).get(i, "serve"),
+                         "draining": i in draining,
+                         "queued": q, "active": 0,
+                         "attainment_interactive": att})
+    routable = reps - len(set(draining) & set(range(reps)))
+    return {"replicas": replicas, "routable": routable,
+            "slots": routable, "queued": sum(
+                (work or {}).values()), "active": 0,
+            "occupancy": occ, "attainment_interactive": att,
+            "shed_rate_window": shed}
+
+
+# ---------------------------------------------------------------------------
+# policy state machine in isolation (no fleet, no KV)
+# ---------------------------------------------------------------------------
+
+def test_hysteresis_window_gates_scale_out():
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4, window=2,
+                          cooldown=0, queue_high=1.0, queue_low=0.2)
+    st = PolicyState()
+    observe(st, _fv(2.0), pol)
+    assert decide(_fv(2.0), pol, st).kind == "none"
+    observe(st, _fv(2.0), pol)
+    act = decide(_fv(2.0), pol, st)
+    assert act.kind == "scale_out", act
+
+
+def test_oscillating_load_never_acts():
+    """Pressured/idle alternating every tick: both streaks keep
+    resetting, so a window-2 policy NEVER reaches an action — the
+    hysteresis is what forbids the flap at the source."""
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4, window=2,
+                          cooldown=0, queue_high=1.0, queue_low=0.5)
+    st = PolicyState()
+    for t in range(20):
+        view = _fv(2.0 if t % 2 == 0 else 0.0, reps=2)
+        observe(st, view, pol)
+        assert decide(view, pol, st).kind == "none", t
+
+
+def test_stabilization_cooldown_blocks_opposite_kind():
+    """After an executed scale_out, an immediate idle phase must wait
+    out the cooldown before the opposite scale_in fires — the
+    stabilization window covers BOTH directions."""
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4, window=1,
+                          cooldown=3, queue_high=1.0, queue_low=0.5)
+    st = PolicyState()
+    observe(st, _fv(2.0, reps=2), pol)
+    act = decide(_fv(2.0, reps=2), pol, st)
+    assert act.kind == "scale_out"
+    after_action(st, act, pol)
+    assert st.cooling("scale_in") and st.cooling("scale_out")
+    idle = _fv(0.0, reps=3)
+    kinds = []
+    for _ in range(4):
+        observe(st, idle, pol)
+        kinds.append(decide(idle, pol, st).kind)
+    assert kinds == ["none", "none", "scale_in", "scale_in"], kinds
+
+
+def test_floor_repair_bypasses_every_gate():
+    """routable < min is an availability incident: no hysteresis, no
+    cooldown — and a draining replica is revived (undrain is free)
+    over spawning fresh."""
+    pol = AutoscalePolicy(min_replicas=2, max_replicas=4, window=5,
+                          cooldown=5, queue_high=1.0, queue_low=0.2)
+    st = PolicyState()
+    st.cooldowns["scale_out"] = 99           # mid-cooldown, streak 0
+    act = decide(_fv(0.0, reps=3, draining=(1, 2)), pol, st)
+    assert act.kind == "scale_out" and act.replica == 1, act
+    act = decide(_fv(0.0, reps=1), pol, st)
+    assert act.kind == "scale_out" and act.replica is None, act
+
+
+def test_scale_in_victim_least_work_newest_on_tie():
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4, window=1,
+                          cooldown=0, queue_high=9.0, queue_low=0.5)
+    st = PolicyState()
+    observe(st, _fv(0.0, reps=3), pol)
+    act = decide(_fv(0.0, reps=3, work={0: 4, 1: 0, 2: 0}), pol, st)
+    assert act.kind == "scale_in" and act.replica == 2, act
+
+
+def test_role_repair_flips_least_loaded_donor():
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4, window=1,
+                          cooldown=0, queue_high=9.0, queue_low=0.0,
+                          target_roles={"serve": 1, "decode": 1})
+    st = PolicyState()
+    act = decide(_fv(0.5, reps=2, work={0: 3, 1: 1}), pol, st)
+    assert act.kind == "role_flip" and act.replica == 1 \
+        and act.role == "decode", act
+
+
+# ---------------------------------------------------------------------------
+# lease + epoch fencing
+# ---------------------------------------------------------------------------
+
+def test_lease_second_daemon_fenced_out(model, autoscale_on):
+    kv = _LocalKV()
+    router = ServeRouter(batchers=[_bat(model)])
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=2,
+                          lease_ttl_s=1000.0)
+    d1 = AutoscalerDaemon(router, kv=kv, policy=pol, daemon_id="a")
+    d2 = AutoscalerDaemon(router, kv=kv, policy=pol, daemon_id="b")
+    assert d1.tick()["status"] != "no_lease"
+    assert d2.tick()["status"] == "no_lease"
+    assert d1.tick()["status"] != "no_lease"     # refresh still holds
+
+
+def test_expired_lease_taken_over(model, autoscale_on):
+    kv = _LocalKV()
+    router = ServeRouter(batchers=[_bat(model)])
+    d1 = AutoscalerDaemon(
+        router, kv=kv, daemon_id="a",
+        policy=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                               lease_ttl_s=0.0))
+    d2 = AutoscalerDaemon(
+        router, kv=kv, daemon_id="b",
+        policy=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                               lease_ttl_s=1000.0))
+    assert d1.tick()["status"] != "no_lease"
+    takeovers = telemetry.counter("autoscaler.lease_takeovers").value
+    assert d2.tick()["status"] != "no_lease"     # expired: taken over
+    assert telemetry.counter("autoscaler.lease_takeovers").value \
+        == takeovers + 1
+    assert d1.tick()["status"] == "no_lease"     # b's lease is live
+
+
+def test_epoch_claim_steps_past_foreign_record(model):
+    """put_new on the journal key is the fence: a foreign epoch-0
+    record survives byte-identical and the claim lands on epoch 1."""
+    router = ServeRouter(batchers=[_bat(model)])
+    d = AutoscalerDaemon(router)
+    foreign = json.dumps({"epoch": 0, "owner": "other",
+                          "status": "done", "kind": "scale_out"})
+    assert d.kv.put_new(d._journal_key(0), foreign)
+    epoch = d._claim_epoch(Action("scale_out"), {})
+    assert epoch == 1
+    assert d.kv.get(d._journal_key(0)) == foreign
+    recs = d.journal()
+    assert [r["epoch"] for r in recs] == [0, 1]
+    assert recs[1]["status"] == "pending"
+
+
+# ---------------------------------------------------------------------------
+# crash recovery + fault rollback (real fleet, _LocalKV)
+# ---------------------------------------------------------------------------
+
+def _idle_policy(**kw):
+    """Empty fleet reads as idle immediately: window 1, occ 0 < 0.9."""
+    base = dict(min_replicas=1, max_replicas=3, window=1, cooldown=0,
+                queue_high=9.0, queue_low=0.9, retry_budget=2,
+                backoff_s=0.0, lease_ttl_s=0.0)
+    base.update(kw)
+    return AutoscalePolicy(**base)
+
+
+def test_crash_before_commit_recovers_without_reexecution(
+        model, autoscale_on):
+    router = ServeRouter(batchers=[_bat(model), _bat(model)])
+    d1 = AutoscalerDaemon(router, policy=_idle_policy(), daemon_id="a")
+    d1._crash_before_commit = True
+    with pytest.raises(_SimulatedCrash):
+        d1.tick()
+    (rec,) = d1.journal()
+    assert rec["status"] == "pending" and rec["kind"] == "scale_in"
+    victim = rec["replica"]
+    assert router._reps[victim].draining      # the drain DID land
+    drains = telemetry.counter("router.drains").value
+    d2 = AutoscalerDaemon(router, kv=d1.kv, policy=_idle_policy(),
+                          daemon_id="b")
+    out = d2.tick()
+    assert out["status"] != "no_lease", out
+    (rec,) = d2.journal()
+    assert rec["status"] == "done", rec       # completed, not redone
+    assert rec["recovered_by"] == "b"
+    assert telemetry.counter("router.drains").value == drains, \
+        "recovery re-executed the drain (double-execution fence broke)"
+
+
+def test_recover_rolls_back_scale_out_that_never_happened(
+        model, autoscale_on):
+    router = ServeRouter(batchers=[_bat(model)])
+    d = AutoscalerDaemon(router, policy=_idle_policy(), daemon_id="a")
+    d.kv.put_new(d._journal_key(0), json.dumps({
+        "epoch": 0, "owner": "dead", "status": "pending",
+        "kind": "scale_out", "replica": None,
+        "fleet_before": len(router._reps)}))
+    assert d.recover() == 1
+    (rec,) = d.journal()
+    assert rec["status"] == "rolled_back"
+    assert rec["recovered_by"] == "a"
+    assert len(router._reps) == 1             # nothing spawned
+
+
+def test_drain_fault_rolls_back_and_returns_replica(
+        model, autoscale_on):
+    router = ServeRouter(batchers=[_bat(model), _bat(model)])
+    d = AutoscalerDaemon(router, policy=_idle_policy(), daemon_id="a")
+    rollbacks = telemetry.counter("autoscaler.rollback").value
+    with fault.scope("autoscale.drain:times=*:mode=error"):
+        out = d.tick()
+    assert out["status"] == "rolled_back", out
+    assert not any(r.draining for r in router._reps)
+    assert len([r for r in router._reps if not r.dead]) == 2
+    (rec,) = d.journal()
+    assert rec["status"] == "rolled_back" and rec["error"], rec
+    assert telemetry.counter("autoscaler.rollback").value \
+        == rollbacks + 1
+
+
+def test_reform_fault_rolls_back_scale_out(model, autoscale_on):
+    router = ServeRouter(batchers=[_bat(model)])
+    d = AutoscalerDaemon(
+        router, spawn=lambda: _bat(model), daemon_id="a",
+        policy=_idle_policy(queue_high=1.5, queue_low=0.1))
+    rng = np.random.RandomState(4)
+    for _ in range(3):
+        router.submit(rng.randint(1, 128, 6).astype(np.int32), 4)
+    with fault.scope("autoscale.reform:times=*:mode=error"):
+        out = d.tick()
+    assert out["status"] == "rolled_back", out
+    assert len(router._reps) == 1             # fleet shape unchanged
+    outs = router.run()                       # the work still completes
+    assert len(outs) == 3 and router.stats()["requests_shed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# DiurnalLoadSim determinism
+# ---------------------------------------------------------------------------
+
+def test_diurnal_sim_reproducible_and_order_independent():
+    a = DiurnalLoadSim(vocab=128, seed=3, period=6, low=1, high=6)
+    b = DiurnalLoadSim(vocab=128, seed=3, period=6, low=1, high=6)
+    b.requests(5)                 # call order must not matter
+    for t in (0, 3, 5):
+        ra, rb = a.requests(t), b.requests(t)
+        assert len(ra) == len(rb) == a.rate(t)
+        for x, y in zip(ra, rb):
+            np.testing.assert_array_equal(x["prompt"], y["prompt"])
+            assert x["slo"] == y["slo"]
+    assert a.rate(3) == 6 and a.rate(0) == 1  # peak/trough of the day
+    c = DiurnalLoadSim(vocab=128, seed=4, period=6, low=1, high=6)
+    assert any(not np.array_equal(x["prompt"], y["prompt"])
+               for x, y in zip(a.requests(3), c.requests(3)))
+
+
+# ---------------------------------------------------------------------------
+# sliding-window shed rate (satellite): the burst ages out
+# ---------------------------------------------------------------------------
+
+def test_shed_window_ages_out_while_cumulative_persists(model):
+    bat = _bat(model, max_batch_size=4, max_len=16)
+    rng = np.random.RandomState(9)
+    p = rng.randint(1, 128, 2).astype(np.int32)
+    set_flags({"FLAGS_serve_queue_depth": 1})
+    try:
+        for _ in range(3):                    # 2 of these shed
+            bat.submit(p, 1, slo="best_effort")
+    finally:
+        set_flags({"FLAGS_serve_queue_depth": 0})
+    bat.run()
+    assert bat.stats()["requests_shed"] == 2
+    assert bat.shed_rate_window > 0.0
+    for _ in range(256):                      # push the burst off
+        bat.submit(p, 1)
+    bat.run()
+    view = bat.router_view()
+    assert view["shed_rate_window"] == 0.0, view
+    assert view["shed_rate"] > 0.0, view      # history NOT rewritten
+    assert bat.stats()["requests_shed"] == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI selftest wiring (satellite 5)
+# ---------------------------------------------------------------------------
+
+def test_autoscale_report_selftest_cli():
+    """Tier-1 wiring: the journal report CLI drives a diurnal fleet
+    in-process and validates >= 1 scale-out + >= 1 scale-in, flap
+    count 0, every record terminal — exit 0."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import autoscale_report as cli
+    finally:
+        sys.path.pop(0)
+    assert cli.main(["--selftest"]) == 0
+
+
+def test_chaos_autoscale_selftest_cli():
+    """Tier-1 wiring: daemon kill mid-drain, drained-replica kill,
+    decide fault, reform fault — fleet converges, outputs bit-exact vs
+    the fixed-fleet reference, no double-executed epoch — exit 0."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_check as cli
+    finally:
+        sys.path.pop(0)
+    assert cli.main(["--autoscale", "--selftest"]) == 0

@@ -284,6 +284,45 @@ class TestAggregator:
             set_flags({"FLAGS_stop_check_timeout": 0})
             agg.close()
 
+    def test_tombstoned_rank_never_reads_as_straggler(self, kv_store):
+        """ISSUE 19 satellite: a rank retired by a scale-in tombstones
+        itself — its stale summaries leave the judged set, the
+        effective world shrinks so the survivors' steps keep being
+        judged, and no spurious fleet.straggler ever fires."""
+        from paddle_tpu.telemetry.fleet import tombstone_rank
+        for step in (1, 2):
+            for rank in (0, 1):
+                _publish(kv_store, rank, step, 10.0)
+        # rank 1 retires mid-run through the sink's own retire() path
+        s = FleetSink(kv_store, job_id="j", rank=1, world=2, every=1)
+        s.retire()
+        assert kv_store.get("j/fleet/1/tombstone") is not None
+        # the survivor keeps stepping alone; rank 1's stale summaries
+        # are still on the plane
+        for step in (3, 4):
+            _publish(kv_store, 0, step, 10.0)
+        probe = telemetry.add_sink(telemetry.MemorySink())
+        try:
+            agg = FleetAggregator(kv_store, job_id="j", world=2,
+                                  skew_ms=50.0)
+            agg.straggler_counts[1] = 3       # stale verdicts clear too
+            rep = agg.poll()
+        finally:
+            telemetry.remove_sink(probe)
+        assert not [r for r in probe.records
+                    if r["event"] == "fleet.straggler"]
+        assert rep["tombstoned"] == [1]
+        assert rep["world_effective"] == 1
+        assert rep["ranks"] == [0]
+        assert rep["stragglers"] == {}
+        # the survivor's solo steps WERE judged (world shrank — the
+        # aggregator isn't waiting forever for the retired rank)
+        assert rep["steps_judged"] == 4
+        # idempotent across polls and across a re-retire
+        assert tombstone_rank(kv_store, "j", 1)
+        rep2 = agg.poll()
+        assert rep2["tombstoned"] == [1] and rep2["stragglers"] == {}
+
     def test_desync_on_step_spread(self, kv_store):
         _publish(kv_store, 0, 30, 10.0)
         _publish(kv_store, 1, 1, 10.0)

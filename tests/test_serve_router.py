@@ -436,6 +436,68 @@ def test_drain_replica_graceful(model):
     assert sorted(outs) == sorted(gids)
 
 
+def test_drain_lands_between_pick_and_place(model, monkeypatch):
+    """ISSUE 19 satellite: drain_replica interleaved between
+    pick_replica choosing a replica and _place enqueueing on it — the
+    re-pick guard must route to the survivor, never shed, output
+    bit-exact vs a fault-free single-replica reference."""
+    router = ServeRouter(batchers=[_bat(model), _bat(model)])
+    orig = ServeRouter._place
+    hit = {}
+
+    def racing(self, rr, rep):
+        if "victim" not in hit:
+            hit["victim"] = rep.idx
+            self.drain_replica(rep.idx)     # the race, exactly here
+        return orig(self, rr, rep)
+
+    monkeypatch.setattr(ServeRouter, "_place", racing)
+    rng = np.random.RandomState(5)
+    p = rng.randint(1, 128, 6).astype(np.int32)
+    gid = router.submit(p, 6, slo="interactive")
+    outs = router.run()
+    rr = router._reqs[gid]
+    assert "victim" in hit
+    assert not rr.shed, rr.shed_reason
+    assert rr.replica != hit["victim"]        # landed on the survivor
+    assert router.stats()["requests_shed"] == 0
+    ref = _bat(model)
+    ref.submit(p, 6)
+    (ref_out,) = ref.run().values()
+    np.testing.assert_array_equal(outs[gid], ref_out)
+
+
+def test_drain_lands_just_after_place(model, monkeypatch):
+    """The other interleaving: the request is already enqueued when
+    the drain arrives — it migrates losslessly to the survivor instead
+    of being shed with the drained replica."""
+    router = ServeRouter(batchers=[_bat(model), _bat(model)])
+    orig = ServeRouter._place
+    hit = {}
+
+    def racing(self, rr, rep):
+        out = orig(self, rr, rep)
+        if "victim" not in hit:
+            hit["victim"] = rep.idx
+            self.drain_replica(rep.idx)
+        return out
+
+    monkeypatch.setattr(ServeRouter, "_place", racing)
+    rng = np.random.RandomState(6)
+    p = rng.randint(1, 128, 6).astype(np.int32)
+    gid = router.submit(p, 6, slo="interactive")
+    outs = router.run()
+    rr = router._reqs[gid]
+    assert not rr.shed, rr.shed_reason
+    assert rr.replica != hit["victim"]        # migrated off the drain
+    assert router.stats()["requests_shed"] == 0
+    assert router.stats()["requests_requeued"] >= 1
+    ref = _bat(model)
+    ref.submit(p, 6)
+    (ref_out,) = ref.run().values()
+    np.testing.assert_array_equal(outs[gid], ref_out)
+
+
 def test_all_replicas_draining_sheds_with_no_leak(model):
     router = ServeRouter(batchers=[_bat(model)])
     router.drain_replica(0)
@@ -581,6 +643,33 @@ def test_kv_publish_discover_roundtrip(model):
         bat = _bat(model)
         assert pub.publish(bat.router_view())
         assert 7 in discover_replicas(kv, "routertest")
+    finally:
+        srv.stop()
+
+
+def test_publisher_retire_tombstones_discovery(model):
+    """ISSUE 19 satellite: a retired replica tombstones itself on the
+    KV plane — discover_replicas drops it even though its stale view/
+    heartbeat keys are still there (a scale-in must not look like a
+    crashed replica to any discoverer)."""
+    from paddle_tpu.distributed.launch.master import KVServer, KVClient
+    from paddle_tpu.inference.router import (ReplicaPublisher,
+                                             discover_replicas)
+    srv = KVServer(0).start()
+    try:
+        kv = KVClient(f"127.0.0.1:{srv.port}")
+        pubs = {i: ReplicaPublisher(kv, job_id="retiretest", replica=i)
+                for i in (0, 3)}
+        bat = _bat(model)
+        for pub in pubs.values():
+            assert pub.publish(bat.router_view())
+        assert sorted(discover_replicas(kv, "retiretest")) == [0, 3]
+        assert pubs[3].retire()
+        views = discover_replicas(kv, "retiretest")
+        assert sorted(views) == [0], views
+        # the stale view key is STILL on the plane — the tombstone wins
+        assert kv.get("retiretest/serve/3/latest") is not None
+        assert kv.get("retiretest/serve/3/tombstone") is not None
     finally:
         srv.stop()
 

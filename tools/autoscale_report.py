@@ -1,0 +1,229 @@
+"""Autoscaler action-journal report CLI (ISSUE 19) — the command-line
+face of paddle_tpu.fleet.autoscaler's KV journal, beside fleet_report /
+telemetry_report in the report-CLI family.
+
+    python tools/autoscale_report.py journal.json [--json] [--cooldown N]
+        Render an action journal (a JSON list of journal records, as
+        `AutoscalerDaemon.journal()` returns or `--dump` writes):
+        per-epoch action table (kind, replica, status, who recovered
+        it), attainment/occupancy before -> after per executed action,
+        the rollback ledger, and the FLAP COUNT — adjacent executed
+        actions of opposite kinds (scale_out then scale_in or vice
+        versa) within `--cooldown` epochs of each other, which a
+        correctly-hysteresised policy never produces.
+
+    python tools/autoscale_report.py --selftest
+        CI canary: drives a deterministic diurnal fleet in-process
+        (DiurnalLoadSim -> ServeRouter -> AutoscalerDaemon), renders
+        its journal, and validates: (a) >= 1 scale-out and >= 1
+        scale-in executed, (b) flap count == 0, (c) every journal
+        record terminal (done/rolled_back — nothing pending), (d)
+        epochs strictly increasing with no duplicates, (e) zero shed
+        requests.  Exit 1 on any violation.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def flap_count(records, cooldown: int = 1) -> int:
+    """Opposite executed scale actions closer together than the policy
+    cooldown — the oscillation the hysteresis window + stabilization
+    cooldown exist to forbid.  Distance is measured in daemon TICKS
+    (the journal's `tick` field; epoch order as a fallback for old
+    journals — epochs are per-action, not per-tick).  Role flips and
+    rollbacks don't count (a rollback changed nothing)."""
+    opposite = {"scale_out": "scale_in", "scale_in": "scale_out"}
+    done = [r for r in records
+            if r.get("status") == "done"
+            and r.get("kind") in opposite]
+    flaps = 0
+    for a, b in zip(done, done[1:]):
+        if b["kind"] != opposite[a["kind"]]:
+            continue
+        if a.get("tick") is not None and b.get("tick") is not None:
+            dist = int(b["tick"]) - int(a["tick"])
+        else:
+            dist = int(b["epoch"]) - int(a["epoch"])
+        if dist < cooldown:
+            flaps += 1
+    return flaps
+
+
+def analyze_journal(records, cooldown: int = 1) -> dict:
+    """Journal records -> the report dict the renderer and the
+    selftest share."""
+    records = sorted(records, key=lambda r: int(r.get("epoch", 0)))
+    epochs = [int(r.get("epoch", 0)) for r in records]
+    by_status, by_kind = {}, {}
+    for r in records:
+        by_status[r.get("status")] = by_status.get(r.get("status"), 0) + 1
+        if r.get("status") == "done":
+            by_kind[r.get("kind")] = by_kind.get(r.get("kind"), 0) + 1
+    return {
+        "actions": len(records),
+        "epochs_unique": len(epochs) == len(set(epochs)),
+        "pending": [e for r, e in zip(records, epochs)
+                    if r.get("status") == "pending"],
+        "by_status": by_status,
+        "executed_by_kind": by_kind,
+        "rollbacks": [r for r in records
+                      if r.get("status") == "rolled_back"],
+        "recovered": [int(r["epoch"]) for r in records
+                      if r.get("recovered_by")],
+        "flaps": flap_count(records, cooldown),
+        "records": records,
+    }
+
+
+def render(report: dict) -> str:
+    lines = []
+    lines.append(f"autoscaler journal: {report['actions']} actions, "
+                 f"executed={report['executed_by_kind']}, "
+                 f"rollbacks={len(report['rollbacks'])}, "
+                 f"recovered={report['recovered']}, "
+                 f"flaps={report['flaps']}")
+    hdr = (f"  {'epoch':>5}  {'kind':<10} {'rep':>4}  {'status':<12} "
+           f"{'occ':>11}  {'att(int)':>13}  reason")
+    lines.append(hdr)
+    for r in report["records"]:
+        vb = r.get("view_before") or {}
+        va = r.get("view_after") or {}
+
+        def fmt(v, key, width=5):
+            x = v.get(key)
+            return f"{x:.2f}" if isinstance(x, (int, float)) else "-"
+        occ = f"{fmt(vb, 'occupancy')}->{fmt(va, 'occupancy')}"
+        att = (f"{fmt(vb, 'attainment_interactive')}->"
+               f"{fmt(va, 'attainment_interactive')}")
+        rep_id = r.get("replica")
+        lines.append(f"  {r.get('epoch', '?'):>5}  "
+                     f"{r.get('kind', '?'):<10} "
+                     f"{'-' if rep_id is None else rep_id:>4}  "
+                     f"{r.get('status', '?'):<12} {occ:>11}  "
+                     f"{att:>13}  {r.get('reason', '')}"
+                     + (f"  [recovered by {r['recovered_by']}]"
+                        if r.get("recovered_by") else "")
+                     + (f"  [error: {r['error']}]"
+                        if r.get("error") else ""))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# selftest
+# ---------------------------------------------------------------------------
+
+def _selftest():
+    """In-process diurnal loop -> journal -> report; returns a list of
+    problem strings (empty = pass)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu.fleet import (AutoscalePolicy, AutoscalerDaemon,
+                                  DiurnalLoadSim)
+    from paddle_tpu.inference import ContinuousBatcher
+    from paddle_tpu.inference.router import ServeRouter
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+
+    paddle.seed(11)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            intermediate_size=128,
+                            num_attention_heads=4,
+                            num_key_value_heads=2, vocab_size=128)
+    model = LlamaForCausalLM(cfg)
+
+    def mk():
+        return ContinuousBatcher(model, max_batch_size=1, max_len=64,
+                                 chunk=4, prefill_chunk=4)
+
+    router = ServeRouter(batchers=[mk(), mk()])
+    policy = AutoscalePolicy(min_replicas=1, max_replicas=3, window=1,
+                             cooldown=2, queue_high=1.0, queue_low=0.8,
+                             lease_ttl_s=0.0)
+    daemon = AutoscalerDaemon(router, policy=policy, spawn=mk)
+    sim = DiurnalLoadSim(vocab=128, seed=3, period=6, low=1, high=6,
+                         prompt_len=6, max_new=4)
+    paddle.set_flags({"FLAGS_autoscale": True})
+    try:
+        for t in range(12):
+            for r in sim.requests(t):
+                router.submit(r["prompt"], r["max_new"], slo=r["slo"])
+            daemon.tick()
+            for _ in range(3):
+                router.step()
+        router.run()
+    finally:
+        paddle.set_flags({"FLAGS_autoscale": False})
+
+    report = analyze_journal(daemon.journal(),
+                             cooldown=policy.cooldown)
+    rendered = render(report)
+    st = router.stats()
+    problems = []
+    if report["executed_by_kind"].get("scale_out", 0) < 1:
+        problems.append("no scale_out executed under the diurnal peak")
+    if report["executed_by_kind"].get("scale_in", 0) < 1:
+        problems.append("no scale_in executed under the diurnal trough")
+    if report["flaps"] != 0:
+        problems.append(f"flap count {report['flaps']} != 0 "
+                        "(hysteresis/cooldown failed)")
+    if report["pending"]:
+        problems.append(f"non-terminal journal records: "
+                        f"{report['pending']}")
+    if not report["epochs_unique"]:
+        problems.append("duplicate journal epochs")
+    if st["requests_shed"]:
+        problems.append(f"{st['requests_shed']} requests shed "
+                        "(the lossless drain contract broke)")
+    if "epoch" not in rendered or "occ" not in rendered:
+        problems.append("render missing the action table")
+    print(rendered)
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render an autoscaler action journal "
+                    "(attainment before/after, rollback ledger, "
+                    "flap count)")
+    ap.add_argument("journal", nargs="?",
+                    help="path to a JSON list of journal records")
+    ap.add_argument("--cooldown", type=int, default=1,
+                    help="epoch distance within which opposite "
+                         "executed actions count as a flap")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--selftest", action="store_true",
+                    help="drive an in-process diurnal fleet and "
+                         "validate the journal contract")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        problems = _selftest()
+        if problems:
+            for p in problems:
+                print(f"PROBLEM: {p}")
+            return 1
+        print("selftest: autoscale journal ok")
+        return 0
+    if not args.journal:
+        ap.error("provide a journal JSON path or --selftest")
+    with open(args.journal) as f:
+        records = json.load(f)
+    report = analyze_journal(records, cooldown=args.cooldown)
+    if args.as_json:
+        slim = dict(report)
+        slim.pop("records")
+        print(json.dumps(slim, indent=2))
+    else:
+        print(render(report))
+    return 0 if not report["pending"] and report["epochs_unique"] \
+        else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

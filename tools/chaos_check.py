@@ -85,6 +85,28 @@ Serve-plane modes (ISSUE 9):
       ELASTIC_EXIT_CODE).  Tier-1-wired
       (tests/test_serve_robustness.py).
 
+Autoscale-plane modes (ISSUE 19 — the SLO-driven elastic loop):
+
+  python tools/chaos_check.py --autoscale --scenario daemon_kill_mid_drain
+      Run the deterministic diurnal serve workload with an
+      AutoscalerDaemon closing the loop, under ONE chaos scenario:
+      `daemon_kill_mid_drain` (the daemon dies after executing a drain
+      but before committing its journal epoch — the next incarnation
+      must complete the pending record, never re-execute it),
+      `drained_replica_kill` (the scale-in victim is killed outright
+      post-decision), `decide_fault` (autoscale.decide faults degrade
+      the tick to a no-op), `reform_fault` (autoscale.reform faults
+      exhaust the retry budget and roll back — target replica returned
+      to rotation, `autoscaler.rollback` emitted).  Every scenario
+      passes iff the fleet converges, every request completes (zero
+      shed — the lossless drain path did its job), outputs are
+      BIT-EXACT vs a fixed-fleet fault-free reference, and the action
+      journal shows no double-executed epoch (epochs unique, all
+      terminal).
+
+  python tools/chaos_check.py --autoscale --selftest
+      All four scenarios.  Tier-1-wired (tests/test_autoscaler.py).
+
   --json     one machine-readable JSON document on stdout
   --steps N  target train steps for --spec runs (default 8)
 """
@@ -638,6 +660,225 @@ def _serve_selftest():
                            ("victim", "migrated", "completed",
                             "requeued", "mismatches", "dup_streams",
                             "kv_leaks")}))
+    return checks
+
+
+# ---------------------------------------------------------------------------
+# autoscale plane (ISSUE 19): the SLO-driven elastic loop under chaos
+# ---------------------------------------------------------------------------
+
+AUTOSCALE_SCENARIOS = ("daemon_kill_mid_drain", "drained_replica_kill",
+                       "decide_fault", "reform_fault")
+_AUTOSCALE_TICKS = 10
+
+
+def _autoscale_sim():
+    from paddle_tpu.fleet import DiurnalLoadSim
+    return DiurnalLoadSim(vocab=128, seed=3, period=6, low=1, high=6,
+                          prompt_len=6, max_new=4)
+
+
+def _autoscale_batcher(model):
+    from paddle_tpu.inference import ContinuousBatcher
+    return ContinuousBatcher(model, max_batch_size=1, max_len=64,
+                             chunk=4, prefill_chunk=4)
+
+
+def _autoscale_policy():
+    from paddle_tpu.fleet import AutoscalePolicy
+    # tight hysteresis/cooldown so the short schedule produces real
+    # actions — queue_low=0.8 makes the tick-0 trough an immediate
+    # scale-in (a DRAIN for the kill/crash scenarios to land on);
+    # lease_ttl_s=0 so a replacement daemon takes over on its first
+    # tick (the epoch journal, not the lease, is the fence)
+    return AutoscalePolicy(min_replicas=1, max_replicas=3, window=1,
+                           cooldown=1, queue_high=1.0, queue_low=0.8,
+                           retry_budget=2, backoff_s=0.0,
+                           lease_ttl_s=0.0)
+
+
+def _autoscale_drive(router, tick_fn, ticks=_AUTOSCALE_TICKS,
+                     steps_per_tick=3):
+    """Drive the deterministic diurnal schedule: submit tick t's
+    request batch, run the daemon hook, a few router rounds; drain at
+    the end.  Returns (gids in submission order, outputs, statuses)."""
+    sim = _autoscale_sim()
+    gids, statuses = [], []
+    for t in range(ticks):
+        for r in sim.requests(t):
+            gids.append(router.submit(r["prompt"], r["max_new"],
+                                      slo=r["slo"]))
+        if tick_fn is not None:
+            statuses.append(tick_fn(t))
+        for _ in range(steps_per_tick):
+            router.step()
+    outs = router.run()
+    return gids, outs, statuses
+
+
+_autoscale_ref_cache = []
+
+
+def _autoscale_reference():
+    """The bit-exactness oracle: the SAME schedule through a FIXED
+    2-replica fleet, no autoscaler — greedy decode is deterministic,
+    so no placement decision may ever change an output."""
+    if not _autoscale_ref_cache:
+        from paddle_tpu.inference.router import ServeRouter
+        model = _serve_model()
+        router = ServeRouter(batchers=[_autoscale_batcher(model)
+                                       for _ in range(2)])
+        gids, outs, _ = _autoscale_drive(router, tick_fn=None)
+        _autoscale_ref_cache.append(
+            [list(map(int, outs[g])) for g in gids])
+    return _autoscale_ref_cache[0]
+
+
+def run_autoscale(scenario):
+    """One autoscale chaos scenario end to end; report dict with
+    report["ok"] the verdict: scenario trigger fired, fleet converged,
+    zero shed, outputs bit-exact vs the fixed-fleet reference, journal
+    epochs unique and terminal (no double-execution)."""
+    if scenario not in AUTOSCALE_SCENARIOS:
+        raise ValueError(f"unknown scenario {scenario!r}; known: "
+                         f"{AUTOSCALE_SCENARIOS}")
+    import paddle_tpu as paddle
+    from paddle_tpu import telemetry
+    from paddle_tpu.distributed import fault
+    from paddle_tpu.fleet import AutoscalerDaemon
+    from paddle_tpu.fleet.autoscaler import _SimulatedCrash
+    from paddle_tpu.inference.router import ServeRouter
+
+    model = _serve_model()
+    ref = _autoscale_reference()
+
+    router = ServeRouter(batchers=[_autoscale_batcher(model)
+                                   for _ in range(2)])
+    policy = _autoscale_policy()
+
+    def spawn():
+        return _autoscale_batcher(model)
+
+    daemons = [AutoscalerDaemon(router, policy=policy, spawn=spawn,
+                                daemon_id="d0")]
+    kv = daemons[0].kv
+    if scenario == "daemon_kill_mid_drain":
+        daemons[0]._crash_before_commit = True
+
+    spec = {"decide_fault":
+            "autoscale.decide:step=1:times=2:mode=error",
+            "reform_fault":
+            "autoscale.reform:times=*:mode=error"}.get(scenario, "")
+    drains = telemetry.counter("router.drains")
+    crash = {"n": 0, "drains_at_crash": None,
+             "drains_after_recovery": None}
+    killed = {"victim": None, "migrated": 0}
+
+    def tick_fn(t):
+        d = daemons[-1]
+        try:
+            st = d.tick()
+        except _SimulatedCrash:
+            # the daemon died between executing a drain and committing
+            # its epoch: a fresh incarnation observes the pending
+            # journal record and completes it — NEVER re-executes
+            crash["n"] += 1
+            crash["drains_at_crash"] = drains.value
+            nd = AutoscalerDaemon(router, kv=kv, policy=policy,
+                                  spawn=spawn,
+                                  daemon_id=f"d{len(daemons)}")
+            daemons.append(nd)
+            st = nd.tick()
+            crash["drains_after_recovery"] = drains.value
+        if scenario == "drained_replica_kill" \
+                and killed["victim"] is None:
+            for rep in router._reps:
+                if rep.draining and not rep.dead:
+                    # the scale-in victim dies outright post-decision:
+                    # its in-flight work must migrate losslessly
+                    killed["victim"] = rep.idx
+                    killed["migrated"] = router.kill_replica(rep.idx)
+                    break
+        return st
+
+    paddle.set_flags({"FLAGS_autoscale": True,
+                      "FLAGS_fault_injection": spec})
+    fault.reset()
+    try:
+        gids, outs, statuses = _autoscale_drive(router, tick_fn)
+        fired = {k: v for k, v in fault.fired_counts().items() if v}
+    finally:
+        paddle.set_flags({"FLAGS_autoscale": False,
+                          "FLAGS_fault_injection": ""})
+        fault.reset()
+
+    got = [list(map(int, outs[g])) for g in gids]
+    mismatches = [i for i, (a, b) in enumerate(zip(got, ref))
+                  if a != b]
+    st = router.stats()
+    journal = daemons[-1].journal()
+    epochs = [r.get("epoch") for r in journal]
+    journal_ok = (len(epochs) == len(set(epochs))
+                  and all(r.get("status") in ("done", "rolled_back")
+                          for r in journal))
+    status_counts = {}
+    for s in statuses:
+        status_counts[s["status"]] = status_counts.get(s["status"], 0) + 1
+    accounting = (sorted(outs) == sorted(gids)
+                  and st["requests_submitted"] == len(gids)
+                  and st["requests_completed"] == len(gids)
+                  and st["requests_shed"] == 0)
+    converged = 1 <= sum(1 for r in router._reps
+                         if not r.dead and not r.draining) \
+        <= policy.max_replicas
+
+    if scenario == "daemon_kill_mid_drain":
+        # exactly one crash, takeover settled the pending epoch without
+        # a second drain, and the record says who recovered it
+        trigger = (crash["n"] == 1
+                   and crash["drains_after_recovery"]
+                   == crash["drains_at_crash"]
+                   and any(r.get("recovered_by") for r in journal))
+    elif scenario == "drained_replica_kill":
+        trigger = killed["victim"] is not None
+    elif scenario == "decide_fault":
+        trigger = (fired.get("autoscale.decide", 0) >= 1
+                   and status_counts.get("degraded", 0) >= 1
+                   and status_counts.get("executed", 0) >= 1)
+    else:   # reform_fault
+        trigger = (fired.get("autoscale.reform", 0) >= 1
+                   and any(r.get("status") == "rolled_back"
+                           for r in journal))
+
+    ok = (trigger and not mismatches and accounting and journal_ok
+          and converged)
+    return {"scenario": scenario, "fired": fired,
+            "trigger_ok": trigger, "crashes": crash["n"],
+            "killed": killed, "statuses": status_counts,
+            "journal": [{k: r.get(k) for k in
+                         ("epoch", "kind", "replica", "status",
+                          "recovered_by")} for r in journal],
+            "completed": st["requests_completed"],
+            "shed": st["requests_shed"],
+            "replicas": st["replicas"],
+            "live_replicas": st["live_replicas"],
+            "mismatches": mismatches, "accounting_ok": accounting,
+            "journal_ok": journal_ok, "converged": converged,
+            "ok": ok}
+
+
+def _autoscale_selftest():
+    """All four autoscale chaos scenarios."""
+    checks = []
+    for scenario in AUTOSCALE_SCENARIOS:
+        rep = run_autoscale(scenario)
+        checks.append({
+            "check": f"autoscale.{scenario.replace('_', '-')}",
+            "fired": rep["trigger_ok"], "recovered": rep["ok"],
+            "detail": json.dumps({k: rep[k] for k in
+                                  ("statuses", "completed", "shed",
+                                   "mismatches", "journal_ok",
+                                   "converged")})})
     return checks
 
 
@@ -1308,12 +1549,53 @@ def main(argv=None):
                          "planes live (--fleet)")
     ap.add_argument("--fleet-reference", action="store_true",
                     help=argparse.SUPPRESS)  # internal: world-1 ref leg
+    ap.add_argument("--autoscale", action="store_true",
+                    help="exercise the AUTOSCALE plane (ISSUE 19): the "
+                         "diurnal serve workload with an "
+                         "AutoscalerDaemon closing the loop, under one "
+                         "chaos scenario (--scenario) or all of them "
+                         "(--selftest)")
+    ap.add_argument("--scenario", choices=AUTOSCALE_SCENARIOS,
+                    help="with --autoscale: the single scenario to run")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
     if args.fleet_worker:
         return fleet_worker_main()
     if args.fleet_reference:
         return fleet_reference_main()
+    if args.autoscale:
+        if args.selftest:
+            checks = _autoscale_selftest()
+            bad = [c for c in checks
+                   if not (c["fired"] and c["recovered"])]
+            if args.as_json:
+                print(json.dumps({"mode": "autoscale-selftest",
+                                  "checks": checks, "ok": not bad},
+                                 indent=2))
+            else:
+                for c in checks:
+                    mark = "ok " if c["fired"] and c["recovered"] \
+                        else "FAIL"
+                    print(f"  [{mark}] {c['check']} "
+                          f"(fired={c['fired']}, "
+                          f"recovered={c['recovered']}) {c['detail']}")
+                print(f"autoscale selftest: {len(checks) - len(bad)}"
+                      f"/{len(checks)} checks passed")
+            return 1 if bad else 0
+        if not args.scenario:
+            ap.error("--autoscale needs --scenario or --selftest")
+        rep = run_autoscale(args.scenario)
+        if args.as_json:
+            print(json.dumps(rep, indent=2))
+        else:
+            verdict = "RECOVERED" if rep["ok"] else "FAILED"
+            print(f"{verdict}: scenario {rep['scenario']}, "
+                  f"statuses={rep['statuses']}, "
+                  f"completed={rep['completed']}, shed={rep['shed']}, "
+                  f"mismatches={rep['mismatches']}, "
+                  f"journal_ok={rep['journal_ok']}, "
+                  f"converged={rep['converged']}")
+        return 0 if rep["ok"] else 1
     if args.fleet:
         if args.selftest:
             checks = _fleet_selftest()
