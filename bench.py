@@ -1447,6 +1447,121 @@ def bench_llama_serve_autoscale():
                  **_peak_hbm_fields()})
 
 
+def bench_llama_serve_disagg():
+    """Disaggregated prefill/decode serving (ISSUE 20): the SAME
+    fixed-size fleet (2 replicas) run role-split — prefill workers
+    freeze finished prompts and stream their KV pages to decode
+    workers, which admit at pos = prompt_len — vs run symmetric, on a
+    mixed long-prefill/short-decode workload sharing a system prompt.
+    Reports aggregate tok/s plus TTFT/TPOT p50 for both fleets and
+    the hand-off counters.  The CPU smoke asserts the topology is
+    REAL: hand-offs > 0, cross-replica prefix-import hits > 0, ZERO
+    prefill tokens ever computed on the decode side, outputs
+    bit-exact vs the symmetric fleet, nothing shed."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    from paddle_tpu.inference import ContinuousBatcher
+    from paddle_tpu.inference.router import ServeRouter
+
+    model, cfg, batch, n_params, roofline = _serving_model()
+    rngm = np.random.RandomState(6)
+    if on_tpu:
+        sys_len, n_req = 256, 16
+        tail_lens = [96, 16, 128, 24] * 4
+        new_toks = [24, 96, 16, 64] * 4
+        chunk, max_len, pchunk, ps = 64, 768, 32, 32
+        rb = max(1, batch // 2)
+    else:
+        sys_len, n_req = 24, 8
+        tail_lens = [10, 4, 12, 5] * 2
+        new_toks = [4, 10, 4, 8] * 2
+        chunk, max_len, pchunk, ps = 4, 64, 4, 8
+        rb = 1
+    sys_prompt = rngm.randint(0, cfg.vocab_size, sys_len) \
+        .astype(np.int32)
+    prompts = [np.concatenate(
+        [sys_prompt, rngm.randint(0, cfg.vocab_size, L)
+         .astype(np.int32)]) for L in tail_lens[:n_req]]
+    geom = dict(max_batch_size=rb, max_len=max_len, chunk=chunk,
+                prefill_chunk=pchunk, page_size=ps)
+    last = {}
+
+    def fleet_once(roles):
+        bats = [ContinuousBatcher(model, **geom) for _ in range(2)]
+        router = ServeRouter(batchers=bats, roles=roles)
+        for p_, n_ in zip(prompts, new_toks):
+            router.submit(p_, n_)
+        t0 = time.perf_counter()
+        outs = router.run()
+        dt = time.perf_counter() - t0
+        last.clear()
+        last.update(stats=router.stats(), outs=outs,
+                    decode=[r.bat.stats() for r in router._reps
+                            if r.role == "decode"])
+        return sum(len(v) for v in outs.values()) / dt
+
+    fleet_once(None)                           # compile (shared progs)
+    base_tok, base_spread, _ = _measure(lambda: fleet_once(None))
+    base = {k: v for k, v in last.items()}
+    fleet_once(["prefill", "decode"])
+    tok_s, spread, vals = _measure(
+        lambda: fleet_once(["prefill", "decode"]))
+    st, outs = last["stats"], last["outs"]
+
+    def _p50(s, k):
+        lat = s["stats"]["latency"].get(k) or {}
+        return float(lat.get("p50") or 0.0)
+
+    ttft, tpot = _p50(last, "ttft_ms"), _p50(last, "tpot_ms")
+    base_ttft, base_tpot = _p50(base, "ttft_ms"), _p50(base, "tpot_ms")
+    cross = int(st["cross_prefix_hit_tokens"])
+    if not on_tpu:
+        # CPU smoke: the disaggregation must be REAL and lossless
+        assert st["handoffs"] > 0, st
+        assert st["handoff_staged"] == 0, st
+        assert cross > 0, st
+        assert st["requests_shed"] == 0, st
+        assert st["requests_completed"] == n_req, st
+        for ds in last["decode"]:
+            assert ds["prefill_tokens"] == 0, \
+                "decode worker recomputed prefill after hand-off"
+        assert set(outs) == set(base["outs"])
+        # role-split must not change a single sampled token
+        for g in outs:
+            assert np.array_equal(outs[g], base["outs"][g]), g
+    else:
+        # the perf contract is an accelerator property: on CPU the
+        # host-plane hand-off (ms-scale page gather/scatter) swamps
+        # the scheduling win the split buys on real prefill/decode
+        # interference, so tok/s and TTFT gate on TPU only
+        assert tok_s >= base_tok, (tok_s, base_tok)
+        assert ttft <= base_ttft, (ttft, base_ttft)
+    vs_sym = tok_s / max(base_tok, 1e-9)
+    _emit("llama_serve_disagg_tokens_per_sec", tok_s,
+          f"aggregate tok/s, {n_req} mixed reqs sharing a "
+          f"{sys_len}-token system prompt on a FIXED 2x{rb}-slot "
+          f"fleet split prefill/decode; handoffs={st['handoffs']} "
+          f"({st['handoff_bytes']}B, p50="
+          f"{st['handoff_ms']['p50']}ms), cross_prefix_hits={cross} "
+          f"tok, ttft p50={ttft:.1f}ms (sym {base_ttft:.1f}ms), "
+          f"tpot p50={tpot:.1f}ms (sym {base_tpot:.1f}ms), "
+          f"vs_symmetric={vs_sym:.2f}x",
+          tok_s / max(roofline, 1e-9), spread, vals,
+          extra={"replicas": 2, "slots_per_replica": rb,
+                 "handoffs": st["handoffs"],
+                 "handoff_bytes": st["handoff_bytes"],
+                 "handoff_ms": st["handoff_ms"],
+                 "cross_prefix_hit_tokens": cross,
+                 "replicated_pages": st["replicated_pages"],
+                 "ttft_ms_p50": round(ttft, 3),
+                 "tpot_ms_p50": round(tpot, 3),
+                 "symmetric_ttft_ms_p50": round(base_ttft, 3),
+                 "symmetric_tpot_ms_p50": round(base_tpot, 3),
+                 "vs_symmetric_fleet": round(vs_sym, 3),
+                 "symmetric_tokens_per_sec": round(base_tok, 1),
+                 **_peak_hbm_fields()})
+
+
 def bench_serve_all():
     """BENCH_CONFIG=serve runs the mixed-length leg, the prefix-shared
     leg, the speculative leg, the serve-fleet router leg AND the
@@ -1458,6 +1573,7 @@ def bench_serve_all():
     bench_llama_serve_speculative()
     bench_llama_serve_fleet()
     bench_llama_serve_autoscale()
+    bench_llama_serve_disagg()
 
 
 CONFIGS = {
@@ -1495,6 +1611,10 @@ _ALIASES = {
     "serve_autoscale": "serve",
     "llama_serve_autoscale": "serve",
     "llama_serve_autoscale_tokens_per_sec": "serve",
+    "disagg": "serve",
+    "serve_disagg": "serve",
+    "llama_serve_disagg": "serve",
+    "llama_serve_disagg_tokens_per_sec": "serve",
     "llama_decode": "decode",
     "llama_decode_tokens_per_sec_per_chip": "decode",
     "llama_train_tokens_per_sec_per_chip": "llama",
@@ -2070,6 +2190,75 @@ def _assert_autoscale_zero_overhead():
         "serve-step HLO changed after the autoscale flag round-trip"
 
 
+def _assert_disagg_zero_overhead():
+    """ISSUE 20 flags-off contract: disaggregation must cost NOTHING
+    when unused.  With FLAGS_serve_disagg off a unified serve run —
+    hand-off/replication code imported, a whole router fleet behind
+    it — leaves the single-batcher serve program-cache keys and
+    lowered HLO byte-identical across the flag round-trip, compiles
+    ZERO page export/import programs, and the no-op replication sweep
+    issues zero KV-plane verbs.  Cheap (1-layer tiny llama); runs
+    before every bench config."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.inference import ContinuousBatcher
+    from paddle_tpu.inference.generation import _program_cache_contains
+    from paddle_tpu.inference.router import ServeRouter
+    from paddle_tpu.inference.serving import (pack_handoff,   # noqa: F401
+                                              unpack_handoff)
+
+    paddle.seed(3)
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=32,
+                            intermediate_size=64,
+                            num_attention_heads=2,
+                            num_key_value_heads=2, vocab_size=64)
+    model = LlamaForCausalLM(cfg)
+    geom = dict(max_batch_size=2, max_len=32, chunk=4, prefill_chunk=4)
+
+    def fingerprint():
+        bat = ContinuousBatcher(model, **geom)
+        keys = (bat._program_key(1, bat.chunk),
+                bat._program_key(bat.prefill_chunk, bat.admit_steps))
+        hlo = (bat.lower_step(mixed=False).as_text(),
+               bat.lower_step(mixed=True).as_text())
+        return bat, keys, hlo
+
+    bat0, keys_off, hlo_off = fingerprint()
+    page_keys = [("serve_page_export", bat0.num_pages, bat0.page_size,
+                  bat0.pages_per_slot, bat0._kv_dtype),
+                 ("serve_page_import", bat0.num_pages, bat0.page_size,
+                  bat0.pages_per_slot, bat0._kv_dtype)]
+    # a flags-off unified fleet run: no role ever set, so no freeze,
+    # no hand-off, no page program may compile
+    rng = np.random.RandomState(1)
+    router = ServeRouter(batchers=[ContinuousBatcher(model, **geom)
+                                   for _ in range(2)])
+    for L in (5, 7, 6):
+        router.submit(rng.randint(1, 64, L).astype(np.int32), 4)
+    outs = router.run()
+    assert len(outs) == 3 and router.stats()["handoffs"] == 0
+    for k in page_keys:
+        assert not _program_cache_contains(model, k), \
+            f"flags-off serve compiled a hand-off page program: {k}"
+    set_flags({"FLAGS_serve_disagg": True,
+               "FLAGS_router_migration_budget": 4})
+    try:
+        _, keys_on, hlo_on = fingerprint()
+    finally:
+        set_flags({"FLAGS_serve_disagg": False,
+                   "FLAGS_router_migration_budget": 0})
+    assert keys_off == keys_on, \
+        f"FLAGS_serve_disagg leaked into serve program keys: " \
+        f"{keys_off} vs {keys_on}"
+    assert hlo_off == hlo_on, \
+        "FLAGS_serve_disagg changed the lowered serve-step HLO"
+    _, keys_off2, hlo_off2 = fingerprint()
+    assert keys_off == keys_off2 and hlo_off == hlo_off2, \
+        "serve programs changed after the disagg flag round-trip"
+
+
 def _assert_decode_roofline_zero_overhead():
     """ISSUE 11 flags-off contract: FLAGS_weight_only_dtype and the
     speculation flags leave the flags-off programs byte-identical.
@@ -2171,6 +2360,7 @@ def _assert_decode_roofline_zero_overhead():
 def main():
     _assert_serve_robustness_zero_overhead()
     _assert_autoscale_zero_overhead()
+    _assert_disagg_zero_overhead()
     _assert_decode_roofline_zero_overhead()
     _assert_analysis_zero_overhead()
     _assert_fault_tolerance_zero_overhead()

@@ -121,7 +121,7 @@ class AutoscalePolicy:
     __slots__ = ("min_replicas", "max_replicas", "queue_high",
                  "queue_low", "attainment_floor", "shed_high",
                  "window", "cooldown", "retry_budget", "backoff_s",
-                 "lease_ttl_s", "target_roles")
+                 "lease_ttl_s", "target_roles", "role_imbalance")
 
     def __init__(self, min_replicas: Optional[int] = None,
                  max_replicas: Optional[int] = None,
@@ -133,7 +133,8 @@ class AutoscalePolicy:
                  cooldown: Optional[int] = None,
                  retry_budget: int = 3, backoff_s: float = 0.0,
                  lease_ttl_s: Optional[float] = None,
-                 target_roles: Optional[Dict[str, int]] = None):
+                 target_roles: Optional[Dict[str, int]] = None,
+                 role_imbalance: Optional[float] = None):
         def flag(name, fallback):
             v = get_flag(name)
             return fallback if v is None else v
@@ -160,6 +161,14 @@ class AutoscalePolicy:
             lease_ttl_s if lease_ttl_s is not None
             else flag("autoscale_lease_ttl_s", 5.0))
         self.target_roles = dict(target_roles) if target_roles else None
+        # ISSUE 20: dynamic role repair — how many times MORE pressure
+        # one side of a disaggregated fleet must carry (sustained for
+        # `window` ticks) before a replica of the relaxed role flips
+        # over.  0 disables; only acts when the fleet actually has
+        # both prefill and decode replicas
+        self.role_imbalance = float(
+            role_imbalance if role_imbalance is not None
+            else flag("autoscale_role_imbalance", 2.0))
 
 
 class PolicyState:
@@ -168,11 +177,17 @@ class PolicyState:
     (ticks remaining).  Mutated only by `observe`/`after_action` —
     `decide` reads it and stays pure."""
 
-    __slots__ = ("pressure_streak", "idle_streak", "cooldowns")
+    __slots__ = ("pressure_streak", "idle_streak", "prefill_streak",
+                 "decode_streak", "cooldowns")
 
     def __init__(self):
         self.pressure_streak = 0
         self.idle_streak = 0
+        # consecutive ticks of one-sided role pressure in a
+        # disaggregated fleet (ISSUE 20): prefill_streak counts ticks
+        # the prefill side out-pressured decode by policy.role_imbalance
+        self.prefill_streak = 0
+        self.decode_streak = 0
         self.cooldowns: Dict[str, int] = {}
 
     def cooling(self, kind: str) -> bool:
@@ -208,6 +223,25 @@ def observe(state: PolicyState, view: dict,
     else:
         state.pressure_streak = 0
         state.idle_streak = 0
+    # role-imbalance streaks (ISSUE 20): only meaningful when the
+    # fleet view carries BOTH sides' pressure signals (a unified
+    # fleet publishes neither) and the policy enables repair
+    pp = view.get("prefill_pressure")
+    dp = view.get("decode_pressure")
+    ratio = policy.role_imbalance
+    if ratio > 0 and pp is not None and dp is not None:
+        if pp > dp * ratio and pp > 0:
+            state.prefill_streak += 1
+            state.decode_streak = 0
+        elif dp > pp * ratio and dp > 0:
+            state.decode_streak += 1
+            state.prefill_streak = 0
+        else:
+            state.prefill_streak = 0
+            state.decode_streak = 0
+    else:
+        state.prefill_streak = 0
+        state.decode_streak = 0
     return state
 
 
@@ -228,6 +262,8 @@ def after_action(state: PolicyState, action: Action,
             state.cooldowns[opp] = policy.cooldown
         state.pressure_streak = 0
         state.idle_streak = 0
+        state.prefill_streak = 0
+        state.decode_streak = 0
     return state
 
 
@@ -282,6 +318,37 @@ def decide(view: dict, policy: AutoscalePolicy,
                           role=under[0],
                           reason=f"roles: {have} -> {want}")
 
+    if not policy.target_roles and policy.role_imbalance > 0 \
+            and not state.cooling("role_flip"):
+        # dynamic role repair (ISSUE 20): sustained one-sided pressure
+        # in a disaggregated fleet flips the least-loaded replica of
+        # the relaxed role — never below one replica per role (a fleet
+        # with no prefill worker admits nothing; one with no decode
+        # worker deadlocks its hand-offs into the unfreeze fallback)
+        pre = [r for r in routable if r.get("role") == "prefill"]
+        dec = [r for r in routable if r.get("role") == "decode"]
+        if pre and dec:
+            def load(r):
+                return (float(r.get("queued") or 0)
+                        + float(r.get("active") or 0),
+                        -int(r["replica"]))
+            if state.prefill_streak >= policy.window and len(dec) > 1:
+                victim = min(dec, key=load)
+                return Action(
+                    "role_flip", replica=int(victim["replica"]),
+                    role="prefill",
+                    reason=f"prefill pressure x{state.prefill_streak} "
+                           f"(pp={view.get('prefill_pressure')} "
+                           f"dp={view.get('decode_pressure')})")
+            if state.decode_streak >= policy.window and len(pre) > 1:
+                victim = min(pre, key=load)
+                return Action(
+                    "role_flip", replica=int(victim["replica"]),
+                    role="decode",
+                    reason=f"decode pressure x{state.decode_streak} "
+                           f"(pp={view.get('prefill_pressure')} "
+                           f"dp={view.get('decode_pressure')})")
+
     if state.pressure_streak >= policy.window \
             and n < policy.max_replicas \
             and not state.cooling("scale_out"):
@@ -329,10 +396,11 @@ def fleet_view(router) -> dict:
             "draining": bool(v.get("draining")),
             "queued": int(v.get("queued") or 0),
             "active": int(v.get("active") or 0),
+            "handoff_ready": int(v.get("handoff_ready") or 0),
             "attainment_interactive":
                 (v.get("attainment") or {}).get("interactive"),
         })
-    return {
+    out = {
         "replicas": reps,
         "routable": len(routable),
         "slots": slots,
@@ -342,18 +410,46 @@ def fleet_view(router) -> dict:
         "attainment_interactive": min(atts) if atts else None,
         "shed_rate_window": round(max(sheds), 4) if sheds else 0.0,
     }
+    # disaggregated split (ISSUE 20): prefill demand is queued work
+    # plus live prompt chunks — a slot FROZEN for hand-off is finished
+    # prefill waiting on a decode slot, so it leaves the prefill side
+    # and counts toward DECODE demand (the hand-off backlog) instead
+    pre = [v for v in routable if v.get("role") == "prefill"]
+    dec = [v for v in routable if v.get("role") == "decode"]
+    if pre and dec:
+        frozen = sum(int(v.get("handoff_ready") or 0) for v in pre)
+        pre_work = sum(int(v.get("queued") or 0)
+                       + int(v.get("active") or 0) for v in pre) - frozen
+        pre_slots = sum(int(v.get("slots") or 0) for v in pre)
+        dec_work = sum(int(v.get("queued") or 0)
+                       + int(v.get("active") or 0)
+                       for v in dec) + frozen
+        dec_slots = sum(int(v.get("slots") or 0) for v in dec)
+        out.update(
+            handoff_ready=frozen,
+            prefill_pressure=round(pre_work / pre_slots, 4)
+            if pre_slots else (99.0 if pre_work else 0.0),
+            decode_pressure=round(dec_work / dec_slots, 4)
+            if dec_slots else (99.0 if dec_work else 0.0),
+        )
+    return out
 
 
 def _view_brief(view: dict) -> dict:
     """The journal-sized slice of a fleet view (before/after per
     action): enough for autoscale_report's attainment table without
     dragging per-replica records into every record."""
-    return {"routable": view.get("routable"),
-            "occupancy": view.get("occupancy"),
-            "queued": view.get("queued"),
-            "attainment_interactive":
-                view.get("attainment_interactive"),
-            "shed_rate_window": view.get("shed_rate_window")}
+    out = {"routable": view.get("routable"),
+           "occupancy": view.get("occupancy"),
+           "queued": view.get("queued"),
+           "attainment_interactive":
+               view.get("attainment_interactive"),
+           "shed_rate_window": view.get("shed_rate_window")}
+    if view.get("prefill_pressure") is not None:
+        out["prefill_pressure"] = view["prefill_pressure"]
+        out["decode_pressure"] = view["decode_pressure"]
+        out["handoff_ready"] = view.get("handoff_ready")
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -338,6 +338,43 @@ define_flag("serve_retry_budget", 3,
             "shed instead of retried — a poisoned request cannot spin "
             "the batch forever")
 
+# --- ISSUE 20: disaggregated prefill/decode serving + fleet-tier
+# prefix cache (inference/serving.py roles, inference/router.py
+# hand-off orchestration).  ALL host-plane: with every flag at its
+# default and no prefill/decode-role replicas constructed, the serve
+# step programs, their cache keys and the single-replica routing path
+# are byte-identical (bench _assert_disagg_zero_overhead pins this).
+define_flag("serve_disagg", False,
+            "role-split default for inference.fleet_serve(): on, a "
+            "fleet built without explicit roles= splits its replicas "
+            "into prefill workers (chunked-prefill-only programs; "
+            "finished prompts freeze and hand their KV pages to a "
+            "decode worker) and decode workers (admit at pos = "
+            "prompt_len — no prefill recompute).  Off (default), "
+            "replicas stay unified/symmetric; explicit roles= always "
+            "wins over the flag")
+define_flag("serve_digest_entries", 32,
+            "bounded trie-digest size a replica publishes in its "
+            "router_view(digest=True): up to N [depth, chain-hash] "
+            "entries over the prefix cache, MRU-first, so peers can "
+            "score cross-replica prefix affinity from the KV plane "
+            "without a token-level probe.  0 publishes no digest")
+define_flag("router_migration_budget", 0,
+            "hot-prefix replication budget: max KV pages the router "
+            "copies per step() sweep when a prefix-affine route has "
+            "to land AWAY from the replica holding the prefix (cache "
+            "placement follows traffic).  Bounded per sweep so "
+            "placement never starves serving; 0 (default) disables "
+            "replication")
+define_flag("autoscale_role_imbalance", 2.0,
+            "sustained prefill-vs-decode pressure ratio that arms the "
+            "autoscaler's dynamic role repair: when one side's "
+            "pressure (queued+active+handoff backlog per slot) "
+            "exceeds the other's by this factor for autoscale_window "
+            "consecutive ticks, decide() emits a role_flip toward the "
+            "starved side (never below one replica per role).  0 "
+            "disables dynamic role repair")
+
 # --- r22: program sentinel (analysis.passes) --------------------------------
 define_flag("static_sentinel", True,
             "master switch for the static pass manager "

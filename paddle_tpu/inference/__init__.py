@@ -30,9 +30,11 @@ import jax.numpy as jnp
 __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "PrecisionType", "PlaceType", "get_version",
            "ContinuousBatcher", "Request", "SLO_CLASSES",
-           "ServeRouter", "pick_replica", "fleet_serve"]
+           "ServeRouter", "pick_replica", "fleet_serve",
+           "pack_handoff", "unpack_handoff"]
 
-from .serving import ContinuousBatcher, Request, SLO_CLASSES  # noqa: E402
+from .serving import (ContinuousBatcher, Request, SLO_CLASSES,  # noqa: E402
+                      pack_handoff, unpack_handoff)
 from .router import ServeRouter, pick_replica  # noqa: E402
 
 

@@ -42,13 +42,61 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["PageAllocator", "AdmitPlan"]
+__all__ = ["PageAllocator", "AdmitPlan", "probe_digest"]
+
+# FNV-1a over token streams — the cross-replica digest hash.  Chosen
+# because it is deterministic across processes (unlike salted hash()),
+# dependency-free, and cheap on the short page-sized chunks it sees.
+_FNV_SEED = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_FNV_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def _fnv(h: int, tokens) -> int:
+    for t in tokens:
+        v = int(t) & _FNV_MASK
+        for _ in range(8):          # 8 LE bytes per token id
+            h = ((h ^ (v & 0xFF)) * _FNV_PRIME) & _FNV_MASK
+            v >>= 8
+    return h
+
+
+def probe_digest(digest, tokens, page_size: int) -> int:
+    """Estimated resident-prefix depth (in tokens) of `tokens` against
+    a replica's published trie digest — the advisory cross-replica twin
+    of `prefix_match_len`.  A digest entry is ``[depth, chain_hash]``
+    where chain_hash is the cumulative FNV-1a of the root→node chunk
+    chain; the probe hashes the prompt's own chunk chain and returns
+    the deepest published depth it reproduces.  Advisory only: a hash
+    collision over-estimates and a bounded digest under-estimates, and
+    either way the router just scores the replica slightly wrong —
+    admission re-matches token-exactly on arrival."""
+    if not digest:
+        return 0
+    have: Dict[int, set] = {}
+    for ent in digest:
+        try:
+            d, h = int(ent[0]), str(ent[1])
+        except (TypeError, ValueError, IndexError):
+            continue
+        have.setdefault(d, set()).add(h)
+    ps = int(page_size)
+    cap = len(tokens) - 1        # last prompt token always prefills
+    h = _FNV_SEED
+    best = 0
+    i = 0
+    while i + ps <= len(tokens) and i + ps <= cap:
+        h = _fnv(h, tokens[i:i + ps])
+        i += ps
+        if "%016x" % h in have.get(i, ()):
+            best = i
+    return best
 
 
 class _Node:
     """One page-sized prompt chunk in the prefix trie."""
     __slots__ = ("tokens", "page", "children", "parent", "complete",
-                 "lru")
+                 "lru", "imported")
 
     def __init__(self, tokens, page, parent):
         self.tokens = tokens          # tuple of page_size ints
@@ -57,6 +105,7 @@ class _Node:
         self.parent = parent          # _Node or None (root child)
         self.complete = False         # all rows written on device
         self.lru = 0
+        self.imported = False         # KV arrived from another replica
 
 
 class AdmitPlan:
@@ -96,6 +145,11 @@ class PageAllocator:
         self.evictions = 0
         self.prefix_hit_tokens = 0
         self.cow_copies = 0
+        # fleet-tier prefix cache: tokens matched against chunks whose
+        # KV was imported from another replica (hand-off graft or hot-
+        # prefix replication) — the cross-replica hit counter
+        self.import_hit_tokens = 0
+        self.grafted_pages = 0
 
     # -- introspection -----------------------------------------------------
     @property
@@ -242,7 +296,8 @@ class PageAllocator:
         return full, partial
 
     def register_chunk(self, parent: Optional[_Node], tokens,
-                       page: int) -> Optional[_Node]:
+                       page: int, imported: bool = False) \
+            -> Optional[_Node]:
         """Register `page` as the (pending) trie node for one full
         prompt chunk under `parent`; returns the node, or None when the
         chunk is already registered (a concurrent admission got there
@@ -252,6 +307,7 @@ class PageAllocator:
         if key in children:
             return None
         node = _Node(key, page, parent)
+        node.imported = imported
         children[key] = node
         self._node_of[page] = node
         self._touch(node)
@@ -267,7 +323,8 @@ class PageAllocator:
             self._drop_node(node)
 
     # -- admission ---------------------------------------------------------
-    def admit(self, prompt, covered_pages: int) -> Optional[AdmitPlan]:
+    def admit(self, prompt, covered_pages: int,
+              imported: bool = False) -> Optional[AdmitPlan]:
         """Plan one admission: match the prompt against the prefix
         cache (capped at len(prompt)-1 so the final prompt token always
         prefills — its logit seeds the first sampled token), allocate
@@ -315,6 +372,12 @@ class PageAllocator:
         if cow_src is not None:
             shared_tokens += partial[1]
         self.prefix_hit_tokens += shared_tokens
+        # attribute hits on grafted chunks: KV computed on ANOTHER
+        # replica, reused here — the fleet-tier cache working
+        imp = sum(ps for node in full if node.imported)
+        if cow_src is not None and cow_src.imported:
+            imp += partial[1]
+        self.import_hit_tokens += imp
         pages = [n.page for n in full] + priv
         # pending nodes for the prompt's own full chunks (content is
         # prompt-determined, so future admissions can share them);
@@ -324,7 +387,8 @@ class PageAllocator:
         parent = full[-1] if full else None
         for ci in range(n_shared, plen // ps):
             chunk = prompt[ci * ps:(ci + 1) * ps]
-            node = self.register_chunk(parent, chunk, pages[ci])
+            node = self.register_chunk(parent, chunk, pages[ci],
+                                       imported=imported)
             if node is None:
                 break   # a concurrent admission owns this subtree
             nodes.append(node)
@@ -355,3 +419,110 @@ class PageAllocator:
             idx = plan.pages.index(node.page)
             if pos >= (idx + 1) * ps:
                 self.complete_node(node)
+
+    # -- fleet-tier prefix cache (ISSUE 20) --------------------------------
+    def export_chain(self, tokens) -> Tuple[int, List[int]]:
+        """Resident complete full-chunk chain for `tokens`: the page
+        list a holder replica would ship when replicating this prefix.
+        Read-only (no pins, no LRU touch) — the caller gathers the page
+        data synchronously at the same chunk boundary, before any
+        allocation can evict."""
+        ps = self.page_size
+        children = self._root
+        pages: List[int] = []
+        i = 0
+        while i + ps <= len(tokens):
+            child = children.get(tuple(int(t) for t in tokens[i:i + ps]))
+            if child is None or not child.complete:
+                break
+            pages.append(child.page)
+            i += ps
+            children = child.children
+        return i, pages
+
+    def graft(self, tokens, max_pages: int) \
+            -> Optional[List[Tuple[int, int]]]:
+        """Slot-less trie graft for hot-prefix replication: register
+        the leading full chunks of `tokens` (up to `max_pages` pages)
+        as COMPLETE cached nodes, allocating pages for chunks not
+        already resident.  Returns [(chunk_idx, page)] the caller must
+        fill with the holder's exported page data before the next
+        admission can match them — complete-on-register is safe because
+        the device write happens at this same chunk boundary.  Chunks
+        already resident are skipped (dedup).  None under pool
+        pressure (nothing registered — placement is best-effort and
+        must never starve serving)."""
+        ps = self.page_size
+        n_chunks = min(len(tokens) // ps, max_pages)
+        if n_chunks <= 0:
+            return []
+        # walk the existing chain; count the missing tail
+        children = self._root
+        parent: Optional[_Node] = None
+        i = 0
+        while i < n_chunks:
+            child = children.get(
+                tuple(int(t) for t in tokens[i * ps:(i + 1) * ps]))
+            if child is None or not child.complete:
+                break
+            parent = child
+            children = child.children
+            i += 1
+        missing = n_chunks - i
+        if missing <= 0:
+            return []
+        # pin the deepest matched node: it is a cached LEAF until the
+        # new children are registered, and alloc()'s eviction loop must
+        # not reclaim the very chain we are extending
+        pin = parent
+        if pin is not None:
+            self.ref_inc(pin.page)
+        pages = self.alloc(missing)
+        if pages is None:
+            if pin is not None:
+                self.release_page(pin.page)
+            return None
+        out: List[Tuple[int, int]] = []
+        for k in range(missing):
+            ci = i + k
+            chunk = tokens[ci * ps:(ci + 1) * ps]
+            node = self.register_chunk(parent, chunk, pages[k],
+                                       imported=True)
+            if node is None:        # raced: subtree already owned
+                for page in pages[k:]:
+                    self.release_page(page)
+                break
+            self.complete_node(node)
+            out.append((ci, pages[k]))
+            parent = node
+        # grafted pages are cache-resident, not slot-mapped: drop the
+        # alloc refcount so they live as refcount-0 cached pages
+        for _, page in out:
+            self.release_page(page)
+        if pin is not None:
+            self.release_page(pin.page)
+        self.grafted_pages += len(out)
+        return out
+
+    def trie_digest(self, max_entries: int = 32) -> List[list]:
+        """Bounded published view of the prefix cache: up to
+        `max_entries` ``[depth_tokens, chain_hash]`` entries for
+        complete trie nodes, most-recently-used first — what a replica
+        ships in its `router_view` so peers can score cross-replica
+        prefix affinity with `probe_digest` without a token-level RPC.
+        Pure walk: no pins, no LRU touch."""
+        if max_entries <= 0:
+            return []
+        ps = self.page_size
+        entries: List[Tuple[int, int, int]] = []   # (lru, depth, hash)
+        stack = [(node, _fnv(_FNV_SEED, node.tokens), ps)
+                 for node in self._root.values()]
+        while stack:
+            node, h, depth = stack.pop()
+            if node.complete:
+                entries.append((node.lru, depth, h))
+            for child in node.children.values():
+                stack.append((child, _fnv(h, child.tokens), depth + ps))
+        entries.sort(key=lambda e: -e[0])
+        return [[depth, "%016x" % h]
+                for _, depth, h in entries[:max_entries]]

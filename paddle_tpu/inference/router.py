@@ -82,8 +82,8 @@ QUEUE_COST_TOKENS = 16.0
 def pick_replica(views: List[dict], slo: str = "batch",
                  prefix_weight: Optional[float] = None,
                  attainment_floor: Optional[float] = None,
-                 queue_cost: float = QUEUE_COST_TOKENS
-                 ) -> Optional[int]:
+                 queue_cost: float = QUEUE_COST_TOKENS,
+                 prompt=None) -> Optional[int]:
     """Choose one replica for a request of class `slo` from per-replica
     policy views (`ContinuousBatcher.router_view()` dicts, or the same
     records read back off the KV plane) — returns the chosen view's
@@ -107,6 +107,12 @@ def pick_replica(views: List[dict], slo: str = "batch",
     (``shed_rate_window``, ISSUE 19 satellite) when the view carries
     one — current pressure, not lifetime history — and falls back to
     the cumulative ``shed_rate`` for older/synthetic views.
+
+    Cross-replica prefix scores (ISSUE 20): with `prompt`, a view
+    that carries no in-process ``prefix_hit_tokens`` but publishes a
+    ``trie_digest`` (replica-per-rank mode over the KV plane) is
+    scored by `paged_kv.probe_digest` — the advisory hash-chain
+    estimate of the prompt's resident depth on that replica.
     """
     if prefix_weight is None:
         prefix_weight = float(get_flag("router_prefix_weight") or 0.0)
@@ -125,11 +131,19 @@ def pick_replica(views: List[dict], slo: str = "batch",
         if floored:
             cands = floored
 
+    def hits(v):
+        got = v.get("prefix_hit_tokens")
+        if got is None and prompt is not None and v.get("trie_digest"):
+            from .paged_kv import probe_digest
+            got = probe_digest(v["trie_digest"], prompt,
+                               int(v.get("page_size") or 16))
+        return float(got or 0)
+
     def rank(v):
         shed = v.get("shed_rate_window")
         if shed is None:
             shed = v.get("shed_rate") or 0.0
-        score = (prefix_weight * float(v.get("prefix_hit_tokens") or 0)
+        score = (prefix_weight * hits(v)
                  - queue_cost * float(v.get("queued") or 0)
                  - queue_cost * float(shed))
         return (score, -float(v.get("queued") or 0),
@@ -220,21 +234,42 @@ class ServeRouter:
 
     def __init__(self, model=None, replicas: Optional[int] = None,
                  batchers: Optional[List[ContinuousBatcher]] = None,
-                 kv=None, job_id: str = "serve", **batcher_kw):
+                 kv=None, job_id: str = "serve",
+                 roles: Optional[List[str]] = None, **batcher_kw):
         if batchers is None:
             if model is None:
                 raise ValueError("ServeRouter needs a model (plus "
                                  "replicas=N) or explicit batchers=")
             n = int(replicas if replicas is not None
                     else get_flag("serve_replicas") or 0) or 2
-            batchers = [ContinuousBatcher(model, **batcher_kw)
-                        for _ in range(n)]
+            if roles is None and get_flag("serve_disagg", False):
+                # FLAGS_serve_disagg default split (ISSUE 20): half the
+                # fleet prefills, half decodes — decode gets the odd
+                # replica (decode rounds emit chunk tokens per program
+                # call vs the admit program's admit_steps, so decode
+                # capacity is the scarcer resource on mixed workloads)
+                n_pre = max(1, n // 2)
+                roles = ["prefill"] * n_pre + ["decode"] * (n - n_pre) \
+                    if n >= 2 else ["serve"]
+            batchers = [ContinuousBatcher(
+                model, role=self._bat_role(roles[i])
+                if roles else "unified", **batcher_kw)
+                for i in range(n)]
         elif batcher_kw or model is not None or replicas is not None:
             raise ValueError("pass model/replicas/batcher kwargs OR "
                              "batchers=, not both")
         if not batchers:
             raise ValueError("ServeRouter needs >= 1 replica")
-        self._reps = [_Replica(i, b) for i, b in enumerate(batchers)]
+        if roles is not None and len(roles) != len(batchers):
+            raise ValueError(f"roles= has {len(roles)} entries for "
+                             f"{len(batchers)} replicas")
+        self._reps = []
+        for i, b in enumerate(batchers):
+            role = roles[i] if roles else (
+                b.role if b.role != "unified" else "serve")
+            if b.role != self._bat_role(role):
+                b.set_role(self._bat_role(role))
+            self._reps.append(_Replica(i, b, role=role))
         self._reqs: Dict[int, _RouterReq] = {}
         self._results: Dict[int, np.ndarray] = {}
         self._next_gid = 0
@@ -247,6 +282,15 @@ class ServeRouter:
         self._prefix_routed = 0
         self._routes = 0
         self._decision_ms: deque = deque(maxlen=4096)
+        self._handoffs = 0
+        self._handoff_bytes = 0
+        self._handoff_ms: deque = deque(maxlen=4096)
+        # hand-offs whose import failed (sink raced out of slots/
+        # pages): the exported blob outlives even its source replica,
+        # retried every sweep until a sink takes it
+        self._handoff_staged: deque = deque()
+        self._replicate_q: deque = deque()
+        self._replicated_pages = 0
         self._last_rebalance = time.monotonic()
         self._draining = False
         self._kv = kv
@@ -275,8 +319,18 @@ class ServeRouter:
     def _live(self) -> List[_Replica]:
         return [r for r in self._reps if not r.dead]
 
-    def _views(self, prompt=None, exclude: Optional[int] = None
-               ) -> List[dict]:
+    @staticmethod
+    def _bat_role(role: str) -> str:
+        """Router role label -> batcher role knob ("serve" is the
+        router's historical name for a unified replica)."""
+        return role if role in ("prefill", "decode") else "unified"
+
+    def _disagg_active(self) -> bool:
+        return any(r.role in ("prefill", "decode")
+                   for r in self._reps if not r.dead)
+
+    def _views(self, prompt=None, exclude: Optional[int] = None,
+               admission: bool = False) -> List[dict]:
         # prefix affinity off (weight 0) -> the hit count is
         # multiplied by zero anyway; skip the O(replicas x prompt)
         # trie probes on the routing hot path entirely
@@ -293,6 +347,17 @@ class ServeRouter:
             if rep.draining:
                 v["draining"] = True
             views.append(v)
+        if admission and self._disagg_active():
+            # fresh prompts start with a prefill: route them to
+            # prefill-capable replicas only — decode replicas receive
+            # work through the hand-off plane.  Degraded-fleet
+            # fallback: with no prefill-capable replica left, a
+            # decode-role replica still admits (its programs run both
+            # phases; the role only governs the freeze-at-prompt-end
+            # behaviour), which beats shedding
+            adm = [v for v in views if v["role"] != "decode"]
+            if adm:
+                views = adm
         return views
 
     # -- submission --------------------------------------------------------
@@ -321,7 +386,7 @@ class ServeRouter:
         self._arrival += 1
         self._reqs[gid] = rr
         t0 = time.perf_counter()
-        views = self._views(ids)
+        views = self._views(ids, admission=True)
         idx = pick_replica(views, slo=slo)
         dt_ms = (time.perf_counter() - t0) * 1e3
         self._decision_ms.append(dt_ms)
@@ -336,6 +401,18 @@ class ServeRouter:
         self._routes += 1
         if hit > 0:
             self._prefix_routed += 1
+        if int(get_flag("router_migration_budget") or 0) > 0:
+            # cache PLACEMENT (ISSUE 20): when another replica holds a
+            # longer resident prefix than where load/SLO pressure sent
+            # this request, queue a bounded background copy of that
+            # prefix TO the chosen replica — traffic pulls hot
+            # prefixes to where it lands instead of chasing them
+            best = max(views, key=lambda v: float(
+                v.get("prefix_hit_tokens") or 0.0))
+            bh = int(best.get("prefix_hit_tokens") or 0)
+            if best["replica"] != idx and bh > hit and bh > 0:
+                self._replicate_q.append(
+                    (ids, int(best["replica"]), idx))
         rep = self._reps[idx]
         rep.routed += 1
         self._place(rr, rep)
@@ -402,7 +479,8 @@ class ServeRouter:
             # that stopped accepting routes — it lands on a survivor
             # or sheds only when the WHOLE fleet is draining, exactly
             # the submit-path contract
-            views = self._views(rr.prompt, exclude=rep.idx)
+            views = self._views(rr.prompt, exclude=rep.idx,
+                                admission=True)
             idx = pick_replica(views, slo=rr.slo)
             if idx is None:
                 self._shed_router(rr, "drain")
@@ -460,13 +538,153 @@ class ServeRouter:
             out.append(gid)
         return out
 
+    # -- the hand-off plane (disaggregated prefill -> decode) --------------
+    def _handoff_import(self, meta, data, rr: _RouterReq,
+                        sinks: List[_Replica], frm: int,
+                        t0: Optional[float] = None) -> bool:
+        """Land one exported hand-off on the least-loaded decode-
+        capable sink.  The incarnation bump BEFORE the import means
+        the decode side's full-stream replay (req.tokens re-seeded
+        with the already-emitted prefix) dedups against the delivered
+        frontier — the consumer never sees a duplicate token across
+        the hand-off.  False when every sink refused (no free slot /
+        pool pressure): the caller stages the blob for the next
+        sweep."""
+        for sink in sorted(sinks, key=lambda s: (s.bat.active, s.idx)):
+            rr.incarnation += 1
+            rr.seen = 0
+            cb = None
+            if rr.on_token is not None:
+                cb = self._make_cb(rr, rr.incarnation)
+            lid = sink.bat.import_handoff(meta, data, on_token=cb)
+            if lid is None:
+                continue
+            sink.local2g[lid] = rr.gid
+            rr.replica, rr.local_id = sink.idx, lid
+            ms = (time.perf_counter() - t0) * 1e3 if t0 else 0.0
+            self._handoffs += 1
+            self._handoff_bytes += int(meta.get("nbytes") or 0)
+            self._handoff_ms.append(ms)
+            from .. import telemetry as _tel
+            _tel.counter("router.handoffs").inc()
+            if _tel.active():
+                _tel.emit("router.handoff", req=rr.gid, frm=frm,
+                          to=sink.idx, pages=int(meta["n_pages"]),
+                          bytes=int(meta.get("nbytes") or 0),
+                          ms=round(ms, 4))
+            return True
+        return False
+
+    def _handoff_sweep(self):
+        """Move finished-prefill requests off their prefill replicas:
+        each frozen (hand-off-ready) slot exports its prompt KV pages
+        and re-admits on a decode-capable sink at pos=prompt_len —
+        zero prefill recomputed.  Exports happen only when some sink
+        has a free slot (otherwise the request stays frozen, its pages
+        pinned on the source, and retries next sweep); an import that
+        still fails (lost the slot race) is staged host-side and
+        survives even the source replica dying.  With NO decode-
+        capable replica in the fleet the frozen slot unfreezes and
+        decodes in place — degraded, never deadlocked."""
+        srcs = [r for r in self._live() if r.bat._handoff_ready]
+        if not srcs and not self._handoff_staged:
+            return
+        sinks = [r for r in self._live()
+                 if not r.draining and r.role != "prefill"
+                 and r.bat.role != "prefill"
+                 and r.bat.kv_layout == "paged"]
+        if self._handoff_staged:
+            if sinks:
+                for _ in range(len(self._handoff_staged)):
+                    meta, data, rr = self._handoff_staged.popleft()
+                    if rr.done:
+                        continue
+                    if not self._handoff_import(meta, data, rr, sinks,
+                                                frm=-1):
+                        self._handoff_staged.append((meta, data, rr))
+            elif not any(r.bat.kv_layout == "paged"
+                         and r.bat.role != "prefill"
+                         for r in self._live()):
+                # a staged blob has no source slot left to unfreeze;
+                # with no import-capable replica even in the pipeline
+                # (draining ones will retire, not recover) the request
+                # is terminally unplaceable — shed it like a whole-
+                # fleet drain, delivered prefix preserved
+                while self._handoff_staged:
+                    meta, data, rr = self._handoff_staged.popleft()
+                    if not rr.done:
+                        self._shed_router(rr, "drain")
+        for src in srcs:
+            for rid in list(src.bat._handoff_ready):
+                gid = src.local2g.get(rid)
+                if gid is None:
+                    # not router-managed (submitted straight to the
+                    # batcher): its owner drives the hand-off
+                    continue
+                if not sinks:
+                    src.bat.unfreeze_handoff(rid)
+                    continue
+                free = any(s.bat.active - (1 if s is src else 0)
+                           < s.bat.B for s in sinks)
+                if not free:
+                    break
+                rr = self._reqs[gid]
+                t0 = time.perf_counter()
+                meta, data = src.bat.export_handoff(rid)
+                del src.local2g[rid]
+                if not self._handoff_import(meta, data, rr, sinks,
+                                            frm=src.idx, t0=t0):
+                    self._handoff_staged.append((meta, data, rr))
+
+    def _maybe_replicate(self):
+        """FLAGS_router_migration_budget pages per sweep of hot-prefix
+        placement: pop queued (prompt, holder, target) intents, export
+        the resident chain on the holder and graft it on the target.
+        Best-effort end to end — a dead replica, an evicted chain or
+        target pool pressure just drops the intent (the next routed
+        request re-queues it); the budget caps device-copy bytes per
+        round so placement never starves serving."""
+        budget = int(get_flag("router_migration_budget") or 0)
+        if budget <= 0:
+            self._replicate_q.clear()
+            return
+        pages = 0
+        rounds = len(self._replicate_q)
+        while self._replicate_q and pages < budget and rounds > 0:
+            rounds -= 1
+            prompt, frm, to = self._replicate_q.popleft()
+            src, dst = self._reps[frm], self._reps[to]
+            if src.dead or dst.dead:
+                continue
+            got = src.bat.export_prefix(prompt)
+            if not got:
+                continue
+            n_tokens, data = got
+            n = dst.bat.import_prefix(prompt, n_tokens, data)
+            if n <= 0:
+                continue
+            pages += n
+            self._replicated_pages += n
+            from .. import telemetry as _tel
+            _tel.counter("router.replicated_pages").inc(n)
+            if _tel.active():
+                _tel.emit("router.replicate", frm=frm, to=to,
+                          pages=n, tokens=int(n_tokens))
+
     def step(self) -> List[int]:
         """One scheduling round across the fleet: every live replica
         with work runs one batcher round; newly-terminal global ids
         are returned.  A replica whose own drain protocol engaged
         (process-level SIGTERM) marks the router drained; a
-        gracefully-draining replica with nothing left is retired."""
+        gracefully-draining replica with nothing left is retired
+        (frozen hand-off-ready slots count as active, so a draining
+        prefill replica exports them before retiring)."""
         finished: List[int] = []
+        # placement BEFORE the batcher round: a prefix replicated now
+        # is shared by this very round's admissions (grafting after
+        # the admit would lose the race to the admit's own trie
+        # registration and no-op)
+        self._maybe_replicate()
         for rep in self._live():
             bat = rep.bat
             if bat.queued or bat.active:
@@ -477,6 +695,7 @@ class ServeRouter:
             if rep.draining and not bat.queued and not bat.active:
                 rep.dead = True
                 self._retire_pub(rep)
+        self._handoff_sweep()
         self._maybe_rebalance()
         self._publish()
         return finished
@@ -485,8 +704,11 @@ class ServeRouter:
         """Drive the fleet until every replica's queue and slots drain;
         returns {gid: tokens} for EVERY submitted request (shed ones
         included — empty or partial outputs), exactly the batcher's
-        run() contract lifted fleet-wide."""
-        while any(r.bat.queued or r.bat.active for r in self._live()):
+        run() contract lifted fleet-wide.  Staged hand-offs count as
+        live work: their requests occupy no slot anywhere until a sink
+        admits them."""
+        while any(r.bat.queued or r.bat.active
+                  for r in self._live()) or self._handoff_staged:
             self.step()
         for rep in self._live():
             self._harvest(rep)
@@ -518,6 +740,10 @@ class ServeRouter:
                     bat._slots[i] = None    # host detach only: the
                     #                         replica is dead, its
                     #                         device state unreachable
+            # frozen hand-off-ready slots are swept with the rest
+            # (their requests migrate for a full re-prefill, which is
+            # bit-exact); the ready-map must not dangle
+            bat._handoff_ready.clear()
         rep.dead = True
         self._kills += 1
         migs = []
@@ -619,6 +845,10 @@ class ServeRouter:
         same ``<job>/serve/<idx>`` schema.  Returns the replica id."""
         idx = len(self._reps)
         rep = _Replica(idx, bat, role=role)
+        if bat.role != self._bat_role(role) \
+                and (self._bat_role(role) == "unified"
+                     or bat.kv_layout == "paged"):
+            bat.set_role(self._bat_role(role))
         self._reps.append(rep)
         if self._kv is not None:
             self._pubs.append(ReplicaPublisher(self._kv,
@@ -633,12 +863,19 @@ class ServeRouter:
         return idx
 
     def set_role(self, idx: int, role: str) -> str:
-        """Flip replica `idx`'s role metadata (host-plane only: routing
-        and programs are untouched here — the autoscaler drains before
-        flipping so in-flight work never straddles a role change).
-        Returns the previous role."""
+        """Flip replica `idx`'s role — routing metadata AND the
+        batcher's own role knob (host-plane only: no program changes —
+        the autoscaler drains before flipping so in-flight work never
+        straddles a role change, and a slot already frozen for
+        hand-off still leaves via the hand-off sweep).  Returns the
+        previous role."""
         rep = self._reps[idx]
         prev, rep.role = rep.role, role
+        want = self._bat_role(role)
+        if rep.bat.role != want \
+                and (want == "unified"
+                     or rep.bat.kv_layout == "paged"):
+            rep.bat.set_role(want)
         from .. import telemetry as _tel
         if _tel.active():
             _tel.emit("router.role", replica=idx, role=role, prev=prev)
@@ -656,7 +893,7 @@ class ServeRouter:
         rr.requeues += 1
         rr.incarnation += 1         # invalidates the old placement's
         rr.seen = 0                 # streaming wrapper
-        views = self._views(rr.prompt, exclude=frm)
+        views = self._views(rr.prompt, exclude=frm, admission=True)
         idx = pick_replica(views, slo=rr.slo)
         if idx is None:
             self._shed_router(rr, "drain")
@@ -744,7 +981,9 @@ class ServeRouter:
         for rep, pub in zip(self._reps, self._pubs):
             if rep.dead or pub is None:
                 continue
-            pub.publish(rep.bat.router_view())
+            v = rep.bat.router_view(digest=True)
+            v["role"] = rep.role
+            pub.publish(v)
 
     # -- stats -------------------------------------------------------------
     def stats(self) -> Dict[str, object]:
@@ -767,6 +1006,17 @@ class ServeRouter:
                 rec["draining"] = True  # batcher's own SIGTERM flag
             per.append(rec)
         dec = summary_of(list(self._decision_ms))
+        hand = summary_of(list(self._handoff_ms))
+        cross = 0
+        lat: Dict[str, list] = {}
+        for rep in self._reps:
+            if rep.dead:
+                continue
+            alloc = getattr(rep.bat, "_alloc", None)
+            if alloc is not None:
+                cross += int(getattr(alloc, "import_hit_tokens", 0))
+            for k, window in rep.bat._lat.items():
+                lat.setdefault(k, []).extend(window)
         return {
             "replicas": len(self._reps),
             "live_replicas": self.live_replicas,
@@ -788,6 +1038,16 @@ class ServeRouter:
                             "p50": round(dec["p50"], 4),
                             "p99": round(dec["p99"], 4),
                             "max": round(dec["max"], 4)},
+            "handoffs": self._handoffs,
+            "handoff_bytes": self._handoff_bytes,
+            "handoff_staged": len(self._handoff_staged),
+            "handoff_ms": {"count": hand["count"],
+                           "p50": round(hand["p50"], 4),
+                           "p99": round(hand["p99"], 4),
+                           "max": round(hand["max"], 4)},
+            "cross_prefix_hit_tokens": cross,
+            "replicated_pages": self._replicated_pages,
+            "latency": {k: summary_of(v) for k, v in lat.items()},
             "per_replica": per,
         }
 

@@ -108,7 +108,51 @@ import jax.numpy as jnp
 from ..framework.flags import get_flag
 from ..framework.tensor import Tensor
 
-__all__ = ["ContinuousBatcher", "Request", "SLO_CLASSES"]
+__all__ = ["ContinuousBatcher", "Request", "SLO_CLASSES",
+           "pack_handoff", "unpack_handoff"]
+
+
+def pack_handoff(meta, data) -> bytes:
+    """Serialize one hand-off (meta + gathered KV pages) for the KV
+    launch plane: multi-process fleets move prefill->decode hand-offs
+    as a single value under ``<job>/serve/handoff/<gid>`` (host plane
+    over the r14 KV plane); in-process fleets skip this entirely and
+    pass the device arrays straight into import_handoff."""
+    import io
+    import json
+    m = dict(meta)
+    m["prompt"] = [int(t) for t in np.asarray(meta["prompt"]).tolist()]
+    arrays = {k: np.asarray(v) for k, v in data.items()}
+    # npz has no bfloat16: ship raw bytes (uint16 view) and record the
+    # real dtype in the header for the view-cast on unpack
+    m["_dtypes"] = {k: str(a.dtype) for k, a in arrays.items()}
+    header = json.dumps(m).encode("utf-8")
+    buf = io.BytesIO()
+    np.savez(buf, **{k: a.view(np.dtype(f"uint{8 * a.dtype.itemsize}"))
+                     if a.dtype.kind not in "iufb" else a
+                     for k, a in arrays.items()})
+    return len(header).to_bytes(8, "big") + header + buf.getvalue()
+
+
+def unpack_handoff(blob: bytes):
+    """Inverse of pack_handoff: (meta, data) with device arrays, ready
+    for import_handoff().  Byte-identical round trip (pinned by
+    tests/test_serve_disagg.py)."""
+    import io
+    import json
+    n = int.from_bytes(blob[:8], "big")
+    meta = json.loads(blob[8:8 + n].decode("utf-8"))
+    meta["prompt"] = np.asarray(meta["prompt"], np.int32)
+    dtypes = meta.pop("_dtypes", {})
+    npz = np.load(io.BytesIO(blob[8 + n:]))
+    data = {}
+    for k in npz.files:
+        a = npz[k]
+        want = dtypes.get(k)
+        if want and str(a.dtype) != want:
+            a = a.view(np.dtype(want))
+        data[k] = jnp.asarray(a)
+    return meta, data
 
 # admission priority order, highest first; shedding walks it in reverse
 SLO_CLASSES = ("interactive", "batch", "best_effort")
@@ -198,7 +242,8 @@ class ContinuousBatcher:
                  weight_only_dtype: Optional[str] = None,
                  spec_tokens: Optional[int] = None,
                  draft_model=None,
-                 draft_layers: Optional[int] = None):
+                 draft_layers: Optional[int] = None,
+                 role: str = "unified"):
         if not hasattr(model, "forward_cached"):
             raise TypeError("ContinuousBatcher needs a decode-capable "
                             "model (forward_cached/init_cache)")
@@ -305,6 +350,27 @@ class ContinuousBatcher:
         self._slots: List[Optional[Request]] = [None] * self.B
         self._finished: Dict[int, Request] = {}
         self._next_id = 0
+        # -- disaggregated serving (ISSUE 20): a prefill-role batcher
+        # runs ONLY chunked-prefill (admit) programs; a slot that
+        # finishes its prompt is FROZEN (done=True device-side, pages
+        # pinned) until export_handoff() ships its KV pages +
+        # page-table row to a decode-role batcher, which admits it at
+        # pos >= prompt_len via import_handoff() — no prefill is ever
+        # recomputed.  "unified" is the classic symmetric replica and
+        # the default: with no prefill/decode batchers in the fleet,
+        # every code path below is dormant and the serve-step programs
+        # are byte-identical (zero-overhead pin in bench.py).
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"role {role!r}: unified|prefill|decode")
+        if role != "unified" and kv_layout != "paged":
+            raise TypeError("disaggregated roles need kv_layout="
+                            "'paged' (the hand-off ships pages)")
+        self.role = role
+        self._handoff_ready: Dict[int, int] = {}   # rid -> slot index
+        self._no_freeze: set = set()    # unfrozen rids: decode HERE
+        self._handoffs_out = 0
+        self._handoffs_in = 0
+        self._handoff_bytes = 0
         self._arrival_seq = 0
         self._now = time.monotonic     # patchable time source (tests)
         self._has_deadlines = False    # sweep is skipped until a
@@ -637,7 +703,12 @@ class ContinuousBatcher:
         if not self._draining:
             self._shed_deadline_missed()
             self._admit()
-        if any(r is not None for r in self._slots):
+        # frozen hand-off slots (prefill role, prompt consumed, waiting
+        # for a decode worker) are done=True device-side and need no
+        # chunks — a prefill batcher whose live slots are all frozen
+        # parks until export_handoff() frees them
+        if any(r is not None and r.req_id not in self._handoff_ready
+               for r in self._slots):
             self._run_chunk(mixed=bool(self._mode_host.any()))
             # pre-chunk evictions cleared their slots, so the two
             # harvests are disjoint
@@ -661,6 +732,17 @@ class ContinuousBatcher:
                     and self._now() > self._drain_deadline:
                 self._flush_partial()
                 break
+            if self._handoff_ready:
+                # prefill-role batcher driven standalone: once every
+                # live slot is frozen awaiting hand-off (and no queued
+                # request can fill a free slot) step() can make no
+                # progress — park and let the router export
+                occ = [r for r in self._slots if r is not None]
+                if occ and all(r.req_id in self._handoff_ready
+                               for r in occ) \
+                        and not (len(occ) < self.B
+                                 and self._queued_count()):
+                    break
             self.step()
         return {rid: r.output() for rid, r in self._finished.items()}
 
@@ -767,6 +849,8 @@ class ContinuousBatcher:
         the paged layout the slot's page mapping (prompt pages stay
         resident as cached prefix pages; the freed slot's junk lanes
         write the null page)."""
+        if self._slots[i] is not None:
+            self._no_freeze.discard(self._slots[i].req_id)
         self._slots[i] = None
         self._done = self._done.at[i].set(True)
         self._mode = self._mode.at[i].set(False)
@@ -993,20 +1077,26 @@ class ContinuousBatcher:
                          else input_ids, np.int32).reshape(-1)
         return self._alloc.prefix_match_len(ids)
 
-    def router_view(self, prompt=None) -> Dict[str, object]:
+    def router_view(self, prompt=None, digest: bool = False) \
+            -> Dict[str, object]:
         """Compact host-plane policy view for the serve-fleet router
         (inference/router.py) — everything pick_replica() weighs, and
         the record a replica-per-rank worker publishes to the KV plane
         (router.ReplicaPublisher, the r14 FleetSink key schema).  Much
         cheaper than stats(): no latency summaries, no device reads.
         With `prompt` the view carries this replica's
-        prefix_hit_tokens for it (read-only probe)."""
+        prefix_hit_tokens for it (read-only probe).  With `digest` the
+        view also carries the bounded trie digest
+        (FLAGS_serve_digest_entries) — only the PUBLISHED view pays
+        the trie walk; per-submit probes never do."""
         qbc = self.queue_snapshot()
         view: Dict[str, object] = {
             "queued": sum(qbc.values()),
             "queued_by_class": qbc,
             "active": self.active,
             "slots": self.B,
+            "role": self.role,
+            "handoff_ready": len(self._handoff_ready),
             "draining": self._draining,
             "shed_rate": round(self._shed_count / self._submitted, 4)
             if self._submitted else 0.0,
@@ -1017,6 +1107,10 @@ class ContinuousBatcher:
         if self.kv_layout == "paged":
             view["kv_pages_free"] = self._alloc.pages_free
             view["kv_pages_cached"] = self._alloc.pages_cached
+            if digest and self.prefix_sharing:
+                n = int(get_flag("serve_digest_entries", 32) or 0)
+                view["trie_digest"] = self._alloc.trie_digest(n)
+                view["page_size"] = self.page_size
         if prompt is not None:
             view["prefix_hit_tokens"] = self.prefix_match_len(prompt)
         return view
@@ -1071,6 +1165,15 @@ class ContinuousBatcher:
             "queued": sum(qbc.values()),
             "queued_by_class": qbc,
             "drained": self._draining,
+            # disaggregated serving (ISSUE 20): hand-off terminals.
+            # Per-batcher no-leak partition becomes submitted ==
+            # completed + shed + handoffs_out (imports count as
+            # submissions on the decode side)
+            "role": self.role,
+            "handoffs_out": self._handoffs_out,
+            "handoffs_in": self._handoffs_in,
+            "handoff_bytes": self._handoff_bytes,
+            "handoff_ready": len(self._handoff_ready),
         }
         wo = getattr(self.model, "_weight_only", None)
         out["weight_only"] = wo["dtype"] if wo else "none"
@@ -1129,11 +1232,14 @@ class ContinuousBatcher:
                 kv_pages_cached=self._alloc.pages_cached,
                 kv_dtype=self._kv_dtype,
                 prefix_hit_tokens=self._alloc.prefix_hit_tokens,
+                import_hit_tokens=self._alloc.import_hit_tokens,
+                grafted_pages=self._alloc.grafted_pages,
                 evictions=self._alloc.evictions,
                 cow_copies=self._alloc.cow_copies,
             )
         else:
-            out.update(prefix_hit_tokens=0, evictions=0, cow_copies=0)
+            out.update(prefix_hit_tokens=0, import_hit_tokens=0,
+                       grafted_pages=0, evictions=0, cow_copies=0)
         return out
 
     # -- scheduling --------------------------------------------------------
@@ -1142,10 +1248,32 @@ class ContinuousBatcher:
         for i, req in enumerate(self._slots):
             if req is None:
                 continue
+            if req.req_id in self._handoff_ready:
+                # frozen awaiting hand-off: done=True device-side is
+                # the freeze, not a finish — never evict, never treat
+                # as capped; export_handoff() clears the slot
+                continue
             hit_eos = self.eos is not None and self.eos in req.tokens
             if hit_eos:
                 req.tokens = req.tokens[: req.tokens.index(self.eos)
                                         + 1]
+            if self.role == "prefill" and not self._mode_host[i] \
+                    and req.tokens and not hit_eos \
+                    and not self._done_host[i] \
+                    and req.req_id not in self._no_freeze \
+                    and len(req.tokens) < req.max_new_tokens:
+                # prefill worker finished this slot's prompt (pos >=
+                # prompt_len, first token(s) emitted inside the admit
+                # scan): FREEZE it — done=True parks the lanes (done
+                # lanes advance nothing; their junk writes land past
+                # pos, never on valid rows) with pages pinned until a
+                # decode worker imports the KV.  Also reached when a
+                # role flip strands mid-decode slots: they hand off
+                # at pos = prompt_len + k and resume elsewhere.
+                self._handoff_ready[req.req_id] = i
+                self._done = self._done.at[i].set(True)
+                self._done_host[i] = True
+                continue
             # capacity clamp: a slot whose ring buffer filled stops
             # emitting — finish it short rather than spin forever
             # (unreachable while submit() enforces prompt+new<=max_len)
@@ -1344,6 +1472,269 @@ class ContinuousBatcher:
                 return out
             return jax.jit(serve_page_copy, donate_argnums=(0,))
         return _model_program_cache(self.model, key, build)
+
+    # -- disaggregated hand-off (ISSUE 20) ---------------------------------
+    def set_role(self, role: str):
+        """Host-plane role flip (the autoscaler's role-repair path).
+        Flipping to 'prefill' strands nothing: slots mid-decode freeze
+        at the next boundary and hand off their KV; flipping away from
+        'prefill' simply reopens normal decode for future admissions
+        (already-frozen slots still leave via export_handoff)."""
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"role {role!r}: unified|prefill|decode")
+        if role != "unified" and self.kv_layout != "paged":
+            raise TypeError("disaggregated roles need kv_layout="
+                            "'paged' (the hand-off ships pages)")
+        self.role = role
+
+    def _page_export_fn(self):
+        """Fixed-shape page gather for hand-off/replication export:
+        [pages_per_slot] page ids -> per-buffer [pages_per_slot, ...]
+        rows.  Pad entries point at the null page (junk by design), so
+        ONE compiled program covers every export regardless of how
+        many pages are valid.  Read-only: the pool is not donated."""
+        from .generation import _model_program_cache
+        key = ("serve_page_export", self.num_pages, self.page_size,
+               self.pages_per_slot, self._kv_dtype)
+
+        def build():
+            def serve_page_export(cache, idx):
+                return {name: cache[name][idx] for name in cache}
+            return jax.jit(serve_page_export)
+        return _model_program_cache(self.model, key, build)
+
+    def _page_import_fn(self):
+        """Fixed-shape page scatter for hand-off/replication import:
+        rows land at the given page ids; entries the import does not
+        need (already-resident shared chunks, pad rows) point at the
+        null page, whose content is junk by contract — so duplicate
+        null indices in the scatter are harmless.  The pool is donated
+        exactly like the step carries."""
+        from .generation import _model_program_cache
+        key = ("serve_page_import", self.num_pages, self.page_size,
+               self.pages_per_slot, self._kv_dtype)
+
+        def build():
+            def serve_page_import(cache, idx, data):
+                out = dict(cache)
+                for name in cache:
+                    out[name] = cache[name].at[idx].set(data[name])
+                return out
+            return jax.jit(serve_page_import, donate_argnums=(0,))
+        return _model_program_cache(self.model, key, build)
+
+    def _handoff_page_bytes(self, data, n_pages: int) -> int:
+        total = 0
+        for a in data.values():
+            total += (a.nbytes // self.pages_per_slot) * n_pages
+        return int(total)
+
+    def export_handoff(self, rid: int):
+        """Detach a frozen hand-off-ready request: gather its valid KV
+        pages (rows [0, pos)) plus everything a decode worker needs to
+        resume at pos — prompt, emitted tokens, SLO state — and free
+        the slot.  The prompt's full chunks stay RESIDENT here as
+        cached prefix pages, so later prompts sharing them still skip
+        their prefill chunks on this worker.  Accounting: the request
+        leaves as a hand-off, not a completion — per batcher,
+        submitted == completed + shed + handoffs_out."""
+        i = self._handoff_ready.pop(rid, None)
+        if i is None:
+            raise KeyError(f"request {rid} is not hand-off ready")
+        req = self._slots[i]
+        pos = int(self._pos_host[i])
+        ps = self.page_size
+        n_pages = -(-pos // ps)
+        plan = self._plans[i]
+        idx = np.zeros((self.pages_per_slot,), np.int32)
+        idx[:n_pages] = plan.pages[:n_pages]
+        data = self._page_export_fn()(self._cache, jnp.asarray(idx))
+        nbytes = self._handoff_page_bytes(data, n_pages)
+        meta = {
+            "rid": int(req.req_id),
+            "prompt": np.asarray(req.prompt, np.int32),
+            "pos": pos,
+            "plen": int(len(req.prompt)),
+            "tokens": [int(t) for t in req.tokens],
+            "max_new_tokens": int(req.max_new_tokens),
+            "slo": req.slo,
+            "deadline": req.deadline,
+            "t_submit": req.t_submit,
+            "t_first": req.t_first,
+            "n_pages": int(n_pages),
+            "page_size": int(ps),
+            "kv_dtype": self._kv_dtype,
+            "nbytes": int(nbytes),
+        }
+        self._handoffs_out += 1
+        self._handoff_bytes += nbytes
+        self._clear_slot(i)
+        from .. import telemetry as _tel
+        if _tel.active():
+            _tel.emit("serve.handoff", dir="export", req=int(rid),
+                      pages=int(n_pages), bytes=int(nbytes), pos=pos)
+        return meta, data
+
+    def import_handoff(self, meta, data, on_token=None) -> Optional[int]:
+        """Admit a handed-off request at ``pos = prompt_len + k``: no
+        prefill chunk ever runs for it here (the zero-recompute
+        contract — this batcher's prefill_tokens stat stays flat).
+        Pages whose chunks are already resident in the local trie are
+        NOT rewritten — their rows are bit-identical by the prefix-
+        sharing determinism argument — and count as cross-replica
+        prefix hits; the rest scatter into freshly allocated pages and
+        the prompt chain grafts into the trie, so the fleet-tier cache
+        grows where decode traffic lands.  Returns the local req_id,
+        or None when no slot (or no pages) is free — the caller
+        retries at the next boundary; nothing is allocated on None."""
+        if self.role == "prefill":
+            raise RuntimeError("prefill-role batcher cannot import a "
+                               "hand-off")
+        if self.kv_layout != "paged":
+            raise TypeError("import_handoff needs the paged KV layout")
+        if int(meta["page_size"]) != self.page_size \
+                or str(meta["kv_dtype"]) != self._kv_dtype:
+            raise ValueError(
+                "hand-off geometry mismatch: got page_size=%s/%s, "
+                "this pool is %d/%s" % (meta["page_size"],
+                                        meta["kv_dtype"],
+                                        self.page_size, self._kv_dtype))
+        with self._qlock:
+            free = [i for i in range(self.B)
+                    if self._slots[i] is None]
+            if not free:
+                return None
+            prompt = np.asarray(meta["prompt"], np.int32)
+            pos = int(meta["pos"])
+            ps = self.page_size
+            covered_rows = min(
+                len(prompt) + int(meta["max_new_tokens"])
+                + self._overshoot, self._cache_len)
+            covered_pages = min(-(-covered_rows // ps),
+                                self.pages_per_slot)
+            n_pages = int(meta["n_pages"])
+            if n_pages > covered_pages:
+                raise ValueError(
+                    f"hand-off spans {n_pages} pages but this pool "
+                    f"covers {covered_pages} per slot")
+            plan = self._alloc.admit(
+                prompt if self.prefix_sharing else prompt[:0],
+                covered_pages, imported=True)
+            if plan is None:
+                return None
+            if plan.cow is not None:
+                # the imported data fully covers the divergence page —
+                # skip the device copy, just unpin the CoW source
+                self._alloc.release_page(plan.cow[0])
+            # scatter only the NON-shared valid pages; shared chunks
+            # already hold bit-identical rows (and may be mapped by
+            # other live slots) — their data rows land on the null page
+            idx = np.zeros((self.pages_per_slot,), np.int32)
+            for j in range(plan.n_shared_pages, n_pages):
+                idx[j] = plan.pages[j]
+            self._cache = self._page_import_fn()(
+                self._cache, jnp.asarray(idx), data)
+            rid = self._next_id
+            self._next_id += 1
+            req = Request(rid, prompt, int(meta["max_new_tokens"]),
+                          slo=str(meta.get("slo", "batch")),
+                          deadline=meta.get("deadline"),
+                          arrival=self._arrival_seq,
+                          on_token=on_token)
+            self._arrival_seq += 1
+            if req.deadline is not None:
+                self._has_deadlines = True
+            req.tokens = [int(t) for t in meta.get("tokens", ())]
+            req.t_submit = float(meta.get("t_submit")
+                                 or self._now())
+            req.t_first = meta.get("t_first")
+            req.t_admit = self._now()
+            i = free[0]
+            self._slots[i] = req
+            self._submitted += 1       # arrives as a hand-off, so the
+            self._admissions += 1      # no-leak partition still closes
+            self._handoffs_in += 1
+            nbytes = int(meta.get("nbytes")
+                         or self._handoff_page_bytes(data, n_pages))
+            self._handoff_bytes += nbytes
+            buf = np.zeros((self.max_len,), np.int32)
+            buf[: len(prompt)] = prompt
+            self._prompts = self._prompts.at[i].set(jnp.asarray(buf))
+            self._plen = self._plen.at[i].set(len(prompt))
+            self._tok = self._tok.at[i].set(
+                int(req.tokens[-1]) if req.tokens else 0)
+            self._done = self._done.at[i].set(False)
+            self._done_host[i] = False
+            self._plans[i] = plan
+            row = np.zeros((self.pages_per_slot,), np.int32)
+            row[: len(plan.pages)] = plan.pages
+            self._page_table = self._page_table.at[i].set(
+                jnp.asarray(row))
+            self._pos = self._pos.at[i].set(pos)
+            self._pos_host[i] = pos
+            self._mode = self._mode.at[i].set(False)
+            self._mode_host[i] = False
+            # the prompt's full chunks are valid through pos: complete
+            # them now — this is the trie GRAFT that makes the prefix
+            # shareable on the decode side
+            self._alloc.mark_progress(plan, pos)
+            from .. import telemetry as _tel
+            if _tel.active():
+                _tel.emit("serve.handoff", dir="import", req=int(rid),
+                          pages=int(n_pages), bytes=nbytes, pos=pos,
+                          dedup_pages=int(plan.n_shared_pages))
+            return rid
+
+    def unfreeze_handoff(self, rid: int):
+        """Degraded-fleet fallback: no decode-capable replica is left,
+        so the frozen slot resumes decoding HERE — the prefill worker
+        temporarily breaks its admit-only program diet rather than
+        deadlock the request."""
+        i = self._handoff_ready.pop(rid)
+        # pin the exemption BEFORE clearing done: without it the next
+        # _evict sweep would re-freeze this slot instantly (all freeze
+        # conditions hold again) and the fleet livelocks on the
+        # freeze/unfreeze ping-pong
+        self._no_freeze.add(rid)
+        self._done = self._done.at[i].set(False)
+        self._done_host[i] = False
+
+    # -- hot-prefix replication (fleet-tier cache placement) ---------------
+    def export_prefix(self, tokens):
+        """Holder side of cache placement: (n_tokens, data) covering
+        the resident complete chain for `tokens`, or None when nothing
+        is resident.  Read-only and synchronous — gathered at this
+        chunk boundary, before any allocation could evict the chain."""
+        if self.kv_layout != "paged" or not self.prefix_sharing:
+            return None
+        n_tok, pages = self._alloc.export_chain(tokens)
+        pages = pages[: self.pages_per_slot]
+        if not pages:
+            return None
+        idx = np.zeros((self.pages_per_slot,), np.int32)
+        idx[: len(pages)] = pages
+        data = self._page_export_fn()(self._cache, jnp.asarray(idx))
+        return len(pages) * self.page_size, data
+
+    def import_prefix(self, tokens, n_tokens: int, data) -> int:
+        """Target side of cache placement: graft the chain's chunks
+        into the local trie (skipping already-resident ones) and
+        scatter the holder's page data.  Returns pages grafted; 0
+        under pool pressure — placement is best-effort and must never
+        starve serving."""
+        if self.kv_layout != "paged" or not self.prefix_sharing:
+            return 0
+        n_chunks = min(int(n_tokens) // self.page_size,
+                       self.pages_per_slot)
+        pairs = self._alloc.graft(tokens, n_chunks)
+        if not pairs:
+            return 0
+        idx = np.zeros((self.pages_per_slot,), np.int32)
+        for ci, page in pairs:
+            idx[ci] = page
+        self._cache = self._page_import_fn()(
+            self._cache, jnp.asarray(idx), data)
+        return len(pairs)
 
     def _step_fn(self, width: int, length: int, record: bool = True):
         """The unified scan program: `length` steps, each feeding a

@@ -1,0 +1,414 @@
+"""Disaggregated prefill/decode serving + fleet-tier prefix cache
+(ISSUE 20).
+
+The contracts under test:
+
+  * HAND-OFF — a frozen prompt's KV pages survive export -> wire
+    (pack/unpack, bfloat16-safe) -> import byte-identical, and the
+    decode side admits at pos = prompt_len: its prefill_tokens stat
+    stays at zero forever (the zero-recompute contract).
+  * FLEET — a prefill/decode split fleet serves a mixed workload
+    bit-exact vs the same replicas run unified, with no duplicate
+    streamed tokens across the hand-off and leak-free page pools on
+    BOTH ends (pages_used == pages_cached after drain).
+  * DEGRADED — with no decode-capable sink anywhere, the frozen slot
+    unfreezes and finishes on the prefill worker rather than deadlock;
+    killing the prefill worker mid-freeze leaves no orphan pages.
+  * FLEET-TIER CACHE — the migration budget replicates a hot prefix
+    to the replica traffic lands on (cross-replica import hits), and
+    a retired replica's digest-bearing view drops from discovery so
+    probes never steer at a tombstone.
+  * AUTOSCALER — the role-imbalance policy is a pure function: a
+    sustained prefill/decode pressure skew flips the least-loaded
+    replica of the relaxed role, never below one per role; chaos
+    coverage rides `chaos_check --serve --disagg` tier-1.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.inference import ContinuousBatcher, ServeRouter
+from paddle_tpu.inference.router import (ReplicaPublisher,
+                                         discover_replicas,
+                                         pick_replica)
+from paddle_tpu.inference.serving import pack_handoff, unpack_handoff
+from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                     llama_tiny_config)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(7)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            intermediate_size=128,
+                            num_attention_heads=4,
+                            num_key_value_heads=2, vocab_size=128)
+    return LlamaForCausalLM(cfg)
+
+
+def _bat(model, **kw):
+    geom = dict(max_batch_size=1, max_len=64, chunk=4, prefill_chunk=4)
+    geom.update(kw)
+    return ContinuousBatcher(model, **geom)
+
+
+def _prompts(n=6, shared=24):
+    rng = np.random.RandomState(5)
+    base = rng.randint(1, 127, size=shared).tolist()
+    out = []
+    for k in range(n):
+        tail = rng.randint(1, 127, size=4 + k).tolist()
+        out.append(np.asarray(base + tail if k % 2 == 0
+                              else rng.randint(1, 127, 6 + k).tolist(),
+                              np.int32))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# hand-off primitive: byte-identical pages, zero recompute
+# ---------------------------------------------------------------------------
+
+def test_handoff_pages_byte_identical(model):
+    """Exported pages land bit-identical in the decode pool: gather
+    the grafted prompt chain on the import side and compare raw rows
+    (per KV buffer, bfloat16 included) against the exported data."""
+    pre = _bat(model, role="prefill")
+    dec = _bat(model, role="decode")
+    prompt = _prompts(1)[0]
+    rid = pre.submit(prompt, max_new_tokens=6)
+    for _ in range(64):
+        pre.step()
+        if pre._handoff_ready:
+            break
+    assert rid in pre._handoff_ready
+    meta, data = pre.export_handoff(rid)
+    # wire round-trip must be lossless (bfloat16 has no npy codec —
+    # pack views through uint16)
+    blob = pack_handoff(meta, data)
+    meta2, data2 = unpack_handoff(blob)
+    assert meta2["pos"] == meta["pos"]
+    assert np.array_equal(meta2["prompt"], meta["prompt"])
+    for name in data:
+        assert np.array_equal(np.asarray(data[name]),
+                              np.asarray(data2[name])), name
+    lid = dec.import_handoff(meta2, data2)
+    assert lid is not None
+    # the full prompt chunks grafted into the decode trie: their pages
+    # must hold the exact rows the prefill side shipped
+    n_tok, dst_pages = dec._alloc.export_chain(meta["prompt"])
+    assert n_tok >= dec.page_size and dst_pages
+    for name in data:
+        src = np.asarray(data[name])[:len(dst_pages)]
+        dst = np.asarray(dec._cache[name][np.asarray(dst_pages)])
+        assert np.array_equal(src, dst), name
+    dec.run()
+    assert dec.stats()["prefill_tokens"] == 0
+    assert dec.stats()["handoffs_in"] == 1
+
+
+def test_disagg_fleet_bit_exact_vs_unified(model):
+    """2-replica unified reference vs the same replicas split
+    prefill/decode: identical outputs, identical streams (no token
+    delivered twice across the hand-off), zero decode-side prefill,
+    leak-free pools on both ends."""
+    prompts, mnt = _prompts(), [12, 6, 10, 8, 14, 7]
+
+    def run(roles):
+        streamed = {}
+        router = ServeRouter(batchers=[_bat(model, max_batch_size=2)
+                                       for _ in range(2)], roles=roles)
+        cb = lambda g, burst, done: \
+            streamed.setdefault(g, []).extend(burst)
+        gids = [router.submit(p, max_new_tokens=m, on_token=cb)
+                for p, m in zip(prompts, mnt)]
+        res = router.run()
+        return router, {g: res[g] for g in gids}, streamed
+
+    _, ref, ref_stream = run(None)
+    router, out, streamed = run(["prefill", "decode"])
+    st = router.stats()
+    assert st["requests_shed"] == 0
+    assert st["handoffs"] > 0
+    assert st["handoff_staged"] == 0
+    for g in ref:
+        assert np.array_equal(ref[g], out[g]), g
+        assert streamed[g] == list(out[g]), g
+        assert ref_stream[g] == list(ref[g]), g
+    dec = router._reps[1].bat
+    assert dec.role == "decode"
+    assert dec.stats()["prefill_tokens"] == 0
+    assert dec.stats()["handoffs_in"] == st["handoffs"]
+    for rep in router._reps:
+        s = rep.bat.stats()
+        assert s["kv_pages_used"] == s["kv_pages_cached"], rep.idx
+    assert st["cross_prefix_hit_tokens"] >= 0
+    assert st["handoff_ms"]["count"] == st["handoffs"]
+
+
+def test_unfreeze_fallback_without_decode_sink(model):
+    """A prefill-only fleet must not deadlock its own admissions: with
+    no decode-capable sink the frozen slot unfreezes and decodes in
+    place, and the output still matches the unified reference."""
+    prompt = _prompts(1)[0]
+    ref = _bat(model)
+    rid = ref.submit(prompt, max_new_tokens=5)
+    want = ref.run()[rid]
+
+    router = ServeRouter(batchers=[_bat(model, role="prefill")],
+                         roles=["prefill"])
+    gid = router.submit(prompt, max_new_tokens=5)
+    out = router.run()
+    assert np.array_equal(out[gid], want)
+    st = router.stats()
+    assert st["handoffs"] == 0 and st["requests_shed"] == 0
+
+
+def test_interrupted_handoff_leaves_no_orphans(model):
+    """Kill the prefill worker while it holds a frozen (hand-off
+    ready) slot: the request requeues and completes elsewhere, and no
+    survivor pool leaks pages (pages_used == pages_cached after
+    drain)."""
+    # short prompts + long decodes: the decode sinks saturate, so a
+    # frozen slot survives the sweep (export defers until a sink has
+    # a free slot) long enough for the kill to land mid-hand-off
+    rng = np.random.RandomState(5)
+    prompts = [np.asarray(rng.randint(1, 127, 8 + k), np.int32)
+               for k in range(4)]
+    mnt = [40, 40, 12, 12]
+    bats = [_bat(model, role=r)
+            for r in ("prefill", "decode", "decode")]
+    router = ServeRouter(batchers=bats,
+                         roles=["prefill", "decode", "decode"])
+    gids = [router.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, mnt)]
+    killed = False
+    for _ in range(64):
+        router.step()
+        if not killed and router._reps[0].bat._handoff_ready:
+            router.kill_replica(0)
+            killed = True
+        if not any(r.bat.queued or r.bat.active
+                   for r in router._live()) \
+                and not router._handoff_staged:
+            break
+    assert killed, "prefill replica never froze a slot"
+    out = router.run()
+    assert all(len(out[g]) == m for g, m in zip(gids, mnt))
+    st = router.stats()
+    assert st["requests_shed"] == 0
+    for rep in router._live():
+        s = rep.bat.stats()
+        assert s["kv_pages_used"] == s["kv_pages_cached"], rep.idx
+
+
+def test_serve_disagg_flag_default_split(model):
+    """FLAGS_serve_disagg splits an in-house fleet prefill-first with
+    the odd replica on decode (decode capacity is the scarcer side)."""
+    set_flags({"FLAGS_serve_disagg": True})
+    try:
+        r = ServeRouter(model=model, replicas=3, max_batch_size=1,
+                        max_len=64, chunk=4, prefill_chunk=4)
+        assert [x.role for x in r._reps] == \
+            ["prefill", "decode", "decode"]
+        assert [x.bat.role for x in r._reps] == \
+            ["prefill", "decode", "decode"]
+    finally:
+        set_flags({"FLAGS_serve_disagg": False})
+
+
+# ---------------------------------------------------------------------------
+# fleet-tier prefix cache
+# ---------------------------------------------------------------------------
+
+def test_migration_budget_replicates_hot_prefix(model):
+    """Load steers a same-prefix request away from the holder; the
+    budgeted sweep copies the prefix to where traffic landed, so the
+    NEXT same-prefix admit hits imported (cross-replica) pages."""
+    rng = np.random.RandomState(5)
+    shared = rng.randint(1, 127, size=24).tolist()
+    set_flags({"FLAGS_router_migration_budget": 8,
+               "FLAGS_router_prefix_weight": 0.001})
+    try:
+        b0, b1 = _bat(model, max_batch_size=2), \
+            _bat(model, max_batch_size=2)
+        p = np.asarray(shared + [5, 9], np.int32)
+        b1.submit(p, 4)
+        b1.run()                      # warm the holder's trie
+        router = ServeRouter(batchers=[b0, b1])
+        # one queued filler loads the holder so pick steers the next
+        # same-prefix request to the cold replica
+        b1.submit(np.asarray(shared + [7, 7], np.int32), 8)
+        router.submit(p, 4)
+        router.step()
+        assert router.stats()["replicated_pages"] > 0
+        router.submit(np.asarray(shared + [5, 9, 3], np.int32), 4)
+        router.run()
+        st = router.stats()
+        assert st["cross_prefix_hit_tokens"] > 0
+        assert st["requests_shed"] == 0
+    finally:
+        set_flags({"FLAGS_router_migration_budget": 0,
+                   "FLAGS_router_prefix_weight": 1.0})
+
+
+def test_tombstone_drops_digest_from_probes():
+    """Regression (satellite): a retired prefill worker's published
+    digest must vanish from discovery — otherwise cross-replica
+    probes keep steering traffic at a corpse."""
+    from paddle_tpu.fleet.autoscaler import _LocalKV
+    kv = _LocalKV()
+    digest = [[3, 123456789], [6, 987654321]]
+    p0 = ReplicaPublisher(kv, job_id="j", replica=0)
+    p1 = ReplicaPublisher(kv, job_id="j", replica=1)
+    p0.publish({"queued": 0, "active": 0, "slots": 1, "role": "prefill",
+                "draining": False, "shed_rate": 0.0,
+                "trie_digest": digest, "page_size": 4})
+    p1.publish({"queued": 0, "active": 0, "slots": 1, "role": "decode",
+                "draining": False, "shed_rate": 0.0})
+    got = discover_replicas(kv, job_id="j")
+    assert set(got) == {0, 1}
+    assert got[0]["trie_digest"] == digest
+    assert got[0]["role"] == "prefill" and got[1]["role"] == "decode"
+    assert p0.retire()
+    got = discover_replicas(kv, job_id="j")
+    assert set(got) == {1}, "tombstoned replica still discoverable"
+    assert not any(v.get("trie_digest") for v in got.values())
+
+
+def test_pick_replica_probes_digest_cross_replica():
+    """A digest-bearing view scores prefix affinity WITHOUT a local
+    probe: the digest hit must win placement over an idle cold
+    replica exactly like a resident prefix_hit_tokens would."""
+    from paddle_tpu.inference.paged_kv import PageAllocator
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    toks = list(range(1, 13))
+    node = None
+    for i in range(0, 12, 4):
+        pages = alloc.alloc(1)
+        node = alloc.register_chunk(node, toks[i:i + 4], pages[0])
+        alloc.complete_node(node)
+    digest = alloc.trie_digest()
+    views = [
+        {"replica": 0, "queued": 0, "active": 0, "slots": 1,
+         "draining": False, "shed_rate": 0.0},
+        {"replica": 1, "queued": 0, "active": 0, "slots": 1,
+         "draining": False, "shed_rate": 0.0,
+         "trie_digest": digest, "page_size": 4},
+    ]
+    # equal load: only the digest hit (12 tokens, 3 full chunks)
+    # separates the replicas — the probe must steer to the holder
+    prompt = np.asarray(toks + [99], np.int32)
+    assert pick_replica(views, prefix_weight=1.0, prompt=prompt) == 1
+    # a cold prompt scores zero on the digest: deterministic tie-break
+    cold = np.asarray([88, 77, 66, 55, 44], np.int32)
+    assert pick_replica(views, prefix_weight=1.0, prompt=cold) == 0
+
+
+# ---------------------------------------------------------------------------
+# autoscaler role repair (pure policy)
+# ---------------------------------------------------------------------------
+
+def _role_view(pp, dp, reps):
+    return {"routable": len([r for r in reps if not r["draining"]]),
+            "draining": 0, "queued": 0, "occupancy": 0.5,
+            "shed_rate": 0.0, "attainment": {},
+            "prefill_pressure": pp, "decode_pressure": dp,
+            "replicas": reps}
+
+
+def _rep(i, role, queued=0, active=0, draining=False):
+    return {"replica": i, "role": role, "queued": queued,
+            "active": active, "slots": 1, "draining": draining,
+            "handoff_ready": 0}
+
+
+def test_role_flip_decide_unit():
+    """Sustained prefill pressure flips the least-loaded decode
+    replica; the floor (one replica per role) is never crossed; the
+    streak resets on a neutral tick."""
+    from paddle_tpu.fleet.autoscaler import (AutoscalePolicy,
+                                             PolicyState, decide,
+                                             observe)
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4, window=2,
+                          cooldown=2, queue_high=99.0, queue_low=0.0,
+                          role_imbalance=2.0, lease_ttl_s=0.0)
+    st = PolicyState()
+    reps = [_rep(0, "prefill", queued=3), _rep(1, "decode"),
+            _rep(2, "decode", active=1)]
+    v = _role_view(3.0, 0.0, reps)
+    observe(st, v, pol)
+    assert st.prefill_streak == 1
+    a = decide(v, pol, st)
+    assert a.kind == "none"          # streak below window
+    observe(st, v, pol)
+    a = decide(v, pol, st)
+    assert a.kind == "role_flip" and a.role == "prefill"
+    assert a.replica == 1            # least-loaded decode replica
+    assert "pressure" in a.reason
+    # floor: a lone decode replica never flips
+    lone = [_rep(0, "prefill", queued=3), _rep(1, "decode")]
+    st2 = PolicyState()
+    v2 = _role_view(3.0, 0.0, lone)
+    observe(st2, v2, pol)
+    observe(st2, v2, pol)
+    assert decide(v2, pol, st2).kind == "none"
+    # neutral tick clears the streak
+    observe(st, _role_view(1.0, 1.0, reps), pol)
+    assert st.prefill_streak == 0 and st.decode_streak == 0
+    # symmetric decode-pressure branch needs a sparable prefill
+    # replica (the lone one above is floor-protected)
+    reps3 = [_rep(0, "prefill", queued=1), _rep(1, "prefill"),
+             _rep(2, "decode", active=1)]
+    st3 = PolicyState()
+    v3 = _role_view(0.0, 3.0, reps3)
+    observe(st3, v3, pol)
+    observe(st3, v3, pol)
+    a = decide(v3, pol, st3)
+    assert a.kind == "role_flip" and a.role == "decode"
+    assert a.replica == 1            # least-loaded prefill replica
+    # and a lone prefill replica never flips to decode
+    st4 = PolicyState()
+    v4 = _role_view(0.0, 3.0, reps)
+    observe(st4, v4, pol)
+    observe(st4, v4, pol)
+    assert decide(v4, pol, st4).kind == "none"
+
+
+def test_fleet_view_splits_role_pressure(model):
+    """fleet_view publishes prefill/decode pressure only for a split
+    fleet, counting frozen hand-off-ready slots as DECODE demand (the
+    work exists, it just has not landed yet)."""
+    from paddle_tpu.fleet.autoscaler import fleet_view
+    router = ServeRouter(batchers=[_bat(model) for _ in range(2)])
+    v = fleet_view(router)
+    assert "prefill_pressure" not in v       # unified fleet: no split
+    router2 = ServeRouter(batchers=[_bat(model, role="prefill"),
+                                    _bat(model, role="decode")],
+                          roles=["prefill", "decode"])
+    v2 = fleet_view(router2)
+    assert v2["prefill_pressure"] == 0.0
+    assert v2["decode_pressure"] == 0.0
+    assert v2["handoff_ready"] == 0
+
+
+# ---------------------------------------------------------------------------
+# tier-1 chaos wiring
+# ---------------------------------------------------------------------------
+
+def test_chaos_disagg_selftest_cli():
+    """Tier-1 wiring: prefill worker killed mid-hand-off AND decode
+    worker killed mid-decode — every request completes bit-exact vs
+    the unified reference, no duplicate streamed tokens, survivor
+    pools leak-free, zero decode-side prefill — exit 0."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_check as cli
+    finally:
+        sys.path.pop(0)
+    assert cli.main(["--serve", "--disagg"]) == 0

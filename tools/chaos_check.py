@@ -547,6 +547,126 @@ def run_router_kill(mode="queued"):
             "programs_ok": programs_ok, "ok": ok}
 
 
+def run_disagg_kill(mode="prefill"):
+    """Disaggregated-fleet worker-kill chaos (ISSUE 20): the mixed-SLO
+    workload through a role-split router (2 prefill + 2 decode, 1 slot
+    each, so hand-offs queue behind busy decode slots), one worker
+    killed — ``mode="prefill"`` while it holds a FROZEN hand-off-ready
+    slot (the kill lands mid-hand-off: the frozen request re-prefills
+    on a survivor, bit-exactly), ``mode="decode"`` while it decodes an
+    IMPORTED request with streamed tokens out the door (the request
+    re-prefills on a prefill survivor and hands off again).  Passes
+    iff hand-offs happened (> 0), every request completed with zero
+    sheds, every output is bit-exact vs the fault-free unified
+    reference, no streamed token was delivered twice, the decode
+    survivors ran zero prefill chunks (zero-recompute held through the
+    chaos), and every survivor's KV pool is leak-free."""
+    import numpy as np
+    from paddle_tpu.inference import ContinuousBatcher
+    from paddle_tpu.inference.router import ServeRouter
+
+    model = _serve_model()
+    # decode-heavy variant of the serve workload (max_new >= 8): the
+    # two decode slots stay busy, so hand-offs BACKLOG — frozen slots
+    # persist across steps and the prefill kill can land mid-hand-off
+    workload = [(6, 10, "interactive"), (11, 8, "batch"),
+                (4, 12, "best_effort"), (9, 9, "interactive"),
+                (13, 8, "batch"), (5, 10, "best_effort")]
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(1, 128, L).astype(np.int32)
+               for L, _, _ in workload]
+    refbat = ContinuousBatcher(model, max_batch_size=2, max_len=64,
+                               chunk=4, prefill_chunk=4)
+    ref_rids = [refbat.submit(p, n, slo=slo)
+                for p, (_, n, slo) in zip(prompts, workload)]
+    ref_outs = refbat.run()
+    ref = {i: list(map(int, ref_outs[r])) for i, r in enumerate(ref_rids)}
+
+    streams = {}
+
+    def cb(gid, toks, done):
+        streams.setdefault(gid, []).extend(toks)
+
+    roles = ["prefill", "prefill", "decode", "decode"]
+    bats = [ContinuousBatcher(model, max_batch_size=1, max_len=64,
+                              chunk=4, prefill_chunk=4, role=r)
+            for r in roles]
+    router = ServeRouter(batchers=bats, roles=roles)
+    gids = [router.submit(p, n, slo=slo, on_token=cb)
+            for p, (_, n, slo) in zip(prompts, workload)]
+
+    victim = None
+    frozen_at_kill = 0
+    delivered_at_kill = 0
+    if mode == "prefill":
+        # step until a prefill worker holds a frozen slot whose
+        # hand-off is stuck behind the busy decode slots — the kill
+        # lands squarely mid-hand-off
+        for _ in range(64):
+            router.step()
+            for rep in router._reps:
+                if rep.role == "prefill" and not rep.dead \
+                        and rep.bat._handoff_ready:
+                    victim = rep.idx
+                    frozen_at_kill = len(rep.bat._handoff_ready)
+                    break
+            if victim is not None:
+                break
+        assert victim is not None, "no frozen hand-off slot to kill"
+    else:
+        # step until a decode worker decodes an imported request that
+        # already streamed tokens
+        for _ in range(64):
+            router.step()
+            for rep in router._reps:
+                if rep.role == "decode" and not rep.dead \
+                        and rep.bat._handoffs_in:
+                    live = [r for r in rep.bat._slots if r is not None]
+                    if any(r.delivered for r in live):
+                        victim = rep.idx
+                        delivered_at_kill = max(r.delivered
+                                                for r in live)
+                        break
+            if victim is not None:
+                break
+        assert victim is not None, "no imported mid-decode stream " \
+                                   "to kill"
+    migrated = router.kill_replica(victim)
+    outs = router.run()
+    st = router.stats()
+
+    mismatches = [i for i, g in enumerate(gids)
+                  if list(map(int, outs[g])) != ref[i]]
+    dup_streams = [g for g in gids
+                   if streams.get(g, []) != list(map(int, outs[g]))]
+    survivors = [r for r in router._reps if not r.dead]
+    leaks = [r.idx for r in survivors
+             if r.bat._alloc.pages_used != r.bat._alloc.pages_cached]
+    recomputed = [r.idx for r in survivors if r.role == "decode"
+                  and r.bat.stats()["prefill_tokens"] > 0]
+    accounting = (
+        sorted(outs) == sorted(gids)
+        and st["requests_submitted"] == len(gids)
+        and st["requests_completed"] == len(gids)
+        and st["requests_shed"] == 0)
+    fired = (migrated > 0 and st["handoffs"] > 0
+             and (frozen_at_kill > 0 if mode == "prefill"
+                  else delivered_at_kill > 0))
+    ok = (fired and not mismatches and not dup_streams and not leaks
+          and not recomputed and accounting
+          and st["handoff_staged"] == 0)
+    return {"mode": mode, "victim": victim, "migrated": migrated,
+            "fired": fired, "frozen_at_kill": frozen_at_kill,
+            "delivered_at_kill": delivered_at_kill,
+            "handoffs": st["handoffs"],
+            "handoff_bytes": st["handoff_bytes"],
+            "completed": st["requests_completed"],
+            "requeued": st["requests_requeued"],
+            "mismatches": mismatches, "dup_streams": dup_streams,
+            "kv_leaks": leaks, "decode_recomputed": recomputed,
+            "accounting_ok": accounting, "ok": ok}
+
+
 _DRAIN_WORKER = r'''
 import json, os, signal, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -867,8 +987,95 @@ def run_autoscale(scenario):
             "ok": ok}
 
 
+def run_role_flip():
+    """Autoscaler role-repair under live traffic (ISSUE 20): a
+    2-prefill + 2-decode fleet gets the mixed-SLO workload queued up
+    front, so the prefill side out-pressures the idle decode side by
+    policy.role_imbalance for `window` consecutive ticks — the daemon
+    DECIDES a role_flip from the fleet_view prefill/decode pressure
+    split alone (no target_roles) and EXECUTES it mid-traffic through
+    drain -> set_role -> undrain.  Passes iff exactly the dynamic
+    trigger fired (a done role_flip journal record whose reason names
+    the pressure), every request completed with zero sheds, outputs
+    bit-exact vs the fault-free unified reference, no duplicate
+    streamed tokens, and hand-offs kept flowing after the flip."""
+    import paddle_tpu as paddle
+    from paddle_tpu.fleet import AutoscalePolicy, AutoscalerDaemon
+    from paddle_tpu.inference import ContinuousBatcher
+    from paddle_tpu.inference.router import ServeRouter
+
+    model = _serve_model()
+    prompts = _serve_prompts()
+    _, ref_rids, ref_outs = _run_serve_workload(model)
+    ref = {i: list(map(int, ref_outs[r])) for i, r in enumerate(ref_rids)}
+
+    streams = {}
+
+    def cb(gid, toks, done):
+        streams.setdefault(gid, []).extend(toks)
+
+    roles = ["prefill", "prefill", "decode", "decode"]
+    bats = [ContinuousBatcher(model, max_batch_size=1, max_len=64,
+                              chunk=4, prefill_chunk=4, role=r)
+            for r in roles]
+    router = ServeRouter(batchers=bats, roles=roles)
+    # queue_high/low pushed out of reach: ONLY the role-imbalance
+    # signal may act (and max_replicas == fleet size pins scale-out)
+    policy = AutoscalePolicy(min_replicas=1, max_replicas=4, window=2,
+                             cooldown=2, queue_high=99.0,
+                             queue_low=0.0, role_imbalance=2.0,
+                             lease_ttl_s=0.0)
+    daemon = AutoscalerDaemon(router, policy=policy, daemon_id="d0")
+    gids = [router.submit(p, n, slo=slo, on_token=cb)
+            for p, (_, n, slo) in zip(prompts, _SERVE_WORKLOAD)]
+
+    paddle.set_flags({"FLAGS_autoscale": True})
+    try:
+        for _ in range(24):
+            daemon.tick()
+            router.step()
+            if not any(r.bat.queued or r.bat.active
+                       for r in router._live()) \
+                    and not router._handoff_staged:
+                break
+        outs = router.run()
+    finally:
+        paddle.set_flags({"FLAGS_autoscale": False})
+
+    st = router.stats()
+    journal = daemon.journal()
+    flips = [r for r in journal if r.get("kind") == "role_flip"]
+    flip_done = [r for r in flips if r.get("status") == "done"]
+    dynamic = [r for r in flip_done
+               if "pressure" in (r.get("reason") or "")]
+    mismatches = [i for i, g in enumerate(gids)
+                  if list(map(int, outs[g])) != ref[i]]
+    dup_streams = [g for g in gids
+                   if streams.get(g, []) != list(map(int, outs[g]))]
+    leaks = [r.idx for r in router._reps if not r.dead
+             and r.bat._alloc.pages_used != r.bat._alloc.pages_cached]
+    accounting = (
+        sorted(outs) == sorted(gids)
+        and st["requests_submitted"] == len(gids)
+        and st["requests_completed"] == len(gids)
+        and st["requests_shed"] == 0)
+    fired = bool(dynamic)
+    ok = (fired and not mismatches and not dup_streams and not leaks
+          and accounting and st["handoffs"] > 0)
+    return {"flips": [{k: r.get(k) for k in
+                       ("epoch", "replica", "role", "status",
+                        "reason")} for r in flips],
+            "fired": fired, "handoffs": st["handoffs"],
+            "completed": st["requests_completed"],
+            "shed": st["requests_shed"],
+            "roles": {r.idx: r.role for r in router._reps},
+            "mismatches": mismatches, "dup_streams": dup_streams,
+            "kv_leaks": leaks, "accounting_ok": accounting, "ok": ok}
+
+
 def _autoscale_selftest():
-    """All four autoscale chaos scenarios."""
+    """All four autoscale chaos scenarios, plus the ISSUE-20 dynamic
+    role-flip check (flip mid-traffic, zero sheds, bit-exact)."""
     checks = []
     for scenario in AUTOSCALE_SCENARIOS:
         rep = run_autoscale(scenario)
@@ -879,6 +1086,14 @@ def _autoscale_selftest():
                                   ("statuses", "completed", "shed",
                                    "mismatches", "journal_ok",
                                    "converged")})})
+    rep = run_role_flip()
+    checks.append({
+        "check": "autoscale.role-flip-mid-traffic",
+        "fired": rep["fired"], "recovered": rep["ok"],
+        "detail": json.dumps({k: rep[k] for k in
+                              ("flips", "handoffs", "completed",
+                               "shed", "roles", "mismatches",
+                               "dup_streams", "kv_leaks")})})
     return checks
 
 
@@ -1524,6 +1739,14 @@ def main(argv=None):
                     help="with --serve: kill one replica of a "
                          "2-replica router fleet (while it queues / "
                          "mid-decode) and verify the lossless requeue")
+    ap.add_argument("--disagg", action="store_true",
+                    help="with --serve: disaggregated-fleet chaos "
+                         "(ISSUE 20) — kill a prefill worker holding "
+                         "a frozen hand-off slot AND a decode worker "
+                         "mid-imported-decode; all requests must "
+                         "complete bit-exact vs the unified "
+                         "reference, no duplicate streamed tokens, "
+                         "decode survivors recompute zero prefill")
     ap.add_argument("--fleet", action="store_true",
                     help="exercise the FLEET plane: an N-proc elastic "
                          "job, one rank killed mid-run, gang re-forms "
@@ -1634,6 +1857,26 @@ def main(argv=None):
             if not rep["ok"]:
                 print(rep["tail"])
         return 0 if rep["ok"] else 1
+    if args.disagg:
+        if not args.serve:
+            ap.error("--disagg needs --serve")
+        reps = [run_disagg_kill(mode) for mode in ("prefill", "decode")]
+        ok = all(r["ok"] for r in reps)
+        if args.as_json:
+            print(json.dumps({"mode": "serve-disagg", "checks": reps,
+                              "ok": ok}, indent=2))
+        else:
+            for r in reps:
+                verdict = "RECOVERED" if r["ok"] else "FAILED"
+                print(f"{verdict}: {r['mode']} worker {r['victim']} "
+                      f"killed, migrated={r['migrated']}, "
+                      f"handoffs={r['handoffs']}, "
+                      f"completed={r['completed']}, "
+                      f"mismatches={r['mismatches']}, "
+                      f"dup_streams={r['dup_streams']}, "
+                      f"kv_leaks={r['kv_leaks']}, "
+                      f"decode_recomputed={r['decode_recomputed']}")
+        return 0 if ok else 1
     if args.replica_kill:
         if not args.serve:
             ap.error("--replica-kill needs --serve")

@@ -243,6 +243,37 @@ def analyze(events, peak=None):
             fleet["decision_ms_p99"] = round(_pct(dec, 99), 4)
         out.setdefault("serve", {})["fleet"] = fleet
 
+    # disaggregated hand-off plane (ISSUE 20): prefill->decode page
+    # streams (serve.handoff export/import pairs), the router's
+    # end-to-end hand-off latency, and the cross-replica dedup rate
+    # (pages the decode side did NOT rewrite because its trie already
+    # held them), plus prefix replication traffic (router.replicate)
+    hoff = [e for e in events if e.get("event") == "serve.handoff"]
+    rhoff = [e for e in events if e.get("event") == "router.handoff"]
+    repl = [e for e in events if e.get("event") == "router.replicate"]
+    if hoff or rhoff or repl:
+        exp = [e for e in hoff if e.get("dir") == "export"]
+        imp = [e for e in hoff if e.get("dir") == "import"]
+        pages_in = sum(int(e.get("pages") or 0) for e in imp)
+        dedup = sum(int(e.get("dedup_pages") or 0) for e in imp)
+        h = {
+            "exports": len(exp),
+            "imports": len(imp),
+            "bytes": sum(int(e.get("bytes") or 0) for e in exp),
+            "pages": sum(int(e.get("pages") or 0) for e in exp),
+            "dedup_pages": dedup,
+            "dedup_rate": round(dedup / pages_in, 4)
+            if pages_in else 0.0,
+            "replicated_pages": sum(int(e.get("pages") or 0)
+                                    for e in repl),
+        }
+        ms = [e["ms"] for e in rhoff
+              if isinstance(e.get("ms"), (int, float))]
+        if ms:
+            h["ms_p50"] = round(_pct(ms, 50), 4)
+            h["ms_p99"] = round(_pct(ms, 99), 4)
+        out.setdefault("serve", {})["handoff"] = h
+
     # per-request latency spans (ISSUE 10): queue/TTFT/TPOT/e2e
     # percentiles + per-SLO-class deadline attainment from the
     # serve.request events the batcher emits per delivered request
@@ -438,6 +469,17 @@ def render(rep):
             if "decision_ms_p50" in f:
                 line += (f", decide p50={f['decision_ms_p50']}/"
                          f"p99={f['decision_ms_p99']}ms")
+            lines.append(line)
+        if "handoff" in s:
+            h = s["handoff"]
+            line = (f"  handoff   {h['exports']} exported / "
+                    f"{h['imports']} imported, {h['pages']} pages "
+                    f"({h['bytes'] / 1e6:.2f}MB), dedup "
+                    f"{h['dedup_rate']}, replicated "
+                    f"{h['replicated_pages']} pages")
+            if "ms_p50" in h:
+                line += (f", p50={h['ms_p50']}/"
+                         f"p99={h['ms_p99']}ms")
             lines.append(line)
         if "robustness" in s:
             r = s["robustness"]
@@ -738,6 +780,54 @@ def _selftest():
                   and "decision_ms_p50" in fleet):
             problems.append(f"fleet serve section wrong: {fleet}")
         print(render(rrep))
+
+        # disaggregated hand-off leg (ISSUE 20): a prefill+decode
+        # split fleet must surface paired serve.handoff export/import
+        # events plus router.handoff latency records, and a "handoff"
+        # report section whose export/import counts balance
+        dlog = os.path.join(d, "disagg.jsonl")
+        sink = telemetry.attach_jsonl(dlog)
+        try:
+            bats = [ContinuousBatcher(model, max_batch_size=1,
+                                      max_len=32, chunk=4,
+                                      prefill_chunk=4, page_size=8,
+                                      role=r)
+                    for r in ("prefill", "decode")]
+            router = ServeRouter(batchers=bats,
+                                 roles=["prefill", "decode"])
+            for t in (5, 6, 7):
+                router.submit(rng.randint(1, 64, t).astype(np.int32),
+                              4)
+            router.run()
+        finally:
+            telemetry.remove_sink(sink)
+        devents = load_events(dlog)
+        hoffs = [e for e in devents
+                 if e.get("event") == "serve.handoff"]
+        exps = [e for e in hoffs if e.get("dir") == "export"]
+        imps = [e for e in hoffs if e.get("dir") == "import"]
+        if not exps or len(exps) != len(imps):
+            problems.append(f"unbalanced serve.handoff events: "
+                            f"{len(exps)} exports vs "
+                            f"{len(imps)} imports")
+        for e in hoffs:
+            for k in ("dir", "req", "pages", "bytes", "pos"):
+                if k not in e:
+                    problems.append(f"serve.handoff missing {k!r}: {e}")
+        if not any(isinstance(e.get("ms"), (int, float))
+                   for e in devents
+                   if e.get("event") == "router.handoff"):
+            problems.append("no router.handoff latency events")
+        drep = analyze(devents)
+        hand = drep.get("serve", {}).get("handoff")
+        if not hand:
+            problems.append(f"report missing handoff section: {drep}")
+        elif not (hand["exports"] == len(exps)
+                  and hand["imports"] == len(imps)
+                  and hand["pages"] > 0 and hand["bytes"] > 0
+                  and "ms_p50" in hand):
+            problems.append(f"handoff section wrong: {hand}")
+        print(render(drep))
     return problems
 
 
